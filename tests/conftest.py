@@ -1,0 +1,59 @@
+"""Test harness: every test runs against 8 virtual CPU devices.
+
+This formalizes the reference's only testability concession
+(``sim_multiCPU_dev``, ``util.py:31-38``) into a pytest fixture layer: XLA is
+forced to expose 8 host devices so collectives, shard_map, and meshes behave
+exactly as on an 8-chip slice, single-process, no hardware.
+
+Must configure the platform before the first JAX backend touch — hence the
+module-level call, not a fixture.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpu_parallel.runtime import simulate_cpu_devices
+
+simulate_cpu_devices(8)
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_parallel.runtime import MeshConfig, make_mesh
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 simulated CPU devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh_data8(devices):
+    """1-D mesh: pure DP over 8 devices."""
+    return make_mesh(MeshConfig(data=8))
+
+
+@pytest.fixture(scope="session")
+def mesh_2x2x2(devices):
+    """3-D mesh: pipe=2, data=2, model=2."""
+    return make_mesh(MeshConfig(data=2, model=2, pipe=2))
+
+
+@pytest.fixture(scope="session")
+def mesh_data4_model2(devices):
+    return make_mesh(MeshConfig(data=4, model=2))
+
+
+@pytest.fixture(scope="session")
+def mesh_pipe4_data2(devices):
+    return make_mesh(MeshConfig(data=2, pipe=4))
+
+
+@pytest.fixture
+def rng():
+    return jax.random.PRNGKey(42)

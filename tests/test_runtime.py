@@ -1,0 +1,88 @@
+"""Tests for runtime bootstrap and mesh construction."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpu_parallel.runtime import (
+    AXIS_ORDER,
+    MeshConfig,
+    factor_mesh,
+    make_mesh,
+    process_info,
+)
+
+
+def test_simulated_devices(devices):
+    assert len(devices) == 8
+    assert all(d.platform == "cpu" for d in devices)
+
+
+def test_process_info(devices):
+    info = process_info()
+    assert info["process_count"] == 1
+    assert info["global_device_count"] == 8
+
+
+def test_mesh_shapes(devices):
+    mesh = make_mesh(MeshConfig(data=8))
+    assert mesh.shape["data"] == 8
+    assert mesh.shape["model"] == 1
+    mesh3 = make_mesh(MeshConfig(data=2, model=2, pipe=2))
+    assert mesh3.shape == dict(pipe=2, data=2, seq=1, model=2)
+    assert mesh3.axis_names == AXIS_ORDER
+
+
+def test_mesh_resolves_remaining(devices):
+    cfg = MeshConfig(data=-1, model=2).resolved(8)
+    assert cfg.data == 4
+
+
+def test_mesh_rejects_bad_shape(devices):
+    with pytest.raises(ValueError):
+        make_mesh(MeshConfig(data=3, model=2))
+    with pytest.raises(ValueError):
+        MeshConfig(data=-1, model=3).resolved(8)
+
+
+def test_factor_mesh():
+    cfg = factor_mesh(8, want_model=2, want_pipe=2)
+    assert (cfg.pipe, cfg.data, cfg.model) == (2, 2, 2)
+    cfg = factor_mesh(6, want_model=4, want_pipe=4)
+    assert cfg.model * cfg.pipe * cfg.data == 6
+    cfg = factor_mesh(1, want_model=8, want_pipe=8)
+    assert (cfg.pipe, cfg.data, cfg.model) == (1, 1, 1)
+
+
+def test_collective_on_mesh(mesh_data8):
+    f = jax.jit(
+        jax.shard_map(
+            lambda x: jax.lax.psum(x, "data"),
+            mesh=mesh_data8,
+            in_specs=P("data"),
+            out_specs=P(),
+        )
+    )
+    out = f(jnp.arange(8.0))
+    np.testing.assert_allclose(out, np.full((1,), 28.0))
+
+
+def test_multi_axis_collectives(mesh_2x2x2):
+    def body(x):
+        a = jax.lax.psum(x, "data")
+        b = jax.lax.psum(a, "model")
+        c = jax.lax.psum(b, "pipe")
+        return c
+
+    f = jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh_2x2x2,
+            in_specs=P(("pipe", "data", "model")),
+            out_specs=P(),
+        )
+    )
+    out = f(jnp.ones(8))
+    np.testing.assert_allclose(out, np.full((1,), 8.0))

@@ -1,0 +1,15 @@
+"""tpu_parallel — a TPU-native distributed-training framework.
+
+A superset of the `jax-distributed-tuts` reference's capabilities, rebuilt
+TPU-first on explicit ``jax.sharding.Mesh`` axes: data parallelism
+(``parallel.dp``), scan-based gradient accumulation, per-device RNG
+discipline, collective-synced metrics, and a CPU-simulated multi-device mode
+for hardware-free testing.  See the ``parallel`` subpackage for the strategy
+modules currently available.
+"""
+
+__version__ = "0.1.0"
+
+from tpu_parallel import core, runtime
+
+__all__ = ["core", "runtime", "__version__"]

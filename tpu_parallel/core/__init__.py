@@ -1,0 +1,38 @@
+from tpu_parallel.core.accumulate import (
+    accumulate_gradients,
+    accumulate_gradients_loop,
+    accumulate_gradients_scan,
+)
+from tpu_parallel.core.metrics import (
+    Metrics,
+    accumulate_metrics,
+    compute,
+    format_metrics,
+    metric,
+    print_metrics,
+    sync_metrics,
+    zeros_like_metrics,
+)
+from tpu_parallel.core.rng import fold_rng_over_axis, split_rng_like
+from tpu_parallel.core.state import Batch, Pytree, TextBatch, TrainState, get_num_params
+
+__all__ = [
+    "accumulate_gradients",
+    "accumulate_gradients_loop",
+    "accumulate_gradients_scan",
+    "Metrics",
+    "accumulate_metrics",
+    "compute",
+    "format_metrics",
+    "metric",
+    "print_metrics",
+    "sync_metrics",
+    "zeros_like_metrics",
+    "fold_rng_over_axis",
+    "split_rng_like",
+    "Batch",
+    "Pytree",
+    "TextBatch",
+    "TrainState",
+    "get_num_params",
+]

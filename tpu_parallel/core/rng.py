@@ -1,0 +1,34 @@
+"""PRNG discipline across mesh axes.
+
+Capability parity: ``fold_rng_over_axis`` (reference ``data_paral.py:28-34``),
+generalized to any number of mesh axes so DP x TP x PP composition gets a
+well-defined key on every device.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+from jax import lax
+
+
+def fold_rng_over_axis(rng: jax.Array, axis_names: Union[str, Sequence[str]]) -> jax.Array:
+    """Derive a device-unique key by folding the mesh position into ``rng``.
+
+    Use for anything that must differ per device (dropout on different data
+    shards, per-stage init).  Leave the key unfolded for anything that must be
+    identical across an axis (replicated init).
+    """
+    if isinstance(axis_names, str):
+        axis_names = (axis_names,)
+    for name in axis_names:
+        rng = jax.random.fold_in(rng, lax.axis_index(name))
+    return rng
+
+
+def split_rng_like(rng: jax.Array, tree) -> "jax.Array":
+    """Split ``rng`` into a pytree of keys matching ``tree``'s structure."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    keys = jax.random.split(rng, len(leaves))
+    return jax.tree_util.tree_unflatten(treedef, list(keys))

@@ -1,0 +1,79 @@
+"""Train state and batch containers.
+
+Capability parity: ``util.py:21-28`` in the reference (``TrainState`` with an
+``rng`` field carried through steps, and a pytree ``Batch``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+from flax import struct
+from flax.training import train_state
+
+from tpu_parallel.core.metrics import Metrics
+
+Pytree = Any
+
+
+class TrainState(train_state.TrainState):
+    """Flax TrainState plus a per-step PRNG key.
+
+    Carrying the key in the state (split each step, fold over mesh axes for
+    per-device decorrelation) keeps the whole training step functional: state
+    in, state out, no Python-side RNG bookkeeping.
+    """
+
+    rng: jax.Array = None
+
+
+@struct.dataclass
+class Batch:
+    """Generic supervised batch: ``inputs`` and integer ``labels``.
+
+    A pytree, so it can be tree-mapped, sliced into microbatches with
+    ``lax.dynamic_slice_in_dim``, and sharded with a single PartitionSpec.
+    """
+
+    inputs: jax.Array
+    labels: jax.Array
+
+    @property
+    def size(self) -> int:
+        return self.inputs.shape[0]
+
+
+@struct.dataclass
+class TextBatch:
+    """Language-modeling batch: token ids plus next-token targets.
+
+    ``segment_ids``/``positions`` support packed sequences; ``loss_mask``
+    zeroes padding out of the loss. All fields share the (batch, seq) layout so
+    one PartitionSpec shards them over both data and sequence axes.
+    """
+
+    tokens: jax.Array
+    targets: jax.Array
+    loss_mask: Optional[jax.Array] = None
+    segment_ids: Optional[jax.Array] = None
+    positions: Optional[jax.Array] = None
+
+    @property
+    def size(self) -> int:
+        return self.tokens.shape[0]
+
+    @property
+    def inputs(self) -> jax.Array:  # uniform access for generic train steps
+        return self.tokens
+
+    @property
+    def labels(self) -> jax.Array:
+        return self.targets
+
+
+def get_num_params(state_or_params: Any) -> int:
+    """Total parameter count (reference: ``util.py:184-185``)."""
+    params = getattr(state_or_params, "params", state_or_params)
+    leaves = jax.tree_util.tree_leaves(params)
+    return int(sum(x.size for x in leaves))

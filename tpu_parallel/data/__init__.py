@@ -1,0 +1,3 @@
+from tpu_parallel.data.synthetic import classification_batch, lm_batch
+
+__all__ = ["classification_batch", "lm_batch"]

@@ -1,0 +1,40 @@
+"""Synthetic data generators for tests and benchmarks.
+
+Capability parity: the reference's inline synthetic batches
+(``data_paral.py:113-124``, ``param_sharding.py:276-287``) — with the intent
+implemented correctly: integer labels come from ``jax.random.randint`` (the
+reference drew them from ``normal`` with the wrong signature, bug #4 in
+SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpu_parallel.core.state import Batch, TextBatch
+
+
+def classification_batch(
+    rng: jax.Array, batch_size: int, input_size: int, num_classes: int
+) -> Batch:
+    k_in, k_lbl = jax.random.split(rng)
+    return Batch(
+        inputs=jax.random.normal(k_in, (batch_size, input_size)),
+        labels=jax.random.randint(k_lbl, (batch_size,), 0, num_classes),
+    )
+
+
+def lm_batch(
+    rng: jax.Array, batch_size: int, seq_len: int, vocab_size: int
+) -> TextBatch:
+    """Next-token-prediction batch from a random token stream."""
+    tokens = jax.random.randint(rng, (batch_size, seq_len + 1), 0, vocab_size)
+    return TextBatch(
+        tokens=tokens[:, :-1],
+        targets=tokens[:, 1:],
+        loss_mask=jnp.ones((batch_size, seq_len), jnp.float32),
+        positions=jnp.broadcast_to(jnp.arange(seq_len), (batch_size, seq_len)),
+    )

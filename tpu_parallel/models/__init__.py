@@ -1,0 +1,3 @@
+from tpu_parallel.models.mlp import MLPClassifier, MLPConfig
+
+__all__ = ["MLPClassifier", "MLPConfig"]

@@ -1,0 +1,45 @@
+"""MLP classifier — the parity model for the DP/FSDP tutorials.
+
+Capability parity: the two near-identical ``Classifier`` modules in the
+reference (``data_paral.py:75-102``, ``param_sharding.py:194-224``), unified:
+one module, with an optional ``dense_wrapper`` hook so a caller can apply a
+parameter-sharding or tensor-parallel transform to every Dense without a
+hard-coded second copy of the model.  bf16 compute, fp32 output cast, dropout
+decorrelated per-device by the caller's RNG folding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPConfig:
+    hidden_size: int = 512
+    num_classes: int = 10
+    dropout_rate: float = 0.1
+    dtype: jnp.dtype = jnp.bfloat16
+    num_hidden_layers: int = 1
+
+
+class MLPClassifier(nn.Module):
+    config: MLPConfig
+    # Optional transform applied to each Dense (e.g. FSDP's shard_module_params
+    # or TP wrappers) — keeps one model definition for every strategy.
+    dense_wrapper: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        cfg = self.config
+        dense = nn.Dense if self.dense_wrapper is None else self.dense_wrapper(nn.Dense)
+        for i in range(cfg.num_hidden_layers):
+            x = dense(cfg.hidden_size, dtype=cfg.dtype, name=f"hidden_{i}")(x)
+            x = nn.silu(x)
+            x = nn.Dropout(rate=cfg.dropout_rate, deterministic=not train)(x)
+        x = dense(cfg.num_classes, dtype=cfg.dtype, name="head")(x)
+        return x.astype(jnp.float32)

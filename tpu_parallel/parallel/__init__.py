@@ -1,0 +1,3 @@
+from tpu_parallel.parallel import dp
+
+__all__ = ["dp"]

@@ -1,0 +1,33 @@
+from tpu_parallel.runtime.bootstrap import (
+    initialize,
+    is_simulated,
+    process_info,
+    simulate_cpu_devices,
+)
+from tpu_parallel.runtime.mesh import (
+    AXIS_ORDER,
+    DATA_AXIS,
+    MODEL_AXIS,
+    PIPE_AXIS,
+    SEQ_AXIS,
+    MeshConfig,
+    factor_mesh,
+    make_mesh,
+    mesh_from_sizes,
+)
+
+__all__ = [
+    "initialize",
+    "is_simulated",
+    "process_info",
+    "simulate_cpu_devices",
+    "AXIS_ORDER",
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "PIPE_AXIS",
+    "SEQ_AXIS",
+    "MeshConfig",
+    "factor_mesh",
+    "make_mesh",
+    "mesh_from_sizes",
+]

@@ -1,0 +1,107 @@
+"""Runtime bootstrap: device discovery, multi-host init, CPU device simulation.
+
+Capability parity target: ``util.py:31-38`` (``sim_multiCPU_dev``) in the
+reference, which fakes an N-device machine by appending
+``--xla_force_host_platform_device_count`` to ``XLA_FLAGS``.  The reference
+version is broken (uses ``os`` without importing it) and fragile (mutates the
+env *after* ``import jax``).  This module makes the ordering explicit and adds
+the two things the reference never had: a real multi-host bootstrap
+(``jax.distributed.initialize``) and introspection helpers for the process
+topology.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+logger = logging.getLogger("tpu_parallel")
+
+_SIMULATED = False
+
+
+def simulate_cpu_devices(num_devices: int = 8) -> None:
+    """Present ``num_devices`` virtual CPU devices to JAX in this process.
+
+    Every collective, ``shard_map``, and mesh then behaves exactly as on a real
+    multi-chip slice, single-process — the canonical JAX trick for testing
+    parallelism without hardware.
+
+    Must run before the first touch of the JAX CPU backend (first
+    ``jax.devices()`` / compilation).  Works both before and after
+    ``import jax``:
+
+    - ``XLA_FLAGS`` is read by the CPU PJRT client at *backend* init, not at
+      import, so setting it here is safe as long as no backend exists yet.
+    - If ``jax`` is already imported with another platform selected (e.g. a
+      TPU plugin chose itself via ``JAX_PLATFORMS``), we also flip
+      ``jax_platforms`` to ``cpu`` through the config system, which — unlike
+      mutating ``os.environ`` — still takes effect post-import.
+    """
+    global _SIMULATED
+    flag = f"--xla_force_host_platform_device_count={num_devices}"
+    prev = os.environ.get("XLA_FLAGS", "")
+    # Replace any stale device-count flag rather than deferring to it.
+    kept = [
+        f for f in prev.split() if "xla_force_host_platform_device_count" not in f
+    ]
+    os.environ["XLA_FLAGS"] = " ".join(kept + [flag])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    _SIMULATED = True
+
+
+def is_simulated() -> bool:
+    return _SIMULATED
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Bootstrap the distributed runtime.
+
+    - Single-process (one host, any number of local chips): no-op.
+    - TPU pod / multi-host: calls ``jax.distributed.initialize``.  On Cloud TPU
+      VMs all three arguments are auto-detected from the metadata server, so
+      ``initialize()`` with no arguments is the common path; the explicit
+      arguments cover manual (e.g. DCN-spanning) launches.
+
+    The reference has no equivalent — it never leaves one process
+    (``util.py:31-38`` is its whole runtime layer).
+    """
+    env_procs = os.environ.get("TPU_PROCESS_COUNT") or os.environ.get("JAX_NUM_PROCESSES")
+    multi = (
+        coordinator_address is not None
+        or num_processes is not None
+        or process_id is not None
+        or (env_procs is not None and int(env_procs) > 1)
+    )
+    if not multi:
+        logger.debug("single-process runtime; skipping jax.distributed.initialize")
+        return
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def process_info() -> dict:
+    """Topology snapshot for logging: process index/count, device counts."""
+    import jax
+
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_device_count": jax.local_device_count(),
+        "global_device_count": jax.device_count(),
+        "platform": jax.devices()[0].platform,
+    }

@@ -1,0 +1,132 @@
+"""Device-mesh construction for DP x FSDP x TP x PP (x SP) parallelism.
+
+The reference only ever builds a 1-D mesh over one ``"data"`` axis inline in
+each script (``data_paral.py:150-152``, ``param_sharding.py`` equivalent).
+Here the mesh is a first-class object: named axes, arbitrary shape, built with
+``jax.experimental.mesh_utils.create_device_mesh`` so the logical axes map onto
+the physical ICI torus well (innermost axes get the tightest rings), and
+DCN-aware when a pod spans multiple slices.
+
+Axis convention (outermost -> innermost):
+
+- ``pipe``  — pipeline stages.  Lowest-bandwidth traffic (one activation
+  handoff per microbatch) so it tolerates the slowest links (DCN).
+- ``data``  — data parallelism; FSDP shards parameters over this same axis
+  (ZeRO-3 style), so its traffic is one gradient reduce-scatter + param
+  all-gather per step.
+- ``seq``   — sequence/context parallelism (ring attention KV rotation).
+- ``model`` — tensor parallelism.  Per-layer activation collectives — the most
+  latency-sensitive — so it sits innermost, on the fastest ICI ring.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+PIPE_AXIS = "pipe"
+SEQ_AXIS = "seq"
+
+# Outer-to-inner ordering used when materializing the physical mesh.
+AXIS_ORDER: Tuple[str, ...] = (PIPE_AXIS, DATA_AXIS, SEQ_AXIS, MODEL_AXIS)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Logical mesh shape. ``-1`` on ``data`` means "all remaining devices"."""
+
+    data: int = -1
+    model: int = 1
+    pipe: int = 1
+    seq: int = 1
+
+    def resolved(self, n_devices: int) -> "MeshConfig":
+        fixed = self.model * self.pipe * self.seq
+        data = self.data
+        if data == -1:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by model*pipe*seq={fixed}"
+                )
+            data = n_devices // fixed
+        if data * fixed != n_devices:
+            raise ValueError(
+                f"mesh shape data={data} model={self.model} pipe={self.pipe} "
+                f"seq={self.seq} does not cover {n_devices} devices"
+            )
+        return MeshConfig(data=data, model=self.model, pipe=self.pipe, seq=self.seq)
+
+    def axis_sizes(self) -> dict:
+        return {
+            PIPE_AXIS: self.pipe,
+            DATA_AXIS: self.data,
+            SEQ_AXIS: self.seq,
+            MODEL_AXIS: self.model,
+        }
+
+
+def make_mesh(
+    config: MeshConfig = MeshConfig(),
+    devices: Optional[Sequence] = None,
+    *,
+    allow_split_physical_axes: bool = True,
+):
+    """Build a ``jax.sharding.Mesh`` with named axes from a logical shape.
+
+    Uses ``mesh_utils.create_device_mesh`` so that on TPU the logical axes are
+    laid out along physical ICI rings ("model" innermost), and falls back to a
+    plain reshape on CPU-simulated devices.  Drops axes of size 1 is NOT done —
+    keeping all four axes means the same ``PartitionSpec``s work for every
+    strategy combination (an axis of size 1 is free).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    cfg = config.resolved(len(devices))
+    sizes = cfg.axis_sizes()
+    shape = tuple(sizes[a] for a in AXIS_ORDER)
+
+    if devices[0].platform == "cpu":
+        dev_array = np.asarray(devices).reshape(shape)
+    else:
+        from jax.experimental import mesh_utils
+
+        try:
+            dev_array = mesh_utils.create_device_mesh(
+                shape,
+                devices=devices,
+                allow_split_physical_axes=allow_split_physical_axes,
+            )
+        except (ValueError, AssertionError, NotImplementedError):
+            dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, AXIS_ORDER)
+
+
+def mesh_from_sizes(data: int = -1, model: int = 1, pipe: int = 1, seq: int = 1, devices=None):
+    return make_mesh(MeshConfig(data=data, model=model, pipe=pipe, seq=seq), devices=devices)
+
+
+def factor_mesh(n_devices: int, *, want_model: int = 1, want_pipe: int = 1) -> MeshConfig:
+    """Best-effort factorization of ``n_devices`` into (pipe, data, model).
+
+    Shrinks the requested model/pipe degrees to the largest divisors that fit.
+    Useful for dry-runs where the device count is dictated from outside.
+    """
+    model = 1
+    for m in range(min(want_model, n_devices), 0, -1):
+        if n_devices % m == 0:
+            model = m
+            break
+    rem = n_devices // model
+    pipe = 1
+    for p in range(min(want_pipe, rem), 0, -1):
+        if rem % p == 0:
+            pipe = p
+            break
+    return MeshConfig(data=rem // pipe, model=model, pipe=pipe, seq=1)
