@@ -1,3 +1,3 @@
-from tpu_parallel.parallel import dp
+from tpu_parallel.parallel import dp, fsdp, pp, spmd, tp
 
-__all__ = ["dp"]
+__all__ = ["dp", "fsdp", "pp", "spmd", "tp"]

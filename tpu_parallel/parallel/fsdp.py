@@ -1,0 +1,194 @@
+"""FSDP / ZeRO-3 parameter sharding over a mesh axis.
+
+Capability parity: ``param_sharding.py`` in the reference — each sufficiently
+large parameter lives sliced 1/N-per-device along one of its own axes; the
+full weight is materialized just-in-time for compute with an ``all_gather``
+whose backward pass is a ``psum_scatter`` (reduce-scatter), so gradients are
+never all-reduced at full size.  Reference cites: shard/gather transforms
+``param_sharding.py:58-191``, custom gradient ``:129-142``, partition-aware
+grad sync ``:293-322``, two-phase eval_shape init ``:253-274``.
+
+Rebuilt here with the reference's latent bugs fixed (SURVEY.md §2.4 #6-#10)
+and generalized to multi-axis meshes: a parameter may be sharded over the
+``data`` axis (FSDP) *and* carry tensor/pipeline partitioning on other axes —
+``sync_gradients`` means each gradient only over the axes its parameter is
+**not** partitioned on.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+logger = logging.getLogger("tpu_parallel")
+
+Pytree = Any
+
+# Parameters smaller than this stay replicated: the all-gather latency would
+# cost more than the memory saved (reference default: param_sharding.py:60).
+DEFAULT_MIN_WEIGHT_SIZE = 2**18
+
+
+@jax.named_scope("shard_params")
+def shard_params(
+    params: Pytree, axis_name: str, min_weight_size: int = DEFAULT_MIN_WEIGHT_SIZE
+) -> Pytree:
+    """Slice each large parameter 1/N along one of its dims over ``axis_name``.
+
+    Runs inside a ``shard_map`` region.  For each leaf, the largest dim that
+    divides the axis size evenly and is not already partitioned is chosen;
+    the local slice is taken with ``dynamic_slice_in_dim`` at this device's
+    axis index, and the leaf is wrapped in ``nn.Partitioned`` so partition
+    specs can later be read off with ``nn.get_partition_spec``.
+    """
+    axis_idx = lax.axis_index(axis_name)
+    axis_size = lax.psum(1, axis_name)
+
+    def split(x: Union[nn.Partitioned, jax.Array]):
+        if isinstance(x, nn.Partitioned):
+            value, names = x.value, list(x.names)
+        else:
+            value, names = x, [None] * x.ndim
+        if axis_name in names:
+            logger.warning(
+                "parameter %s already partitioned on %s; skipping", value.shape, axis_name
+            )
+            return x
+        if value.size <= min_weight_size:
+            return x
+        # Prefer the largest dim for an even 1/N split.
+        order = np.argsort(value.shape)[::-1]
+        for dim in order:
+            dim = int(dim)
+            if value.shape[dim] % axis_size == 0 and names[dim] is None:
+                shard_size = value.shape[dim] // axis_size
+                local = lax.dynamic_slice_in_dim(
+                    value, axis_idx * shard_size, shard_size, axis=dim
+                )
+                names[dim] = axis_name
+                return nn.Partitioned(local, names=tuple(names))
+        logger.warning(
+            "could not shard parameter of shape %s over axis %s: "
+            "no dim divisible; keeping replicated",
+            value.shape,
+            axis_name,
+        )
+        return x
+
+    return jax.tree_util.tree_map(
+        split, params, is_leaf=lambda x: isinstance(x, nn.Partitioned)
+    )
+
+
+def _gather_with_scattered_grad(x: jax.Array, axis_name: str, axis: int) -> jax.Array:
+    """All-gather ``x`` along ``axis``; backward is a mean reduce-scatter.
+
+    The custom gradient is the heart of ZeRO-3: the forward materializes the
+    full weight (``all_gather`` rides ICI), and the cotangent — which is the
+    *summed* gradient of the full weight across the data axis — comes back as
+    each device's 1/N slice via ``psum_scatter``, divided by N to make the
+    DP-mean convention line up with replicated parameters.
+    """
+
+    @jax.custom_gradient
+    def gather(p):
+        def grad_fn(g):
+            return (
+                lax.psum_scatter(g, axis_name, scatter_dimension=axis, tiled=True)
+                / lax.psum(1, axis_name)
+            )
+
+        return lax.all_gather(p, axis_name, axis=axis, tiled=True), grad_fn
+
+    return gather(x)
+
+
+@jax.named_scope("gather_params")
+def gather_params(params: Pytree, axis_name: str) -> Pytree:
+    """Materialize full weights from their 1/N shards for compute."""
+
+    def gather(p):
+        if isinstance(p, nn.Partitioned) and axis_name in p.names:
+            axis = p.names.index(axis_name)
+            value = _gather_with_scattered_grad(p.value, axis_name, axis)
+            names = tuple(n if i != axis else None for i, n in enumerate(p.names))
+            if any(n is not None for n in names):
+                return nn.Partitioned(value, names=names)
+            return value
+        return p
+
+    return jax.tree_util.tree_map(
+        gather, params, is_leaf=lambda x: isinstance(x, nn.Partitioned)
+    )
+
+
+def shard_module_params(
+    target: Union[nn.Module, Callable],
+    axis_name: str,
+    min_weight_size: int = DEFAULT_MIN_WEIGHT_SIZE,
+):
+    """Wrap a module (or module class) so its params live FSDP-sharded.
+
+    Uses ``nn.map_variables``: parameters are gathered on the way *into*
+    compute and re-sharded on the way *out* (init writes shards), so the
+    module body never knows it is sharded.  Mirrors the intent of
+    ``param_sharding.py:179-191`` with the config-nesting bugs fixed.
+    """
+    return nn.map_variables(
+        target,
+        trans_in_fn=functools.partial(gather_params, axis_name=axis_name),
+        trans_out_fn=functools.partial(
+            shard_params, axis_name=axis_name, min_weight_size=min_weight_size
+        ),
+        mapped_collections="params",
+        mutable=True,
+    )
+
+
+@jax.named_scope("sync_gradients")
+def sync_gradients(
+    grads: Pytree,
+    axis_names: Union[str, Sequence[str]],
+    psum_axes: Union[str, Sequence[str]] = (),
+) -> Pytree:
+    """Reduce each gradient over exactly the axes its param is replicated on.
+
+    A gradient for a parameter partitioned on ``axis`` is already per-device
+    correct on that axis (the reduce-scatter in the gather's backward did the
+    reduction); reducing it again would be wrong.  Gradients of replicated
+    parameters are **pmean**-ed over ``axis_names`` (data-parallel replicas
+    averaging the same-loss estimate — reference ``param_sharding.py:293-322``)
+    and **psum**-ed over ``psum_axes`` (axes where ranks contribute disjoint
+    *pieces* of the gradient — e.g. a pipeline axis, where only the rank
+    hosting the embed/head produces its nonzero gradient).
+    """
+    if isinstance(axis_names, str):
+        axis_names = (axis_names,)
+    if isinstance(psum_axes, str):
+        psum_axes = (psum_axes,)
+
+    def sync(g):
+        if isinstance(g, nn.Partitioned):
+            mean_axes = [a for a in axis_names if a not in g.names]
+            sum_axes = [a for a in psum_axes if a not in g.names]
+            value = g.value
+            if mean_axes:
+                value = lax.pmean(value, mean_axes)
+            if sum_axes:
+                value = lax.psum(value, sum_axes)
+            return g.replace(value=value)
+        g = lax.pmean(g, axis_names)
+        if psum_axes:
+            g = lax.psum(g, psum_axes)
+        return g
+
+    return jax.tree_util.tree_map(
+        sync, grads, is_leaf=lambda x: isinstance(x, nn.Partitioned)
+    )

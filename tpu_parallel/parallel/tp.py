@@ -1,0 +1,227 @@
+"""Megatron-style tensor parallelism over a mesh axis.
+
+No reference capability exists for TP (SURVEY.md §2.2: "Absent" — the
+reference's ``param_sharding.py`` is ZeRO-3, which gathers full weights before
+compute).  This module is designed from scratch for the BASELINE.json config-3
+target: 1-D tensor parallel transformer layers on a ``model`` mesh axis,
+composable with DP/FSDP on ``data`` and pipeline stages on ``pipe``.
+
+Design (shard_map idiom — every function here runs per-device inside a
+``shard_map`` region):
+
+- :class:`ModuleShard` makes any inner module hold *per-device* parameters on
+  one mesh axis: params get a stacked leading axis tagged ``nn.Partitioned``
+  (global shape ``[axis_size, ...]``, local ``[1, ...]``), and the init RNG is
+  folded over the axis so every device draws an independent slice.  This one
+  wrapper implements both TP weight slicing and (in ``parallel.pp``) per-stage
+  pipeline weights.
+- :class:`TPDense` builds column-parallel (``full -> sharded`` activations)
+  and row-parallel (``sharded -> full`` via one ``psum``) projections on top.
+  A column -> nonlinearity -> row pair is the Megatron f/g conjugate pattern:
+  exactly one all-reduce per MLP block on the forward pass, one on the
+  backward.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_parallel.core.rng import fold_rng_over_axis
+
+Pytree = Any
+
+
+def stack_params(
+    params: Pytree, axis_name: str, *, axis: int = 0, mask_except: Optional[int] = None
+) -> Pytree:
+    """Add a size-1 leading axis tagged as partitioned over ``axis_name``.
+
+    The global (unsharded) view of such a parameter is ``[axis_size, ...]`` —
+    device i owns slice i.  ``mask_except`` zeroes the value on every device
+    except one (used e.g. to keep a bias on a single TP rank so the
+    post-``psum`` sum adds it exactly once).
+    """
+
+    def _stack(x):
+        if isinstance(x, nn.Partitioned):
+            value, names = x.value, x.names
+        else:
+            value, names = x, (None,) * x.ndim
+        if mask_except is not None:
+            axis_index = lax.axis_index(axis_name)
+            value = jnp.where(axis_index == mask_except, value, jnp.zeros_like(value))
+        value = jnp.expand_dims(value, axis)
+        names = names[:axis] + (axis_name,) + names[axis:]
+        return nn.Partitioned(value, names=names)
+
+    return jax.tree_util.tree_map(
+        _stack, params, is_leaf=lambda x: isinstance(x, nn.Partitioned)
+    )
+
+
+def unstack_params(params: Pytree, axis_name: str) -> Pytree:
+    """Inverse of :func:`stack_params`: drop the stacked axis for compute."""
+
+    def _unstack(x):
+        if isinstance(x, nn.Partitioned) and axis_name in x.names:
+            axis = x.names.index(axis_name)
+            value = x.value.squeeze(axis)
+            names = tuple(n for i, n in enumerate(x.names) if i != axis)
+            if any(n is not None for n in names):
+                return nn.Partitioned(value, names)
+            return value
+        return x
+
+    return jax.tree_util.tree_map(
+        _unstack, params, is_leaf=lambda x: isinstance(x, nn.Partitioned)
+    )
+
+
+class ModuleShard(nn.Module):
+    """Give the wrapped module independent per-device parameters on one axis.
+
+    ``module_fn`` constructs the inner module (called lazily so the wrapper is
+    cheap to instantiate in lists/scans).  During init the params RNG is
+    folded over ``axis_name`` — each device initializes its own shard; during
+    apply the stacked axis is stripped before the inner module sees params.
+    """
+
+    module_fn: Callable[[], nn.Module]
+    axis_name: str
+    mask_except: Optional[int] = None
+
+    @nn.compact
+    def __call__(self, *args, **kwargs):
+        if self.is_initializing():
+            # Decorrelate per-device init draws.
+            rng = self.scope.rngs["params"]
+            self.scope.rngs["params"] = rng.replace(
+                rng=fold_rng_over_axis(rng.rng, self.axis_name)
+            )
+        mapped = nn.map_variables(
+            self.module_fn,
+            trans_in_fn=functools.partial(unstack_params, axis_name=self.axis_name),
+            trans_out_fn=functools.partial(
+                stack_params, axis_name=self.axis_name, mask_except=self.mask_except
+            ),
+            mapped_collections="params",
+            mutable=True,
+        )
+        return mapped(name="sharded")(*args, **kwargs)
+
+
+def split_over_axis(x: jax.Array, axis_name: str, axis: int = -1) -> jax.Array:
+    """Keep only this device's slice of ``x`` along ``axis`` (free: a slice)."""
+    axis_size = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    if x.shape[axis] % axis_size != 0:
+        raise ValueError(
+            f"cannot split axis of size {x.shape[axis]} evenly over "
+            f"{axis_name}; remainder features would be silently dropped"
+        )
+    slice_size = x.shape[axis] // axis_size
+    return lax.dynamic_slice_in_dim(x, idx * slice_size, slice_size, axis=axis)
+
+
+class TPDense(nn.Module):
+    """Tensor-parallel Dense over ``axis_name``.
+
+    styles:
+      - ``"column"``: input replicated, output feature-sharded (each device
+        computes ``features // tp`` outputs).  Set ``gather_output=True`` to
+        all-gather the result back to full features (e.g. for an lm_head).
+      - ``"row"``: input feature-sharded (``split_input=True`` slices a
+        replicated input instead), output full features via one ``psum``.
+        The bias is a plain replicated parameter added *after* the psum, so
+        it contributes exactly once regardless of tp degree.
+
+    ``features`` is always the *global* output feature count.
+    """
+
+    features: int
+    axis_name: str = "model"
+    style: str = "column"
+    use_bias: bool = True
+    gather_output: bool = False
+    split_input: bool = False
+    dtype: Optional[jnp.dtype] = None
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    bias_init: Callable = nn.initializers.zeros
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        tp_size = jax.lax.psum(1, self.axis_name)
+        if self.style == "column":
+            if self.features % tp_size != 0:
+                raise ValueError(
+                    f"column-parallel features={self.features} not divisible by "
+                    f"tp={tp_size}"
+                )
+            dense_fn = functools.partial(
+                nn.Dense,
+                features=self.features // tp_size,
+                use_bias=self.use_bias,
+                dtype=self.dtype,
+                kernel_init=self.kernel_init,
+            )
+            y = ModuleShard(dense_fn, axis_name=self.axis_name, name="shard")(x)
+            if self.gather_output:
+                y = lax.all_gather(y, self.axis_name, axis=-1, tiled=True)
+            return y
+        elif self.style == "row":
+            if self.split_input:
+                x = split_over_axis(x, self.axis_name, axis=-1)
+            dense_fn = functools.partial(
+                nn.Dense,
+                features=self.features,
+                use_bias=False,
+                dtype=self.dtype,
+                kernel_init=self.kernel_init,
+            )
+            y = ModuleShard(dense_fn, axis_name=self.axis_name, name="shard")(x)
+            with jax.named_scope("tp_row_psum"):
+                y = lax.psum(y, self.axis_name)
+            if self.use_bias:
+                bias = self.param("bias", self.bias_init, (self.features,))
+                y = y + jnp.asarray(bias, y.dtype)
+            return y
+        raise ValueError(f"unknown TPDense style: {self.style!r}")
+
+
+class TPMLP(nn.Module):
+    """Megatron MLP block: column-parallel up, activation, row-parallel down.
+
+    One forward psum per block; the backward all-reduce pairs with the
+    column layer's gradient.
+    """
+
+    hidden_features: int
+    out_features: int
+    axis_name: str = "model"
+    activation: Callable = nn.gelu
+    dtype: Optional[jnp.dtype] = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        h = TPDense(
+            features=self.hidden_features,
+            axis_name=self.axis_name,
+            style="column",
+            dtype=self.dtype,
+            name="up",
+        )(x)
+        h = self.activation(h)
+        y = TPDense(
+            features=self.out_features,
+            axis_name=self.axis_name,
+            style="row",
+            dtype=self.dtype,
+            name="down",
+        )(h)
+        return y
