@@ -1,0 +1,90 @@
+"""Benchmark: GPT-2 125M training throughput on the available hardware.
+
+Prints ONE JSON line:
+    {"metric": "tokens/sec/chip", "value": N, "unit": "tokens/sec/chip",
+     "vs_baseline": M, ...}
+
+``vs_baseline`` is measured MFU divided by the 0.40 north-star target from
+BASELINE.json (the reference publishes no numbers of its own — BASELINE.md).
+Runs on whatever ``jax.devices()`` offers: the real TPU chip under the
+driver, or CPU (with a tiny model) when no accelerator is present.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    device = jax.devices()[0]
+    on_tpu = device.platform == "tpu"
+    n_chips = jax.device_count()
+
+    from tpu_parallel.runtime import MeshConfig
+    from tpu_parallel.train_lib import Trainer, TrainerConfig
+    from tpu_parallel.utils.profiling import peak_flops, transformer_flops_per_token
+
+    if on_tpu:
+        model, batch, steps, minib = "gpt2_125m", 8 * n_chips, 20, 1
+        overrides = dict(dropout_rate=0.0)
+    else:
+        model, batch, steps, minib = "tiny", 8 * n_chips, 10, 1
+        overrides = dict(num_microbatches=1)
+
+    config = TrainerConfig(
+        model=model,
+        model_overrides=overrides,
+        mesh=MeshConfig(data=-1),
+        global_batch_size=batch,
+        num_minibatches=minib,
+        steps=steps,
+        log_every=10_000,  # no intermediate logging inside the timed loop
+        donate=True,
+    )
+    trainer = Trainer(config)
+    trainer.init()
+
+    tokens_per_step = batch * trainer.model_config.seq_len
+
+    # warmup (compile + first steps)
+    state, metrics = trainer.state, None
+    for _ in range(3):
+        state, metrics = trainer.funcs.step_fn(state, metrics, trainer.example_batch)
+    jax.block_until_ready(state)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = trainer.funcs.step_fn(state, metrics, trainer.example_batch)
+    jax.block_until_ready(state)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = tokens_per_step * steps / dt
+    tokens_per_sec_chip = tokens_per_sec / n_chips
+    flops_per_token = transformer_flops_per_token(trainer.model_config)
+    peak = peak_flops(device) or 197e12  # CPU: nominal, MFU not meaningful
+    mfu = tokens_per_sec_chip * flops_per_token / peak
+
+    print(
+        json.dumps(
+            {
+                "metric": "tokens/sec/chip",
+                "value": round(tokens_per_sec_chip, 1),
+                "unit": "tokens/sec/chip",
+                "vs_baseline": round(mfu / 0.40, 4),
+                "mfu": round(mfu, 4),
+                "model": model,
+                "params_m": round(trainer.num_params / 1e6, 1),
+                "n_chips": n_chips,
+                "device": getattr(device, "device_kind", device.platform),
+                "global_batch": batch,
+                "seq_len": trainer.model_config.seq_len,
+                "steps_timed": steps,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,22 @@
+"""Llama-style 1B on a DP x TP x PP 3-D mesh (BASELINE config 5: v5e-64)."""
+
+from ml_collections import ConfigDict
+
+
+def get_config():
+    c = ConfigDict()
+    c.simulate_cpu_devices = 0
+    c.model = "llama_1b"
+    c.model_overrides = ConfigDict(dict(num_microbatches=8, fsdp=True))
+    c.mesh = ConfigDict(dict(data=-1, model=4, pipe=4, seq=1))
+    c.global_batch_size = 64
+    c.num_minibatches = 1
+    c.steps = 100
+    c.learning_rate = 3e-4
+    c.warmup_steps = 20
+    c.weight_decay = 0.1
+    c.grad_clip = 1.0
+    c.seed = 0
+    c.log_every = 10
+    c.donate = True
+    return c

@@ -1,0 +1,26 @@
+"""Reference-parity config: tiny model, DP over 8 simulated CPU devices.
+
+Mirrors BASELINE config 1 (the reference's data_paral.py scenario) in the
+new framework's config format.
+"""
+
+from ml_collections import ConfigDict
+
+
+def get_config():
+    c = ConfigDict()
+    c.simulate_cpu_devices = 8
+    c.model = "tiny"
+    c.model_overrides = ConfigDict()
+    c.mesh = ConfigDict(dict(data=8, model=1, pipe=1, seq=1))
+    c.global_batch_size = 32
+    c.num_minibatches = 4
+    c.steps = 15
+    c.learning_rate = 1e-3
+    c.warmup_steps = 5
+    c.weight_decay = 0.01
+    c.grad_clip = 1.0
+    c.seed = 69
+    c.log_every = 5
+    c.donate = True
+    return c

@@ -1,0 +1,22 @@
+"""Tiny GPT on a simulated 2x2x2 DP x TP x PP mesh — CPU smoke test."""
+
+from ml_collections import ConfigDict
+
+
+def get_config():
+    c = ConfigDict()
+    c.simulate_cpu_devices = 8
+    c.model = "tiny"
+    c.model_overrides = ConfigDict(dict(num_microbatches=2))
+    c.mesh = ConfigDict(dict(data=2, model=2, pipe=2, seq=1))
+    c.global_batch_size = 16
+    c.num_minibatches = 1
+    c.steps = 20
+    c.learning_rate = 3e-3
+    c.warmup_steps = 5
+    c.weight_decay = 0.1
+    c.grad_clip = 1.0
+    c.seed = 0
+    c.log_every = 5
+    c.donate = True
+    return c

@@ -119,6 +119,26 @@ def test_row_bias_added_once(mesh_data4_model2, rng):
     np.testing.assert_allclose(np.asarray(out), np.ones((2, 4)), atol=1e-7)
 
 
+def test_row_init_variance_matches_dense(mesh_data4_model2, rng):
+    """Row-parallel kernel init must use global fan-in: shard std == dense std."""
+    in_dim, out_dim = 256, 64
+    x = jnp.zeros((2, in_dim))
+    params, _ = _run_tp(
+        mesh_data4_model2,
+        lambda: tp.TPDense(features=out_dim, style="row", split_input=True),
+        x,
+        rng,
+    )
+    shard_std = float(np.std(_full(params["shard"]["sharded"]["kernel"])))
+    dense = nn.Dense(out_dim)
+    dense_params = dense.init(jax.random.PRNGKey(0), jnp.zeros((1, in_dim)))
+    dense_std = float(np.std(np.asarray(dense_params["params"]["kernel"])))
+    assert abs(shard_std - dense_std) / dense_std < 0.15, (
+        f"row shard std {shard_std:.4f} vs dense {dense_std:.4f} — init "
+        "variance depends on tp degree"
+    )
+
+
 def test_split_over_axis_rejects_indivisible(mesh_data4_model2, rng):
     x = jnp.zeros((2, 9))  # 9 features over tp=2
     with pytest.raises(ValueError, match="silently dropped"):

@@ -1,0 +1,71 @@
+"""Trainer / config-system tests."""
+
+import jax
+import pytest
+
+from tpu_parallel.runtime import MeshConfig
+from tpu_parallel.train_lib import Trainer, TrainerConfig
+
+
+def test_trainer_tiny_3d(devices):
+    config = TrainerConfig(
+        model="tiny",
+        model_overrides=dict(num_microbatches=2),
+        mesh=MeshConfig(data=2, model=2, pipe=2),
+        global_batch_size=16,
+        steps=8,
+        log_every=4,
+        donate=False,
+    )
+    trainer = Trainer(config)
+    assert trainer.model_config.pipe_size == 2  # mesh dictates pipeline degree
+    trainer.init()
+    logs = []
+    result = trainer.train(log_fn=lambda step, m: logs.append((step, m)))
+    assert result["loss"] > 0
+    assert result["tokens_per_sec"] > 0
+    assert logs and logs[-1][0] == 8
+
+
+def test_trainer_from_config_dict(devices):
+    from ml_collections import ConfigDict
+
+    cd = ConfigDict(
+        dict(
+            model="tiny",
+            model_overrides=ConfigDict(),
+            mesh=ConfigDict(dict(data=8, model=1, pipe=1, seq=1)),
+            global_batch_size=16,
+            num_minibatches=2,
+            steps=2,
+            learning_rate=1e-3,
+            warmup_steps=1,
+            weight_decay=0.0,
+            grad_clip=1.0,
+            seed=1,
+            log_every=1,
+            donate=False,
+        )
+    )
+    config = TrainerConfig.from_config_dict(cd)
+    assert config.mesh.data == 8
+    trainer = Trainer(config)
+    result = trainer.train()
+    assert result["loss"] > 0
+
+
+def test_trainer_rejects_indivisible_batch(devices):
+    config = TrainerConfig(
+        model="tiny", mesh=MeshConfig(data=8), global_batch_size=12
+    )
+    with pytest.raises(ValueError, match="not divisible"):
+        Trainer(config)
+
+
+def test_trainer_num_params(devices):
+    config = TrainerConfig(
+        model="tiny", mesh=MeshConfig(data=8), global_batch_size=16
+    )
+    trainer = Trainer(config)
+    n = trainer.num_params
+    assert 1e4 < n < 1e6

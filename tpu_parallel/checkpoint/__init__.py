@@ -1,0 +1,3 @@
+from tpu_parallel.checkpoint.io import Checkpointer, abstract_state_of
+
+__all__ = ["Checkpointer", "abstract_state_of"]

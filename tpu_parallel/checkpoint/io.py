@@ -1,0 +1,70 @@
+"""Checkpoint / resume on top of orbax — the TPU-native answer.
+
+The reference has no persistence at all (SURVEY.md §5: "no orbax/flax
+serialization anywhere"; its ``TrainState`` is checkpointable-by-construction
+but nothing saves it).  This module supplies the capability: sharded
+``TrainState`` pytrees (including ``nn.Partitioned``-boxed leaves) saved with
+orbax and restored *onto the same mesh layout* via an abstract target derived
+from the trainer's init function — every leaf comes back with its
+NamedSharding, so restore never materializes a full replica on one host.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+Pytree = Any
+
+
+class Checkpointer:
+    """Thin orbax wrapper bound to one run directory.
+
+    ``abstract_state``: pytree of ShapeDtypeStruct (with shardings) matching
+    the live state — build it with :func:`abstract_state_of`.
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        self.manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, step: int, state: Pytree, *, wait: bool = False) -> None:
+        self.manager.save(step, args=ocp.args.StandardSave(state))
+        if wait:
+            self.manager.wait_until_finished()
+
+    def restore(self, abstract_state: Pytree, step: Optional[int] = None) -> Pytree:
+        step = step if step is not None else self.manager.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint found in {self.directory}")
+        return self.manager.restore(
+            step, args=ocp.args.StandardRestore(abstract_state)
+        )
+
+    @property
+    def latest_step(self) -> Optional[int]:
+        return self.manager.latest_step()
+
+    def wait(self) -> None:
+        self.manager.wait_until_finished()
+
+    def close(self) -> None:
+        self.manager.close()
+
+
+def abstract_state_of(init_fn: Callable, *example_args) -> Pytree:
+    """Abstract (shape/dtype/sharding) twin of ``init_fn(*example_args)``.
+
+    ``init_fn`` should be the jitted sharded init from
+    ``build_train_functions`` — its output shardings become the restore
+    layout.
+    """
+    return jax.eval_shape(init_fn, *example_args)

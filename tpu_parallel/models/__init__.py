@@ -1,3 +1,24 @@
+from tpu_parallel.models.gpt import (
+    GPTConfig,
+    GPTLM,
+    gpt2_125m,
+    gpt2_350m,
+    llama_1b,
+    make_gpt_loss,
+    tiny_test,
+)
+from tpu_parallel.models.layers import TransformerConfig
 from tpu_parallel.models.mlp import MLPClassifier, MLPConfig
 
-__all__ = ["MLPClassifier", "MLPConfig"]
+__all__ = [
+    "GPTConfig",
+    "GPTLM",
+    "gpt2_125m",
+    "gpt2_350m",
+    "llama_1b",
+    "make_gpt_loss",
+    "tiny_test",
+    "TransformerConfig",
+    "MLPClassifier",
+    "MLPConfig",
+]

@@ -1,0 +1,208 @@
+"""Decoder-only transformer LM, composable over DP x FSDP x TP x PP meshes.
+
+The flagship model family for the BASELINE.json matrix: GPT-2 125M/350M
+(learned positions, LayerNorm, gelu) and Llama-style (RoPE, RMSNorm, SwiGLU)
+via :class:`~tpu_parallel.models.layers.TransformerConfig` switches.  No
+reference model exists to mirror (the reference trains 2-layer MLPs only);
+the parallelism semantics follow the framework's strategy modules:
+
+- TP: structural (TPDense everywhere; identity on tp=1 meshes).
+- FSDP: ``config.fsdp`` wraps each Block / embedding in
+  ``fsdp.shard_module_params`` over the data axis — gathers are per-block,
+  so peak HBM holds one block's full weights, not the model's.
+- PP: ``pipe_size > 1`` runs the block stack as GPipe stages over the pipe
+  axis.  Logits are then valid on the **last** pipe rank only — train with
+  :func:`make_gpt_loss`, which masks by :func:`pp.last_stage_mask`.
+  Under PP, ``positions``/``segment_ids`` must be ``None`` (unpacked
+  sequences; blocks regenerate default positions per microbatch).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+from tpu_parallel.core.metrics import Metrics
+from tpu_parallel.core.rng import fold_rng_over_axis
+from tpu_parallel.models.layers import (
+    Attention,
+    Block,
+    BlockStack,
+    Embedding,
+    TransformerConfig,
+    make_norm,
+)
+from tpu_parallel.parallel import fsdp, pp
+from tpu_parallel.parallel.tp import TPDense
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig(TransformerConfig):
+    """TransformerConfig plus pipeline degree (static model knobs only)."""
+
+    pipe_size: int = 1  # number of pipeline stages the block stack is cut into
+
+
+class GPTLM(nn.Module):
+    """tokens [B, S] -> logits [B, S, vocab]."""
+
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        tokens: jax.Array,
+        positions: Optional[jax.Array] = None,
+        segment_ids: Optional[jax.Array] = None,
+        train: bool = True,
+    ) -> jax.Array:
+        cfg = self.config
+        embed_cls = Embedding
+        if cfg.fsdp:
+            embed_cls = fsdp.shard_module_params(
+                Embedding, cfg.data_axis, cfg.fsdp_min_size
+            )
+        x = embed_cls(cfg, name="embed")(tokens, positions=positions)
+
+        if cfg.pipe_size > 1:
+            # positions are consumed by the (pre-pipeline) embedding; inside
+            # the pipeline, RoPE blocks fall back to default arange positions.
+            # Packed sequences can't ride the activation ppermute yet:
+            if segment_ids is not None:
+                raise NotImplementedError(
+                    "pipeline parallelism currently requires unpacked sequences "
+                    "(segment_ids must be None)"
+                )
+            if cfg.n_layers % cfg.pipe_size != 0:
+                raise ValueError(
+                    f"n_layers={cfg.n_layers} not divisible by pipe_size={cfg.pipe_size}"
+                )
+            layers_per_stage = cfg.n_layers // cfg.pipe_size
+            x = pp.PipelineModule(
+                stage_fn=functools.partial(BlockStack, cfg, layers_per_stage),
+                num_microbatches=cfg.num_microbatches,
+                axis_name=cfg.pipe_axis,
+                name="pipeline",
+            )(x, train=train)
+        else:
+            x = BlockStack(cfg, cfg.n_layers, name="blocks")(
+                x, positions=positions, segment_ids=segment_ids, train=train
+            )
+
+        x = make_norm(cfg, "norm_final")(x).astype(cfg.dtype)
+        logits = TPDense(
+            features=cfg.vocab_size,
+            axis_name=cfg.model_axis,
+            style="column",
+            gather_output=True,
+            use_bias=False,
+            dtype=cfg.dtype,
+            name="lm_head",
+        )(x)
+        return logits.astype(jnp.float32)
+
+
+def make_gpt_loss(config: GPTConfig):
+    """Next-token CE in the accumulate_gradients loss shape, PP/TP-aware.
+
+    Dropout RNG folds over every parallel axis; under PP the loss and metric
+    counts are masked to the last pipe rank (the only rank with real logits).
+    """
+    fold_axes = (config.data_axis, config.model_axis, config.pipe_axis)
+
+    def loss_fn(params, apply_fn, batch, rng):
+        dropout_rng = fold_rng_over_axis(rng, fold_axes)
+        logits = apply_fn(
+            {"params": params},
+            batch.tokens,
+            positions=batch.positions,
+            segment_ids=None if config.pipe_size > 1 else batch.segment_ids,
+            train=True,
+            rngs={"dropout": dropout_rng},
+        )
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, batch.targets)
+        mask = (
+            batch.loss_mask
+            if batch.loss_mask is not None
+            else jnp.ones_like(loss, jnp.float32)
+        )
+        if config.pipe_size > 1:
+            mask = mask * pp.last_stage_mask(config.pipe_axis)
+        loss = loss * mask
+        n_tok = mask.sum()
+        correct = ((logits.argmax(-1) == batch.targets) * mask).sum()
+        metrics: Metrics = {
+            "loss": (loss.sum(), n_tok),
+            "accuracy": (correct.astype(jnp.float32), n_tok),
+        }
+        return loss.sum() / jnp.maximum(n_tok, 1.0), metrics
+
+    return loss_fn
+
+
+# --- Named configurations (BASELINE.md matrix) --------------------------------
+
+
+def gpt2_125m(**overrides) -> GPTConfig:
+    return GPTConfig(
+        **{
+            **dict(
+                vocab_size=50304, d_model=768, n_layers=12, n_heads=12, seq_len=1024
+            ),
+            **overrides,
+        }
+    )
+
+
+def gpt2_350m(**overrides) -> GPTConfig:
+    return GPTConfig(
+        **{
+            **dict(
+                vocab_size=50304, d_model=1024, n_layers=24, n_heads=16, seq_len=1024
+            ),
+            **overrides,
+        }
+    )
+
+
+def llama_1b(**overrides) -> GPTConfig:
+    return GPTConfig(
+        **{
+            **dict(
+                vocab_size=32000,
+                d_model=2048,
+                n_layers=16,
+                n_heads=16,
+                seq_len=2048,
+                positional="rope",
+                norm="rmsnorm",
+                mlp="swiglu",
+            ),
+            **overrides,
+        }
+    )
+
+
+def tiny_test(**overrides) -> GPTConfig:
+    """Small config for CPU-mesh tests: real structure, toy sizes."""
+    return GPTConfig(
+        **{
+            **dict(
+                vocab_size=256,
+                d_model=32,
+                n_layers=4,
+                n_heads=4,
+                seq_len=32,
+                dtype=jnp.float32,
+                num_microbatches=2,
+            ),
+            **overrides,
+        }
+    )

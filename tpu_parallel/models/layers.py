@@ -1,0 +1,342 @@
+"""Shared transformer building blocks, parallelism-aware.
+
+No reference capability exists for any of this (the reference's models are
+2-layer MLPs — SURVEY.md §2.4); these layers serve the BASELINE.json
+transformer configs (GPT-2 125M/350M, Llama-style 1B).  TPU-first choices:
+
+- bf16 activations / fp32 params and fp32 LayerNorm+softmax accumulation
+  (MXU-friendly, numerically safe).
+- Tensor parallelism is *structural*, not conditional: attention and MLP
+  projections are :class:`~tpu_parallel.parallel.tp.TPDense` over the
+  ``model`` axis.  On a mesh where that axis has size 1 the collectives are
+  identity — one model definition serves every mesh shape.
+- ``nn.remat`` + ``nn.scan`` over layers keep compile time and HBM in check
+  at 125M+ scale.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_parallel.parallel.tp import TPDense, axis_size_or_none
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    """Architecture + parallelism knobs for the transformer family."""
+
+    vocab_size: int = 50304  # GPT-2's 50257 padded up to a multiple of 128 (MXU lanes)
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    seq_len: int = 1024
+    mlp_ratio: int = 4
+    dropout_rate: float = 0.0
+    dtype: Any = jnp.bfloat16
+    # positional encoding: "learned" (GPT-2) or "rope" (Llama)
+    positional: str = "learned"
+    rope_theta: float = 10000.0
+    # norm: "layernorm" (GPT-2) or "rmsnorm" (Llama)
+    norm: str = "layernorm"
+    # mlp: "gelu" (GPT-2) or "swiglu" (Llama)
+    mlp: str = "gelu"
+    # parallelism
+    model_axis: str = "model"
+    data_axis: str = "data"
+    pipe_axis: str = "pipe"
+    seq_axis: str = "seq"
+    num_microbatches: int = 4  # pipeline schedule depth (used when pipe > 1)
+    remat: bool = True
+    scan_layers: bool = True
+    fsdp: bool = False  # shard big params over the data axis (ZeRO-3)
+    fsdp_min_size: int = 2**18
+    attn_impl: str = "xla"  # "xla" | "flash" | "ring"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+def make_norm(config: TransformerConfig, name: str):
+    """fp32 norm (LayerNorm or RMSNorm) — small, precision-critical."""
+    if config.norm == "rmsnorm":
+        return nn.RMSNorm(dtype=jnp.float32, name=name)
+    return nn.LayerNorm(dtype=jnp.float32, name=name)
+
+
+def apply_rope(
+    x: jax.Array, positions: jax.Array, theta: float = 10000.0
+) -> jax.Array:
+    """Rotary position embedding over the last (head_dim) axis.
+
+    ``x``: [batch, seq, heads, head_dim]; ``positions``: [batch, seq].
+    """
+    head_dim = x.shape[-1]
+    freq_exponents = jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim
+    inv_freq = 1.0 / (theta**freq_exponents)  # [head_dim/2]
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B, S, hd/2]
+    angles = angles[:, :, None, :]  # broadcast over heads
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., ::2], x[..., 1::2]
+    rotated = jnp.stack(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1
+    ).reshape(x.shape)
+    return rotated.astype(x.dtype)
+
+
+def causal_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    segment_ids: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Reference causal attention: fp32 softmax, bf16 matmuls on the MXU.
+
+    ``q, k, v``: [batch, seq, heads, head_dim].  O(seq^2) memory — the
+    Pallas flash kernel (``ops.flash_attention``) replaces this on TPU for
+    long sequences.
+    """
+    head_dim = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(head_dim).astype(q.dtype)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k)
+    scores = scores.astype(jnp.float32)
+    q_pos = lax.broadcasted_iota(jnp.int32, scores.shape, 2)
+    k_pos = lax.broadcasted_iota(jnp.int32, scores.shape, 3)
+    mask = q_pos >= k_pos
+    if segment_ids is not None:
+        same_seg = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+        mask = jnp.logical_and(mask, same_seg)
+    scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+class Attention(nn.Module):
+    """Multi-head causal self-attention, heads sharded over the model axis.
+
+    QKV is one fused column-parallel projection (each model rank owns
+    ``n_heads / tp`` heads); the output projection is row-parallel, closing
+    the Megatron f/g pair with a single psum.
+    """
+
+    config: TransformerConfig
+    # injected attention implementation; defaults resolved in __call__
+    attn_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,
+        positions: Optional[jax.Array] = None,
+        segment_ids: Optional[jax.Array] = None,
+        train: bool = True,
+    ) -> jax.Array:
+        cfg = self.config
+        tp_size = axis_size_or_none(cfg.model_axis) or 1
+        if cfg.n_heads % tp_size != 0:
+            raise ValueError(f"n_heads={cfg.n_heads} not divisible by tp={tp_size}")
+        local_heads = cfg.n_heads // tp_size
+        qkv = TPDense(
+            features=3 * cfg.d_model,
+            axis_name=cfg.model_axis,
+            style="column",
+            dtype=cfg.dtype,
+            name="qkv",
+        )(x)
+        qkv = qkv.reshape(*x.shape[:-1], local_heads, 3 * cfg.head_dim)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        if cfg.positional == "rope":
+            if positions is None:
+                local = jnp.arange(x.shape[1])
+                if cfg.attn_impl == "ring" and axis_size_or_none(cfg.seq_axis):
+                    # seq-sharded: offset local positions to global ones
+                    local = local + lax.axis_index(cfg.seq_axis) * x.shape[1]
+                positions = jnp.broadcast_to(local, x.shape[:2])
+            q = apply_rope(q, positions, cfg.rope_theta)
+            k = apply_rope(k, positions, cfg.rope_theta)
+        attn_fn = self.attn_fn
+        if attn_fn is None:
+            if cfg.attn_impl == "flash":
+                from tpu_parallel.ops.flash_attention import flash_attention
+
+                attn_fn = flash_attention
+            elif cfg.attn_impl == "ring":
+                from tpu_parallel.ops.ring_attention import ring_attention
+
+                if segment_ids is not None:
+                    raise NotImplementedError(
+                        "ring attention does not support packed sequences yet"
+                    )
+
+                def attn_fn(q, k, v, segment_ids=None):
+                    return ring_attention(q, k, v, axis_name=cfg.seq_axis)
+
+            else:
+                attn_fn = causal_attention
+        out = attn_fn(q, k, v, segment_ids=segment_ids)
+        out = out.reshape(*x.shape[:-1], local_heads * cfg.head_dim)
+        out = TPDense(
+            features=cfg.d_model,
+            axis_name=cfg.model_axis,
+            style="row",
+            dtype=cfg.dtype,
+            name="out",
+        )(out)
+        if cfg.dropout_rate > 0.0:
+            out = nn.Dropout(rate=cfg.dropout_rate, deterministic=not train)(out)
+        return out
+
+
+class MLP(nn.Module):
+    """Transformer MLP: column-up / row-down (Megatron pair); gelu or SwiGLU."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        cfg = self.config
+        hidden = cfg.mlp_ratio * cfg.d_model
+        if cfg.mlp == "swiglu":
+            # Llama-style: two column projections, silu-gated, row back down.
+            gate = TPDense(
+                features=hidden, axis_name=cfg.model_axis, style="column",
+                dtype=cfg.dtype, use_bias=False, name="gate",
+            )(x)
+            up = TPDense(
+                features=hidden, axis_name=cfg.model_axis, style="column",
+                dtype=cfg.dtype, use_bias=False, name="up",
+            )(x)
+            h = nn.silu(gate) * up
+        else:
+            h = TPDense(
+                features=hidden, axis_name=cfg.model_axis, style="column",
+                dtype=cfg.dtype, name="up",
+            )(x)
+            h = nn.gelu(h)
+        y = TPDense(
+            features=cfg.d_model, axis_name=cfg.model_axis, style="row",
+            dtype=cfg.dtype, use_bias=cfg.mlp != "swiglu", name="down",
+        )(h)
+        if cfg.dropout_rate > 0.0:
+            y = nn.Dropout(rate=cfg.dropout_rate, deterministic=not train)(y)
+        return y
+
+
+class Block(nn.Module):
+    """Pre-norm transformer block: x + attn(norm(x)); x + mlp(norm(x))."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,
+        positions: Optional[jax.Array] = None,
+        segment_ids: Optional[jax.Array] = None,
+        train: bool = True,
+    ) -> jax.Array:
+        cfg = self.config
+        h = make_norm(cfg, "norm_attn")(x).astype(cfg.dtype)
+        x = x + Attention(cfg, name="attn")(
+            h, positions=positions, segment_ids=segment_ids, train=train
+        )
+        h = make_norm(cfg, "norm_mlp")(x).astype(cfg.dtype)
+        x = x + MLP(cfg, name="mlp")(h, train=train)
+        return x
+
+
+class _ScanBlock(nn.Module):
+    """nn.scan target: one Block per tick, carrying (x, positions, segment_ids)."""
+
+    config: TransformerConfig
+    train: bool
+
+    @nn.compact
+    def __call__(self, carry, _):
+        x, positions, segment_ids = carry
+        x = Block(self.config, name="block")(
+            x, positions=positions, segment_ids=segment_ids, train=self.train
+        )
+        return (x, positions, segment_ids), None
+
+
+class BlockStack(nn.Module):
+    """``n_layers`` blocks, optionally remat'd and scanned.
+
+    ``nn.scan`` stacks per-layer params along a leading axis
+    (``PARTITION_NAME=None`` keeps flax's Partitioned metadata consistent);
+    compile time is then constant in depth.  ``nn.remat`` trades recompute
+    for HBM — the standard TPU recipe for 125M+ models.
+    """
+
+    config: TransformerConfig
+    n_layers: int
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,
+        positions: Optional[jax.Array] = None,
+        segment_ids: Optional[jax.Array] = None,
+        train: bool = True,
+    ) -> jax.Array:
+        cfg = self.config
+        if cfg.scan_layers:
+            scan_target = _ScanBlock
+            if cfg.remat:
+                scan_target = nn.remat(_ScanBlock, prevent_cse=False)
+            stacked = nn.scan(
+                scan_target,
+                variable_axes={"params": 0},
+                variable_broadcast=False,
+                split_rngs={"params": True, "dropout": True},
+                length=self.n_layers,
+                metadata_params={nn.PARTITION_NAME: None},
+            )(cfg, train, name="layers")
+            (x, _, _), _ = stacked((x, positions, segment_ids), None)
+        else:
+            block_cls = nn.remat(Block, prevent_cse=False) if cfg.remat else Block
+            for i in range(self.n_layers):
+                x = block_cls(cfg, name=f"layer_{i}")(
+                    x, positions=positions, segment_ids=segment_ids, train=train
+                )
+        return x
+
+
+class Embedding(nn.Module):
+    """Token (+ learned positional) embedding, bf16 output."""
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(
+        self, tokens: jax.Array, positions: Optional[jax.Array] = None
+    ) -> jax.Array:
+        cfg = self.config
+        emb = nn.Embed(
+            num_embeddings=cfg.vocab_size,
+            features=cfg.d_model,
+            dtype=cfg.dtype,
+            name="tok",
+        )(tokens)
+        if cfg.positional == "learned":
+            if positions is None:
+                positions = jnp.broadcast_to(
+                    jnp.arange(tokens.shape[1]), tokens.shape
+                )
+            pos_emb = nn.Embed(
+                num_embeddings=cfg.seq_len,
+                features=cfg.d_model,
+                dtype=cfg.dtype,
+                name="pos",
+            )(positions)
+            emb = emb + pos_emb
+        return emb

@@ -1,0 +1,91 @@
+"""Ring attention: causal attention with the sequence sharded over a mesh axis.
+
+Long-context / context-parallel capability (no reference equivalent —
+SURVEY.md §5).  Each device holds a contiguous sequence chunk of Q, K, V
+(``[batch, seq/n, heads, head_dim]``).  K/V chunks rotate around the ring
+with ``lax.ppermute`` (ICI neighbour exchange, overlappable with compute by
+XLA's latency-hiding scheduler) while each device's Q chunk accumulates
+attention over every K/V chunk with an online-softmax combine — memory stays
+O(seq/n) per device, communication is the ring's bisection bandwidth.
+
+Causality across chunks: chunk ``c`` of K/V is fully visible to Q chunk
+``r`` when ``c < r``, diagonally masked when ``c == r``, and fully masked
+when ``c > r`` (rows are masked elementwise; the compute is uniform across
+ranks, as SPMD requires).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+@jax.named_scope("ring_attention")
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "seq",
+    use_checkpoint: bool = True,
+) -> jax.Array:
+    """Causal ring attention on seq-sharded [batch, local_seq, heads, hd].
+
+    Must run inside a ``shard_map`` region binding ``axis_name``.  Returns
+    the local output chunk.  ``use_checkpoint`` remats the per-step combine
+    so the backward pass replays the ring instead of storing every rotated
+    K/V chunk (keeps the O(seq/n) memory promise under autodiff).
+    """
+    n_chunks = lax.psum(1, axis_name)
+    my_chunk = lax.axis_index(axis_name)
+    b, local_s, h, d = q.shape
+    scale = 1.0 / (d**0.5)
+    qf = (q.astype(jnp.float32) * scale).transpose(0, 2, 1, 3)  # [B,H,ls,D]
+
+    def combine(carry, kv_and_src):
+        """One ring step: attend local q to the currently-held kv chunk."""
+        out, m_prev, l_prev = carry
+        k_cur, v_cur, src_chunk = kv_and_src
+        kf = k_cur.astype(jnp.float32).transpose(0, 2, 1, 3)
+        vf = v_cur.astype(jnp.float32).transpose(0, 2, 1, 3)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf)
+        q_pos = my_chunk * local_s + lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        k_pos = src_chunk * local_s + lax.broadcasted_iota(jnp.int32, s.shape, 3)
+        mask = q_pos >= k_pos
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        # a fully-masked row keeps m == NEG_INF; exp(s - m) would be exp(0)=1
+        # there, so zero masked entries explicitly.
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        out = out * alpha + jnp.einsum("bhqk,bhkd->bhqd", p, vf)
+        return (out, m_new, l_new)
+
+    if use_checkpoint:
+        combine = jax.checkpoint(combine)
+
+    def step(carry, _):
+        (out, m, l), (k_cur, v_cur, src_chunk) = carry
+        new_acc = combine((out, m, l), (k_cur, v_cur, src_chunk))
+        # rotate kv to the next rank (rank i's chunk moves to rank i+1), so
+        # after step t this rank holds chunk (my_chunk - t - 1) mod n.
+        perm = [(i, (i + 1) % n_chunks) for i in range(n_chunks)]
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        src_next = (src_chunk - 1) % n_chunks
+        return (new_acc, (k_next, v_next, src_next)), None
+
+    out0 = jnp.zeros((b, h, local_s, d), jnp.float32)
+    m0 = jnp.full((b, h, local_s, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, local_s, 1), jnp.float32)
+    init = ((out0, m0, l0), (k, v, my_chunk))
+    ((out, m, l), _), _ = lax.scan(step, init, None, length=n_chunks)
+    out = out / jnp.maximum(l, 1e-20)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)

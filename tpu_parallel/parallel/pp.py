@@ -171,7 +171,10 @@ class PipelineModule(nn.Module):
     """Wrap a stage constructor into a full pipeline over ``axis_name``.
 
     ``stage_fn`` builds the per-stage module (e.g. a stack of
-    ``n_layers // num_stages`` transformer blocks).  Its parameters are made
+    ``n_layers // num_stages`` transformer blocks).  It must be a module
+    constructor that accepts flax module kwargs — a class or
+    ``functools.partial(Class, ...)``, not a zero-argument lambda (the
+    wrapper instantiates it with a ``name``).  Stage parameters are made
     per-rank with :class:`ModuleShard` — each pipe rank initializes and owns
     only its stage — and the GPipe schedule above moves activations through
     the ranks.

@@ -28,7 +28,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpu_parallel.core.accumulate import LossFn, accumulate_gradients
-from tpu_parallel.core.metrics import Metrics, sync_metrics
+from tpu_parallel.core.metrics import Metrics, accumulate_metrics, sync_metrics
 from tpu_parallel.core.state import TrainState
 from tpu_parallel.parallel import fsdp
 
@@ -134,8 +134,7 @@ def build_train_functions(
             grads = fsdp.sync_gradients(grads, grad_sync_axes, psum_axes=grad_psum_axes)
         new_state = state.apply_gradients(grads=grads, rng=rng)
         step_metrics = sync_metrics(step_metrics, metric_axes) if metric_axes else step_metrics
-        if metrics is not None:
-            step_metrics = jax.tree_util.tree_map(jnp.add, metrics, step_metrics)
+        step_metrics = accumulate_metrics(metrics, step_metrics)
         return new_state, step_metrics
 
     step_sharded = jax.shard_map(

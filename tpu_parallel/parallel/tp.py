@@ -25,7 +25,7 @@ Design (shard_map idiom — every function here runs per-device inside a
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, Optional, Tuple
+from typing import Any, Callable, Optional
 
 import flax.linen as nn
 import jax
@@ -35,6 +35,23 @@ from jax import lax
 from tpu_parallel.core.rng import fold_rng_over_axis
 
 Pytree = Any
+
+
+def axis_size_or_none(axis_name: str):
+    """Size of a bound mesh axis, or ``None`` outside any shard_map binding it.
+
+    Lets the TP layers degrade to plain dense compute when the model runs
+    without a mesh (single-device inference, abstract param counting) — the
+    structural-TP design means the same module definition must work in both
+    worlds.  Note the *parameter tree differs* between the two: under a mesh,
+    weights are ModuleShard-stacked ``nn.Partitioned``; without one they are
+    plain Dense params.  To reuse mesh-trained checkpoints on one device,
+    load them under a size-1 mesh instead.
+    """
+    try:
+        return lax.psum(1, axis_name)
+    except NameError:
+        return None
 
 
 def stack_params(
@@ -98,6 +115,9 @@ class ModuleShard(nn.Module):
 
     @nn.compact
     def __call__(self, *args, **kwargs):
+        if axis_size_or_none(self.axis_name) is None:
+            # No mesh axis bound: plain single-copy module.
+            return self.module_fn(name="sharded")(*args, **kwargs)
         if self.is_initializing():
             # Decorrelate per-device init draws.
             rng = self.scope.rngs["params"]
@@ -156,7 +176,18 @@ class TPDense(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        tp_size = jax.lax.psum(1, self.axis_name)
+        tp_size = axis_size_or_none(self.axis_name)
+        if tp_size is None:
+            # No mesh: ordinary Dense with the full feature count.  Named
+            # "shard" so the top-level param key matches the mesh layout.
+            return nn.Dense(
+                features=self.features,
+                use_bias=self.use_bias,
+                dtype=self.dtype,
+                kernel_init=self.kernel_init,
+                bias_init=self.bias_init,
+                name="shard",
+            )(x)
         if self.style == "column":
             if self.features % tp_size != 0:
                 raise ValueError(
@@ -177,12 +208,22 @@ class TPDense(nn.Module):
         elif self.style == "row":
             if self.split_input:
                 x = split_over_axis(x, self.axis_name, axis=-1)
+
+            # Each shard sees fan_in/tp, so a variance-scaling init (lecun/he)
+            # would come out sqrt(tp) too wide and the psum of tp shards would
+            # start with tp-times the dense output variance.  Rescale to the
+            # global fan-in so init statistics are tp-degree-invariant.
+            def row_kernel_init(key, shape, dtype=jnp.float32):
+                return self.kernel_init(key, shape, dtype) * (
+                    1.0 / jnp.sqrt(tp_size).astype(dtype)
+                )
+
             dense_fn = functools.partial(
                 nn.Dense,
                 features=self.features,
                 use_bias=False,
                 dtype=self.dtype,
-                kernel_init=self.kernel_init,
+                kernel_init=row_kernel_init,
             )
             y = ModuleShard(dense_fn, axis_name=self.axis_name, name="shard")(x)
             with jax.named_scope("tp_row_psum"):

@@ -1,0 +1,17 @@
+from tpu_parallel.utils.logging_utils import MetricLogger
+from tpu_parallel.utils.profiling import (
+    mfu,
+    peak_flops,
+    timeit,
+    trace,
+    transformer_flops_per_token,
+)
+
+__all__ = [
+    "MetricLogger",
+    "mfu",
+    "peak_flops",
+    "timeit",
+    "trace",
+    "transformer_flops_per_token",
+]

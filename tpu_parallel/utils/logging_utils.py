@@ -1,0 +1,42 @@
+"""Metric logging: stdout + JSONL file sink.
+
+Replaces the reference's ``print_metrics``-only observability
+(``util.py:170-181``) with a logger that keeps machine-readable history
+(one JSON object per log step) next to the human-readable stream — and only
+on process 0 of a multi-host run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Optional
+
+import jax
+
+
+class MetricLogger:
+    def __init__(self, logdir: Optional[str] = None, name: str = "train"):
+        self.is_main = jax.process_index() == 0
+        self.file = None
+        if logdir and self.is_main:
+            os.makedirs(logdir, exist_ok=True)
+            self.path = os.path.join(logdir, f"{name}.jsonl")
+            self.file = open(self.path, "a")
+        self._t0 = time.time()
+
+    def log(self, step: int, metrics: Dict[str, float]) -> None:
+        if not self.is_main:
+            return
+        record = {"step": step, "time": round(time.time() - self._t0, 3), **metrics}
+        parts = " ".join(f"{k}={v:.5g}" for k, v in sorted(metrics.items()))
+        print(f"[step {step}] {parts}", flush=True)
+        if self.file is not None:
+            self.file.write(json.dumps(record) + "\n")
+            self.file.flush()
+
+    def close(self) -> None:
+        if self.file is not None:
+            self.file.close()
+            self.file = None

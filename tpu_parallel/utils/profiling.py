@@ -1,0 +1,85 @@
+"""Profiling, timing, and MFU accounting.
+
+The reference's entire observability story is ``jax.named_scope`` labels
+(SURVEY.md §5, tracing row).  This module keeps those (every collective in
+the framework is scoped) and adds what the reference lacked: a
+``jax.profiler`` trace context for Perfetto/XProf, a ``block_until_ready``
+timing harness, and model-FLOPs-utilization math for the benchmark harness.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Callable, Dict, Optional
+
+import jax
+
+# Dense bf16 peak FLOPs/s per chip.
+PEAK_FLOPS_BY_KIND = {
+    "tpu v5 lite": 197e12,
+    "tpu v5litepod": 197e12,
+    "tpu v5": 197e12,
+    "tpu v4": 275e12,
+    "tpu v6 lite": 918e12,
+    "tpu v6": 918e12,
+}
+
+
+def peak_flops(device=None) -> Optional[float]:
+    """Peak bf16 FLOPs/s for ``device`` (None if unknown, e.g. CPU)."""
+    device = device or jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in PEAK_FLOPS_BY_KIND.items():
+        if key in kind:
+            return val
+    return None
+
+
+def transformer_flops_per_token(cfg) -> float:
+    """Training FLOPs per token: 6*N for the matmul params + attention term.
+
+    Standard PaLM-appendix accounting: 6 FLOPs per parameter per token
+    (fwd 2 + bwd 4) over matmul-participating params, plus
+    ``12 * L * d * T`` for the T-length causal attention (QK^T, softmax*V,
+    fwd+bwd).  Embedding lookups are excluded (gather, not matmul); the
+    untied lm_head matmul is included.
+    """
+    matmul_params = (
+        cfg.vocab_size * cfg.d_model  # lm_head projection
+        + cfg.n_layers * (4 * cfg.d_model**2 + 2 * cfg.mlp_ratio * cfg.d_model**2)
+    )
+    attn = 12 * cfg.n_layers * cfg.d_model * cfg.seq_len
+    return 6 * matmul_params + attn
+
+
+def mfu(tokens_per_sec_per_chip: float, cfg, device=None) -> Optional[float]:
+    peak = peak_flops(device)
+    if peak is None:
+        return None
+    return tokens_per_sec_per_chip * transformer_flops_per_token(cfg) / peak
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """``with trace("/tmp/trace"):`` — dumps an XProf/Perfetto trace."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def timeit(
+    fn: Callable, *args, iters: int = 10, warmup: int = 3, **kwargs
+) -> float:
+    """Mean seconds per call, with compile excluded and device-synced timing."""
+    out = None
+    for _ in range(warmup):
+        out = fn(*args, **kwargs)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args, **kwargs)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
