@@ -52,6 +52,18 @@ def simulate_cpu_devices(num_devices: int = 8) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+    # Post-condition, not an assert (must survive `python -O`): if another
+    # backend was already initialized, the config update above silently has
+    # no effect and every later mesh/reshape error would be obscure.
+    devices = jax.devices()
+    if devices[0].platform != "cpu" or len(devices) != num_devices:
+        raise RuntimeError(
+            f"simulate_cpu_devices({num_devices}) failed: backend is "
+            f"{len(devices)} x {devices[0].platform!r} — a JAX backend was "
+            "initialized before this call (it must run before the first "
+            "jax.devices()/compilation in the process)"
+        )
     _SIMULATED = True
 
 
