@@ -22,9 +22,14 @@ def main():
     on_tpu = device.platform == "tpu"
     n_chips = jax.device_count()
 
+    from tpu_parallel.core import compute as compute_metrics
     from tpu_parallel.runtime import MeshConfig
     from tpu_parallel.train_lib import Trainer, TrainerConfig
-    from tpu_parallel.utils.profiling import peak_flops, transformer_flops_per_token
+    from tpu_parallel.utils.profiling import (
+        peak_flops,
+        sync,
+        transformer_flops_per_token,
+    )
 
     if on_tpu:
         model, batch, steps, minib = "gpt2_125m", 8 * n_chips, 20, 1
@@ -48,17 +53,21 @@ def main():
 
     tokens_per_step = batch * trainer.model_config.seq_len
 
-    # warmup (compile + first steps)
+    # warmup (compile + first steps).  Sync via a device->host scalar read:
+    # on some transports block_until_ready returns before execution finishes,
+    # which would inflate throughput; a value fetch cannot lie.
     state, metrics = trainer.state, None
     for _ in range(3):
         state, metrics = trainer.funcs.step_fn(state, metrics, trainer.example_batch)
-    jax.block_until_ready(state)
+    sync((state, metrics))
 
+    metrics = None  # drop warmup-step sums so final_loss covers timed steps only
     t0 = time.perf_counter()
     for _ in range(steps):
         state, metrics = trainer.funcs.step_fn(state, metrics, trainer.example_batch)
-    jax.block_until_ready(state)
+    sync((state, metrics))
     dt = time.perf_counter() - t0
+    final_loss = compute_metrics(metrics)["loss"]
 
     tokens_per_sec = tokens_per_step * steps / dt
     tokens_per_sec_chip = tokens_per_sec / n_chips
@@ -81,6 +90,7 @@ def main():
                 "global_batch": batch,
                 "seq_len": trainer.model_config.seq_len,
                 "steps_timed": steps,
+                "final_loss": round(final_loss, 4),
             }
         )
     )

@@ -55,8 +55,10 @@ def simulate_cpu_devices(num_devices: int = 8) -> None:
 
     # Post-condition, not an assert (must survive `python -O`): if another
     # backend was already initialized, the config update above silently has
-    # no effect and every later mesh/reshape error would be obscure.
-    devices = jax.devices()
+    # no effect and every later mesh/reshape error would be obscure.  Local
+    # devices, so the check is also correct under multi-process fakes
+    # (jax.devices() is global across processes).
+    devices = jax.local_devices()
     if devices[0].platform != "cpu" or len(devices) != num_devices:
         raise RuntimeError(
             f"simulate_cpu_devices({num_devices}) failed: backend is "

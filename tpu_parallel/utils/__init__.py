@@ -2,6 +2,7 @@ from tpu_parallel.utils.logging_utils import MetricLogger
 from tpu_parallel.utils.profiling import (
     mfu,
     peak_flops,
+    sync,
     timeit,
     trace,
     transformer_flops_per_token,
@@ -11,6 +12,7 @@ __all__ = [
     "MetricLogger",
     "mfu",
     "peak_flops",
+    "sync",
     "timeit",
     "trace",
     "transformer_flops_per_token",

@@ -70,6 +70,26 @@ def trace(logdir: str):
         jax.profiler.stop_trace()
 
 
+def sync(out) -> None:
+    """Force completion of every array in the pytree ``out``.
+
+    ``block_until_ready`` (all shards, all leaves) plus a device->host fetch
+    of one element: on some transports (e.g. tunneled single-chip setups)
+    ``block_until_ready`` can return before execution finishes; reading a
+    value back cannot.  The fetch indexes a single element (no ``ravel``
+    copy, works on non-fully-addressable arrays via the XLA slice path).
+    """
+    out = jax.block_until_ready(out)
+    leaves = [
+        l
+        for l in jax.tree_util.tree_leaves(out)
+        if hasattr(l, "shape") and getattr(l, "size", 0) > 0
+    ]
+    if leaves:
+        leaf = leaves[0]
+        jax.device_get(leaf[(0,) * leaf.ndim])
+
+
 def timeit(
     fn: Callable, *args, iters: int = 10, warmup: int = 3, **kwargs
 ) -> float:
@@ -77,9 +97,9 @@ def timeit(
     out = None
     for _ in range(warmup):
         out = fn(*args, **kwargs)
-    jax.block_until_ready(out)
+    sync(out)
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args, **kwargs)
-    jax.block_until_ready(out)
+    sync(out)
     return (time.perf_counter() - t0) / iters

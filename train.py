@@ -22,18 +22,16 @@ _CONFIG = config_flags.DEFINE_config_file("config", None, "Training config file.
 def main(argv):
     del argv
     cd = _CONFIG.value
-    sim = cd.get("simulate_cpu_devices", 0)
-    if sim:
-        from tpu_parallel.runtime import simulate_cpu_devices
-
-        simulate_cpu_devices(sim)
-
-    import jax
-
-    from tpu_parallel.runtime import initialize, process_info
+    from tpu_parallel.runtime import initialize, process_info, simulate_cpu_devices
     from tpu_parallel.train_lib import Trainer, TrainerConfig
 
+    # Distributed bootstrap first: jax.distributed.initialize must run before
+    # the first backend touch (simulate_cpu_devices initializes the backend to
+    # validate its post-condition).
     initialize()
+    sim = cd.get("simulate_cpu_devices", 0)
+    if sim:
+        simulate_cpu_devices(sim)
     logging.info("topology: %s", process_info())
 
     trainer_cd = dict(cd)
