@@ -1,0 +1,98 @@
+"""Perf sweep for GPT-2 125M on the available chip: batch x remat x attn.
+
+Prints one JSON line per config with tokens/sec/chip and MFU; used to pick
+bench.py defaults.  Not part of the driver contract — a tuning tool.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+
+def run_one(batch, remat, attn_impl, steps=12, minib=1, scan_layers=True):
+    from tpu_parallel.core import compute as compute_metrics
+    from tpu_parallel.runtime import MeshConfig
+    from tpu_parallel.train_lib import Trainer, TrainerConfig
+    from tpu_parallel.utils.profiling import (
+        peak_flops,
+        sync,
+        transformer_flops_per_token,
+    )
+
+    overrides = dict(dropout_rate=0.0, attn_impl=attn_impl, scan_layers=scan_layers)
+    if remat == "dots":
+        overrides.update(remat=True, remat_policy="dots")
+    else:
+        overrides.update(remat=remat == "1")
+    config = TrainerConfig(
+        model="gpt2_125m",
+        model_overrides=overrides,
+        mesh=MeshConfig(data=-1),
+        global_batch_size=batch,
+        num_minibatches=minib,
+        steps=steps,
+        log_every=10_000,
+        donate=True,
+    )
+    trainer = Trainer(config)
+    trainer.init()
+    state, metrics = trainer.state, None
+    for _ in range(3):
+        state, metrics = trainer.funcs.step_fn(state, metrics, trainer.example_batch)
+    sync((state, metrics))
+    metrics = None
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = trainer.funcs.step_fn(state, metrics, trainer.example_batch)
+    sync((state, metrics))
+    dt = time.perf_counter() - t0
+
+    device = jax.devices()[0]
+    tokens_per_sec = batch * trainer.model_config.seq_len * steps / dt
+    flops_per_token = transformer_flops_per_token(trainer.model_config)
+    peak = peak_flops(device) or 197e12
+    mfu = tokens_per_sec * flops_per_token / peak / jax.device_count()
+    return dict(
+        batch=batch,
+        remat=remat,
+        attn=attn_impl,
+        tokens_per_sec_chip=round(tokens_per_sec / jax.device_count(), 1),
+        mfu=round(mfu, 4),
+        final_loss=round(compute_metrics(metrics)["loss"], 3),
+    )
+
+
+def main():
+    combos = []
+    for arg in sys.argv[1:]:
+        parts = arg.split(",")
+        b, r, a = parts[:3]
+        minib = int(parts[3]) if len(parts) > 3 else 1
+        scan = parts[4] != "0" if len(parts) > 4 else True
+        combos.append((int(b), r, a, minib, scan))
+    if not combos:
+        combos = [(16, "1", "xla", 1, True), (32, "1", "xla", 1, True)]
+    for batch, remat, attn, minib, scan in combos:
+        try:
+            result = run_one(batch, remat, attn, minib=minib, scan_layers=scan)
+            result["minib"], result["scan"] = minib, scan
+            print(json.dumps(result), flush=True)
+        except Exception as e:  # OOM etc — report and keep sweeping
+            print(
+                json.dumps(
+                    dict(
+                        batch=batch, remat=remat, attn=attn, minib=minib,
+                        scan=scan, error=repr(e)[:200],
+                    )
+                ),
+                flush=True,
+            )
+
+
+if __name__ == "__main__":
+    main()

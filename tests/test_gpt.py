@@ -161,3 +161,68 @@ def test_gpt_llama_variant_forward(rng):
     out = model.apply(variables, tokens, train=False)
     assert out.shape == (2, 16, cfg.vocab_size)
     assert out.dtype == jnp.float32
+
+
+def test_gqa_matches_mha_when_kv_replicated(rng):
+    """GQA with duplicated KV groups computes the same attention as MHA.
+
+    Build a GQA model (n_kv_heads=2 of 4), copy its Q/KV projections into an
+    MHA model whose K/V head weights repeat each KV group — outputs must
+    match exactly.
+    """
+    cfg_gqa = tiny_test(n_kv_heads=2, remat=False, scan_layers=False, n_layers=1)
+    cfg_mha = tiny_test(remat=False, scan_layers=False, n_layers=1)
+    tokens = jax.random.randint(rng, (2, 16), 0, cfg_gqa.vocab_size)
+    model_g = GPTLM(cfg_gqa)
+    vars_g = model_g.init({"params": jax.random.PRNGKey(3)}, tokens, train=False)
+
+    hd, nh, nkv = cfg_gqa.head_dim, cfg_gqa.n_heads, 2
+    attn_g = vars_g["params"]["blocks"]["layer_0"]["attn"]
+    wq = attn_g["q"]["shard"]["kernel"]  # [d, nh*hd]
+    wkv = attn_g["kv"]["shard"]["kernel"].reshape(-1, nkv, 2 * hd)
+    wk, wv = wkv[..., :hd], wkv[..., hd:]  # [d, nkv, hd]
+    rep = nh // nkv
+    wk_full = jnp.repeat(wk, rep, axis=1).reshape(-1, nh * hd)
+    wv_full = jnp.repeat(wv, rep, axis=1).reshape(-1, nh * hd)
+    # MHA fused qkv kernel layout: [d, nh, 3*hd] flattened
+    wqkv = jnp.concatenate(
+        [
+            wq.reshape(-1, nh, hd),
+            wk_full.reshape(-1, nh, hd),
+            wv_full.reshape(-1, nh, hd),
+        ],
+        axis=-1,
+    ).reshape(-1, nh * 3 * hd)
+    bq = attn_g["q"]["shard"]["bias"].reshape(nh, hd)
+    bkv = attn_g["kv"]["shard"]["bias"].reshape(nkv, 2 * hd)
+    bk = jnp.repeat(bkv[:, :hd], rep, axis=0)
+    bv = jnp.repeat(bkv[:, hd:], rep, axis=0)
+    bqkv = jnp.concatenate([bq, bk, bv], axis=-1).reshape(nh * 3 * hd)
+
+    model_m = GPTLM(cfg_mha)
+    vars_m = model_m.init({"params": jax.random.PRNGKey(3)}, tokens, train=False)
+    params_m = jax.tree_util.tree_map(lambda x: x, vars_m["params"])
+    attn_m = params_m["blocks"]["layer_0"]["attn"]
+    attn_m["qkv"] = {"shard": {"kernel": wqkv, "bias": bqkv}}
+    attn_m["out"] = attn_g["out"]
+    for shared in ("embed", "norm_final", "lm_head"):
+        params_m[shared] = vars_g["params"][shared]
+    for shared in ("norm_attn", "norm_mlp", "mlp"):
+        params_m["blocks"]["layer_0"][shared] = vars_g["params"]["blocks"][
+            "layer_0"
+        ][shared]
+
+    out_g = model_g.apply(vars_g, tokens, train=False)
+    out_m = model_m.apply({"params": params_m}, tokens, train=False)
+    np.testing.assert_allclose(
+        np.asarray(out_g), np.asarray(out_m), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_gqa_tp_training(mesh_data4_model2, rng):
+    """GQA trains under tensor parallelism (kv heads split across tp=2)."""
+    cfg = tiny_test(n_kv_heads=2)
+    first, last, _ = _train(
+        mesh_data4_model2, cfg, rng, grad_sync_axes=("data", "model")
+    )
+    assert last < first

@@ -62,8 +62,23 @@ class GPTLM(nn.Module):
         positions: Optional[jax.Array] = None,
         segment_ids: Optional[jax.Array] = None,
         train: bool = True,
+        decode: bool = False,
     ) -> jax.Array:
         cfg = self.config
+        if decode and cfg.pipe_size > 1:
+            raise NotImplementedError("incremental decoding under pipeline parallelism")
+        if decode and positions is None:
+            # default decode positions from a model-level step counter, so
+            # learned positional embeddings see global positions (Attention
+            # keeps its own per-layer cache index for the K/V mask — both
+            # advance by the same token count and stay consistent)
+            counter = self.variable(
+                "cache", "decode_pos", lambda: jnp.zeros((), jnp.int32)
+            )
+            positions = jnp.broadcast_to(
+                counter.value + jnp.arange(tokens.shape[1])[None, :], tokens.shape
+            )
+            counter.value = counter.value + tokens.shape[1]
         embed_cls = Embedding
         if cfg.fsdp:
             embed_cls = fsdp.shard_module_params(
@@ -93,7 +108,11 @@ class GPTLM(nn.Module):
             )(x, train=train)
         else:
             x = BlockStack(cfg, cfg.n_layers, name="blocks")(
-                x, positions=positions, segment_ids=segment_ids, train=train
+                x,
+                positions=positions,
+                segment_ids=segment_ids,
+                train=train,
+                decode=decode,
             )
 
         x = make_norm(cfg, "norm_final")(x).astype(cfg.dtype)

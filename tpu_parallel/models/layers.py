@@ -24,6 +24,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 
 from tpu_parallel.parallel.tp import TPDense, axis_size_or_none
 
@@ -36,6 +37,9 @@ class TransformerConfig:
     d_model: int = 768
     n_layers: int = 12
     n_heads: int = 12
+    # grouped-query attention: number of K/V heads (None = MHA; 1 = MQA).
+    # Q heads are grouped onto the K/V heads by repetition after RoPE.
+    n_kv_heads: Optional[int] = None
     seq_len: int = 1024
     mlp_ratio: int = 4
     dropout_rate: float = 0.0
@@ -54,6 +58,13 @@ class TransformerConfig:
     seq_axis: str = "seq"
     num_microbatches: int = 4  # pipeline schedule depth (used when pipe > 1)
     remat: bool = True
+    # remat granularity: "full" recomputes everything in the backward pass;
+    # "proj" saves only the named projection outputs (qkv/out/up/down) so the
+    # backward recomputes just norms, elementwise ops, and attention probs —
+    # most of full-remat's memory win without re-running the big matmuls;
+    # "dots" saves every matmul output (includes O(seq^2) attention scores —
+    # only viable at short sequence or small batch)
+    remat_policy: str = "full"
     scan_layers: bool = True
     fsdp: bool = False  # shard big params over the data axis (ZeRO-3)
     fsdp_min_size: int = 2**18
@@ -119,12 +130,37 @@ def causal_attention(
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def decode_attention(
+    q: jax.Array, k_all: jax.Array, v_all: jax.Array, positions: jax.Array
+) -> jax.Array:
+    """Attention of new queries against a full KV cache.
+
+    ``q``: [batch, new_len, heads, head_dim] at global ``positions``
+    [batch, new_len]; ``k_all``/``v_all``: [batch, cache_len, heads,
+    head_dim] where entries beyond the write index are zeros and masked out
+    by the position comparison (cache slot j holds global position j).
+    """
+    head_dim = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(head_dim).astype(q.dtype)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k_all).astype(jnp.float32)
+    k_pos = jnp.arange(k_all.shape[1])
+    mask = k_pos[None, None, None, :] <= positions[:, None, :, None]
+    scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v_all)
+
+
 class Attention(nn.Module):
     """Multi-head causal self-attention, heads sharded over the model axis.
 
     QKV is one fused column-parallel projection (each model rank owns
     ``n_heads / tp`` heads); the output projection is row-parallel, closing
     the Megatron f/g pair with a single psum.
+
+    ``decode=True`` switches to incremental decoding: K/V are appended to a
+    ``cache`` collection of length ``seq_len`` (created on first mutable
+    apply), and queries attend to the full cache prefix.  The same path
+    serves prefill (multi-token write at index 0) and per-token decode.
     """
 
     config: TransformerConfig
@@ -138,30 +174,134 @@ class Attention(nn.Module):
         positions: Optional[jax.Array] = None,
         segment_ids: Optional[jax.Array] = None,
         train: bool = True,
+        decode: bool = False,
     ) -> jax.Array:
         cfg = self.config
         tp_size = axis_size_or_none(cfg.model_axis) or 1
+        n_kv = cfg.n_kv_heads or cfg.n_heads
         if cfg.n_heads % tp_size != 0:
             raise ValueError(f"n_heads={cfg.n_heads} not divisible by tp={tp_size}")
+        if n_kv % tp_size != 0 or cfg.n_heads % n_kv != 0:
+            raise ValueError(
+                f"n_kv_heads={n_kv} must divide n_heads={cfg.n_heads} and be "
+                f"divisible by tp={tp_size}"
+            )
         local_heads = cfg.n_heads // tp_size
-        qkv = TPDense(
-            features=3 * cfg.d_model,
-            axis_name=cfg.model_axis,
-            style="column",
-            dtype=cfg.dtype,
-            name="qkv",
-        )(x)
-        qkv = qkv.reshape(*x.shape[:-1], local_heads, 3 * cfg.head_dim)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        local_kv = n_kv // tp_size
+        if n_kv == cfg.n_heads:
+            qkv = TPDense(
+                features=3 * cfg.d_model,
+                axis_name=cfg.model_axis,
+                style="column",
+                dtype=cfg.dtype,
+                name="qkv",
+            )(x)
+            qkv = checkpoint_name(qkv, "proj")
+            qkv = qkv.reshape(*x.shape[:-1], local_heads, 3 * cfg.head_dim)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+        else:
+            # GQA: separate projections (Q is n_heads wide, KV n_kv wide)
+            q = TPDense(
+                features=cfg.n_heads * cfg.head_dim,
+                axis_name=cfg.model_axis,
+                style="column",
+                dtype=cfg.dtype,
+                name="q",
+            )(x)
+            q = checkpoint_name(q, "proj").reshape(
+                *x.shape[:-1], local_heads, cfg.head_dim
+            )
+            kv = TPDense(
+                features=2 * n_kv * cfg.head_dim,
+                axis_name=cfg.model_axis,
+                style="column",
+                dtype=cfg.dtype,
+                name="kv",
+            )(x)
+            kv = checkpoint_name(kv, "proj").reshape(
+                *x.shape[:-1], local_kv, 2 * cfg.head_dim
+            )
+            k, v = jnp.split(kv, 2, axis=-1)
+        if decode:
+            if axis_size_or_none(cfg.seq_axis) and cfg.attn_impl in (
+                "ring",
+                "ulysses",
+            ):
+                raise NotImplementedError(
+                    "incremental decoding under sequence parallelism"
+                )
+            if segment_ids is not None:
+                raise NotImplementedError(
+                    "incremental decoding with packed sequences (segment_ids)"
+                )
+            b = x.shape[0]
+            # cache at K/V-head width (local_kv): under GQA this is the whole
+            # point — n_heads/n_kv less cache HBM; groups expand after read
+            cached_k = self.variable(
+                "cache",
+                "cached_key",
+                jnp.zeros,
+                (b, cfg.seq_len, local_kv, cfg.head_dim),
+                cfg.dtype,
+            )
+            cached_v = self.variable(
+                "cache",
+                "cached_value",
+                jnp.zeros,
+                (b, cfg.seq_len, local_kv, cfg.head_dim),
+                cfg.dtype,
+            )
+            cache_index = self.variable(
+                "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            idx = cache_index.value
+            if positions is None:
+                positions = jnp.broadcast_to(
+                    idx + jnp.arange(x.shape[1])[None, :], x.shape[:2]
+                )
         if cfg.positional == "rope":
             if positions is None:
                 local = jnp.arange(x.shape[1])
-                if cfg.attn_impl == "ring" and axis_size_or_none(cfg.seq_axis):
+                if cfg.attn_impl in ("ring", "ulysses") and axis_size_or_none(
+                    cfg.seq_axis
+                ):
                     # seq-sharded: offset local positions to global ones
                     local = local + lax.axis_index(cfg.seq_axis) * x.shape[1]
                 positions = jnp.broadcast_to(local, x.shape[:2])
             q = apply_rope(q, positions, cfg.rope_theta)
             k = apply_rope(k, positions, cfg.rope_theta)
+        group = local_heads // local_kv
+        if decode:
+            k_all = lax.dynamic_update_slice_in_dim(cached_k.value, k, idx, axis=1)
+            v_all = lax.dynamic_update_slice_in_dim(cached_v.value, v, idx, axis=1)
+            cached_k.value, cached_v.value = k_all, v_all
+            cache_index.value = idx + x.shape[1]
+            if group != 1:
+                k_all = jnp.repeat(k_all, group, axis=2)
+                v_all = jnp.repeat(v_all, group, axis=2)
+            out = decode_attention(q, k_all, v_all, positions)
+        else:
+            if group != 1:
+                # expand K/V groups to one head each; XLA fuses the broadcast
+                # into the attention matmuls, so HBM never holds the repeat
+                k = jnp.repeat(k, group, axis=2)
+                v = jnp.repeat(v, group, axis=2)
+            out = self._attend(q, k, v, segment_ids)
+        out = out.reshape(*x.shape[:-1], local_heads * cfg.head_dim)
+        out = TPDense(
+            features=cfg.d_model,
+            axis_name=cfg.model_axis,
+            style="row",
+            dtype=cfg.dtype,
+            name="out",
+        )(out)
+        out = checkpoint_name(out, "proj")
+        if cfg.dropout_rate > 0.0:
+            out = nn.Dropout(rate=cfg.dropout_rate, deterministic=not train)(out)
+        return out
+
+    def _attend(self, q, k, v, segment_ids):
+        cfg = self.config
         attn_fn = self.attn_fn
         if attn_fn is None:
             if cfg.attn_impl == "flash":
@@ -179,20 +319,20 @@ class Attention(nn.Module):
                 def attn_fn(q, k, v, segment_ids=None):
                     return ring_attention(q, k, v, axis_name=cfg.seq_axis)
 
+            elif cfg.attn_impl == "ulysses":
+                from tpu_parallel.ops.ulysses import ulysses_attention
+
+                if segment_ids is not None:
+                    raise NotImplementedError(
+                        "ulysses attention does not support packed sequences yet"
+                    )
+
+                def attn_fn(q, k, v, segment_ids=None):
+                    return ulysses_attention(q, k, v, axis_name=cfg.seq_axis)
+
             else:
                 attn_fn = causal_attention
-        out = attn_fn(q, k, v, segment_ids=segment_ids)
-        out = out.reshape(*x.shape[:-1], local_heads * cfg.head_dim)
-        out = TPDense(
-            features=cfg.d_model,
-            axis_name=cfg.model_axis,
-            style="row",
-            dtype=cfg.dtype,
-            name="out",
-        )(out)
-        if cfg.dropout_rate > 0.0:
-            out = nn.Dropout(rate=cfg.dropout_rate, deterministic=not train)(out)
-        return out
+        return attn_fn(q, k, v, segment_ids=segment_ids)
 
 
 class MLP(nn.Module):
@@ -214,17 +354,18 @@ class MLP(nn.Module):
                 features=hidden, axis_name=cfg.model_axis, style="column",
                 dtype=cfg.dtype, use_bias=False, name="up",
             )(x)
-            h = nn.silu(gate) * up
+            h = nn.silu(checkpoint_name(gate, "proj")) * checkpoint_name(up, "proj")
         else:
             h = TPDense(
                 features=hidden, axis_name=cfg.model_axis, style="column",
                 dtype=cfg.dtype, name="up",
             )(x)
-            h = nn.gelu(h)
+            h = nn.gelu(checkpoint_name(h, "proj"))
         y = TPDense(
             features=cfg.d_model, axis_name=cfg.model_axis, style="row",
             dtype=cfg.dtype, use_bias=cfg.mlp != "swiglu", name="down",
         )(h)
+        y = checkpoint_name(y, "proj")
         if cfg.dropout_rate > 0.0:
             y = nn.Dropout(rate=cfg.dropout_rate, deterministic=not train)(y)
         return y
@@ -242,11 +383,16 @@ class Block(nn.Module):
         positions: Optional[jax.Array] = None,
         segment_ids: Optional[jax.Array] = None,
         train: bool = True,
+        decode: bool = False,
     ) -> jax.Array:
         cfg = self.config
         h = make_norm(cfg, "norm_attn")(x).astype(cfg.dtype)
         x = x + Attention(cfg, name="attn")(
-            h, positions=positions, segment_ids=segment_ids, train=train
+            h,
+            positions=positions,
+            segment_ids=segment_ids,
+            train=train,
+            decode=decode,
         )
         h = make_norm(cfg, "norm_mlp")(x).astype(cfg.dtype)
         x = x + MLP(cfg, name="mlp")(h, train=train)
@@ -258,12 +404,17 @@ class _ScanBlock(nn.Module):
 
     config: TransformerConfig
     train: bool
+    decode: bool = False
 
     @nn.compact
     def __call__(self, carry, _):
         x, positions, segment_ids = carry
         x = Block(self.config, name="block")(
-            x, positions=positions, segment_ids=segment_ids, train=self.train
+            x,
+            positions=positions,
+            segment_ids=segment_ids,
+            train=self.train,
+            decode=self.decode,
         )
         return (x, positions, segment_ids), None
 
@@ -287,26 +438,47 @@ class BlockStack(nn.Module):
         positions: Optional[jax.Array] = None,
         segment_ids: Optional[jax.Array] = None,
         train: bool = True,
+        decode: bool = False,
     ) -> jax.Array:
         cfg = self.config
+        # prevent_cse=False is safe (and fastest) under scan for plain remat,
+        # but with a save-policy XLA can CSE the "recompute" against the
+        # forward and hoist per-layer score tensors out of the scan — 9G+ of
+        # stacked [layers, B, H, S, S] buffers.  Keep CSE prevention on when
+        # a policy narrows the saveable set.
+        remat_kwargs = dict(prevent_cse=cfg.remat_policy != "full")
+        if cfg.remat_policy == "dots":
+            remat_kwargs["policy"] = (
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            )
+        elif cfg.remat_policy == "proj":
+            remat_kwargs["policy"] = jax.checkpoint_policies.save_only_these_names(
+                "proj"
+            )
         if cfg.scan_layers:
             scan_target = _ScanBlock
-            if cfg.remat:
-                scan_target = nn.remat(_ScanBlock, prevent_cse=False)
+            if cfg.remat and not decode:
+                scan_target = nn.remat(_ScanBlock, **remat_kwargs)
             stacked = nn.scan(
                 scan_target,
-                variable_axes={"params": 0},
+                variable_axes={"params": 0, "cache": 0},
                 variable_broadcast=False,
                 split_rngs={"params": True, "dropout": True},
                 length=self.n_layers,
                 metadata_params={nn.PARTITION_NAME: None},
-            )(cfg, train, name="layers")
+            )(cfg, train, decode, name="layers")
             (x, _, _), _ = stacked((x, positions, segment_ids), None)
         else:
-            block_cls = nn.remat(Block, prevent_cse=False) if cfg.remat else Block
+            block_cls = (
+                nn.remat(Block, **remat_kwargs) if cfg.remat and not decode else Block
+            )
             for i in range(self.n_layers):
                 x = block_cls(cfg, name=f"layer_{i}")(
-                    x, positions=positions, segment_ids=segment_ids, train=train
+                    x,
+                    positions=positions,
+                    segment_ids=segment_ids,
+                    train=train,
+                    decode=decode,
                 )
         return x
 

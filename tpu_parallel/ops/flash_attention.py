@@ -55,13 +55,16 @@ def reference_attention(
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k, scale):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale  # [block_q, D]
+    # keep MXU operands in the input dtype (bf16 on TPU: full MXU rate) and
+    # accumulate fp32 via preferred_element_type; fp32 operands would run
+    # the systolic array at a fraction of peak
+    q = (q_ref[0] * jnp.asarray(scale, q_ref.dtype)).astype(q_ref.dtype)
     num_k_blocks = (qi + 1) * block_q // block_k  # causal: only blocks <= qi
 
     def body(ki, carry):
         acc, m_prev, l_prev = carry
-        k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(ki * block_k, block_k), :]
+        v = v_ref[0, pl.ds(ki * block_k, block_k), :]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
         q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
         k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
@@ -70,7 +73,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k, scale)
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
-        acc = acc * alpha + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        acc = acc * alpha + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32
+        )
         return acc, m_new, l_new
 
     d = q_ref.shape[-1]
@@ -79,8 +84,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k, scale)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc, m, l = lax.fori_loop(0, num_k_blocks, body, (acc, m0, l0))
     o_ref[0] = (acc / l).astype(o_ref.dtype)
-    # log-sum-exp per query row, needed by the backward pass
-    lse_ref[0] = (m + jnp.log(l))[:, 0]
+    # log-sum-exp per query row, needed by the backward pass.  Kept as a
+    # trailing length-1 lane dim: TPU blocks need the last two dims to be
+    # (8k, 128k) or full — [block_q, 1] against a [bh, s, 1] array is legal,
+    # [1, block_q] against [bh, s] is not.
+    lse_ref[0] = m + jnp.log(l)
 
 
 def _flash_fwd(
@@ -111,11 +119,11 @@ def _flash_fwd(
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda bh_, qi: (bh_, qi)),
+            pl.BlockSpec((1, block_q, 1), lambda bh_, qi: (bh_, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
         ],
         interpret=interpret,
     )(qf, kf, vf)
@@ -129,22 +137,22 @@ def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, block_q, block_k, scale
 ):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale
-    do = do_ref[0].astype(jnp.float32)  # [bq, D]
-    lse = lse_ref[0][:, None]  # [bq, 1]
-    delta = delta_ref[0][:, None]  # [bq, 1]
+    q = (q_ref[0] * jnp.asarray(scale, q_ref.dtype)).astype(q_ref.dtype)
+    do = do_ref[0]  # [bq, D]
+    lse = lse_ref[0]  # [bq, 1]
+    delta = delta_ref[0]  # [bq, 1]
     num_k_blocks = (qi + 1) * block_q // block_k
 
     def body(ki, dq):
-        k = k_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(ki * block_k, block_k), :]
+        v = v_ref[0, pl.ds(ki * block_k, block_k), :]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
         k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jnp.exp(s - lse)  # [bq, bk]
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(k.dtype)
         return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
     d = q_ref.shape[-1]
@@ -157,25 +165,30 @@ def _bwd_dkv_kernel(
     *, block_q, block_k, scale, seq_len,
 ):
     ki = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)  # [block_k, D]
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]  # [block_k, D]
+    v = v_ref[0]
     num_q_blocks = seq_len // block_q
     first_q_block = ki * block_k // block_q  # causal: q blocks >= diag only
 
     def body(qi, carry):
         dk, dv = carry
-        q = q_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32) * scale
-        do = do_ref[0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(qi * block_q, block_q)][:, None]
-        delta = delta_ref[0, pl.ds(qi * block_q, block_q)][:, None]
+        q = (
+            q_ref[0, pl.ds(qi * block_q, block_q), :]
+            * jnp.asarray(scale, q_ref.dtype)
+        ).astype(q_ref.dtype)
+        do = do_ref[0, pl.ds(qi * block_q, block_q), :]
+        lse = lse_ref[0, pl.ds(qi * block_q, block_q), :]
+        delta = delta_ref[0, pl.ds(qi * block_q, block_q), :]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
         q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
         k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jnp.exp(s - lse)
-        dv = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dv = dv + jnp.dot(
+            p.astype(do.dtype).T, do, preferred_element_type=jnp.float32
+        )
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        ds = (p * (dp - delta)).astype(q.dtype)
         dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
         return dk, dv
 
@@ -196,8 +209,8 @@ def _flash_bwd(
     delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)
     qf, kf, vf = (x.reshape(bh, s, d) for x in (q, k, v))
     dof = do.reshape(bh, s, d)
-    lsef = lse.reshape(bh, s)
-    deltaf = delta.reshape(bh, s)
+    lsef = lse.reshape(bh, s, 1)
+    deltaf = delta.reshape(bh, s, 1)
 
     dq = pl.pallas_call(
         functools.partial(
@@ -209,8 +222,8 @@ def _flash_bwd(
             pl.BlockSpec((1, s, d), lambda bh_, qi: (bh_, 0, 0)),
             pl.BlockSpec((1, s, d), lambda bh_, qi: (bh_, 0, 0)),
             pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda bh_, qi: (bh_, qi)),
-            pl.BlockSpec((1, block_q), lambda bh_, qi: (bh_, qi)),
+            pl.BlockSpec((1, block_q, 1), lambda bh_, qi: (bh_, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh_, qi: (bh_, qi, 0)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
@@ -231,8 +244,8 @@ def _flash_bwd(
             pl.BlockSpec((1, block_k, d), lambda bh_, ki: (bh_, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh_, ki: (bh_, ki, 0)),
             pl.BlockSpec((1, s, d), lambda bh_, ki: (bh_, 0, 0)),
-            pl.BlockSpec((1, s), lambda bh_, ki: (bh_, 0)),
-            pl.BlockSpec((1, s), lambda bh_, ki: (bh_, 0)),
+            pl.BlockSpec((1, s, 1), lambda bh_, ki: (bh_, 0, 0)),
+            pl.BlockSpec((1, s, 1), lambda bh_, ki: (bh_, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda bh_, ki: (bh_, ki, 0)),

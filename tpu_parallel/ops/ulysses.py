@@ -1,0 +1,63 @@
+"""Ulysses (DeepSpeed-style) sequence parallelism: all-to-all head resharding.
+
+Long-context capability complementing ring attention (no reference
+equivalent — SURVEY.md §5).  Where ring attention keeps the sequence
+sharded and rotates K/V around the mesh axis, Ulysses pays two
+``lax.all_to_all`` reshards instead: gather the full sequence while
+scattering heads, run ordinary (flash) attention per local head group, then
+reshard back.  Communication is two all-to-alls of the activations per call
+— cheaper than a full ring when heads >= axis size and the per-chip
+sequence fits HBM; ring wins when the sequence itself must never
+materialize on one chip.
+
+Requires ``n_heads % axis_size == 0`` (after any GQA head repetition).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+from jax import lax
+
+
+@jax.named_scope("ulysses_attention")
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "seq",
+    attn_fn: Optional[Callable] = None,
+    segment_ids=None,
+) -> jax.Array:
+    """Attention on seq-sharded [batch, local_seq, heads, head_dim].
+
+    Must run inside a ``shard_map`` region binding ``axis_name``.  The inner
+    ``attn_fn`` (default: the flash kernel via its own dispatch) sees
+    [batch, full_seq, heads/n, head_dim] — contiguous global sequence, so
+    plain causal masking is correct.
+    """
+    n = lax.psum(1, axis_name)
+    h = q.shape[2]
+    if h % n != 0:
+        raise ValueError(f"n_heads={h} not divisible by seq axis size {n}")
+    if attn_fn is None:
+        # flash by default: the inner attention runs over the FULL gathered
+        # sequence, so a naive softmax would materialize the [B, H/n, S, S]
+        # scores this mode exists to avoid.  flash_attention streams K/V
+        # blocks (and falls back to the reference path off-TPU / at tiny,
+        # non-128-divisible sequence lengths).
+        from tpu_parallel.ops.flash_attention import flash_attention
+
+        attn_fn = flash_attention
+
+    def gather_seq_scatter_heads(x):
+        # [B, s/n, H, D] -> [B, s, H/n, D]; tiled all_to_all concatenates
+        # the sequence chunks in rank order, restoring global order.
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    q, k, v = map(gather_seq_scatter_heads, (q, k, v))
+    out = attn_fn(q, k, v, segment_ids=segment_ids)
+    # [B, s, H/n, D] -> [B, s/n, H, D]
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
