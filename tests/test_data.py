@@ -1,0 +1,123 @@
+"""Data pipeline tests: memmap token datasets and global batch assembly."""
+
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpu_parallel.core import compute
+from tpu_parallel.data import DataLoader, TokenDataset, make_global_batch
+
+
+@pytest.fixture
+def token_file(tmp_path):
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, 256, size=10_000, dtype=np.uint16)
+    path = tmp_path / "corpus.bin"
+    TokenDataset.write_bin(str(path), tokens)
+    return str(path), tokens
+
+
+def test_dataset_windows_match_stream(token_file):
+    path, tokens = token_file
+    ds = TokenDataset(path, seq_len=64)
+    assert ds.num_windows == (10_000 - 1) // 64
+    w = ds.window(3)
+    np.testing.assert_array_equal(w, tokens[3 * 64 : 3 * 64 + 65].astype(np.int32))
+
+
+def test_dataset_batch_targets_are_shifted(token_file):
+    path, _ = token_file
+    ds = TokenDataset(path, seq_len=32)
+    batch = ds.batch(np.array([0, 5, 7]))
+    np.testing.assert_array_equal(batch.tokens[:, 1:], batch.targets[:, :-1])
+    assert batch.tokens.shape == (3, 32)
+
+
+def test_make_global_batch_is_sharded(token_file, mesh_data8):
+    path, _ = token_file
+    ds = TokenDataset(path, seq_len=16)
+    local = ds.batch(np.arange(16))
+    gb = make_global_batch(local, mesh_data8, P("data"))
+    assert gb.tokens.shape == (16, 16)
+    assert gb.tokens.sharding.spec == P("data")
+    # content preserved through the lift
+    np.testing.assert_array_equal(np.asarray(gb.tokens), local.tokens)
+
+
+def test_loader_deterministic_and_disjoint(token_file, mesh_data8):
+    path, _ = token_file
+    ds = TokenDataset(path, seq_len=16)
+    dl_a = DataLoader(ds, mesh_data8, global_batch_size=8, seed=1)
+    dl_b = DataLoader(ds, mesh_data8, global_batch_size=8, seed=1)
+    batches_a = [np.asarray(b.tokens) for b in dl_a.epoch(0)]
+    batches_b = [np.asarray(b.tokens) for b in dl_b.epoch(0)]
+    assert len(batches_a) == ds.num_windows // 8
+    for a, b in zip(batches_a, batches_b):
+        np.testing.assert_array_equal(a, b)
+    # different epoch -> different order
+    first_e1 = next(iter(dl_a.epoch(1)))
+    assert not np.array_equal(batches_a[0], np.asarray(first_e1.tokens))
+
+
+def test_loader_rejects_too_small_dataset(token_file, mesh_data8):
+    path, _ = token_file
+    ds = TokenDataset(path, seq_len=4096)  # only ~2 windows in 10k tokens
+    with pytest.raises(ValueError, match="fewer than"):
+        DataLoader(ds, mesh_data8, global_batch_size=8)
+
+
+def test_batch_at_is_step_pure(token_file, mesh_data8):
+    """batch_at(s) is a pure function of (seed, s) — the resume contract."""
+    path, _ = token_file
+    ds = TokenDataset(path, seq_len=16)
+    dl = DataLoader(ds, mesh_data8, global_batch_size=8, seed=2)
+    bpe = dl.batches_per_epoch
+    # jump around epochs in arbitrary order; same step -> same batch
+    probe = [0, bpe + 3, 1, 2 * bpe, bpe + 3, 0]
+    seen = {}
+    for s in probe:
+        tok = np.asarray(dl.batch_at(s).tokens)
+        if s in seen:
+            np.testing.assert_array_equal(tok, seen[s])
+        seen[s] = tok
+    assert not np.array_equal(seen[0], seen[bpe + 3])
+
+
+def test_loader_trains_gpt(token_file, mesh_data8, rng):
+    """Real-data smoke test: loss decreases on memmap-fed batches."""
+    import jax
+    import optax
+
+    from tpu_parallel.models import GPTLM, make_gpt_loss, tiny_test
+    from tpu_parallel.parallel.spmd import build_train_functions
+
+    path, _ = token_file
+    cfg = tiny_test()
+    ds = TokenDataset(path, seq_len=cfg.seq_len)
+    dl = DataLoader(ds, mesh_data8, global_batch_size=8, seed=0)
+    it = iter(dl)
+    first_batch = next(it)
+
+    model = GPTLM(cfg)
+    tx = optax.adamw(3e-3)
+
+    def model_init(r, b):
+        from tpu_parallel.core.state import TrainState
+
+        variables = model.init(
+            {"params": r}, b.tokens, positions=b.positions, train=False
+        )
+        return TrainState.create(
+            apply_fn=model.apply, params=variables["params"], tx=tx, rng=r
+        )
+
+    funcs = build_train_functions(
+        model_init, make_gpt_loss(cfg), mesh_data8, first_batch,
+        batch_spec=P("data"), donate=False,
+    )
+    state = funcs.init_fn(rng, first_batch)
+    state, m0 = funcs.step_fn(state, None, first_batch)
+    first = compute(m0)["loss"]
+    for _ in range(12):
+        state, m = funcs.step_fn(state, None, next(it))
+    assert compute(m)["loss"] < first

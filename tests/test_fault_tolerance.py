@@ -1,0 +1,121 @@
+"""Fault tolerance: auto-resume, periodic checkpoints, step-failure recovery."""
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_parallel.runtime import MeshConfig
+from tpu_parallel.train_lib import Trainer, TrainerConfig
+
+
+def _config(steps):
+    return TrainerConfig(
+        model="tiny",
+        model_overrides=dict(num_microbatches=1),
+        mesh=MeshConfig(data=8),
+        global_batch_size=8,
+        steps=steps,
+        log_every=100,
+        donate=False,
+        seed=3,
+    )
+
+
+def test_fit_checkpoints_and_resumes(tmp_path):
+    ckpt_dir = str(tmp_path / "run")
+    t1 = Trainer(_config(steps=6))
+    t1.fit(ckpt_dir, checkpoint_every=3)
+    assert int(t1.state.step) == 6
+
+    # a fresh process-equivalent: new Trainer, same dir -> resumes at 6,
+    # runs only the remaining 4 steps to 10
+    t2 = Trainer(_config(steps=10))
+    t2.fit(ckpt_dir, checkpoint_every=3)
+    assert int(t2.state.step) == 10
+    # resumed params came from the checkpoint, not re-init: loss continues
+    # from trained values (step-6 params differ from a fresh init)
+    fresh = Trainer(_config(steps=10))
+    fresh.init()
+    p_resumed = jax.tree_util.tree_leaves(t2.state.params)[0]
+    p_fresh = jax.tree_util.tree_leaves(fresh.state.params)[0]
+    assert not np.allclose(np.asarray(p_resumed), np.asarray(p_fresh))
+
+
+def test_fit_recovers_from_step_failure(tmp_path):
+    """A transient step failure rolls back to the last checkpoint and retries."""
+    ckpt_dir = str(tmp_path / "run")
+    t = Trainer(_config(steps=8))
+
+    real_step = t.funcs.step_fn
+    boom = {"at": 6, "done": False}
+
+    def flaky_step(state, metrics, batch):
+        if int(state.step) == boom["at"] and not boom["done"]:
+            boom["done"] = True
+            raise RuntimeError("injected device failure")
+        return real_step(state, metrics, batch)
+
+    import dataclasses
+    t.funcs = dataclasses.replace(t.funcs, step_fn=flaky_step)
+    t.fit(ckpt_dir, checkpoint_every=2)
+    assert boom["done"], "failure was never injected"
+    assert int(t.state.step) == 8
+
+
+def test_fit_data_loader_replays_exact_order(tmp_path):
+    """With a step-indexed loader, retried steps re-consume the same batches."""
+    import numpy as np
+
+    from tpu_parallel.data import DataLoader, TokenDataset
+
+    ckpt_dir = str(tmp_path / "run")
+    t = Trainer(_config(steps=6))
+    tokens = np.random.default_rng(0).integers(
+        0, 256, size=20_000, dtype=np.uint16
+    )
+    ds = TokenDataset(tokens, seq_len=t.model_config.seq_len)
+    dl = DataLoader(ds, t.mesh, global_batch_size=8, seed=1)
+
+    fed = []
+    real_batch_at = dl.batch_at
+
+    def recording_batch_at(step):
+        fed.append(step)
+        return real_batch_at(step)
+
+    dl.batch_at = recording_batch_at
+
+    real_step = t.funcs.step_fn
+    boom = {"done": False}
+
+    def flaky_step(state, metrics, batch):
+        if int(state.step) == 4 and not boom["done"]:
+            boom["done"] = True
+            raise RuntimeError("injected failure")
+        return real_step(state, metrics, batch)
+
+    import dataclasses
+
+    t.funcs = dataclasses.replace(t.funcs, step_fn=flaky_step)
+    t.fit(ckpt_dir, data_loader=dl, checkpoint_every=2)
+    assert boom["done"]
+    # steps 4 (failed), then rollback to ckpt@4? -> retried from restored
+    # step; each executed step s consumed exactly batch s, and step 4's
+    # batch was requested again after the rollback
+    assert fed.count(4) == 2, fed
+    assert int(t.state.step) == 6
+
+
+def test_fit_gives_up_after_max_failures(tmp_path):
+    ckpt_dir = str(tmp_path / "run")
+    t = Trainer(_config(steps=4))
+
+    def always_fails(state, metrics, batch):
+        raise RuntimeError("permanent failure")
+
+    # run 2 good steps first so a checkpoint exists to roll back to
+    t.fit(ckpt_dir, checkpoint_every=1, steps=2)
+    import dataclasses
+    t.funcs = dataclasses.replace(t.funcs, step_fn=always_fails)
+    with pytest.raises(RuntimeError, match="permanent failure"):
+        t.fit(ckpt_dir, checkpoint_every=1, steps=4, max_failures=2)

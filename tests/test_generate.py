@@ -1,0 +1,167 @@
+"""KV-cache generation tests: cache decode must equal full re-forwarding."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_parallel.models import GPTLM, tiny_test
+from tpu_parallel.models.generate import generate
+
+
+def _greedy_no_cache(model, params, prompt, n_new):
+    """Reference: argmax loop re-running the full forward each step."""
+    toks = prompt
+    out = []
+    for _ in range(n_new):
+        logits = model.apply({"params": params}, toks, train=False)
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        out.append(nxt)
+        toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+    return jnp.stack(out, axis=1)
+
+
+@pytest.mark.parametrize("variant", ["gpt", "llama", "gqa", "unrolled"])
+def test_generate_matches_full_forward(rng, variant):
+    overrides = dict(
+        gpt={},
+        llama=dict(positional="rope", norm="rmsnorm", mlp="swiglu"),
+        gqa=dict(n_kv_heads=2),
+        unrolled=dict(scan_layers=False),
+    )[variant]
+    cfg = tiny_test(dtype=jnp.float32, remat=False, **overrides)
+    model = GPTLM(cfg)
+    prompt = jax.random.randint(rng, (2, 5), 0, cfg.vocab_size)
+    params = model.init({"params": jax.random.PRNGKey(1)}, prompt, train=False)[
+        "params"
+    ]
+    got = generate(model, params, prompt, max_new_tokens=8, temperature=0.0)
+    want = _greedy_no_cache(model, params, prompt, 8)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_decode_default_positions_match_explicit(rng):
+    """decode=True with positions=None uses the model-level step counter.
+
+    Learned positional embeddings must see global positions even when the
+    caller omits them — prefill then one decode step must equal the same
+    calls with explicit positions.
+    """
+    cfg = tiny_test(dtype=jnp.float32, remat=False)
+    model = GPTLM(cfg)
+    prompt = jax.random.randint(rng, (2, 5), 0, cfg.vocab_size)
+    params = model.init({"params": jax.random.PRNGKey(1)}, prompt, train=False)[
+        "params"
+    ]
+
+    def run(with_positions):
+        pos_p = (
+            jnp.broadcast_to(jnp.arange(5), (2, 5)) if with_positions else None
+        )
+        logits, v = model.apply(
+            {"params": params}, prompt, positions=pos_p,
+            train=False, decode=True, mutable=["cache"],
+        )
+        tok = jnp.argmax(logits[:, -1:], axis=-1).astype(jnp.int32)
+        pos_d = jnp.full((2, 1), 5, jnp.int32) if with_positions else None
+        logits2, _ = model.apply(
+            {"params": params, "cache": v["cache"]}, tok, positions=pos_d,
+            train=False, decode=True, mutable=["cache"],
+        )
+        return logits2
+
+    np.testing.assert_allclose(
+        np.asarray(run(True)), np.asarray(run(False)), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_generate_sampling_shapes(rng):
+    cfg = tiny_test(dtype=jnp.float32, remat=False)
+    model = GPTLM(cfg)
+    prompt = jax.random.randint(rng, (3, 4), 0, cfg.vocab_size)
+    params = model.init({"params": jax.random.PRNGKey(1)}, prompt, train=False)[
+        "params"
+    ]
+    out = generate(
+        model, params, prompt, jax.random.PRNGKey(7),
+        max_new_tokens=6, temperature=0.8, top_k=5,
+    )
+    assert out.shape == (3, 6)
+    assert out.dtype == jnp.int32
+    assert (out >= 0).all() and (out < cfg.vocab_size).all()
+
+
+def test_export_single_device_params_roundtrip(mesh_data8, rng):
+    """Mesh-trained (DP) params export to the mesh-free layout and generate."""
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_parallel.data import lm_batch
+    from tpu_parallel.models import make_gpt_loss
+    from tpu_parallel.models.generate import export_single_device_params
+    from tpu_parallel.parallel.spmd import build_train_functions
+
+    cfg = tiny_test(dtype=jnp.float32)
+    batch = lm_batch(jax.random.PRNGKey(0), 8, cfg.seq_len, cfg.vocab_size)
+    model = GPTLM(cfg)
+    tx = optax.adamw(1e-3)
+
+    def model_init(r, b):
+        from tpu_parallel.core.state import TrainState
+
+        v = model.init({"params": r}, b.tokens, positions=b.positions, train=False)
+        return TrainState.create(
+            apply_fn=model.apply, params=v["params"], tx=tx, rng=r
+        )
+
+    funcs = build_train_functions(
+        model_init, make_gpt_loss(cfg), mesh_data8, batch,
+        batch_spec=P("data"), donate=False,
+    )
+    state = funcs.init_fn(rng, batch)
+    params = export_single_device_params(state.params)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    out = generate(model, params, prompt, max_new_tokens=4)
+    assert out.shape == (1, 4)
+
+
+def test_export_refuses_tp_sharded_params(mesh_data4_model2, rng):
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_parallel.data import lm_batch
+    from tpu_parallel.models import make_gpt_loss
+    from tpu_parallel.models.generate import export_single_device_params
+    from tpu_parallel.parallel.spmd import build_train_functions
+
+    cfg = tiny_test()
+    batch = lm_batch(jax.random.PRNGKey(0), 8, cfg.seq_len, cfg.vocab_size)
+    model = GPTLM(cfg)
+    tx = optax.adamw(1e-3)
+
+    def model_init(r, b):
+        from tpu_parallel.core.state import TrainState
+
+        v = model.init({"params": r}, b.tokens, positions=b.positions, train=False)
+        return TrainState.create(
+            apply_fn=model.apply, params=v["params"], tx=tx, rng=r
+        )
+
+    funcs = build_train_functions(
+        model_init, make_gpt_loss(cfg), mesh_data4_model2, batch,
+        batch_spec=P("data"), grad_sync_axes=("data", "model"), donate=False,
+    )
+    state = funcs.init_fn(rng, batch)
+    with pytest.raises(ValueError, match="split over mesh axis"):
+        export_single_device_params(state.params)
+
+
+def test_generate_rejects_overflow(rng):
+    cfg = tiny_test(dtype=jnp.float32, remat=False)
+    model = GPTLM(cfg)
+    prompt = jnp.zeros((1, cfg.seq_len - 2), jnp.int32)
+    params = model.init({"params": jax.random.PRNGKey(1)}, prompt, train=False)[
+        "params"
+    ]
+    with pytest.raises(ValueError, match="exceeds seq_len"):
+        generate(model, params, prompt, max_new_tokens=8)

@@ -1,3 +1,10 @@
+from tpu_parallel.data.loader import DataLoader, TokenDataset, make_global_batch
 from tpu_parallel.data.synthetic import classification_batch, lm_batch
 
-__all__ = ["classification_batch", "lm_batch"]
+__all__ = [
+    "DataLoader",
+    "TokenDataset",
+    "make_global_batch",
+    "classification_batch",
+    "lm_batch",
+]

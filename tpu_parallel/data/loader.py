@@ -1,0 +1,162 @@
+"""Token-stream data loading: memmap datasets + multi-host global batches.
+
+No reference capability exists (the reference trains on inline synthetic
+tensors — SURVEY.md §1 "no data-loading layer"); this supplies the input
+pipeline a real framework needs, TPU-first:
+
+- :class:`TokenDataset` reads a flat binary token file through ``np.memmap``
+  (zero-copy, no RAM blowup at corpus scale) and cuts deterministic,
+  seeded, shuffled ``seq_len+1`` windows — the standard GPT-style layout
+  (same format as nanoGPT/llm.jax ``.bin`` corpora).
+- :func:`make_global_batch` turns each process's **local** shard of a batch
+  into one logically-global sharded ``jax.Array`` via
+  ``jax.make_array_from_process_local_data`` — the multi-host feeding
+  pattern (each host reads only its slice; XLA sees a single global array
+  laid out over the mesh's data axis).
+- :class:`DataLoader` composes the two into the iterator the Trainer
+  consumes, with per-process disjoint sampling derived from
+  ``jax.process_index()``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from tpu_parallel.core.state import TextBatch
+
+
+class TokenDataset:
+    """Windows over a flat token stream (memmap file or in-memory array).
+
+    ``sample(epoch_rng, index)`` is deterministic: the same seed and index
+    always give the same window, so a resumed run (checkpointed step count)
+    replays the identical data order.
+    """
+
+    def __init__(self, tokens, seq_len: int):
+        if isinstance(tokens, (str,)):
+            tokens = np.memmap(tokens, dtype=np.uint16, mode="r")
+        self.tokens = tokens
+        self.seq_len = seq_len
+        self.num_windows = (len(tokens) - 1) // seq_len
+        if self.num_windows <= 0:
+            raise ValueError(
+                f"stream of {len(tokens)} tokens too short for seq_len={seq_len}"
+            )
+
+    @staticmethod
+    def write_bin(path: str, tokens: np.ndarray) -> None:
+        """Write a token array in the flat uint16 format ``__init__`` reads."""
+        np.asarray(tokens, dtype=np.uint16).tofile(path)
+
+    def window(self, i: int) -> np.ndarray:
+        """Window ``i``: ``seq_len + 1`` tokens (inputs + shifted targets)."""
+        start = i * self.seq_len
+        return np.asarray(self.tokens[start : start + self.seq_len + 1], np.int32)
+
+    def batch(self, order: np.ndarray) -> TextBatch:
+        """Assemble the windows in ``order`` into a TextBatch (numpy)."""
+        rows = np.stack([self.window(int(i)) for i in order])
+        seq = self.seq_len
+        return TextBatch(
+            tokens=rows[:, :-1],
+            targets=rows[:, 1:],
+            loss_mask=np.ones((len(order), seq), np.float32),
+            positions=np.broadcast_to(np.arange(seq), (len(order), seq)),
+        )
+
+
+def make_global_batch(
+    local_batch: TextBatch, mesh: Mesh, batch_spec: P = P("data")
+) -> TextBatch:
+    """Lift per-process local arrays into one global sharded TextBatch.
+
+    Each process passes its own ``global_batch/process_count`` rows;
+    ``jax.make_array_from_process_local_data`` stitches them into a global
+    array sharded by ``batch_spec`` over ``mesh`` without gathering —
+    the canonical multi-host feeding path (the single-process reference
+    never faced this; SURVEY.md §7 "multi-host correctness").
+    """
+
+    def lift(x):
+        if x is None:
+            return None
+        sharding = NamedSharding(mesh, batch_spec)
+        return jax.make_array_from_process_local_data(sharding, np.asarray(x))
+
+    return jax.tree_util.tree_map(lift, local_batch)
+
+
+@dataclasses.dataclass
+class DataLoader:
+    """Seeded, shard-aware iterator of global TextBatches.
+
+    Each epoch draws a fresh permutation of window indices from a
+    ``numpy`` RNG seeded by ``(seed, epoch)``; process ``p`` of ``P`` takes
+    rows ``p::P`` of every batch — disjoint coverage with no coordination.
+    """
+
+    dataset: TokenDataset
+    mesh: Mesh
+    global_batch_size: int
+    seed: int = 0
+    batch_spec: P = P("data")
+
+    def __post_init__(self):
+        self.process_count = jax.process_count()
+        self.process_index = jax.process_index()
+        if self.global_batch_size % self.process_count:
+            raise ValueError(
+                f"global batch {self.global_batch_size} not divisible by "
+                f"process count {self.process_count}"
+            )
+        self.local_batch_size = self.global_batch_size // self.process_count
+        if self.dataset.num_windows < self.global_batch_size:
+            raise ValueError(
+                f"dataset has {self.dataset.num_windows} windows — fewer than "
+                f"one global batch of {self.global_batch_size}"
+            )
+
+    @property
+    def batches_per_epoch(self) -> int:
+        return self.dataset.num_windows // self.global_batch_size
+
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        if getattr(self, "_order_epoch", None) != epoch:
+            self._order_epoch = epoch
+            self._order = np.random.default_rng((self.seed, epoch)).permutation(
+                self.dataset.num_windows
+            )
+        return self._order
+
+    def batch_at(self, step: int) -> TextBatch:
+        """The batch for absolute training step ``step`` (0-based).
+
+        Pure function of ``(seed, step)`` — the contract that makes
+        checkpoint resume and failure rollback replay the exact data order
+        (``Trainer.fit`` feeds from this when given a loader).
+        """
+        epoch, b = divmod(step, self.batches_per_epoch)
+        order = self._epoch_order(epoch)
+        rows = order[b * self.global_batch_size : (b + 1) * self.global_batch_size]
+        local = rows[self.process_index :: self.process_count]
+        return make_global_batch(
+            self.dataset.batch(local), self.mesh, self.batch_spec
+        )
+
+    def epoch(self, epoch: int) -> Iterator[TextBatch]:
+        for b in range(self.batches_per_epoch):
+            yield self.batch_at(epoch * self.batches_per_epoch + b)
+
+    def __iter__(self) -> Iterator[TextBatch]:
+        step = 0
+        while True:
+            yield self.batch_at(step)
+            step += 1

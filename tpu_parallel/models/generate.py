@@ -1,0 +1,101 @@
+"""Autoregressive generation with a KV cache, fully jitted.
+
+No reference capability exists (the reference is training-only tutorial
+scripts — SURVEY.md §0); this provides the inference path users expect of a
+framework.  The decode loop is a ``lax.scan`` over single-token steps: each
+step appends K/V to the per-layer ``cache`` collection
+(:class:`~tpu_parallel.models.layers.Attention` decode mode) and attends
+against the cached prefix only — O(seq) per generated token instead of the
+O(seq^2) of re-running the full forward.
+
+Works for MHA and GQA, learned and RoPE positions, scan and unrolled layer
+stacks.  TP meshes work by wrapping :func:`generate` in ``shard_map`` (the
+cache shards over heads exactly as activations do).  Pipeline-parallel
+decoding is not supported.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_parallel.models.gpt import GPTLM
+from tpu_parallel.parallel.tp import export_single_device_params  # noqa: F401  (re-export: mesh-trained state -> generate-able params)
+
+
+def _sample(logits: jax.Array, rng: jax.Array, temperature: float, top_k: int):
+    """One token per row from [batch, vocab] logits."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+@functools.partial(
+    jax.jit, static_argnums=(0,), static_argnames=("max_new_tokens", "temperature", "top_k")
+)
+def generate(
+    model: GPTLM,
+    params,
+    prompt: jax.Array,
+    rng: Optional[jax.Array] = None,
+    *,
+    max_new_tokens: int = 32,
+    temperature: float = 0.0,
+    top_k: int = 0,
+) -> jax.Array:
+    """Generate ``max_new_tokens`` continuations of ``prompt`` [batch, P].
+
+    Returns [batch, max_new_tokens] of sampled tokens (greedy when
+    ``temperature == 0``).  The prompt must fit the model's ``seq_len``
+    together with the new tokens (the cache is allocated at ``seq_len``).
+    """
+    cfg = model.config
+    b, prompt_len = prompt.shape
+    if prompt_len + max_new_tokens > cfg.seq_len:
+        raise ValueError(
+            f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds seq_len ({cfg.seq_len})"
+        )
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    # Prefill: one batched forward over the prompt creates and fills the
+    # cache ('cache' is created on the fly because it is marked mutable).
+    positions = jnp.broadcast_to(jnp.arange(prompt_len), (b, prompt_len))
+    logits, variables = model.apply(
+        {"params": params},
+        prompt,
+        positions=positions,
+        train=False,
+        decode=True,
+        mutable=["cache"],
+    )
+    rng, sub = jax.random.split(rng)
+    first = _sample(logits[:, -1], sub, temperature, top_k)
+
+    def step(carry, _):
+        cache, tok, pos, rng = carry
+        logits, updated = model.apply(
+            {"params": params, "cache": cache},
+            tok[:, None],
+            positions=jnp.full((b, 1), pos, jnp.int32),
+            train=False,
+            decode=True,
+            mutable=["cache"],
+        )
+        rng, sub = jax.random.split(rng)
+        nxt = _sample(logits[:, -1], sub, temperature, top_k)
+        return (updated["cache"], nxt, pos + 1, rng), tok
+
+    init = (variables["cache"], first, jnp.int32(prompt_len), rng)
+    (_, last, _, _), toks = lax.scan(step, init, None, length=max_new_tokens - 1)
+    # scan emits the *input* token of each step; append the final sample
+    return jnp.concatenate([toks.T, last[:, None]], axis=1)

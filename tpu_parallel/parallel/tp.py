@@ -178,16 +178,21 @@ class TPDense(nn.Module):
     def __call__(self, x: jax.Array) -> jax.Array:
         tp_size = axis_size_or_none(self.axis_name)
         if tp_size is None:
-            # No mesh: ordinary Dense with the full feature count.  Named
-            # "shard" so the top-level param key matches the mesh layout.
-            return nn.Dense(
+            # No mesh: ordinary Dense with the full feature count, laid out
+            # exactly like the mesh path (same scopes, row bias outside the
+            # shard) so ``export_single_device_params`` round-trips.
+            y = nn.Dense(
                 features=self.features,
-                use_bias=self.use_bias,
+                use_bias=self.use_bias and self.style == "column",
                 dtype=self.dtype,
                 kernel_init=self.kernel_init,
                 bias_init=self.bias_init,
                 name="shard",
             )(x)
+            if self.style == "row" and self.use_bias:
+                bias = self.param("bias", self.bias_init, (self.features,))
+                y = y + jnp.asarray(bias, y.dtype)
+            return y
         if self.style == "column":
             if self.features % tp_size != 0:
                 raise ValueError(
@@ -233,6 +238,57 @@ class TPDense(nn.Module):
                 y = y + jnp.asarray(bias, y.dtype)
             return y
         raise ValueError(f"unknown TPDense style: {self.style!r}")
+
+
+def export_single_device_params(params: Pytree) -> Pytree:
+    """Convert mesh-trained params to the mesh-free module layout.
+
+    Bridges the two parameter layouts of the structural-TP design (see
+    :func:`axis_size_or_none`): unboxes ``nn.Partitioned`` leaves, squeezes
+    stacked per-device axes of global size 1, and collapses the ModuleShard
+    ``sharded`` scope so the tree matches what the same model produces with
+    no mesh axis bound.  Use it to run single-device inference (e.g.
+    ``models.generate``) on a state trained under a DP/FSDP mesh.
+
+    Raises if a parameter is genuinely split over a >1 mesh axis (tp or
+    pipe degree > 1) — such weights live on multiple devices; run inference
+    under the same mesh instead of exporting.
+    """
+
+    def unbox(x):
+        if isinstance(x, nn.Partitioned):
+            value, names = x.value, x.names
+            for i in reversed(range(len(names))):
+                if names[i] is None:
+                    continue
+                if value.shape[i] == 1:
+                    value = jnp.squeeze(value, i)
+                elif i == 0:  # stacked ModuleShard axis with real tp/pipe degree
+                    raise ValueError(
+                        f"parameter is split over mesh axis {names[i]!r} "
+                        f"(size {value.shape[i]}); export requires tp/pipe "
+                        "degree 1 — run inference under the mesh instead"
+                    )
+                # non-leading named dims (FSDP shards of a real dim) keep
+                # their global shape after unboxing — nothing to do
+            return value
+        return x
+
+    def collapse(tree):
+        if isinstance(tree, dict):
+            if set(tree.keys()) == {"sharded"}:
+                return collapse(tree["sharded"])
+            return {k: collapse(v) for k, v in tree.items()}
+        return tree
+
+    unboxed = jax.tree_util.tree_map(
+        unbox, params, is_leaf=lambda x: isinstance(x, nn.Partitioned)
+    )
+    import flax
+
+    if isinstance(unboxed, flax.core.FrozenDict):
+        unboxed = unboxed.unfreeze()
+    return collapse(unboxed)
 
 
 class TPMLP(nn.Module):

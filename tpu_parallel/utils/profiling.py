@@ -76,14 +76,17 @@ def sync(out) -> None:
     ``block_until_ready`` (all shards, all leaves) plus a device->host fetch
     of one element: on some transports (e.g. tunneled single-chip setups)
     ``block_until_ready`` can return before execution finishes; reading a
-    value back cannot.  The fetch indexes a single element (no ``ravel``
-    copy, works on non-fully-addressable arrays via the XLA slice path).
+    value back cannot.  The fetch only runs on fully-addressable arrays
+    (eager indexing of a multi-host global array would raise); on a pod,
+    ``block_until_ready`` alone is the barrier.
     """
     out = jax.block_until_ready(out)
     leaves = [
         l
         for l in jax.tree_util.tree_leaves(out)
-        if hasattr(l, "shape") and getattr(l, "size", 0) > 0
+        if hasattr(l, "shape")
+        and getattr(l, "size", 0) > 0
+        and getattr(l, "is_fully_addressable", True)
     ]
     if leaves:
         leaf = leaves[0]
