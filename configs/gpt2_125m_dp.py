@@ -19,4 +19,9 @@ def get_config():
     c.seed = 0
     c.log_every = 10
     c.donate = True
+    # optional run plumbing (empty = disabled)
+    c.checkpoint_dir = ""
+    c.checkpoint_every = 100
+    c.data_path = ""
+    c.eval_steps = 0
     return c

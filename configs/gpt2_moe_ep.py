@@ -1,4 +1,4 @@
-"""GPT-2 125M, 1-D Megatron tensor parallelism (BASELINE config 3: v5e-8)."""
+"""GPT-2-small MoE (8 experts) with expert parallelism over the model axis."""
 
 from ml_collections import ConfigDict
 
@@ -7,12 +7,14 @@ def get_config():
     c = ConfigDict()
     c.simulate_cpu_devices = 0
     c.model = "gpt2_125m"
-    c.model_overrides = ConfigDict()
-    c.mesh = ConfigDict(dict(data=1, model=-1, pipe=1, seq=1))
-    c.global_batch_size = 32
+    c.model_overrides = ConfigDict(
+        dict(moe_experts=8, moe_capacity_factor=1.25, dropout_rate=0.0)
+    )
+    c.mesh = ConfigDict(dict(data=-1, model=4, pipe=1, seq=1))
+    c.global_batch_size = 64
     c.num_minibatches = 1
     c.steps = 100
-    c.learning_rate = 6e-4
+    c.learning_rate = 3e-4
     c.warmup_steps = 20
     c.weight_decay = 0.1
     c.grad_clip = 1.0

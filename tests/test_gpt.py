@@ -115,7 +115,8 @@ def test_gpt_3d_mesh_training(mesh_2x2x2, rng):
         rng,
         grad_sync_axes=("data", "model"),
         grad_psum_axes=("pipe",),
-        metric_axes=("data", "model", "pipe"),
+        metric_axes=("data", "pipe"),
+        metric_mean_axes=("model",),
     )
     assert last < first, f"3D-mesh loss did not decrease: {first} -> {last}"
 

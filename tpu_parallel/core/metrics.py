@@ -23,12 +23,32 @@ def metric(value: jax.Array, count: Union[int, jax.Array] = 1) -> Tuple[jax.Arra
     return (jnp.asarray(value, jnp.float32), jnp.asarray(count, jnp.float32))
 
 
-def sync_metrics(metrics: Metrics, axis_names: Union[str, Sequence[str]]) -> Metrics:
-    """All-reduce metric sums and counts over the given mesh axes."""
+def sync_metrics(
+    metrics: Metrics,
+    axis_names: Union[str, Sequence[str]],
+    mean_axes: Union[str, Sequence[str]] = (),
+) -> Metrics:
+    """All-reduce metric sums and counts over the given mesh axes.
+
+    ``axis_names``: axes whose ranks hold *disjoint* tokens (data, seq, and
+    pipe under last-stage masking) — summed.  ``mean_axes``: axes whose ranks
+    compute *replicated* metrics (the tensor-parallel axis) — averaged, so
+    token counts stay exact instead of multiplying by the axis size.
+    """
     if isinstance(axis_names, str):
         axis_names = (axis_names,)
+    if isinstance(mean_axes, str):
+        mean_axes = (mean_axes,)
+
+    def _sync(x):
+        if axis_names:
+            x = lax.psum(x, axis_names)
+        if mean_axes:
+            x = lax.pmean(x, mean_axes)
+        return x
+
     with jax.named_scope("sync_metrics"):
-        return jax.tree_util.tree_map(lambda x: lax.psum(x, axis_names), metrics)
+        return jax.tree_util.tree_map(_sync, metrics)
 
 
 def accumulate_metrics(running: Optional[Metrics], step: Metrics) -> Metrics:

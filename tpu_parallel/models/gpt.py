@@ -128,24 +128,35 @@ class GPTLM(nn.Module):
         return logits.astype(jnp.float32)
 
 
-def make_gpt_loss(config: GPTConfig):
+def make_gpt_loss(config: GPTConfig, train: bool = True):
     """Next-token CE in the accumulate_gradients loss shape, PP/TP-aware.
 
     Dropout RNG folds over every parallel axis; under PP the loss and metric
     counts are masked to the last pipe rank (the only rank with real logits).
+    ``train=False`` builds the evaluation variant (dropout off).
     """
     fold_axes = (config.data_axis, config.model_axis, config.pipe_axis)
 
     def loss_fn(params, apply_fn, batch, rng):
         dropout_rng = fold_rng_over_axis(rng, fold_axes)
-        logits = apply_fn(
-            {"params": params},
-            batch.tokens,
+        apply_kwargs = dict(
             positions=batch.positions,
             segment_ids=None if config.pipe_size > 1 else batch.segment_ids,
-            train=True,
+            train=train,
             rngs={"dropout": dropout_rng},
         )
+        aux_loss = 0.0
+        if config.moe_experts > 0:
+            logits, mods = apply_fn(
+                {"params": params}, batch.tokens, mutable=["losses"], **apply_kwargs
+            )
+            sown = jax.tree_util.tree_leaves(mods.get("losses", {}))
+            if sown:
+                # one balance term per MoE layer (stacked under scan): mean,
+                # so the weight is depth-invariant
+                aux_loss = sum(jnp.sum(leaf) for leaf in sown) / config.n_layers
+        else:
+            logits = apply_fn({"params": params}, batch.tokens, **apply_kwargs)
         loss = optax.softmax_cross_entropy_with_integer_labels(logits, batch.targets)
         mask = (
             batch.loss_mask
@@ -161,7 +172,11 @@ def make_gpt_loss(config: GPTConfig):
             "loss": (loss.sum(), n_tok),
             "accuracy": (correct.astype(jnp.float32), n_tok),
         }
-        return loss.sum() / jnp.maximum(n_tok, 1.0), metrics
+        total = loss.sum() / jnp.maximum(n_tok, 1.0)
+        if config.moe_experts > 0:
+            metrics["moe_balance"] = (aux_loss * n_tok, n_tok)
+            total = total + config.moe_balance_weight * aux_loss
+        return total, metrics
 
     return loss_fn
 

@@ -68,7 +68,12 @@ class TransformerConfig:
     scan_layers: bool = True
     fsdp: bool = False  # shard big params over the data axis (ZeRO-3)
     fsdp_min_size: int = 2**18
-    attn_impl: str = "xla"  # "xla" | "flash" | "ring"
+    attn_impl: str = "xla"  # "xla" | "flash" | "ring" | "ulysses"
+    # mixture-of-experts: 0 = dense MLP; >0 replaces every block's MLP with
+    # top-1 routed experts, expert-parallel over the model axis
+    moe_experts: int = 0
+    moe_capacity_factor: float = 1.25
+    moe_balance_weight: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -395,7 +400,12 @@ class Block(nn.Module):
             decode=decode,
         )
         h = make_norm(cfg, "norm_mlp")(x).astype(cfg.dtype)
-        x = x + MLP(cfg, name="mlp")(h, train=train)
+        if cfg.moe_experts > 0:
+            from tpu_parallel.models.moe import MoEMLP
+
+            x = x + MoEMLP(cfg, name="moe")(h, train=train)
+        else:
+            x = x + MLP(cfg, name="mlp")(h, train=train)
         return x
 
 
@@ -461,7 +471,7 @@ class BlockStack(nn.Module):
                 scan_target = nn.remat(_ScanBlock, **remat_kwargs)
             stacked = nn.scan(
                 scan_target,
-                variable_axes={"params": 0, "cache": 0},
+                variable_axes={"params": 0, "cache": 0, "losses": 0},
                 variable_broadcast=False,
                 split_rngs={"params": True, "dropout": True},
                 length=self.n_layers,

@@ -1,0 +1,149 @@
+"""Mixture-of-Experts MLP with expert parallelism (Switch-style top-1).
+
+No reference capability exists (SURVEY.md §2.2: EP "Absent"); built for the
+framework's EP slot, TPU-first:
+
+- **Static shapes everywhere**: capacity-based routing (``capacity_factor``)
+  with one-hot dispatch/combine einsums — the Mesh-TensorFlow/Switch
+  formulation that XLA compiles to dense MXU work, no dynamic gather.
+- **Expert parallelism over the ``model`` mesh axis**: each rank owns
+  ``n_experts / tp`` experts (weights stacked per-rank via ModuleShard, so
+  gradient sync already treats them as partitioned).  Activations are
+  replicated over the model axis (the batch shards over data/seq), so
+  dispatch needs no communication at all: each rank slices out its own
+  experts' slots, runs them (``1/ep`` of the expert FLOPs), and the
+  combine closes with one ``psum`` — the same collective shape as a TP
+  row-parallel projection.
+- **Router in fp32** (numerically fragile softmax over experts), activations
+  in the model dtype.
+- Load-balance auxiliary loss (Switch: ``E * sum(f_i * P_i)``) sown into a
+  ``"losses"`` collection; ``make_gpt_loss`` folds it into the objective.
+
+Works mesh-free too (no bound model axis): all experts live on the one
+device and the all_to_alls vanish — same module, same params layout rules
+as the rest of the structural-TP design.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_parallel.parallel.tp import ModuleShard, axis_size_or_none
+
+
+class ExpertFFN(nn.Module):
+    """One expert: the standard transformer FFN at model dtype."""
+
+    config: "TransformerConfig"  # noqa: F821 — forward ref, see layers.py
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.config
+        hidden = cfg.mlp_ratio * cfg.d_model
+        if cfg.mlp == "swiglu":
+            gate = nn.Dense(hidden, use_bias=False, dtype=cfg.dtype, name="gate")(x)
+            up = nn.Dense(hidden, use_bias=False, dtype=cfg.dtype, name="up")(x)
+            h = nn.silu(gate) * up
+        else:
+            h = nn.gelu(nn.Dense(hidden, dtype=cfg.dtype, name="up")(x))
+        return nn.Dense(cfg.d_model, dtype=cfg.dtype, name="down")(h)
+
+
+class MoEMLP(nn.Module):
+    """Drop-in MLP replacement: top-1 routed experts, EP over ``model``."""
+
+    config: "TransformerConfig"  # noqa: F821
+
+    @nn.compact
+    def __call__(
+        self, x: jax.Array, train: bool = True, aux_scale: jax.Array | None = None
+    ) -> jax.Array:
+        """``aux_scale``: multiplier on the sown balance loss — the pipeline
+        schedule passes 0.0 on bubble ticks so garbage activations never
+        contribute to (or take gradients from) the router regularizer."""
+        cfg = self.config
+        n_experts = cfg.moe_experts
+        ep_size = axis_size_or_none(cfg.model_axis) or 1
+        if n_experts % ep_size != 0:
+            raise ValueError(
+                f"moe_experts={n_experts} not divisible by model axis {ep_size}"
+            )
+        local_experts = n_experts // ep_size
+        b, s, d = x.shape
+        tokens = b * s
+        xf = x.reshape(tokens, d)
+
+        # --- route (fp32) ---------------------------------------------------
+        logits = nn.Dense(
+            n_experts, use_bias=False, dtype=jnp.float32, name="router"
+        )(xf.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)  # [T, E]
+        gate = jnp.max(probs, axis=-1)  # [T]
+        expert_idx = jnp.argmax(probs, axis=-1)  # [T]
+        onehot = jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.float32)
+
+        # Switch load-balance loss: E * sum_i fraction_i * router_prob_i
+        frac = onehot.mean(axis=0)
+        mean_prob = probs.mean(axis=0)
+        self.sow(
+            "losses",
+            "moe_balance",
+            n_experts * jnp.sum(frac * mean_prob),
+            reduce_fn=lambda a, b_: a + b_,
+            init_fn=lambda: jnp.float32(0.0),
+        )
+
+        # --- capacity + dispatch masks (static shapes) ----------------------
+        capacity = max(
+            1, int(cfg.moe_capacity_factor * tokens / n_experts + 0.999)
+        )
+        position = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # [T, E]
+        in_capacity = (position < capacity).astype(jnp.float32) * onehot
+        pos_idx = jnp.sum(position, axis=-1).astype(jnp.int32)  # [T]
+        pos_onehot = jax.nn.one_hot(pos_idx, capacity, dtype=jnp.float32)
+        # [T, E, C]: 1 where token t landed in slot c of expert e
+        dispatch = in_capacity[:, :, None] * pos_onehot[:, None, :]
+        combine = dispatch * gate[:, None, None]
+
+        # --- to experts -----------------------------------------------------
+        x_exp = jnp.einsum("td,tec->ecd", xf.astype(jnp.float32), dispatch)
+        x_exp = x_exp.astype(cfg.dtype)  # [E, C, d]
+        if ep_size > 1:
+            # each rank keeps its experts' slots from EVERY rank:
+            # [E, C, d] -> [E/ep, ep*C, d], rank-ordered along the slot axis
+            x_exp = lax.all_to_all(
+                x_exp, cfg.model_axis, split_axis=0, concat_axis=1, tiled=True
+            )
+
+        expert_stack = nn.vmap(
+            ExpertFFN,
+            in_axes=0,
+            out_axes=0,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+        )
+        if ep_size > 1:
+            import functools
+
+            y_exp = ModuleShard(
+                functools.partial(expert_stack, cfg),
+                axis_name=cfg.model_axis,
+                name="experts",
+            )(x_exp)
+        else:
+            y_exp = expert_stack(cfg, name="experts")(x_exp)
+
+        if ep_size > 1:
+            y_exp = lax.all_to_all(
+                y_exp, cfg.model_axis, split_axis=1, concat_axis=0, tiled=True
+            )
+
+        # --- back to tokens -------------------------------------------------
+        y = jnp.einsum("ecd,tec->td", y_exp.astype(jnp.float32), combine)
+        y = y.astype(cfg.dtype).reshape(b, s, d)
+        if cfg.dropout_rate > 0.0:
+            y = nn.Dropout(rate=cfg.dropout_rate, deterministic=not train)(y)
+        return y

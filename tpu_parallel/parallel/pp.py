@@ -117,6 +117,10 @@ def execute_pipeline(
     _, outputs = nn.scan(
         _ScanWrapper,
         variable_broadcast="params",
+        # aux-loss collections (MoE balance) stack one entry per schedule
+        # tick; bubble ticks route zero-vectors, adding a near-constant bias
+        # with negligible gradient — acceptable for the regularizer
+        variable_axes={"losses": 0},
         split_rngs={"params": False, "dropout": True},
     )(module, axis_name=axis_name, static_kwargs=tuple(sorted(kwargs.items())))(
         carry_init, inputs

@@ -67,6 +67,7 @@ class TrainFunctions:
     step_fn: Callable  # (state, metrics, batch) -> (state, metrics)
     state_specs: Pytree  # PartitionSpec pytree for the TrainState
     state_shapes: Pytree  # abstract per-device shapes (ShapeDtypeStruct)
+    eval_fn: Optional[Callable] = None  # (state, metrics, batch) -> metrics
 
 
 def build_train_functions(
@@ -79,10 +80,12 @@ def build_train_functions(
     grad_sync_axes: Union[str, Sequence[str]] = ("data",),
     grad_psum_axes: Union[str, Sequence[str]] = (),
     metric_axes: Optional[Sequence[str]] = None,
+    metric_mean_axes: Optional[Sequence[str]] = None,
     num_minibatches: int = 1,
     use_scan: bool = True,
     donate: bool = True,
     init_rng: Optional[jax.Array] = None,
+    eval_loss_fn: Optional[LossFn] = None,
 ) -> TrainFunctions:
     """Build matched (init, train_step) functions for ``mesh``.
 
@@ -97,13 +100,21 @@ def build_train_functions(
     pipe axis).  Partitioned parameters are reduced only over the axes they
     are *not* partitioned on.
 
-    ``metric_axes``: axes to psum metrics over; defaults to all mesh axes so
-    reported metrics are global regardless of strategy.
+    ``metric_axes``: axes whose ranks hold disjoint tokens — metrics are
+    psum'd over them (defaults to every >1 mesh axis except ``model``).
+    ``metric_mean_axes``: replicated-compute axes — pmean'd so counts stay
+    exact (defaults to ``model`` when >1).
     """
     if isinstance(grad_sync_axes, str):
         grad_sync_axes = (grad_sync_axes,)
     if metric_axes is None:
-        metric_axes = tuple(n for n in mesh.axis_names if mesh.shape[n] > 1)
+        metric_axes = tuple(
+            n for n in mesh.axis_names if mesh.shape[n] > 1 and n != "model"
+        )
+    if metric_mean_axes is None:
+        metric_mean_axes = tuple(
+            n for n in mesh.axis_names if mesh.shape[n] > 1 and n == "model"
+        )
     if init_rng is None:
         init_rng = jax.random.PRNGKey(0)
 
@@ -133,7 +144,8 @@ def build_train_functions(
         with jax.named_scope("sync_gradients"):
             grads = fsdp.sync_gradients(grads, grad_sync_axes, psum_axes=grad_psum_axes)
         new_state = state.apply_gradients(grads=grads, rng=rng)
-        step_metrics = sync_metrics(step_metrics, metric_axes) if metric_axes else step_metrics
+        if metric_axes or metric_mean_axes:
+            step_metrics = sync_metrics(step_metrics, metric_axes, metric_mean_axes)
         step_metrics = accumulate_metrics(metrics, step_metrics)
         return new_state, step_metrics
 
@@ -146,9 +158,31 @@ def build_train_functions(
     )
     step_fn = jax.jit(step_sharded, donate_argnums=(0, 1) if donate else ())
 
+    eval_fn = None
+    if eval_loss_fn is not None:
+
+        def eval_step(state: TrainState, metrics: Optional[Metrics], batch):
+            _, step_metrics = eval_loss_fn(
+                state.params, state.apply_fn, batch, state.rng
+            )
+            if metric_axes or metric_mean_axes:
+                step_metrics = sync_metrics(step_metrics, metric_axes, metric_mean_axes)
+            return accumulate_metrics(metrics, step_metrics)
+
+        eval_fn = jax.jit(
+            jax.shard_map(
+                eval_step,
+                mesh=mesh,
+                in_specs=(state_specs, P(), batch_spec),
+                out_specs=P(),
+                check_vma=False,
+            )
+        )
+
     return TrainFunctions(
         init_fn=init_fn,
         step_fn=step_fn,
         state_specs=state_specs,
         state_shapes=state_shapes,
+        eval_fn=eval_fn,
     )

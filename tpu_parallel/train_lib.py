@@ -133,6 +133,7 @@ class Trainer:
             grad_psum_axes=("pipe",),
             num_minibatches=config.num_minibatches,
             donate=config.donate,
+            eval_loss_fn=make_gpt_loss(self.model_config, train=False),
         )
         self.state: Optional[TrainState] = None
 
@@ -265,6 +266,16 @@ class Trainer:
             return last
         finally:
             ckpt.close()
+
+    def evaluate(self, batch_iter=None, steps: int = 10) -> Dict[str, float]:
+        """Mean metrics over ``steps`` eval batches (dropout off, no update)."""
+        if self.state is None:
+            self.init()
+        metrics = None
+        for _ in range(steps):
+            batch = next(batch_iter) if batch_iter is not None else self.example_batch
+            metrics = self.funcs.eval_fn(self.state, metrics, batch)
+        return compute_metrics(metrics)
 
     def save_checkpoint(self, directory: str, step: int, *, wait: bool = True) -> None:
         from tpu_parallel.checkpoint import Checkpointer

@@ -36,6 +36,10 @@ def main(argv):
 
     trainer_cd = dict(cd)
     trainer_cd.pop("simulate_cpu_devices", None)
+    checkpoint_dir = trainer_cd.pop("checkpoint_dir", "")
+    checkpoint_every = trainer_cd.pop("checkpoint_every", 100)
+    data_path = trainer_cd.pop("data_path", "")
+    eval_steps = trainer_cd.pop("eval_steps", 0)
     config = TrainerConfig.from_config_dict(trainer_cd)
     trainer = Trainer(config)
     logging.info(
@@ -45,12 +49,39 @@ def main(argv):
         dict(trainer.mesh.shape),
     )
 
+    data_loader = None
+    if data_path:
+        from tpu_parallel.data import DataLoader, TokenDataset
+
+        data_loader = DataLoader(
+            TokenDataset(data_path, trainer.model_config.seq_len),
+            trainer.mesh,
+            config.global_batch_size,
+            seed=config.seed,
+        )
+
     def log_fn(step, metrics):
         parts = " ".join(f"{k}={v:.4f}" for k, v in sorted(metrics.items()))
         logging.info("step %d: %s", step, parts)
 
-    final = trainer.train(log_fn=log_fn)
+    if checkpoint_dir:
+        # fault-tolerant path: auto-resume + periodic saves + exact data replay
+        final = trainer.fit(
+            checkpoint_dir,
+            data_loader=data_loader,
+            checkpoint_every=checkpoint_every,
+            log_fn=log_fn,
+        )
+    else:
+        final = trainer.train(
+            batch_iter=iter(data_loader) if data_loader else None, log_fn=log_fn
+        )
     logging.info("final: %s", final)
+    if eval_steps:
+        ev = trainer.evaluate(
+            batch_iter=iter(data_loader) if data_loader else None, steps=eval_steps
+        )
+        logging.info("eval: %s", ev)
 
 
 if __name__ == "__main__":
