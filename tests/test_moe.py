@@ -133,7 +133,7 @@ def test_moe_4way_mesh_dp_sp_ep_fsdp(rng):
 
 
 def test_moe_ep_matches_single_device_routing(mesh_data4_model2, rng):
-    """The EP all_to_all round-trip computes the same function as local MoE.
+    """EP (local expert slice + psum combine) == the same function as local MoE.
 
     Same params (EP ranks hold slices of the same stacked expert weights),
     same tokens -> forward outputs must agree.  Capacity is set high enough
@@ -186,3 +186,123 @@ def test_moe_ep_matches_single_device_routing(mesh_data4_model2, rng):
     np.testing.assert_allclose(
         np.asarray(y_local), np.asarray(y_ep), rtol=2e-4, atol=2e-4
     )
+
+
+def test_moe_ep_gradients_match_single_device(mesh_data4_model2, rng):
+    """EP grads (after pmean-over-model sync) == single-device grads.
+
+    The slice + psum-combine EP design leaves per-rank gradients *partial*
+    for everything upstream of the expert split (router, inputs); the
+    pmean over the model axis must recover the exact dense gradient —
+    this pins that contract.
+    """
+    import flax.linen as nn
+    from tpu_parallel.models.moe import MoEMLP
+    from tpu_parallel.parallel import fsdp
+
+    cfg = tiny_test(moe_experts=4, dtype=jnp.float32, moe_capacity_factor=4.0)
+    x = jax.random.normal(rng, (2, 8, cfg.d_model), jnp.float32)
+    w_out = jax.random.normal(jax.random.PRNGKey(3), x.shape, jnp.float32)
+
+    moe = MoEMLP(cfg)
+    variables = moe.init({"params": jax.random.PRNGKey(7)}, x, train=False)
+    p = variables["params"]
+
+    def local_loss(params, x):
+        y = moe.apply({"params": params}, x, train=False, mutable=["losses"])[0]
+        return jnp.sum(y * w_out)
+
+    g_local = jax.grad(local_loss)(p, x)
+
+    ep_params = {
+        "router": p["router"],
+        "experts": {
+            "sharded": jax.tree_util.tree_map(
+                lambda w: nn.Partitioned(
+                    w.reshape(2, 2, *w.shape[1:]), names=("model",) + (None,) * w.ndim
+                ),
+                p["experts"],
+            )
+        },
+    }
+
+    def ep_grads(params, x, w):
+        def loss(params):
+            y = moe.apply({"params": params}, x, train=False, mutable=["losses"])[0]
+            return jnp.sum(y * w)
+
+        g = jax.grad(loss)(params)
+        return fsdp.sync_gradients(g, ("model",))
+
+    specs = nn.get_partition_spec(ep_params)
+    g_ep = jax.jit(
+        jax.shard_map(
+            ep_grads,
+            mesh=mesh_data4_model2,
+            in_specs=(specs, P(), P()),
+            out_specs=specs,
+            check_vma=False,
+        )
+    )(ep_params, x, w_out)
+
+    np.testing.assert_allclose(
+        np.asarray(g_local["router"]["kernel"]),
+        np.asarray(g_ep["router"]["kernel"]),
+        rtol=1e-4,
+        atol=1e-5,
+    )
+    for name in g_local["experts"]:
+        for leaf in g_local["experts"][name]:
+            want = np.asarray(g_local["experts"][name][leaf])
+            got = np.asarray(
+                g_ep["experts"]["sharded"][name][leaf].value
+            ).reshape(want.shape)
+            np.testing.assert_allclose(want, got, rtol=1e-4, atol=1e-5)
+
+
+def test_pp_moe_bubble_ticks_sow_zero(mesh_pipe4_data2, rng):
+    """Pipeline bubble ticks must contribute exactly 0 to the balance loss.
+
+    With pass_validity, rank r's sown loss at tick t is nonzero only for
+    real microbatches (r <= t < r + num_microbatches).
+    """
+    import functools
+
+    from tpu_parallel.models.layers import BlockStack
+    from tpu_parallel.parallel.pp import PipelineModule
+
+    num_mb, stages = 2, 4
+    cfg = tiny_test(
+        moe_experts=2, dtype=jnp.float32, remat=False, num_microbatches=num_mb
+    )
+    module = PipelineModule(
+        stage_fn=functools.partial(BlockStack, cfg, 1),
+        num_microbatches=num_mb,
+        axis_name="pipe",
+        pass_validity=True,
+    )
+    x = jax.random.normal(rng, (4, 8, cfg.d_model), jnp.float32)
+
+    def per_tick_losses(x, r):
+        v = module.init({"params": r}, x, train=False)
+        _, mods = module.apply(v, x, train=False, mutable=["losses"])
+        leaf = jax.tree_util.tree_leaves(mods["losses"])[0]
+        return leaf.reshape(leaf.shape[0], -1).sum(-1)[None]  # [1, ticks]
+
+    per_rank = jax.jit(
+        jax.shard_map(
+            per_tick_losses,
+            mesh=mesh_pipe4_data2,
+            in_specs=(P("data"), P()),
+            out_specs=P("pipe"),
+            check_vma=False,
+        )
+    )(jnp.tile(x, (2, 1, 1)), rng)
+    per_rank = np.asarray(per_rank)  # [stages, ticks]
+    assert per_rank.shape == (stages, num_mb + stages - 1)
+    for r in range(stages):
+        for t in range(per_rank.shape[1]):
+            if r <= t < r + num_mb:
+                assert per_rank[r, t] > 0.5, (r, t, per_rank)
+            else:
+                assert per_rank[r, t] == 0.0, (r, t, per_rank)

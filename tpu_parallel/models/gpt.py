@@ -104,6 +104,9 @@ class GPTLM(nn.Module):
                 stage_fn=functools.partial(BlockStack, cfg, layers_per_stage),
                 num_microbatches=cfg.num_microbatches,
                 axis_name=cfg.pipe_axis,
+                # BlockStack accepts aux_scale: bubble ticks contribute
+                # exactly zero to sown losses (MoE balance)
+                pass_validity=True,
                 name="pipeline",
             )(x, train=train)
         else:
@@ -152,9 +155,19 @@ def make_gpt_loss(config: GPTConfig, train: bool = True):
             )
             sown = jax.tree_util.tree_leaves(mods.get("losses", {}))
             if sown:
-                # one balance term per MoE layer (stacked under scan): mean,
-                # so the weight is depth-invariant
-                aux_loss = sum(jnp.sum(leaf) for leaf in sown) / config.n_layers
+                # Normalize the tick/layer-stacked sum to a per-layer mean so
+                # the aux weight is depth- and schedule-invariant.  Without PP
+                # each of this rank's n_layers blocks sows once.  Under PP this
+                # rank's layers_per_stage blocks each sow once per REAL tick
+                # (bubble ticks are zeroed via aux_scale — pp.py), i.e.
+                # num_microbatches times.
+                if config.pipe_size > 1:
+                    denom = (
+                        config.n_layers // config.pipe_size
+                    ) * config.num_microbatches
+                else:
+                    denom = config.n_layers
+                aux_loss = sum(jnp.sum(leaf) for leaf in sown) / denom
         else:
             logits = apply_fn({"params": params}, batch.tokens, **apply_kwargs)
         loss = optax.softmax_cross_entropy_with_integer_labels(logits, batch.targets)

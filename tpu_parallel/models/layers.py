@@ -389,6 +389,7 @@ class Block(nn.Module):
         segment_ids: Optional[jax.Array] = None,
         train: bool = True,
         decode: bool = False,
+        aux_scale: Optional[jax.Array] = None,
     ) -> jax.Array:
         cfg = self.config
         h = make_norm(cfg, "norm_attn")(x).astype(cfg.dtype)
@@ -403,14 +404,15 @@ class Block(nn.Module):
         if cfg.moe_experts > 0:
             from tpu_parallel.models.moe import MoEMLP
 
-            x = x + MoEMLP(cfg, name="moe")(h, train=train)
+            x = x + MoEMLP(cfg, name="moe")(h, train=train, aux_scale=aux_scale)
         else:
             x = x + MLP(cfg, name="mlp")(h, train=train)
         return x
 
 
 class _ScanBlock(nn.Module):
-    """nn.scan target: one Block per tick, carrying (x, positions, segment_ids)."""
+    """nn.scan target: one Block per tick, carrying (x, positions, segment_ids,
+    aux_scale)."""
 
     config: TransformerConfig
     train: bool
@@ -418,15 +420,16 @@ class _ScanBlock(nn.Module):
 
     @nn.compact
     def __call__(self, carry, _):
-        x, positions, segment_ids = carry
+        x, positions, segment_ids, aux_scale = carry
         x = Block(self.config, name="block")(
             x,
             positions=positions,
             segment_ids=segment_ids,
             train=self.train,
             decode=self.decode,
+            aux_scale=aux_scale,
         )
-        return (x, positions, segment_ids), None
+        return (x, positions, segment_ids, aux_scale), None
 
 
 class BlockStack(nn.Module):
@@ -449,6 +452,7 @@ class BlockStack(nn.Module):
         segment_ids: Optional[jax.Array] = None,
         train: bool = True,
         decode: bool = False,
+        aux_scale: Optional[jax.Array] = None,
     ) -> jax.Array:
         cfg = self.config
         # prevent_cse=False is safe (and fastest) under scan for plain remat,
@@ -477,7 +481,7 @@ class BlockStack(nn.Module):
                 length=self.n_layers,
                 metadata_params={nn.PARTITION_NAME: None},
             )(cfg, train, decode, name="layers")
-            (x, _, _), _ = stacked((x, positions, segment_ids), None)
+            (x, _, _, _), _ = stacked((x, positions, segment_ids, aux_scale), None)
         else:
             block_cls = (
                 nn.remat(Block, **remat_kwargs) if cfg.remat and not decode else Block
@@ -489,6 +493,7 @@ class BlockStack(nn.Module):
                     segment_ids=segment_ids,
                     train=train,
                     decode=decode,
+                    aux_scale=aux_scale,
                 )
         return x
 

@@ -7,21 +7,25 @@ framework's EP slot, TPU-first:
   with one-hot dispatch/combine einsums — the Mesh-TensorFlow/Switch
   formulation that XLA compiles to dense MXU work, no dynamic gather.
 - **Expert parallelism over the ``model`` mesh axis**: each rank owns
-  ``n_experts / tp`` experts (weights stacked per-rank via ModuleShard, so
+  ``n_experts / ep`` experts (weights stacked per-rank via ModuleShard, so
   gradient sync already treats them as partitioned).  Activations are
   replicated over the model axis (the batch shards over data/seq), so
-  dispatch needs no communication at all: each rank slices out its own
-  experts' slots, runs them (``1/ep`` of the expert FLOPs), and the
-  combine closes with one ``psum`` — the same collective shape as a TP
-  row-parallel projection.
+  dispatch needs **no communication at all**: each rank slices out its own
+  experts' dispatch/combine masks, runs only its experts (``1/ep`` of the
+  expert FLOPs), and the partial combines close with one ``psum`` — the
+  same collective shape as a TP row-parallel projection, so the existing
+  pmean-over-model gradient sync stays exact.
 - **Router in fp32** (numerically fragile softmax over experts), activations
   in the model dtype.
 - Load-balance auxiliary loss (Switch: ``E * sum(f_i * P_i)``) sown into a
   ``"losses"`` collection; ``make_gpt_loss`` folds it into the objective.
+  ``aux_scale`` gates the sown value — the pipeline schedule passes 0.0 on
+  bubble ticks so garbage activations contribute exactly zero to (and take
+  no gradient from) the router regularizer.
 
 Works mesh-free too (no bound model axis): all experts live on the one
-device and the all_to_alls vanish — same module, same params layout rules
-as the rest of the structural-TP design.
+device, no slicing, no psum — same module, same params layout rules as the
+rest of the structural-TP design.
 """
 
 from __future__ import annotations
@@ -61,9 +65,6 @@ class MoEMLP(nn.Module):
     def __call__(
         self, x: jax.Array, train: bool = True, aux_scale: jax.Array | None = None
     ) -> jax.Array:
-        """``aux_scale``: multiplier on the sown balance loss — the pipeline
-        schedule passes 0.0 on bubble ticks so garbage activations never
-        contribute to (or take gradients from) the router regularizer."""
         cfg = self.config
         n_experts = cfg.moe_experts
         ep_size = axis_size_or_none(cfg.model_axis) or 1
@@ -85,13 +86,16 @@ class MoEMLP(nn.Module):
         expert_idx = jnp.argmax(probs, axis=-1)  # [T]
         onehot = jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.float32)
 
-        # Switch load-balance loss: E * sum_i fraction_i * router_prob_i
-        frac = onehot.mean(axis=0)
-        mean_prob = probs.mean(axis=0)
+        # Switch load-balance loss: E * sum_i fraction_i * router_prob_i.
+        # aux_scale (0.0 on pipeline bubble ticks) zeroes both the value and,
+        # through the multiply, its gradient into the router.
+        balance = n_experts * jnp.sum(onehot.mean(axis=0) * probs.mean(axis=0))
+        if aux_scale is not None:
+            balance = balance * jnp.asarray(aux_scale, jnp.float32)
         self.sow(
             "losses",
             "moe_balance",
-            n_experts * jnp.sum(frac * mean_prob),
+            balance,
             reduce_fn=lambda a, b_: a + b_,
             init_fn=lambda: jnp.float32(0.0),
         )
@@ -108,15 +112,20 @@ class MoEMLP(nn.Module):
         dispatch = in_capacity[:, :, None] * pos_onehot[:, None, :]
         combine = dispatch * gate[:, None, None]
 
-        # --- to experts -----------------------------------------------------
-        x_exp = jnp.einsum("td,tec->ecd", xf.astype(jnp.float32), dispatch)
-        x_exp = x_exp.astype(cfg.dtype)  # [E, C, d]
+        # --- expert parallelism: slice my experts, partial-combine, psum ----
+        # Each rank materializes only its own experts' [E/ep, C] masks, so the
+        # dispatch/combine einsums and the expert FFNs all run at 1/ep cost.
         if ep_size > 1:
-            # each rank keeps its experts' slots from EVERY rank:
-            # [E, C, d] -> [E/ep, ep*C, d], rank-ordered along the slot axis
-            x_exp = lax.all_to_all(
-                x_exp, cfg.model_axis, split_axis=0, concat_axis=1, tiled=True
+            rank = lax.axis_index(cfg.model_axis)
+            dispatch = lax.dynamic_slice_in_dim(
+                dispatch, rank * local_experts, local_experts, axis=1
             )
+            combine = lax.dynamic_slice_in_dim(
+                combine, rank * local_experts, local_experts, axis=1
+            )
+
+        x_exp = jnp.einsum("td,tec->ecd", xf.astype(jnp.float32), dispatch)
+        x_exp = x_exp.astype(cfg.dtype)  # [E/ep, C, d]
 
         expert_stack = nn.vmap(
             ExpertFFN,
@@ -136,13 +145,14 @@ class MoEMLP(nn.Module):
         else:
             y_exp = expert_stack(cfg, name="experts")(x_exp)
 
-        if ep_size > 1:
-            y_exp = lax.all_to_all(
-                y_exp, cfg.model_axis, split_axis=1, concat_axis=0, tiled=True
-            )
-
         # --- back to tokens -------------------------------------------------
+        # Partial combine over my experts; the psum sums the disjoint expert
+        # contributions (TP row-parallel shape; pmean-over-model grad sync
+        # keeps upstream gradients exact, see tests/test_moe.py).
         y = jnp.einsum("ecd,tec->td", y_exp.astype(jnp.float32), combine)
+        if ep_size > 1:
+            with jax.named_scope("moe_combine_psum"):
+                y = lax.psum(y, cfg.model_axis)
         y = y.astype(cfg.dtype).reshape(b, s, d)
         if cfg.dropout_rate > 0.0:
             y = nn.Dropout(rate=cfg.dropout_rate, deterministic=not train)(y)

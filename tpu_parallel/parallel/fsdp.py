@@ -157,32 +157,51 @@ def sync_gradients(
     grads: Pytree,
     axis_names: Union[str, Sequence[str]],
     psum_axes: Union[str, Sequence[str]] = (),
+    replicated_loss_axes: Union[str, Sequence[str]] = ("model",),
 ) -> Pytree:
     """Reduce each gradient over exactly the axes its param is replicated on.
 
-    A gradient for a parameter partitioned on ``axis`` is already per-device
-    correct on that axis (the reduce-scatter in the gather's backward did the
-    reduction); reducing it again would be wrong.  Gradients of replicated
-    parameters are **pmean**-ed over ``axis_names`` (data-parallel replicas
-    averaging the same-loss estimate — reference ``param_sharding.py:293-322``)
-    and **psum**-ed over ``psum_axes`` (axes where ranks contribute disjoint
-    *pieces* of the gradient — e.g. a pipeline axis, where only the rank
-    hosting the embed/head produces its nonzero gradient).
+    Per-rank shard_map gradients obey ``g_r = d(sum_over_ranks L_s)/d theta_r``
+    (collective transposes route every rank's loss cotangent into every rank's
+    backward).  Syncing therefore depends on how the loss relates to each axis:
+
+    - Gradients of **replicated** parameters are pmean-ed over ``axis_names``
+      (reference ``param_sharding.py:293-322``) and psum-ed over ``psum_axes``
+      (axes where ranks hold disjoint gradient *pieces* — e.g. the pipe axis,
+      where the loss lives on the last stage only).
+    - A parameter **partitioned** on a data-style axis is already per-device
+      correct there (FSDP's gather backward does psum_scatter/axis_size);
+      reducing again would be wrong.
+    - A parameter partitioned on an axis where the loss is *computed
+      redundantly by every rank* (``replicated_loss_axes`` — the tensor/expert
+      -parallel axis: all ranks hold the same tokens and the same loss value)
+      comes out exactly axis_size too large: the backward sums axis_size
+      identical loss cotangents, and no collective divides them back down.
+      Those gradients are divided by the axis size here.  (Empirically pinned
+      by ``tests/test_tp.py::test_tp_training_grads_match_dense`` and
+      ``tests/test_moe.py::test_moe_ep_gradients_match_single_device``.)
     """
     if isinstance(axis_names, str):
         axis_names = (axis_names,)
     if isinstance(psum_axes, str):
         psum_axes = (psum_axes,)
+    if isinstance(replicated_loss_axes, str):
+        replicated_loss_axes = (replicated_loss_axes,)
 
     def sync(g):
         if isinstance(g, nn.Partitioned):
             mean_axes = [a for a in axis_names if a not in g.names]
             sum_axes = [a for a in psum_axes if a not in g.names]
+            div_axes = [
+                a for a in replicated_loss_axes if a in g.names and a in axis_names
+            ]
             value = g.value
             if mean_axes:
                 value = lax.pmean(value, mean_axes)
             if sum_axes:
                 value = lax.psum(value, sum_axes)
+            for a in div_axes:
+                value = value / jnp.asarray(lax.psum(1, a), value.dtype)
             return g.replace(value=value)
         g = lax.pmean(g, axis_names)
         if psum_axes:

@@ -25,7 +25,7 @@ Mechanics (per device, inside ``shard_map``):
 
 from __future__ import annotations
 
-from typing import Any, Callable, Tuple
+from typing import Any, Callable, Optional, Tuple
 
 import flax.linen as nn
 import jax
@@ -41,6 +41,9 @@ def execute_pipeline_step(
     microbatch: jax.Array,
     *,
     axis_name: str,
+    tick: Optional[jax.Array] = None,
+    num_microbatches: Optional[int] = None,
+    pass_validity: bool = False,
     **kwargs,
 ) -> tuple[jax.Array, jax.Array]:
     """One schedule tick: select input, run the stage, rotate outputs.
@@ -48,11 +51,24 @@ def execute_pipeline_step(
     ``carry`` is the activation received from the previous rank last tick;
     rank 0 instead consumes ``microbatch`` (valid only while microbatches
     remain — afterwards it receives garbage that is masked out downstream).
+
+    ``pass_validity=True`` hands the stage an ``aux_scale`` scalar: 1.0 when
+    this rank is processing a real microbatch this tick, 0.0 on bubble ticks
+    (fill/drain) — so sown regularizers (MoE balance loss) can exclude
+    garbage activations exactly.  Requires the stage module to accept an
+    ``aux_scale`` keyword (``models.layers.BlockStack`` does).
     """
     num_stages = lax.psum(1, axis_name)
     stage = lax.axis_index(axis_name)
     # Stage 0 reads fresh microbatches; other stages read the rotated carry.
     inputs = jnp.where(stage == 0, microbatch, carry)
+    if pass_validity:
+        # Rank r works on microbatch (tick - r): real iff it is in range.
+        mb_index = tick - stage
+        kwargs = dict(kwargs)
+        kwargs["aux_scale"] = jnp.logical_and(
+            mb_index >= 0, mb_index < num_microbatches
+        ).astype(jnp.float32)
     outputs = module(inputs, **kwargs)
     if outputs.shape != inputs.shape:
         raise ValueError(
@@ -80,6 +96,7 @@ def execute_pipeline(
     num_microbatches: int,
     axis_name: str,
     broadcast_outputs: bool = False,
+    pass_validity: bool = False,
     **kwargs,
 ) -> jax.Array:
     """Run ``module`` as a pipeline stage over the full GPipe schedule.
@@ -114,17 +131,22 @@ def execute_pipeline(
     )
 
     carry_init = jnp.zeros_like(microbatches[0])
+    # aux-loss collections (MoE balance) stack one entry per schedule tick;
+    # with pass_validity the stage zeroes bubble-tick entries via aux_scale,
+    # so only the num_microbatches real ticks contribute.
+    ticks = jnp.arange(num_iterations, dtype=jnp.int32)
     _, outputs = nn.scan(
         _ScanWrapper,
         variable_broadcast="params",
-        # aux-loss collections (MoE balance) stack one entry per schedule
-        # tick; bubble ticks route zero-vectors, adding a near-constant bias
-        # with negligible gradient — acceptable for the regularizer
         variable_axes={"losses": 0},
         split_rngs={"params": False, "dropout": True},
-    )(module, axis_name=axis_name, static_kwargs=tuple(sorted(kwargs.items())))(
-        carry_init, inputs
-    )
+    )(
+        module,
+        axis_name=axis_name,
+        num_microbatches=num_microbatches,
+        pass_validity=pass_validity,
+        static_kwargs=tuple(sorted(kwargs.items())),
+    )(carry_init, (inputs, ticks))
     # outputs: [num_iterations, mb, ...]; valid last-stage outputs occupy the
     # final num_microbatches slots (earlier ticks were pipeline fill).  The
     # per-tick collection already zeroed every rank but the last.
@@ -146,14 +168,20 @@ class _ScanWrapper(nn.Module):
 
     module: nn.Module
     axis_name: str
+    num_microbatches: Optional[int] = None
+    pass_validity: bool = False
     static_kwargs: Tuple[Tuple[str, Any], ...] = ()
 
-    def __call__(self, carry, microbatch):
+    def __call__(self, carry, xs):
+        microbatch, tick = xs
         return execute_pipeline_step(
             self.module,
             carry,
             microbatch,
             axis_name=self.axis_name,
+            tick=tick,
+            num_microbatches=self.num_microbatches,
+            pass_validity=self.pass_validity,
             **dict(self.static_kwargs),
         )
 
@@ -188,6 +216,9 @@ class PipelineModule(nn.Module):
     num_microbatches: int
     axis_name: str = "pipe"
     broadcast_outputs: bool = False
+    # hand the stage a per-tick aux_scale validity scalar (see
+    # execute_pipeline_step); the stage must accept the keyword
+    pass_validity: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, **kwargs) -> jax.Array:
@@ -200,5 +231,6 @@ class PipelineModule(nn.Module):
             num_microbatches=self.num_microbatches,
             axis_name=self.axis_name,
             broadcast_outputs=self.broadcast_outputs,
+            pass_validity=self.pass_validity,
             **kwargs,
         )

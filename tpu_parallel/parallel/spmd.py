@@ -79,6 +79,7 @@ def build_train_functions(
     batch_spec: P = P("data"),
     grad_sync_axes: Union[str, Sequence[str]] = ("data",),
     grad_psum_axes: Union[str, Sequence[str]] = (),
+    replicated_loss_axes: Union[str, Sequence[str]] = ("model",),
     metric_axes: Optional[Sequence[str]] = None,
     metric_mean_axes: Optional[Sequence[str]] = None,
     num_minibatches: int = 1,
@@ -100,20 +101,33 @@ def build_train_functions(
     pipe axis).  Partitioned parameters are reduced only over the axes they
     are *not* partitioned on.
 
+    ``replicated_loss_axes``: mesh axes on which every rank computes the same
+    loss on the same tokens (the tensor/expert-parallel axis — whatever it is
+    named on this mesh).  Partitioned-param gradients are divided by these
+    axes' sizes in :func:`fsdp.sync_gradients`, and metric defaults treat
+    them as replicated (pmean) rather than disjoint (psum).
+
     ``metric_axes``: axes whose ranks hold disjoint tokens — metrics are
-    psum'd over them (defaults to every >1 mesh axis except ``model``).
-    ``metric_mean_axes``: replicated-compute axes — pmean'd so counts stay
-    exact (defaults to ``model`` when >1).
+    psum'd over them (defaults to every >1 mesh axis not in
+    ``replicated_loss_axes``).  ``metric_mean_axes``: replicated-compute axes
+    — pmean'd so counts stay exact (defaults to the >1 axes of
+    ``replicated_loss_axes``).
     """
     if isinstance(grad_sync_axes, str):
         grad_sync_axes = (grad_sync_axes,)
+    if isinstance(replicated_loss_axes, str):
+        replicated_loss_axes = (replicated_loss_axes,)
     if metric_axes is None:
         metric_axes = tuple(
-            n for n in mesh.axis_names if mesh.shape[n] > 1 and n != "model"
+            n
+            for n in mesh.axis_names
+            if mesh.shape[n] > 1 and n not in replicated_loss_axes
         )
     if metric_mean_axes is None:
         metric_mean_axes = tuple(
-            n for n in mesh.axis_names if mesh.shape[n] > 1 and n == "model"
+            n
+            for n in mesh.axis_names
+            if mesh.shape[n] > 1 and n in replicated_loss_axes
         )
     if init_rng is None:
         init_rng = jax.random.PRNGKey(0)
@@ -142,7 +156,12 @@ def build_train_functions(
             state, batch, step_rng, num_minibatches, loss_fn, use_scan=use_scan
         )
         with jax.named_scope("sync_gradients"):
-            grads = fsdp.sync_gradients(grads, grad_sync_axes, psum_axes=grad_psum_axes)
+            grads = fsdp.sync_gradients(
+                grads,
+                grad_sync_axes,
+                psum_axes=grad_psum_axes,
+                replicated_loss_axes=replicated_loss_axes,
+            )
         new_state = state.apply_gradients(grads=grads, rng=rng)
         if metric_axes or metric_mean_axes:
             step_metrics = sync_metrics(step_metrics, metric_axes, metric_mean_axes)

@@ -37,7 +37,7 @@ AXIS_ORDER: Tuple[str, ...] = (PIPE_AXIS, DATA_AXIS, SEQ_AXIS, MODEL_AXIS)
 
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
-    """Logical mesh shape. ``-1`` on ``data`` means "all remaining devices"."""
+    """Logical mesh shape. ``-1`` on any ONE axis means "all remaining devices"."""
 
     data: int = -1
     model: int = 1
@@ -45,20 +45,28 @@ class MeshConfig:
     seq: int = 1
 
     def resolved(self, n_devices: int) -> "MeshConfig":
-        fixed = self.model * self.pipe * self.seq
-        data = self.data
-        if data == -1:
-            if n_devices % fixed != 0:
+        sizes = dict(data=self.data, model=self.model, pipe=self.pipe, seq=self.seq)
+        wild = [name for name, v in sizes.items() if v == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one mesh axis may be -1, got {wild}")
+        if wild:
+            fixed = 1
+            for name, v in sizes.items():
+                if name != wild[0]:
+                    fixed *= v
+            if fixed <= 0 or n_devices % fixed != 0:
                 raise ValueError(
-                    f"{n_devices} devices not divisible by model*pipe*seq={fixed}"
+                    f"{n_devices} devices not divisible by the fixed axes "
+                    f"product {fixed} (mesh {sizes})"
                 )
-            data = n_devices // fixed
-        if data * fixed != n_devices:
+            sizes[wild[0]] = n_devices // fixed
+        if sizes["data"] * sizes["model"] * sizes["pipe"] * sizes["seq"] != n_devices:
             raise ValueError(
-                f"mesh shape data={data} model={self.model} pipe={self.pipe} "
-                f"seq={self.seq} does not cover {n_devices} devices"
+                f"mesh shape data={sizes['data']} model={sizes['model']} "
+                f"pipe={sizes['pipe']} seq={sizes['seq']} does not cover "
+                f"{n_devices} devices"
             )
-        return MeshConfig(data=data, model=self.model, pipe=self.pipe, seq=self.seq)
+        return MeshConfig(**sizes)
 
     def axis_sizes(self) -> dict:
         return {
@@ -112,21 +120,25 @@ def mesh_from_sizes(data: int = -1, model: int = 1, pipe: int = 1, seq: int = 1,
     return make_mesh(MeshConfig(data=data, model=model, pipe=pipe, seq=seq), devices=devices)
 
 
-def factor_mesh(n_devices: int, *, want_model: int = 1, want_pipe: int = 1) -> MeshConfig:
-    """Best-effort factorization of ``n_devices`` into (pipe, data, model).
+def factor_mesh(
+    n_devices: int, *, want_model: int = 1, want_pipe: int = 1, want_seq: int = 1
+) -> MeshConfig:
+    """Best-effort factorization of ``n_devices`` into (pipe, data, seq, model).
 
-    Shrinks the requested model/pipe degrees to the largest divisors that fit.
-    Useful for dry-runs where the device count is dictated from outside.
+    Shrinks the requested model/pipe/seq degrees to the largest divisors that
+    fit (in that priority order).  Useful for dry-runs where the device count
+    is dictated from outside.
     """
-    model = 1
-    for m in range(min(want_model, n_devices), 0, -1):
-        if n_devices % m == 0:
-            model = m
-            break
+
+    def largest_divisor(n: int, want: int) -> int:
+        for d in range(min(want, n), 0, -1):
+            if n % d == 0:
+                return d
+        return 1
+
+    model = largest_divisor(n_devices, want_model)
     rem = n_devices // model
-    pipe = 1
-    for p in range(min(want_pipe, rem), 0, -1):
-        if rem % p == 0:
-            pipe = p
-            break
-    return MeshConfig(data=rem // pipe, model=model, pipe=pipe, seq=1)
+    pipe = largest_divisor(rem, want_pipe)
+    rem //= pipe
+    seq = largest_divisor(rem, want_seq)
+    return MeshConfig(data=rem // seq, model=model, pipe=pipe, seq=seq)
