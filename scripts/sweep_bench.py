@@ -14,7 +14,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 
 
-def run_one(batch, remat, attn_impl, steps=12, minib=1, scan_layers=True):
+def run_one(batch, remat, attn_impl, steps=12, minib=1, scan_layers=True, chunk=0):
     from tpu_parallel.core import compute as compute_metrics
     from tpu_parallel.runtime import MeshConfig
     from tpu_parallel.train_lib import Trainer, TrainerConfig
@@ -24,11 +24,15 @@ def run_one(batch, remat, attn_impl, steps=12, minib=1, scan_layers=True):
         transformer_flops_per_token,
     )
 
-    overrides = dict(dropout_rate=0.0, attn_impl=attn_impl, scan_layers=scan_layers)
-    if remat == "dots":
-        overrides.update(remat=True, remat_policy="dots")
+    overrides = dict(
+        dropout_rate=0.0, attn_impl=attn_impl, scan_layers=scan_layers,
+        loss_chunk=chunk,
+    )
+    # remat spec: "0" = off, "1"/"full" = full remat, "proj"/"dots" = that policy
+    if remat in ("dots", "proj"):
+        overrides.update(remat=True, remat_policy=remat)
     else:
-        overrides.update(remat=remat == "1")
+        overrides.update(remat=remat in ("1", "full"))
     config = TrainerConfig(
         model="gpt2_125m",
         model_overrides=overrides,
@@ -74,20 +78,23 @@ def main():
         b, r, a = parts[:3]
         minib = int(parts[3]) if len(parts) > 3 else 1
         scan = parts[4] != "0" if len(parts) > 4 else True
-        combos.append((int(b), r, a, minib, scan))
+        chunk = int(parts[5]) if len(parts) > 5 else 0
+        combos.append((int(b), r, a, minib, scan, chunk))
     if not combos:
-        combos = [(16, "1", "xla", 1, True), (32, "1", "xla", 1, True)]
-    for batch, remat, attn, minib, scan in combos:
+        combos = [(16, "1", "xla", 1, True, 0), (32, "1", "xla", 1, True, 0)]
+    for batch, remat, attn, minib, scan, chunk in combos:
         try:
-            result = run_one(batch, remat, attn, minib=minib, scan_layers=scan)
-            result["minib"], result["scan"] = minib, scan
+            result = run_one(
+                batch, remat, attn, minib=minib, scan_layers=scan, chunk=chunk
+            )
+            result["minib"], result["scan"], result["chunk"] = minib, scan, chunk
             print(json.dumps(result), flush=True)
         except Exception as e:  # OOM etc — report and keep sweeping
             print(
                 json.dumps(
                     dict(
                         batch=batch, remat=remat, attn=attn, minib=minib,
-                        scan=scan, error=repr(e)[:200],
+                        scan=scan, chunk=chunk, error=repr(e)[:300],
                     )
                 ),
                 flush=True,

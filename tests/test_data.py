@@ -121,3 +121,56 @@ def test_loader_trains_gpt(token_file, mesh_data8, rng):
     for _ in range(12):
         state, m = funcs.step_fn(state, None, next(it))
     assert compute(m)["loss"] < first
+
+
+def test_holdout_split_disjoint_and_exhaustive(token_file, mesh_data8):
+    """train/eval views: eval tokens are provably never sampled by train.
+
+    Covers every epoch-0..2 train batch and every eval batch; window index
+    sets must be disjoint, with eval = the stream's tail.
+    """
+    path, tokens = token_file
+    ds = TokenDataset(path, seq_len=16)
+    train = DataLoader(
+        ds, mesh_data8, global_batch_size=8, seed=3, holdout_fraction=0.25
+    )
+    ev = train.eval_view()
+    n_eval = int(round(ds.num_windows * 0.25))
+    assert train.num_windows == ds.num_windows - n_eval
+    assert ev.num_windows == n_eval
+
+    def window_ids(loader, epochs):
+        seen = set()
+        for e in range(epochs):
+            for b in range(loader.batches_per_epoch):
+                batch = loader.batch_at(e * loader.batches_per_epoch + b)
+                # recover window ids from the first token of each row
+                for row in np.asarray(batch.tokens):
+                    starts = np.flatnonzero(
+                        tokens[: ds.num_windows * 16 : 16].astype(np.int32)
+                        == row[0]
+                    )
+                    # match on the full row to disambiguate repeated tokens
+                    wid = next(
+                        int(s)
+                        for s in starts
+                        if np.array_equal(
+                            tokens[s * 16 : s * 16 + 16].astype(np.int32), row
+                        )
+                    )
+                    seen.add(wid)
+        return seen
+
+    train_ids = window_ids(train, 3)
+    eval_ids = window_ids(ev, 1)
+    assert train_ids and eval_ids
+    assert train_ids.isdisjoint(eval_ids)
+    assert max(train_ids) < ds.num_windows - n_eval
+    assert min(eval_ids) >= ds.num_windows - n_eval
+
+
+def test_eval_view_requires_holdout(token_file, mesh_data8):
+    path, _ = token_file
+    ds = TokenDataset(path, seq_len=16)
+    with pytest.raises(ValueError, match="holdout_fraction"):
+        DataLoader(ds, mesh_data8, global_batch_size=8).eval_view()

@@ -227,3 +227,62 @@ def test_gqa_tp_training(mesh_data4_model2, rng):
         mesh_data4_model2, cfg, rng, grad_sync_axes=("data", "model")
     )
     assert last < first
+
+
+def test_chunked_loss_matches_full(mesh_data8, rng):
+    """loss_chunk: chunked lm_head+CE == full-logits loss (value, metrics,
+    and gradients) on the same params and tokens."""
+    import dataclasses
+
+    from tpu_parallel.parallel import fsdp
+
+    cfg_full = tiny_test(remat=False)
+    cfg_chunk = dataclasses.replace(cfg_full, loss_chunk=8)
+    model = GPTLM(cfg_full)  # same params serve both loss variants
+    batch = lm_batch(jax.random.PRNGKey(0), 16, cfg_full.seq_len, cfg_full.vocab_size)
+    loss_full = make_gpt_loss(cfg_full, train=False)
+    loss_chunk = make_gpt_loss(cfg_chunk, train=False)
+
+    def init(r, b):
+        return model.init({"params": r}, b.tokens, train=False)["params"]
+
+    probe = jax.shard_map(
+        init, mesh=mesh_data8, in_specs=(P(), P("data")), out_specs=P(),
+        check_vma=False,
+    )
+    specs = nn.get_partition_spec(jax.eval_shape(probe, rng, batch))
+    params = jax.jit(
+        jax.shard_map(
+            init, mesh=mesh_data8, in_specs=(P(), P("data")), out_specs=specs,
+            check_vma=False,
+        )
+    )(rng, batch)
+
+    def grads_of(loss_fn):
+        def f(params, b, r):
+            (total, metrics), g = jax.value_and_grad(
+                lambda p: loss_fn(p, model.apply, b, r), has_aux=True
+            )(params)
+            return total, metrics, fsdp.sync_gradients(g, ("data",))
+
+        return jax.jit(
+            jax.shard_map(
+                f, mesh=mesh_data8, in_specs=(specs, P("data"), P()),
+                out_specs=(P(), P(), specs), check_vma=False,
+            )
+        )(params, batch, rng)
+
+    t_full, m_full, g_full = grads_of(loss_full)
+    t_chunk, m_chunk, g_chunk = grads_of(loss_chunk)
+    np.testing.assert_allclose(float(t_chunk), float(t_full), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(m_chunk["accuracy"][0]), float(m_full["accuracy"][0])
+    )
+    flat_f = jax.tree_util.tree_leaves_with_path(g_full)
+    flat_c = jax.tree_util.tree_leaves(g_chunk)
+    assert len(flat_f) == len(flat_c)
+    for (path, leaf_f), leaf_c in zip(flat_f, flat_c):
+        np.testing.assert_allclose(
+            np.asarray(leaf_c), np.asarray(leaf_f), rtol=1e-4, atol=1e-6,
+            err_msg=str(path),
+        )

@@ -260,6 +260,133 @@ def test_moe_ep_gradients_match_single_device(mesh_data4_model2, rng):
             np.testing.assert_allclose(want, got, rtol=1e-4, atol=1e-5)
 
 
+def test_pp_aux_gradient_invariance(mesh_pipe4_data2, rng):
+    """Router gradients (CE + balance aux) match between pipe_size=1 and 4.
+
+    Same logical model, same tokens: the no-PP side accumulates over
+    ``num_microbatches`` contiguous minibatches (mirroring what GPipe's
+    microbatching does to the aux term — the balance loss is nonlinear in
+    the batch, so per-microbatch aux is the reference semantics).  Pins the
+    ``n_layers * num_microbatches`` aux normalization in make_gpt_loss: the
+    old ``layers_per_stage`` denominator inflates router grads by pipe_size.
+    """
+    import flax.linen as nn
+
+    from tpu_parallel.parallel import fsdp
+
+    num_mb = 2
+    common = dict(
+        moe_experts=2,
+        dtype=jnp.float32,
+        remat=False,
+        num_microbatches=num_mb,
+        moe_balance_weight=1.0,
+    )
+    cfg1 = tiny_test(**common)
+    cfg4 = tiny_test(**common, pipe_size=4)
+    model1, model4 = GPTLM(cfg1), GPTLM(cfg4)
+    loss1 = make_gpt_loss(cfg1, train=False)
+    loss4 = make_gpt_loss(cfg4, train=False)
+    batch = lm_batch(jax.random.PRNGKey(0), 8, cfg1.seq_len, cfg1.vocab_size)
+    mesh = mesh_pipe4_data2
+
+    def make_init(model):
+        def init(r, b):
+            return model.init({"params": r}, b.tokens, train=False)["params"]
+
+        return init
+
+    def specs_and_params(model):
+        probe = jax.shard_map(
+            make_init(model), mesh=mesh, in_specs=(P(), P("data")),
+            out_specs=P(), check_vma=False,
+        )
+        shapes = jax.eval_shape(probe, rng, batch)
+        specs = nn.get_partition_spec(shapes)
+        real = jax.jit(
+            jax.shard_map(
+                make_init(model), mesh=mesh, in_specs=(P(), P("data")),
+                out_specs=specs, check_vma=False,
+            )
+        )(rng, batch)
+        return specs, real
+
+    specs1, params1 = specs_and_params(model1)
+    specs4, _ = specs_and_params(model4)
+
+    # Transplant: no-PP scan-stacked block params [n_layers, ...] become the
+    # PP layout [pipe, 1(scan), ...] — stage r holds layer r.
+    def to_pp(x):
+        if isinstance(x, nn.Partitioned):
+            v, names = x.value, x.names
+        else:
+            v, names = x, (None,) * x.ndim
+        return nn.Partitioned(
+            v.reshape(v.shape[0], 1, *v.shape[1:]), ("pipe",) + tuple(names)
+        )
+
+    params4 = dict(params1)
+    blocks = params4.pop("blocks")
+    params4["pipeline"] = {
+        "stage": {
+            "sharded": jax.tree_util.tree_map(
+                to_pp,
+                blocks,
+                is_leaf=lambda x: isinstance(x, nn.Partitioned),
+            )
+        }
+    }
+
+    def grads_nopp(params, b, r):
+        """Manual num_mb-minibatch accumulation (contiguous slices, like the
+        GPipe microbatch split), then pmean over data."""
+        total = None
+        mb_size = b.tokens.shape[0] // num_mb
+        for i in range(num_mb):
+            mb = jax.tree_util.tree_map(
+                lambda a: a[i * mb_size : (i + 1) * mb_size], b
+            )
+            g = jax.grad(lambda p: loss1(p, model1.apply, mb, r)[0])(params)
+            total = g if total is None else jax.tree_util.tree_map(
+                jnp.add, total, g
+            )
+        g = jax.tree_util.tree_map(lambda x: x / num_mb, total)
+        return fsdp.sync_gradients(g, ("data",))
+
+    def grads_pp(params, b, r):
+        g = jax.grad(lambda p: loss4(p, model4.apply, b, r)[0])(params)
+        return fsdp.sync_gradients(g, ("data",))
+
+    g1 = jax.jit(
+        jax.shard_map(
+            grads_nopp, mesh=mesh, in_specs=(specs1, P("data"), P()),
+            out_specs=specs1, check_vma=False,
+        )
+    )(params1, batch, rng)
+    g4 = jax.jit(
+        jax.shard_map(
+            grads_pp, mesh=mesh, in_specs=(specs4, P("data"), P()),
+            out_specs=specs4, check_vma=False,
+        )
+    )(params4, batch, rng)
+
+    def unbox(x):
+        return np.asarray(x.value if isinstance(x, nn.Partitioned) else x)
+
+    g1_blocks = g1["blocks"]["layers"]["block"]
+    g4_blocks = g4["pipeline"]["stage"]["sharded"]["layers"]["block"]
+    # router gradient: the aux-normalization target
+    want = unbox(g1_blocks["moe"]["router"]["kernel"])
+    got = unbox(g4_blocks["moe"]["router"]["kernel"]).reshape(want.shape)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-6)
+    # a non-MoE weight too: full CE-path GPipe == sequential equivalence
+    want = unbox(g1_blocks["attn"]["qkv"]["shard"]["sharded"]["kernel"])
+    got = unbox(
+        g4_blocks["attn"]["qkv"]["shard"]["sharded"]["kernel"]
+    ).reshape(want.shape)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-6)
+
+
 def test_pp_moe_bubble_ticks_sow_zero(mesh_pipe4_data2, rng):
     """Pipeline bubble ticks must contribute exactly 0 to the balance loss.
 

@@ -21,6 +21,20 @@ from tpu_parallel.core.state import Batch, TextBatch
 AxisNames = Union[str, Sequence[str]]
 
 
+def token_cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Per-token CE with fp32 math from logits of any dtype.
+
+    Models emit bf16 logits (their matmuls already round to bf16 — a model-
+    side fp32 cast would only double the [B, S, vocab] HBM footprint, the
+    dominant buffer at GPT-2 vocab sizes).  The upcast here fuses into the
+    log-softmax reductions on TPU, so no fp32 logits tensor materializes.
+    """
+    logits = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    ll = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return lse - ll
+
+
 def make_classification_loss(fold_axes: AxisNames = "data") -> Callable:
     """Softmax-CE loss for ``Batch``; dropout rng folded over ``fold_axes``."""
 
@@ -53,7 +67,7 @@ def make_lm_loss(fold_axes: AxisNames = "data") -> Callable:
             train=True,
             rngs={"dropout": dropout_rng},
         )
-        loss = optax.softmax_cross_entropy_with_integer_labels(logits, batch.targets)
+        loss = token_cross_entropy(logits, batch.targets)
         mask = (
             batch.loss_mask
             if batch.loss_mask is not None

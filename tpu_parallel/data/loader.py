@@ -108,6 +108,15 @@ class DataLoader:
     global_batch_size: int
     seed: int = 0
     batch_spec: P = P("data")
+    # held-out evaluation: the LAST ``round(holdout_fraction * num_windows)``
+    # windows of the stream never enter the train split.  ``split="train"``
+    # samples the head, ``split="eval"`` (see :meth:`eval_view`) the tail —
+    # disjoint window sets (tests/test_data.py); adjacent windows share one
+    # boundary token (window i spans [i*s, i*s+s] inclusive), so exactly one
+    # context token leaks across the split — eval *targets* never appear as
+    # train targets.
+    holdout_fraction: float = 0.0
+    split: str = "train"
 
     def __post_init__(self):
         self.process_count = jax.process_count()
@@ -118,21 +127,40 @@ class DataLoader:
                 f"process count {self.process_count}"
             )
         self.local_batch_size = self.global_batch_size // self.process_count
-        if self.dataset.num_windows < self.global_batch_size:
+        if not 0.0 <= self.holdout_fraction < 1.0:
+            raise ValueError(f"holdout_fraction={self.holdout_fraction} not in [0, 1)")
+        n_eval = int(round(self.dataset.num_windows * self.holdout_fraction))
+        if self.split == "train":
+            self._window_offset = 0
+            self.num_windows = self.dataset.num_windows - n_eval
+        elif self.split == "eval":
+            if n_eval == 0:
+                raise ValueError(
+                    "split='eval' needs holdout_fraction > 0 (no held-out windows)"
+                )
+            self._window_offset = self.dataset.num_windows - n_eval
+            self.num_windows = n_eval
+        else:
+            raise ValueError(f"unknown split {self.split!r}")
+        if self.num_windows < self.global_batch_size:
             raise ValueError(
-                f"dataset has {self.dataset.num_windows} windows — fewer than "
-                f"one global batch of {self.global_batch_size}"
+                f"{self.split} split has {self.num_windows} windows — fewer "
+                f"than one global batch of {self.global_batch_size}"
             )
+
+    def eval_view(self) -> "DataLoader":
+        """The held-out counterpart of this loader (same stream, disjoint tail)."""
+        return dataclasses.replace(self, split="eval")
 
     @property
     def batches_per_epoch(self) -> int:
-        return self.dataset.num_windows // self.global_batch_size
+        return self.num_windows // self.global_batch_size
 
     def _epoch_order(self, epoch: int) -> np.ndarray:
         if getattr(self, "_order_epoch", None) != epoch:
             self._order_epoch = epoch
             self._order = np.random.default_rng((self.seed, epoch)).permutation(
-                self.dataset.num_windows
+                self.num_windows
             )
         return self._order
 
@@ -146,6 +174,7 @@ class DataLoader:
         epoch, b = divmod(step, self.batches_per_epoch)
         order = self._epoch_order(epoch)
         rows = order[b * self.global_batch_size : (b + 1) * self.global_batch_size]
+        rows = rows + self._window_offset
         local = rows[self.process_index :: self.process_count]
         return make_global_batch(
             self.dataset.batch(local), self.mesh, self.batch_spec

@@ -29,6 +29,9 @@ from tpu_parallel.parallel.tp import export_single_device_params  # noqa: F401  
 
 def _sample(logits: jax.Array, rng: jax.Array, temperature: float, top_k: int):
     """One token per row from [batch, vocab] logits."""
+    # models emit cfg.dtype (bf16) logits; sample in fp32 so the temperature
+    # scale and the categorical's gumbel trick don't round at bf16
+    logits = logits.astype(jnp.float32)
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature

@@ -26,9 +26,9 @@ from typing import Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
-import optax
 from jax import lax
 
+from tpu_parallel.core.losses import token_cross_entropy
 from tpu_parallel.core.metrics import Metrics
 from tpu_parallel.core.rng import fold_rng_over_axis
 from tpu_parallel.models.layers import (
@@ -48,6 +48,29 @@ class GPTConfig(TransformerConfig):
     """TransformerConfig plus pipeline degree (static model knobs only)."""
 
     pipe_size: int = 1  # number of pipeline stages the block stack is cut into
+    # chunked lm_head + CE: compute logits ``loss_chunk`` sequence positions
+    # at a time inside the loss (rematerialized in the backward), so the full
+    # [B, S, vocab] logits tensor never exists in HBM.  0 = off.  The
+    # dominant-memory fix for large batches at GPT-2 vocab (50304): full
+    # logits are ~3 GB bf16 per 32x1024 batch, twice that with their
+    # gradient.  Costs one extra lm_head matmul in the backward (~9% of
+    # model FLOPs) — a win whenever it unlocks a larger batch.
+    loss_chunk: int = 0
+
+
+def _make_lm_head(cfg: "GPTConfig", name: Optional[str] = "lm_head") -> TPDense:
+    """The vocab projection — one definition for the in-model call and the
+    standalone per-chunk apply in :func:`make_gpt_loss` (``name=None``; the
+    loss binds it directly to ``params["lm_head"]``)."""
+    return TPDense(
+        features=cfg.vocab_size,
+        axis_name=cfg.model_axis,
+        style="column",
+        gather_output=True,
+        use_bias=False,
+        dtype=cfg.dtype,
+        name=name,
+    )
 
 
 class GPTLM(nn.Module):
@@ -63,6 +86,7 @@ class GPTLM(nn.Module):
         segment_ids: Optional[jax.Array] = None,
         train: bool = True,
         decode: bool = False,
+        hidden_only: bool = False,
     ) -> jax.Array:
         cfg = self.config
         if decode and cfg.pipe_size > 1:
@@ -119,16 +143,15 @@ class GPTLM(nn.Module):
             )
 
         x = make_norm(cfg, "norm_final")(x).astype(cfg.dtype)
-        logits = TPDense(
-            features=cfg.vocab_size,
-            axis_name=cfg.model_axis,
-            style="column",
-            gather_output=True,
-            use_bias=False,
-            dtype=cfg.dtype,
-            name="lm_head",
-        )(x)
-        return logits.astype(jnp.float32)
+        if hidden_only:
+            # for chunked-loss training (make_gpt_loss applies the lm_head
+            # itself, loss_chunk positions at a time)
+            return x
+        # Logits stay in cfg.dtype: the bf16 matmul already rounded them, so
+        # an fp32 cast here would only double the largest tensor in the
+        # program (see token_cross_entropy, which upcasts inside the
+        # reductions instead).
+        return _make_lm_head(cfg)(x)
 
 
 def make_gpt_loss(config: GPTConfig, train: bool = True):
@@ -137,8 +160,37 @@ def make_gpt_loss(config: GPTConfig, train: bool = True):
     Dropout RNG folds over every parallel axis; under PP the loss and metric
     counts are masked to the last pipe rank (the only rank with real logits).
     ``train=False`` builds the evaluation variant (dropout off).
+
+    With ``config.loss_chunk > 0`` the model returns final hidden states and
+    the lm_head + CE run ``loss_chunk`` sequence positions at a time under a
+    rematerialized ``lax.scan`` — the full [B, S, vocab] logits tensor never
+    materializes (see ``GPTConfig.loss_chunk``).
     """
     fold_axes = (config.data_axis, config.model_axis, config.pipe_axis)
+    chunk = config.loss_chunk
+    head = _make_lm_head(config, name=None) if chunk else None
+
+    def chunked_ce(params, h, targets, mask):
+        """scan over sequence chunks of the lm_head + CE; returns
+        (loss_sum, correct_sum) without materializing full logits."""
+        b, s = targets.shape
+        if s % chunk != 0:
+            raise ValueError(f"seq_len={s} not divisible by loss_chunk={chunk}")
+        n = s // chunk
+        hs = h.reshape(b, n, chunk, h.shape[-1]).transpose(1, 0, 2, 3)
+        ts = targets.reshape(b, n, chunk).transpose(1, 0, 2)
+        ms = mask.reshape(b, n, chunk).transpose(1, 0, 2)
+
+        def body(carry, xs):
+            h_i, t_i, m_i = xs
+            logits = head.apply({"params": params["lm_head"]}, h_i)
+            ce = token_cross_entropy(logits, t_i) * m_i
+            correct = ((logits.argmax(-1) == t_i) * m_i).sum()
+            return (carry[0] + ce.sum(), carry[1] + correct), None
+
+        init = (jnp.float32(0.0), jnp.float32(0.0))
+        (loss_sum, correct), _ = lax.scan(jax.checkpoint(body), init, (hs, ts, ms))
+        return loss_sum, correct
 
     def loss_fn(params, apply_fn, batch, rng):
         dropout_rng = fold_rng_over_axis(rng, fold_axes)
@@ -147,6 +199,7 @@ def make_gpt_loss(config: GPTConfig, train: bool = True):
             segment_ids=None if config.pipe_size > 1 else batch.segment_ids,
             train=train,
             rngs={"dropout": dropout_rng},
+            hidden_only=chunk > 0,
         )
         aux_loss = 0.0
         if config.moe_experts > 0:
@@ -155,39 +208,53 @@ def make_gpt_loss(config: GPTConfig, train: bool = True):
             )
             sown = jax.tree_util.tree_leaves(mods.get("losses", {}))
             if sown:
-                # Normalize the tick/layer-stacked sum to a per-layer mean so
-                # the aux weight is depth- and schedule-invariant.  Without PP
-                # each of this rank's n_layers blocks sows once.  Under PP this
-                # rank's layers_per_stage blocks each sow once per REAL tick
-                # (bubble ticks are zeroed via aux_scale — pp.py), i.e.
-                # num_microbatches times.
+                # Normalize the tick/layer-stacked sum so the aux gradient per
+                # router matches the no-PP case regardless of pipe degree.
+                # Without PP each of the n_layers blocks sows once.  Under PP
+                # each rank's layers_per_stage blocks sow once per REAL tick
+                # (bubble ticks zeroed via aux_scale — pp.py), i.e.
+                # num_microbatches times — and every rank adds its own
+                # aux term to its local total, so the denominator must count
+                # ALL layers (n_layers, not layers_per_stage): summed across
+                # ranks the aux terms then reconstruct exactly the per-layer
+                # mean-over-microbatches, and each router's gradient carries
+                # the same 1/n_layers weight as at pipe_size=1
+                # (tests/test_moe.py::test_pp_aux_gradient_invariance).
                 if config.pipe_size > 1:
-                    denom = (
-                        config.n_layers // config.pipe_size
-                    ) * config.num_microbatches
+                    denom = config.n_layers * config.num_microbatches
                 else:
                     denom = config.n_layers
                 aux_loss = sum(jnp.sum(leaf) for leaf in sown) / denom
         else:
             logits = apply_fn({"params": params}, batch.tokens, **apply_kwargs)
-        loss = optax.softmax_cross_entropy_with_integer_labels(logits, batch.targets)
+        # (with loss_chunk, ``logits`` holds the final hidden states instead)
         mask = (
             batch.loss_mask
             if batch.loss_mask is not None
-            else jnp.ones_like(loss, jnp.float32)
+            else jnp.ones(batch.targets.shape, jnp.float32)
         )
         if config.pipe_size > 1:
             mask = mask * pp.last_stage_mask(config.pipe_axis)
-        loss = loss * mask
         n_tok = mask.sum()
-        correct = ((logits.argmax(-1) == batch.targets) * mask).sum()
+        if chunk:
+            loss_sum, correct = chunked_ce(params, logits, batch.targets, mask)
+        else:
+            loss_sum = (token_cross_entropy(logits, batch.targets) * mask).sum()
+            correct = ((logits.argmax(-1) == batch.targets) * mask).sum()
         metrics: Metrics = {
-            "loss": (loss.sum(), n_tok),
+            "loss": (loss_sum, n_tok),
             "accuracy": (correct.astype(jnp.float32), n_tok),
         }
-        total = loss.sum() / jnp.maximum(n_tok, 1.0)
+        total = loss_sum / jnp.maximum(n_tok, 1.0)
         if config.moe_experts > 0:
-            metrics["moe_balance"] = (aux_loss * n_tok, n_tok)
+            # Metric: the full-model per-layer balance mean.  Under PP each
+            # rank holds only its stage's share (aux_loss sums to the full
+            # mean across ranks) and n_tok is nonzero on the last rank only —
+            # psum the shares so the reported value covers every layer.
+            aux_metric = aux_loss
+            if config.pipe_size > 1:
+                aux_metric = lax.psum(aux_loss, config.pipe_axis)
+            metrics["moe_balance"] = (aux_metric * n_tok, n_tok)
             total = total + config.moe_balance_weight * aux_loss
         return total, metrics
 

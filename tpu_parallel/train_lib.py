@@ -24,6 +24,7 @@ from tpu_parallel.models import GPTLM, GPTConfig, make_gpt_loss
 from tpu_parallel.models import gpt2_125m, gpt2_350m, llama_1b, tiny_test
 from tpu_parallel.parallel.spmd import TrainFunctions, build_train_functions
 from tpu_parallel.runtime import MeshConfig, make_mesh
+from tpu_parallel.utils.profiling import mfu
 
 MODEL_REGISTRY: Dict[str, Callable[..., GPTConfig]] = {
     "gpt2_125m": gpt2_125m,
@@ -157,19 +158,41 @@ class Trainer:
             self.init()
         steps = steps if steps is not None else self.config.steps
         state, metrics = self.state, None
-        t0 = time.perf_counter()
         tokens_per_step = (
             self.config.global_batch_size * self.model_config.seq_len
         )
         last = {}
+        t_start = t0 = time.perf_counter()
+        timed_from = 0  # throughput covers steps AFTER this one
         for step in range(1, steps + 1):
             batch = next(batch_iter) if batch_iter is not None else self.example_batch
             state, metrics = self.funcs.step_fn(state, metrics, batch)
+            if step == 1:
+                # steady-state timing: the first step carries compilation —
+                # restart the clock so tokens_per_sec reflects the machine,
+                # not the compiler (bench.py measures the same way)
+                jax.block_until_ready(metrics)
+                t0 = time.perf_counter()
+                timed_from = 1
             if step % self.config.log_every == 0 or step == steps:
                 jax.block_until_ready(metrics)
                 dt = time.perf_counter() - t0
                 last = compute_metrics(metrics)
-                last["tokens_per_sec"] = tokens_per_step * step / dt
+                timed = step - timed_from
+                if timed > 0:
+                    last["tokens_per_sec"] = tokens_per_step * timed / dt
+                else:
+                    # a 1-step run has no steady-state window; report the
+                    # compile-inclusive rate rather than dropping the key
+                    last["tokens_per_sec"] = tokens_per_step * step / max(
+                        time.perf_counter() - t_start, 1e-9
+                    )
+                util = mfu(
+                    last["tokens_per_sec"] / jax.device_count(),
+                    self.model_config,
+                )
+                if util is not None:  # None off-TPU (no known peak FLOPs)
+                    last["mfu"] = util
                 if log_fn is not None:
                     log_fn(step, last)
         jax.block_until_ready(state)

@@ -40,6 +40,9 @@ def main(argv):
     checkpoint_every = trainer_cd.pop("checkpoint_every", 100)
     data_path = trainer_cd.pop("data_path", "")
     eval_steps = trainer_cd.pop("eval_steps", 0)
+    # fraction of the token stream held out for eval (never trained on);
+    # defaults on whenever eval is requested over a real dataset
+    eval_fraction = trainer_cd.pop("eval_fraction", 0.1 if eval_steps else 0.0)
     config = TrainerConfig.from_config_dict(trainer_cd)
     trainer = Trainer(config)
     logging.info(
@@ -58,7 +61,12 @@ def main(argv):
             trainer.mesh,
             config.global_batch_size,
             seed=config.seed,
+            holdout_fraction=eval_fraction,
         )
+        if eval_steps:
+            # fail fast: an eval split smaller than one batch (or
+            # eval_fraction=0) should abort before training, not after it
+            data_loader.eval_view()
 
     def log_fn(step, metrics):
         parts = " ".join(f"{k}={v:.4f}" for k, v in sorted(metrics.items()))
@@ -78,9 +86,9 @@ def main(argv):
         )
     logging.info("final: %s", final)
     if eval_steps:
-        ev = trainer.evaluate(
-            batch_iter=iter(data_loader) if data_loader else None, steps=eval_steps
-        )
+        # held-out split: windows the train loader can never sample
+        eval_iter = iter(data_loader.eval_view()) if data_loader else None
+        ev = trainer.evaluate(batch_iter=eval_iter, steps=eval_steps)
         logging.info("eval: %s", ev)
 
 
