@@ -1,0 +1,121 @@
+"""Worker process for the multi-host correctness tests.
+
+Launched (2x) by ``tests/test_multihost.py`` with a shared coordinator port.
+Each worker simulates 4 CPU devices, joins the 2-process jax.distributed
+cluster (global mesh: 8 devices over 2 hosts), and exercises the exact
+multi-host paths the single-process test suite cannot reach
+(SURVEY.md §7 "Multi-host correctness"):
+
+- per-process disjoint loader shards (``data.DataLoader``),
+- ``make_global_batch`` / ``jax.make_array_from_process_local_data``,
+- one DP train step with cross-process collectives.
+
+Results land in ``<outdir>/worker<i>.npz`` for the parent test to compare
+against its own single-process ground truth.
+
+Usage: python multihost_worker.py <process_id> <num_processes> <port> <outdir>
+"""
+
+import os
+import sys
+
+
+def main(process_id: int, num_processes: int, port: int, outdir: str) -> None:
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    # the framework's bootstrap path, not a hand-rolled initialize
+    from tpu_parallel.runtime import initialize
+
+    initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    assert jax.process_count() == num_processes, jax.process_count()
+    assert jax.device_count() == 4 * num_processes
+    assert jax.local_device_count() == 4
+
+    from tpu_parallel.core import TrainState
+    from tpu_parallel.core.losses import make_classification_loss
+    from tpu_parallel.data import DataLoader, TokenDataset, make_global_batch
+    from tpu_parallel.models import MLPClassifier, MLPConfig
+    from tpu_parallel.parallel import dp
+    from tpu_parallel.runtime import MeshConfig, make_mesh
+
+    mesh = make_mesh(MeshConfig(data=8))
+
+    # --- loader: per-process shards must be disjoint and deterministic -----
+    ds = TokenDataset(os.path.join(outdir, "corpus.bin"), seq_len=16)
+    loader = DataLoader(ds, mesh, global_batch_size=8, seed=7)
+    local_rows = []
+    global_tokens = []
+    for step in range(3):
+        batch = loader.batch_at(step)
+        # the pre-lift local shard (deterministic row content per process)
+        epoch, b = divmod(step, loader.batches_per_epoch)
+        order = loader._epoch_order(epoch) + loader._window_offset
+        rows = order[b * 8 : (b + 1) * 8][process_id::num_processes]
+        local_rows.append(rows)
+        # the global array must reassemble to the full batch on every host:
+        # all-gather the addressable shards through the cluster
+        from jax.experimental import multihost_utils
+
+        global_tokens.append(
+            np.asarray(multihost_utils.process_allgather(batch.tokens, tiled=True))
+        )
+
+    # --- one DP train step with cross-process pmean ------------------------
+    from tpu_parallel.data import classification_batch
+
+    cls_batch = classification_batch(jax.random.PRNGKey(0), 16, 32, 10)
+    model = MLPClassifier(MLPConfig(hidden_size=32, dtype=jnp.float32))
+    tx = optax.sgd(0.1)
+
+    def init(rng, inputs):
+        p = model.init({"params": rng}, jnp.zeros_like(inputs), train=False)[
+            "params"
+        ]
+        return TrainState.create(
+            apply_fn=model.apply, params=p, tx=tx, rng=rng
+        )
+
+    state = dp.make_init(init, mesh=mesh)(jax.random.PRNGKey(1), cls_batch.inputs)
+    step_fn = dp.make_train_step(
+        make_classification_loss("data"), num_minibatches=2, mesh=mesh, donate=False
+    )
+    # feed the batch as a global array built from per-process local halves —
+    # the real multi-host feeding path
+    local_half = jax.tree_util.tree_map(
+        lambda x: np.asarray(x)[process_id::num_processes], cls_batch
+    )
+    global_batch = make_global_batch(local_half, mesh, P("data"))
+    state, metrics = step_fn(state, None, global_batch)
+    jax.block_until_ready(state)
+
+    params_flat = {
+        "/".join(str(k) for k in path): np.asarray(leaf.addressable_data(0))
+        for path, leaf in jax.tree_util.tree_flatten_with_path(state.params)[0]
+    }
+    loss_sum = np.asarray(metrics["loss"][0].addressable_data(0))
+
+    np.savez(
+        os.path.join(outdir, f"worker{process_id}.npz"),
+        local_rows=np.stack(local_rows),
+        global_tokens=np.stack(global_tokens),
+        loss_sum=loss_sum,
+        **params_flat,
+    )
+    print(f"worker {process_id} ok", flush=True)
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])

@@ -98,3 +98,114 @@ def test_attention_hook_in_model(rng):
     np.testing.assert_allclose(
         np.asarray(out_hook), np.asarray(out_model), rtol=2e-3, atol=2e-3
     )
+
+
+def _packed_segments(rng, b, s):
+    """Random monotone segment ids: 3 segments of random lengths per row."""
+    cuts = jax.random.randint(rng, (b, 2), 1, s - 1)
+    lo = jnp.minimum(cuts[:, 0], cuts[:, 1])[:, None]
+    hi = jnp.maximum(cuts[:, 0], cuts[:, 1])[:, None]
+    pos = jnp.arange(s)[None, :]
+    return (pos >= lo).astype(jnp.int32) + (pos >= hi).astype(jnp.int32)
+
+
+def test_packed_forward_matches_reference(rng):
+    """segment_ids run in-kernel (no fallback) and match the masked reference."""
+    q, k, v = _make_qkv(rng, b=2, s=256)
+    seg = _packed_segments(jax.random.PRNGKey(9), 2, 256)
+    out = flash_attention(
+        q, k, v, segment_ids=seg, block_q=64, block_k=64, interpret=True
+    )
+    ref = reference_attention(
+        q.transpose(0, 2, 1, 3),
+        k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3),
+        segment_ids=seg,
+    ).transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
+def test_packed_no_cross_segment_leakage(rng):
+    """Perturbing segment 0's K/V must not change segment 1+ outputs."""
+    q, k, v = _make_qkv(rng, b=1, s=128, h=1, d=32)
+    seg = jnp.concatenate(
+        [jnp.zeros((1, 64), jnp.int32), jnp.ones((1, 64), jnp.int32)], axis=1
+    )
+    out1 = flash_attention(q, k, v, segment_ids=seg, block_q=64, block_k=64, interpret=True)
+    k2 = k.at[:, :64].add(1.0)
+    v2 = v.at[:, :64].add(1.0)
+    out2 = flash_attention(q, k2, v2, segment_ids=seg, block_q=64, block_k=64, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(out1[:, 64:]), np.asarray(out2[:, 64:]), rtol=1e-5, atol=1e-5
+    )
+    assert not np.allclose(np.asarray(out1[:, :64]), np.asarray(out2[:, :64]))
+
+
+def test_packed_gradients_match_reference(rng):
+    q, k, v = _make_qkv(rng, b=1, s=128, h=2, d=32)
+    seg = _packed_segments(jax.random.PRNGKey(4), 1, 128)
+
+    def loss_flash(q, k, v):
+        return (
+            flash_attention(
+                q, k, v, segment_ids=seg, block_q=64, block_k=64, interpret=True
+            )
+            ** 2
+        ).sum()
+
+    def loss_ref(q, k, v):
+        out = reference_attention(
+            q.transpose(0, 2, 1, 3),
+            k.transpose(0, 2, 1, 3),
+            v.transpose(0, 2, 1, 3),
+            segment_ids=seg,
+        ).transpose(0, 2, 1, 3)
+        return (out**2).sum()
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-3, atol=5e-3,
+            err_msg=f"d{name} mismatch",
+        )
+
+
+def test_packed_model_trains_with_flash(rng):
+    """End-to-end: a GPT with attn_impl='flash' accepts packed batches."""
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_parallel.core import compute
+    from tpu_parallel.core.state import TextBatch, TrainState
+    from tpu_parallel.data import lm_batch
+    from tpu_parallel.models import GPTLM, make_gpt_loss, tiny_test
+    from tpu_parallel.parallel.spmd import build_train_functions
+    from tpu_parallel.runtime import MeshConfig, make_mesh
+
+    mesh = make_mesh(MeshConfig(data=8))
+    cfg = tiny_test(attn_impl="flash", seq_len=64)
+    base = lm_batch(jax.random.PRNGKey(0), 16, cfg.seq_len, cfg.vocab_size)
+    seg = np.asarray(_packed_segments(jax.random.PRNGKey(2), 16, cfg.seq_len))
+    batch = TextBatch(
+        tokens=base.tokens, targets=base.targets, loss_mask=base.loss_mask,
+        positions=base.positions, segment_ids=seg,
+    )
+    model = GPTLM(cfg)
+    tx = optax.adamw(3e-3)
+
+    def init(rng_, b):
+        v = model.init({"params": rng_}, b.tokens, train=False)["params"]
+        return TrainState.create(apply_fn=model.apply, params=v, tx=tx, rng=rng_)
+
+    funcs = build_train_functions(
+        init, make_gpt_loss(cfg), mesh, batch, batch_spec=P("data"), donate=False,
+        # interpret-mode pallas inside the step: JAX vma limitation (see spmd)
+        check_vma=False,
+    )
+    state = funcs.init_fn(rng, batch)
+    state, m0 = funcs.step_fn(state, None, batch)
+    first = compute(m0)["loss"]
+    for _ in range(5):
+        state, m = funcs.step_fn(state, None, batch)
+    assert compute(m)["loss"] < first

@@ -113,6 +113,9 @@ def test_gpt_ulysses_attention_training(mesh_seq4, rng):
         grad_sync_axes=("data", "seq"),
         metric_axes=("data", "seq"),
         donate=False,
+        # ulysses runs the flash kernel in interpret mode on CPU: JAX vma
+        # limitation (see build_train_functions docstring)
+        check_vma=False,
     )
     state = funcs.init_fn(rng, batch)
     state, m0 = funcs.step_fn(state, None, batch)

@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from tpu_parallel.core.metrics import Metrics, zeros_like_metrics
+from tpu_parallel.core.metrics import Metrics, pvary_missing
 from tpu_parallel.core.state import TrainState
 
 Pytree = Any
@@ -87,8 +87,20 @@ def accumulate_gradients_scan(
         mb = _slice_minibatch(batch, idx, minibatch_size)
         return _grads_and_metrics(state, mb, step_rng, loss_fn)
 
+    # Zero-init carry from eval_shape (the reference's ``util.py:123-129``
+    # pattern), with each leaf promoted to the varying-axes type eval_shape
+    # inferred for the real step outputs: under shard_map's replication
+    # checker (check_vma) plain zeros would under-claim (they look
+    # replicated) and scan requires carry-in/carry-out types to match.
+    # Unrolling minibatch 0 outside the scan would fix the types too, but at
+    # the cost of compiling the whole fwd+bwd region twice.
     shapes = jax.eval_shape(one_step, jnp.asarray(0), rngs[0])
-    carry_init = zeros_like_metrics(shapes)
+    carry_init = jax.tree_util.tree_map(
+        lambda s: pvary_missing(
+            jnp.zeros(s.shape, s.dtype), tuple(getattr(s, "vma", ()) or ())
+        ),
+        shapes,
+    )
 
     def scan_step(carry, xs):
         idx, step_rng = xs

@@ -29,10 +29,9 @@ def token_cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:
     dominant buffer at GPT-2 vocab sizes).  The upcast here fuses into the
     log-softmax reductions on TPU, so no fp32 logits tensor materializes.
     """
-    logits = logits.astype(jnp.float32)
-    lse = jax.scipy.special.logsumexp(logits, axis=-1)
-    ll = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return lse - ll
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), targets
+    )
 
 
 def make_classification_loss(fold_axes: AxisNames = "data") -> Callable:

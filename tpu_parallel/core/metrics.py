@@ -18,6 +18,42 @@ from jax import lax
 Metrics = Dict[str, Tuple[jax.Array, jax.Array]]
 
 
+def vma_of(x) -> Tuple[str, ...]:
+    """The mesh axes ``x`` is varying over (empty outside shard_map).
+
+    Single home for the version-sensitive vma introspection — works on
+    traced arrays and on ``jax.eval_shape`` results.
+    """
+    return tuple(getattr(jax.typeof(x), "vma", ()) or ())
+
+
+def _cast_varying(x: jax.Array, axis_names: Sequence[str]) -> jax.Array:
+    # lax.pcast supersedes the deprecated lax.pvary; keep the fallback while
+    # the pinned jax still ships both
+    pcast = getattr(lax, "pcast", None)
+    if pcast is not None:
+        return pcast(x, tuple(axis_names), to="varying")
+    return lax.pvary(x, tuple(axis_names))
+
+
+def pvary_missing(x: jax.Array, axis_names: Sequence[str]) -> jax.Array:
+    """Promote ``x`` to "varying" over any of ``axis_names`` it isn't yet.
+
+    Under shard_map's replication checker (check_vma=True) a collective may
+    only reduce over axes its operand varies on; a metric computed from
+    replicated inputs (e.g. an eval loss on a broadcast batch) is *invarying*
+    over the data axis and a bare ``psum(x, "data")`` is rejected.  The
+    promotion is semantically free — the per-device values are identical, so
+    the sum simply multiplies by the axis size exactly as it did with the
+    checker off.  Outside shard_map (no vma tracking) this is a no-op.
+    """
+    vma = getattr(jax.typeof(x), "vma", None)
+    if vma is None:
+        return x
+    missing = tuple(a for a in axis_names if a not in vma)
+    return _cast_varying(x, missing) if missing else x
+
+
 def metric(value: jax.Array, count: Union[int, jax.Array] = 1) -> Tuple[jax.Array, jax.Array]:
     """Build one (sum, count) entry. ``value`` should already be a sum."""
     return (jnp.asarray(value, jnp.float32), jnp.asarray(count, jnp.float32))
@@ -42,9 +78,9 @@ def sync_metrics(
 
     def _sync(x):
         if axis_names:
-            x = lax.psum(x, axis_names)
+            x = lax.psum(pvary_missing(x, axis_names), axis_names)
         if mean_axes:
-            x = lax.pmean(x, mean_axes)
+            x = lax.pmean(pvary_missing(x, mean_axes), mean_axes)
         return x
 
     with jax.named_scope("sync_metrics"):

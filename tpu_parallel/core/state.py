@@ -25,7 +25,14 @@ class TrainState(train_state.TrainState):
     in, state out, no Python-side RNG bookkeeping.
     """
 
-    rng: jax.Array = None
+    rng: jax.Array = struct.field(
+        default_factory=lambda: (_ for _ in ()).throw(
+            TypeError(
+                "TrainState requires an explicit rng key: "
+                "TrainState.create(..., rng=jax.random.PRNGKey(seed))"
+            )
+        )
+    )
 
 
 @struct.dataclass

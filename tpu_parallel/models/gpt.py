@@ -166,7 +166,9 @@ def make_gpt_loss(config: GPTConfig, train: bool = True):
     rematerialized ``lax.scan`` — the full [B, S, vocab] logits tensor never
     materializes (see ``GPTConfig.loss_chunk``).
     """
-    fold_axes = (config.data_axis, config.model_axis, config.pipe_axis)
+    fold_axes = (
+        config.data_axis, config.model_axis, config.pipe_axis, config.seq_axis
+    )
     chunk = config.loss_chunk
     head = _make_lm_head(config, name=None) if chunk else None
 
@@ -188,7 +190,19 @@ def make_gpt_loss(config: GPTConfig, train: bool = True):
             correct = ((logits.argmax(-1) == t_i) * m_i).sum()
             return (carry[0] + ce.sum(), carry[1] + correct), None
 
-        init = (jnp.float32(0.0), jnp.float32(0.0))
+        # promote the zero carry to the body outputs' varying-axes type (the
+        # hidden states' axes plus the model axis, which the lm_head's
+        # gather_output all_gather introduces) so the scan type-checks under
+        # shard_map's replication checker
+        from tpu_parallel.core.metrics import pvary_missing, vma_of
+
+        vma = vma_of(h)
+        if vma and config.model_axis not in vma:
+            vma = vma + (config.model_axis,)
+        init = (
+            pvary_missing(jnp.float32(0.0), vma),
+            pvary_missing(jnp.float32(0.0), vma),
+        )
         (loss_sum, correct), _ = lax.scan(jax.checkpoint(body), init, (hs, ts, ms))
         return loss_sum, correct
 

@@ -11,6 +11,12 @@ structure is exploited twice: key blocks beyond the query block are skipped
 (not masked — skipped), and the backward kernels iterate only the triangle
 they need.
 
+Packed sequences: ``segment_ids`` [batch, seq] adds a same-segment condition
+to the causal mask in all three kernels (each query can always see itself, so
+no row is ever fully masked).  The segment mask rides the same fp32 score
+tile the causal mask uses — no extra HBM traffic beyond one int32 [seq] lane
+per batch row.
+
 Falls back to the jnp reference implementation off-TPU (CPU tests run the
 kernels in interpret mode explicitly).
 """
@@ -18,6 +24,7 @@ kernels in interpret mode explicitly).
 from __future__ import annotations
 
 import functools
+import warnings
 from typing import Optional, Tuple
 
 import jax
@@ -38,27 +45,51 @@ NEG_INF = -1e30
 
 
 def reference_attention(
-    q: jax.Array, k: jax.Array, v: jax.Array
+    q: jax.Array, k: jax.Array, v: jax.Array, segment_ids: Optional[jax.Array] = None
 ) -> jax.Array:
     """jnp causal attention on [B, H, S, D] (fp32 softmax) — ground truth."""
     scale = 1.0 / jnp.sqrt(q.shape[-1])
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
     s = q.shape[2]
     mask = jnp.tril(jnp.ones((s, s), bool))
+    if segment_ids is not None:
+        same = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+        mask = jnp.logical_and(mask, same)
     scores = jnp.where(mask, scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), v)
 
 
+
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct for pallas out_shape, inheriting ``like``'s varying
+    axes — under shard_map's replication checker (check_vma=True) pallas
+    outputs must declare their vma explicitly."""
+    from tpu_parallel.core.metrics import vma_of
+
+    vma = vma_of(like)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 # --- forward kernel -----------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k, scale):
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, *rest, block_q, block_k, scale, has_segments
+):
+    if has_segments:
+        seg_ref, o_ref, lse_ref = rest
+    else:
+        o_ref, lse_ref = rest
     qi = pl.program_id(1)
     # keep MXU operands in the input dtype (bf16 on TPU: full MXU rate) and
     # accumulate fp32 via preferred_element_type; fp32 operands would run
     # the systolic array at a fraction of peak
     q = (q_ref[0] * jnp.asarray(scale, q_ref.dtype)).astype(q_ref.dtype)
+    if has_segments:
+        seg_q = seg_ref[0, pl.ds(qi * block_q, block_q), :]  # [bq, 1]
     num_k_blocks = (qi + 1) * block_q // block_k  # causal: only blocks <= qi
 
     def body(ki, carry):
@@ -68,7 +99,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k, scale)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
         q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
         k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        mask = q_pos >= k_pos
+        if has_segments:
+            seg_k = seg_ref[0, pl.ds(ki * block_k, block_k), :]  # [bk, 1]
+            mask = jnp.logical_and(mask, seg_q == seg_k.T)
+        s = jnp.where(mask, s, NEG_INF)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
@@ -95,6 +130,7 @@ def _flash_fwd(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
+    seg: Optional[jax.Array],
     *,
     block_q: int,
     block_k: int,
@@ -107,26 +143,38 @@ def _flash_fwd(
     kf = k.reshape(bh, s, d)
     vf = v.reshape(bh, s, d)
     grid = (bh, s // block_q)
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0)),
+        pl.BlockSpec((1, s, d), lambda bh_, qi: (bh_, 0, 0)),
+        pl.BlockSpec((1, s, d), lambda bh_, qi: (bh_, 0, 0)),
+    ]
+    args = [qf, kf, vf]
+    if seg is not None:
+        # seg is [B, S, 1]; all H heads of batch row b read the same block
+        in_specs.append(
+            pl.BlockSpec((1, s, 1), lambda bh_, qi: (bh_ // h, 0, 0))
+        )
+        args.append(seg)
     out, lse = pl.pallas_call(
         functools.partial(
-            _fwd_kernel, block_q=block_q, block_k=block_k, scale=scale
+            _fwd_kernel,
+            block_q=block_q,
+            block_k=block_k,
+            scale=scale,
+            has_segments=seg is not None,
         ),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0)),
-            pl.BlockSpec((1, s, d), lambda bh_, qi: (bh_, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda bh_, qi: (bh_, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0)),
             pl.BlockSpec((1, block_q, 1), lambda bh_, qi: (bh_, qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
+            _sds((bh, s, d), q.dtype, qf),
+            _sds((bh, s, 1), jnp.float32, qf),
         ],
         interpret=interpret,
-    )(qf, kf, vf)
+    )(*args)
     return out.reshape(b, h, s, d), lse.reshape(b, h, s)
 
 
@@ -134,13 +182,20 @@ def _flash_fwd(
 
 
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, block_q, block_k, scale
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    block_q, block_k, scale, has_segments,
 ):
+    if has_segments:
+        seg_ref, dq_ref = rest
+    else:
+        (dq_ref,) = rest
     qi = pl.program_id(1)
     q = (q_ref[0] * jnp.asarray(scale, q_ref.dtype)).astype(q_ref.dtype)
     do = do_ref[0]  # [bq, D]
     lse = lse_ref[0]  # [bq, 1]
     delta = delta_ref[0]  # [bq, 1]
+    if has_segments:
+        seg_q = seg_ref[0, pl.ds(qi * block_q, block_q), :]
     num_k_blocks = (qi + 1) * block_q // block_k
 
     def body(ki, dq):
@@ -149,7 +204,11 @@ def _bwd_dq_kernel(
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
         k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        mask = q_pos >= k_pos
+        if has_segments:
+            seg_k = seg_ref[0, pl.ds(ki * block_k, block_k), :]
+            mask = jnp.logical_and(mask, seg_q == seg_k.T)
+        s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse)  # [bq, bk]
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = (p * (dp - delta)).astype(k.dtype)
@@ -161,12 +220,18 @@ def _bwd_dq_kernel(
 
 
 def _bwd_dkv_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    *, block_q, block_k, scale, seq_len,
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    block_q, block_k, scale, seq_len, has_segments,
 ):
+    if has_segments:
+        seg_ref, dk_ref, dv_ref = rest
+    else:
+        dk_ref, dv_ref = rest
     ki = pl.program_id(1)
     k = k_ref[0]  # [block_k, D]
     v = v_ref[0]
+    if has_segments:
+        seg_k = seg_ref[0, pl.ds(ki * block_k, block_k), :]  # [bk, 1]
     num_q_blocks = seq_len // block_q
     first_q_block = ki * block_k // block_q  # causal: q blocks >= diag only
 
@@ -182,7 +247,11 @@ def _bwd_dkv_kernel(
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
         q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
         k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        mask = q_pos >= k_pos
+        if has_segments:
+            seg_q = seg_ref[0, pl.ds(qi * block_q, block_q), :]
+            mask = jnp.logical_and(mask, seg_q == seg_k.T)
+        s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse)
         dv = dv + jnp.dot(
             p.astype(do.dtype).T, do, preferred_element_type=jnp.float32
@@ -201,7 +270,7 @@ def _bwd_dkv_kernel(
 
 
 def _flash_bwd(
-    q, k, v, out, lse, do, *, block_q, block_k, interpret
+    q, k, v, seg, out, lse, do, *, block_q, block_k, interpret
 ):
     b, h, s, d = q.shape
     scale = 1.0 / (d**0.5)
@@ -211,25 +280,51 @@ def _flash_bwd(
     dof = do.reshape(bh, s, d)
     lsef = lse.reshape(bh, s, 1)
     deltaf = delta.reshape(bh, s, 1)
+    has_segments = seg is not None
 
+    in_specs = [
+        pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0)),
+        pl.BlockSpec((1, s, d), lambda bh_, qi: (bh_, 0, 0)),
+        pl.BlockSpec((1, s, d), lambda bh_, qi: (bh_, 0, 0)),
+        pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda bh_, qi: (bh_, qi, 0)),
+        pl.BlockSpec((1, block_q, 1), lambda bh_, qi: (bh_, qi, 0)),
+    ]
+    args = [qf, kf, vf, dof, lsef, deltaf]
+    if has_segments:
+        in_specs.append(
+            pl.BlockSpec((1, s, 1), lambda bh_, qi: (bh_ // h, 0, 0))
+        )
+        args.append(seg)
     dq = pl.pallas_call(
         functools.partial(
-            _bwd_dq_kernel, block_q=block_q, block_k=block_k, scale=scale
+            _bwd_dq_kernel,
+            block_q=block_q,
+            block_k=block_k,
+            scale=scale,
+            has_segments=has_segments,
         ),
         grid=(bh, s // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0)),
-            pl.BlockSpec((1, s, d), lambda bh_, qi: (bh_, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda bh_, qi: (bh_, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda bh_, qi: (bh_, qi, 0)),
-            pl.BlockSpec((1, block_q, 1), lambda bh_, qi: (bh_, qi, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+        out_shape=_sds((bh, s, d), q.dtype, qf),
         interpret=interpret,
-    )(qf, kf, vf, dof, lsef, deltaf)
+    )(*args)
 
+    in_specs = [
+        pl.BlockSpec((1, s, d), lambda bh_, ki: (bh_, 0, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh_, ki: (bh_, ki, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh_, ki: (bh_, ki, 0)),
+        pl.BlockSpec((1, s, d), lambda bh_, ki: (bh_, 0, 0)),
+        pl.BlockSpec((1, s, 1), lambda bh_, ki: (bh_, 0, 0)),
+        pl.BlockSpec((1, s, 1), lambda bh_, ki: (bh_, 0, 0)),
+    ]
+    args = [qf, kf, vf, dof, lsef, deltaf]
+    if has_segments:
+        in_specs.append(
+            pl.BlockSpec((1, s, 1), lambda bh_, ki: (bh_ // h, 0, 0))
+        )
+        args.append(seg)
     dk, dv = pl.pallas_call(
         functools.partial(
             _bwd_dkv_kernel,
@@ -237,26 +332,20 @@ def _flash_bwd(
             block_k=block_k,
             scale=scale,
             seq_len=s,
+            has_segments=has_segments,
         ),
         grid=(bh, s // block_k),
-        in_specs=[
-            pl.BlockSpec((1, s, d), lambda bh_, ki: (bh_, 0, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh_, ki: (bh_, ki, 0)),
-            pl.BlockSpec((1, block_k, d), lambda bh_, ki: (bh_, ki, 0)),
-            pl.BlockSpec((1, s, d), lambda bh_, ki: (bh_, 0, 0)),
-            pl.BlockSpec((1, s, 1), lambda bh_, ki: (bh_, 0, 0)),
-            pl.BlockSpec((1, s, 1), lambda bh_, ki: (bh_, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda bh_, ki: (bh_, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh_, ki: (bh_, ki, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, s, d), q.dtype),
+            _sds((bh, s, d), q.dtype, qf),
+            _sds((bh, s, d), q.dtype, qf),
         ],
         interpret=interpret,
-    )(qf, kf, vf, dof, lsef, deltaf)
+    )(*args)
 
     return (
         dq.reshape(b, h, s, d),
@@ -268,25 +357,28 @@ def _flash_bwd(
 # --- public API with custom VJP ----------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_attention_bhsd(q, k, v, block_q, block_k, interpret):
-    out, _ = _flash_fwd(q, k, v, block_q=block_q, block_k=block_k, interpret=interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_attention_bhsd(q, k, v, seg, block_q, block_k, interpret):
+    out, _ = _flash_fwd(
+        q, k, v, seg, block_q=block_q, block_k=block_k, interpret=interpret
+    )
     return out
 
 
-def _fwd_rule(q, k, v, block_q, block_k, interpret):
+def _fwd_rule(q, k, v, seg, block_q, block_k, interpret):
     out, lse = _flash_fwd(
-        q, k, v, block_q=block_q, block_k=block_k, interpret=interpret
+        q, k, v, seg, block_q=block_q, block_k=block_k, interpret=interpret
     )
-    return out, (q, k, v, out, lse)
+    return out, (q, k, v, seg, out, lse)
 
 
 def _bwd_rule(block_q, block_k, interpret, residuals, do):
-    q, k, v, out, lse = residuals
+    q, k, v, seg, out, lse = residuals
     dq, dk, dv = _flash_bwd(
-        q, k, v, out, lse, do, block_q=block_q, block_k=block_k, interpret=interpret
+        q, k, v, seg, out, lse, do,
+        block_q=block_q, block_k=block_k, interpret=interpret,
     )
-    return dq, dk, dv
+    return dq, dk, dv, None  # integer segment ids carry no gradient
 
 
 _flash_attention_bhsd.defvjp(_fwd_rule, _bwd_rule)
@@ -306,24 +398,31 @@ def flash_attention(
 
     Drop-in replacement for
     :func:`tpu_parallel.models.layers.causal_attention` (the ``attn_fn``
-    hook).  ``segment_ids`` (packed sequences) are not yet supported by the
-    kernel — falls back to the reference path.  ``interpret`` defaults to
-    True off-TPU so tests exercise the same kernel code on CPU.
+    hook).  ``segment_ids`` [batch, seq] masks attention to same-segment
+    prefixes (packed sequences) inside the kernel.  ``interpret`` defaults
+    to True off-TPU so tests exercise the same kernel code on CPU.
     """
     b, s, h, d = q.shape
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     block_q = min(block_q, s)
     block_k = min(block_k, s)
-    if (
-        segment_ids is not None
-        or s % block_q != 0
-        or s % block_k != 0
-        or block_q % block_k != 0
-    ):
+    if s % block_q != 0 or s % block_k != 0 or block_q % block_k != 0:
+        # O(seq^2) escape hatch for shapes the kernel can't tile — loud, not
+        # silent: this is a memory/perf cliff the caller should know about
+        warnings.warn(
+            f"flash_attention falling back to the O(seq^2) reference path: "
+            f"seq_len={s} not divisible by block_q={block_q}/block_k={block_k}",
+            stacklevel=2,
+        )
         from tpu_parallel.models.layers import causal_attention
 
         return causal_attention(q, k, v, segment_ids=segment_ids)
+    seg = None
+    if segment_ids is not None:
+        # one int32 lane per batch row ([B, S, 1]); the kernels' BlockSpec
+        # index maps route all H heads of row b to the same block
+        seg = segment_ids.astype(jnp.int32)[:, :, None]
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
-    out = _flash_attention_bhsd(qt, kt, vt, block_q, block_k, interpret)
+    out = _flash_attention_bhsd(qt, kt, vt, seg, block_q, block_k, interpret)
     return out.transpose(0, 2, 1, 3)

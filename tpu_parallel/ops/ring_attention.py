@@ -85,6 +85,13 @@ def ring_attention(
     out0 = jnp.zeros((b, h, local_s, d), jnp.float32)
     m0 = jnp.full((b, h, local_s, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, local_s, 1), jnp.float32)
+    # the accumulators come out of `combine` varying over every axis q varies
+    # on; promote the zero inits to the same type so the scan carry
+    # type-checks under shard_map's replication checker
+    from tpu_parallel.core.metrics import pvary_missing, vma_of
+
+    q_vma = vma_of(q)
+    out0, m0, l0 = (pvary_missing(x, q_vma) for x in (out0, m0, l0))
     init = ((out0, m0, l0), (k, v, my_chunk))
     ((out, m, l), _), _ = lax.scan(step, init, None, length=n_chunks)
     out = out / jnp.maximum(l, 1e-20)

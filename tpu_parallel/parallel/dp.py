@@ -72,7 +72,7 @@ def make_train_step(
         mesh=mesh,
         in_specs=(P(), P(), P(data_axis)),
         out_specs=(P(), P()),
-        check_vma=False,
+        check_vma=True,
     )
     return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
 
@@ -94,6 +94,6 @@ def make_init(
             mesh=mesh,
             in_specs=(P(), P(data_axis)),
             out_specs=P(),
-            check_vma=False,
+            check_vma=True,
         )
     )

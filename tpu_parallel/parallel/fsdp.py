@@ -27,6 +27,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from tpu_parallel.core.metrics import pvary_missing
+
 logger = logging.getLogger("tpu_parallel")
 
 Pytree = Any
@@ -189,6 +191,9 @@ def sync_gradients(
         replicated_loss_axes = (replicated_loss_axes,)
 
     def sync(g):
+        # pvary_missing: a gradient that is provably identical across an axis
+        # (invarying under check_vma) must be promoted before reducing over
+        # it — same values, same result, but the types line up
         if isinstance(g, nn.Partitioned):
             mean_axes = [a for a in axis_names if a not in g.names]
             sum_axes = [a for a in psum_axes if a not in g.names]
@@ -197,15 +202,15 @@ def sync_gradients(
             ]
             value = g.value
             if mean_axes:
-                value = lax.pmean(value, mean_axes)
+                value = lax.pmean(pvary_missing(value, mean_axes), mean_axes)
             if sum_axes:
-                value = lax.psum(value, sum_axes)
+                value = lax.psum(pvary_missing(value, sum_axes), sum_axes)
             for a in div_axes:
                 value = value / jnp.asarray(lax.psum(1, a), value.dtype)
             return g.replace(value=value)
-        g = lax.pmean(g, axis_names)
+        g = lax.pmean(pvary_missing(g, axis_names), axis_names)
         if psum_axes:
-            g = lax.psum(g, psum_axes)
+            g = lax.psum(pvary_missing(g, psum_axes), psum_axes)
         return g
 
     return jax.tree_util.tree_map(

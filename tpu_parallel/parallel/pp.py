@@ -130,7 +130,12 @@ def execute_pipeline(
         axis=0,
     )
 
-    carry_init = jnp.zeros_like(microbatches[0])
+    # The rotating carry comes back from ppermute varying over the pipe axis;
+    # promote the zeros init to the same varying type or the scan's
+    # carry-in/carry-out types disagree under shard_map's replication checker
+    from tpu_parallel.core.metrics import pvary_missing
+
+    carry_init = pvary_missing(jnp.zeros_like(microbatches[0]), (axis_name,))
     # aux-loss collections (MoE balance) stack one entry per schedule tick;
     # with pass_validity the stage zeroes bubble-tick entries via aux_scale,
     # so only the num_microbatches real ticks contribute.

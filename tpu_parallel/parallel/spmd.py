@@ -87,6 +87,7 @@ def build_train_functions(
     donate: bool = True,
     init_rng: Optional[jax.Array] = None,
     eval_loss_fn: Optional[LossFn] = None,
+    check_vma: bool = True,
 ) -> TrainFunctions:
     """Build matched (init, train_step) functions for ``mesh``.
 
@@ -108,31 +109,45 @@ def build_train_functions(
     them as replicated (pmean) rather than disjoint (psum).
 
     ``metric_axes``: axes whose ranks hold disjoint tokens — metrics are
-    psum'd over them (defaults to every >1 mesh axis not in
+    psum'd over them (defaults to every mesh axis not in
     ``replicated_loss_axes``).  ``metric_mean_axes``: replicated-compute axes
-    — pmean'd so counts stay exact (defaults to the >1 axes of
-    ``replicated_loss_axes``).
+    — pmean'd so counts stay exact (defaults to ``replicated_loss_axes``).
+
+    ``check_vma``: shard_map's replication checker — ON by default (the
+    reference disabled its equivalent everywhere, ``check_rep=False``; we
+    keep it as the race/typing sanitizer it is).  The one legitimate reason
+    to pass False: interpret-mode pallas kernels inside the step (CPU tests
+    of flash attention) trip a JAX vma-inference limitation in
+    ``dynamic_slice`` ("Please open an issue ... as a temporary workaround
+    pass check_vma=False").  Real-TPU pallas does not hit that path.
     """
     if isinstance(grad_sync_axes, str):
         grad_sync_axes = (grad_sync_axes,)
     if isinstance(replicated_loss_axes, str):
         replicated_loss_axes = (replicated_loss_axes,)
+    # Size-1 axes are included on purpose: the reductions are free, and they
+    # normalize the value's varying-axes type so check_vma can prove the
+    # P() out_specs (an all_gather output, e.g. the TP lm_head, stays
+    # "varying" over its axis until a psum/pmean closes it — even at size 1).
     if metric_axes is None:
         metric_axes = tuple(
-            n
-            for n in mesh.axis_names
-            if mesh.shape[n] > 1 and n not in replicated_loss_axes
+            n for n in mesh.axis_names if n not in replicated_loss_axes
         )
     if metric_mean_axes is None:
         metric_mean_axes = tuple(
-            n
-            for n in mesh.axis_names
-            if mesh.shape[n] > 1 and n in replicated_loss_axes
+            n for n in mesh.axis_names if n in replicated_loss_axes
         )
     if init_rng is None:
         init_rng = jax.random.PRNGKey(0)
 
-    # Phase 1: abstract init to discover the partitioning.
+    # Phase 1: abstract init to discover the partitioning.  check_vma must be
+    # off HERE AND ONLY HERE: the whole point of the probe is that the true
+    # out_specs are unknown until this trace reads the nn.Partitioned
+    # metadata off the result, so the placeholder P() necessarily
+    # under-claims for FSDP/TP/PP-partitioned leaves (whose per-device
+    # values vary over their mesh axes via axis_index).  Nothing executes —
+    # the probe runs under eval_shape only.  Every executing shard_map in
+    # this module keeps the checker on.
     probe_init = jax.shard_map(
         model_init, mesh=mesh, in_specs=(P(), batch_spec), out_specs=P(), check_vma=False
     )
@@ -146,7 +161,7 @@ def build_train_functions(
             mesh=mesh,
             in_specs=(P(), batch_spec),
             out_specs=state_specs,
-            check_vma=False,
+            check_vma=check_vma,
         )
     )
 
@@ -173,7 +188,7 @@ def build_train_functions(
         mesh=mesh,
         in_specs=(state_specs, P(), batch_spec),
         out_specs=(state_specs, P()),
-        check_vma=False,
+        check_vma=check_vma,
     )
     step_fn = jax.jit(step_sharded, donate_argnums=(0, 1) if donate else ())
 
@@ -194,7 +209,7 @@ def build_train_functions(
                 mesh=mesh,
                 in_specs=(state_specs, P(), batch_spec),
                 out_specs=P(),
-                check_vma=False,
+                check_vma=check_vma,
             )
         )
 

@@ -124,17 +124,38 @@ class Trainer:
                 rng=rng,
             )
 
+        # Sequence/context parallelism: a >1 ``seq`` axis shards the token
+        # dimension of the batch (ring/Ulysses attention then communicates
+        # K/V over it); gradients pick up a partial contribution per seq
+        # rank, synced by pmean like the data axis.
+        seq_parallel = mesh_sizes.get("seq", 1) > 1
+        if seq_parallel and self.model_config.attn_impl not in ("ring", "ulysses"):
+            raise ValueError(
+                f"mesh has seq={mesh_sizes['seq']} but attn_impl="
+                f"{self.model_config.attn_impl!r} cannot shard the sequence "
+                "axis — use attn_impl='ring' or 'ulysses'"
+            )
+        # exposed so data loaders can place batches in the step's layout
+        # directly (no per-step reshard): train.py passes it to DataLoader
+        self.batch_spec = P("data", "seq") if seq_parallel else P("data")
         self.funcs: TrainFunctions = build_train_functions(
             model_init,
             self.loss_fn,
             self.mesh,
             self.example_batch,
-            batch_spec=P("data"),
-            grad_sync_axes=("data", "model"),
+            batch_spec=self.batch_spec,
+            grad_sync_axes=("data", "seq", "model") if seq_parallel else ("data", "model"),
             grad_psum_axes=("pipe",),
             num_minibatches=config.num_minibatches,
             donate=config.donate,
             eval_loss_fn=make_gpt_loss(self.model_config, train=False),
+            # interpret-mode pallas (flash/ulysses off-TPU) trips a JAX
+            # vma-inference limitation; the checker stays on everywhere else
+            # (see build_train_functions docstring)
+            check_vma=not (
+                self.model_config.attn_impl in ("flash", "ulysses")
+                and jax.default_backend() != "tpu"
+            ),
         )
         self.state: Optional[TrainState] = None
 

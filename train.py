@@ -62,6 +62,7 @@ def main(argv):
             config.global_batch_size,
             seed=config.seed,
             holdout_fraction=eval_fraction,
+            batch_spec=trainer.batch_spec,
         )
         if eval_steps:
             # fail fast: an eval split smaller than one batch (or
