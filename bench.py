@@ -32,8 +32,14 @@ def main():
     )
 
     if on_tpu:
-        model, batch, steps, minib = "gpt2_125m", 8 * n_chips, 20, 1
-        overrides = dict(dropout_rate=0.0)
+        # Defaults from the round-3 sweep (SWEEP_r03.json, scripts/
+        # sweep_bench.py): global_batch 16 with remat_policy="proj" and XLA
+        # attention measured best on v5e-1 (0.2852 MFU vs 0.2669 for the old
+        # batch-8 full-remat config; the Pallas flash kernel measured ~5%
+        # slower than XLA attention at seq 1024, and batch 32 only fits via
+        # loss_chunk whose extra lm_head backward pass nets out slower).
+        model, batch, steps, minib = "gpt2_125m", 16 * n_chips, 20, 1
+        overrides = dict(dropout_rate=0.0, remat=True, remat_policy="proj")
     else:
         model, batch, steps, minib = "tiny", 8 * n_chips, 10, 1
         overrides = dict(num_microbatches=1)

@@ -1,0 +1,134 @@
+"""Profile one training-step workload and print where the time goes.
+
+Runs a few steps of the bench config under ``jax.profiler.trace``, then
+parses the captured ``.xplane.pb`` with ``tensorboard_plugin_profile`` and
+prints the top ops by self time — the evidence needed to close the MFU gap
+(BASELINE.md north star) instead of guessing at configs.
+
+Usage:
+    python scripts/profile_step.py [batch] [remat] [attn] [chunk]
+e.g.
+    python scripts/profile_step.py 16 proj xla 0
+"""
+
+import glob
+import gzip
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_and_trace(batch, remat, attn, chunk, logdir):
+    import jax
+
+    from tpu_parallel.runtime import MeshConfig
+    from tpu_parallel.train_lib import Trainer, TrainerConfig
+    from tpu_parallel.utils.profiling import sync, trace
+
+    overrides = dict(
+        dropout_rate=0.0, attn_impl=attn, loss_chunk=chunk,
+    )
+    if remat in ("dots", "proj"):
+        overrides.update(remat=True, remat_policy=remat)
+    else:
+        overrides.update(remat=remat in ("1", "full"))
+    on_tpu = jax.devices()[0].platform == "tpu"
+    config = TrainerConfig(
+        model="gpt2_125m" if on_tpu else "tiny",
+        model_overrides=overrides,
+        mesh=MeshConfig(data=-1),
+        global_batch_size=batch,
+        steps=5,
+        log_every=10_000,
+        donate=False,  # donation confuses repeated stepping here
+    )
+    trainer = Trainer(config)
+    trainer.init()
+    state, metrics = trainer.state, None
+    for _ in range(3):  # compile + settle outside the trace
+        state, metrics = trainer.funcs.step_fn(state, metrics, trainer.example_batch)
+    sync((state, metrics))
+    with trace(logdir):
+        for _ in range(3):
+            state, metrics = trainer.funcs.step_fn(
+                state, metrics, trainer.example_batch
+            )
+        sync((state, metrics))
+
+
+def summarize(logdir, top=30):
+    """Aggregate per-op device time from the newest xplane.pb.
+
+    Parses the trace with a locally-compiled mirror of the XSpace proto
+    (scripts/xplane.proto) — the image's tensorboard_plugin_profile build
+    can't read xplane files, protoc can.
+    """
+    import subprocess
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    with tempfile.TemporaryDirectory() as tmp:
+        subprocess.run(
+            ["protoc", f"--python_out={tmp}", "--proto_path", here, "xplane.proto"],
+            check=True,
+        )
+        sys.path.insert(0, tmp)
+        os.environ.setdefault("PROTOCOL_BUFFERS_PYTHON_IMPLEMENTATION", "python")
+        import xplane_pb2  # noqa: E402
+
+        xplanes = sorted(
+            glob.glob(os.path.join(logdir, "**", "*.xplane.pb"), recursive=True),
+            key=os.path.getmtime,
+        )
+        if not xplanes:
+            print("no xplane.pb captured", file=sys.stderr)
+            return
+        space = xplane_pb2.XSpace()
+        with open(xplanes[-1], "rb") as f:
+            space.ParseFromString(f.read())
+
+    printed = False
+    for plane in space.planes:
+        is_device = plane.name.startswith("/device:") or "TPU" in plane.name
+        if not is_device:
+            continue
+        printed = True
+        print(f"\n=== plane: {plane.name} ===")
+        totals = {}
+        line_span = 0
+        for line in plane.lines:
+            # XLA op lines carry the per-op schedule; sum self durations
+            span = 0
+            for ev in line.events:
+                name = plane.event_metadata[ev.metadata_id].name
+                totals[name] = totals.get(name, 0) + ev.duration_ps
+                span += ev.duration_ps
+            line_span = max(line_span, span)
+        if not totals:
+            continue
+        grand = sum(totals.values())
+        print(f"{'time%':>7}  {'ms':>9}  op")
+        for name, ps in sorted(totals.items(), key=lambda kv: -kv[1])[:top]:
+            print(f"{ps / grand * 100:7.2f}  {ps / 1e9:9.3f}  {name[:90]}")
+        print(f"total attributed: {grand / 1e9:.3f} ms across {len(totals)} ops")
+    if not printed:
+        # CPU traces carry no per-op device lines — list what was captured
+        names = ", ".join(p.name for p in space.planes)
+        print(f"no device plane with op events (planes: {names})")
+
+
+def main():
+    args = sys.argv[1:]
+    batch = int(args[0]) if len(args) > 0 else 16
+    remat = args[1] if len(args) > 1 else "proj"
+    attn = args[2] if len(args) > 2 else "xla"
+    chunk = int(args[3]) if len(args) > 3 else 0
+    logdir = os.environ.get("PROFILE_DIR", "/tmp/tpu_parallel_profile")
+    run_and_trace(batch, remat, attn, chunk, logdir)
+    summarize(logdir)
+
+
+if __name__ == "__main__":
+    main()

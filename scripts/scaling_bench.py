@@ -104,10 +104,9 @@ def main():
             )
         sync((state, metrics))
         dt = (time.perf_counter() - t0) / iters
-        import jax as _jax
         return dict(
             strategy=strategy,
-            simulated=_jax.devices()[0].platform == "cpu",
+            simulated=jax.devices()[0].platform == "cpu",
             n_chips=n,
             step_time_ms=round(dt * 1e3, 3),
             tokens_per_sec=round(batch * seq_len / dt, 1),

@@ -88,11 +88,20 @@ _SLOW_TESTS = {
 
 
 def pytest_collection_modifyitems(config, items):
+    seen = set()
     for item in items:
         base = item.name.split("[")[0]
+        seen.add(base)
         if item.fspath.basename in _SLOW_FILES or base in _SLOW_TESTS:
             continue
         item.add_marker(pytest.mark.fast)
+    # fail loudly when the deny-list rots: a renamed slow test would
+    # otherwise silently rejoin the fast tier
+    if len(items) > len(_SLOW_TESTS):  # skip for partial collections
+        stale = _SLOW_TESTS - seen
+        assert not stale, (
+            f"_SLOW_TESTS entries no longer exist (renamed/deleted?): {stale}"
+        )
 
 
 @pytest.fixture(scope="session")

@@ -56,15 +56,20 @@ def main(process_id: int, num_processes: int, port: int, outdir: str) -> None:
     # --- loader: per-process shards must be disjoint and deterministic -----
     ds = TokenDataset(os.path.join(outdir, "corpus.bin"), seq_len=16)
     loader = DataLoader(ds, mesh, global_batch_size=8, seed=7)
-    local_rows = []
+    local_tokens = []
     global_tokens = []
     for step in range(3):
         batch = loader.batch_at(step)
-        # the pre-lift local shard (deterministic row content per process)
-        epoch, b = divmod(step, loader.batches_per_epoch)
-        order = loader._epoch_order(epoch) + loader._window_offset
-        rows = order[b * 8 : (b + 1) * 8][process_id::num_processes]
-        local_rows.append(rows)
+        # record what this process ACTUALLY holds: the addressable shards of
+        # the lifted global array (not loader internals — the parent test
+        # recovers window ids from this content, keeping the check
+        # non-circular)
+        shards = sorted(
+            batch.tokens.addressable_shards, key=lambda s: s.index[0].start
+        )
+        local_tokens.append(
+            np.concatenate([np.asarray(s.data) for s in shards])
+        )
         # the global array must reassemble to the full batch on every host:
         # all-gather the addressable shards through the cluster
         from jax.experimental import multihost_utils
@@ -109,7 +114,7 @@ def main(process_id: int, num_processes: int, port: int, outdir: str) -> None:
 
     np.savez(
         os.path.join(outdir, f"worker{process_id}.npz"),
-        local_rows=np.stack(local_rows),
+        local_tokens=np.stack(local_tokens),
         global_tokens=np.stack(global_tokens),
         loss_sum=loss_sum,
         **params_flat,

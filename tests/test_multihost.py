@@ -56,9 +56,17 @@ def cluster_outputs(tmp_path_factory):
         for i in range(2)
     ]
     logs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=600)
-        logs.append(out)
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            logs.append(out)
+    finally:
+        # a hung worker (e.g. peer died mid-collective) must not leak past
+        # the fixture — kill both before re-raising
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
     assert all(p.returncode == 0 for p in procs), "\n".join(logs)
     return (
         outdir,
@@ -67,15 +75,35 @@ def cluster_outputs(tmp_path_factory):
     )
 
 
+def _window_ids(ds, rows):
+    """Recover window indices from actual token rows (content-matched, so
+    assertions on them are not circular with loader internals)."""
+    stream = np.asarray(ds.tokens[: ds.num_windows * ds.seq_len]).astype(np.int32)
+    ids = []
+    for row in rows:
+        starts = np.flatnonzero(stream[:: ds.seq_len] == row[0])
+        ids.append(
+            next(
+                int(s)
+                for s in starts
+                if np.array_equal(
+                    stream[s * ds.seq_len : (s + 1) * ds.seq_len], row
+                )
+            )
+        )
+    return np.asarray(ids)
+
+
 def test_loader_shards_disjoint_and_deterministic(cluster_outputs, mesh_data8):
-    """Process p takes rows p::P of every batch — disjoint, and identical to
-    what a single-process loader would assign."""
+    """Process p holds rows p::P of every batch (recovered from the token
+    content each worker actually received) — disjoint, and identical to the
+    single-process loader's assignment."""
     outdir, w0, w1 = cluster_outputs
     ds = TokenDataset(str(outdir / "corpus.bin"), seq_len=16)
     ref = DataLoader(ds, mesh_data8, global_batch_size=8, seed=7)
     for step in range(3):
-        rows0 = w0["local_rows"][step]
-        rows1 = w1["local_rows"][step]
+        rows0 = _window_ids(ds, w0["local_tokens"][step])
+        rows1 = _window_ids(ds, w1["local_tokens"][step])
         assert set(rows0).isdisjoint(rows1)
         epoch, b = divmod(step, ref.batches_per_epoch)
         order = ref._epoch_order(epoch) + ref._window_offset
@@ -116,7 +144,7 @@ def test_dp_step_matches_single_process(cluster_outputs, mesh_data8):
     from tpu_parallel.parallel import dp
 
     outdir, w0, w1 = cluster_outputs
-    param_keys = [k for k in w0.files if k not in ("local_rows", "global_tokens", "loss_sum")]
+    param_keys = [k for k in w0.files if k not in ("local_tokens", "global_tokens", "loss_sum")]
     assert param_keys
     for k in param_keys:  # replicated state must agree across hosts exactly
         np.testing.assert_array_equal(w0[k], w1[k], err_msg=k)

@@ -46,15 +46,20 @@ def ring_attention(
     my_chunk = lax.axis_index(axis_name)
     b, local_s, h, d = q.shape
     scale = 1.0 / (d**0.5)
-    qf = (q.astype(jnp.float32) * scale).transpose(0, 2, 1, 3)  # [B,H,ls,D]
+    # keep MXU operands in the input dtype (bf16 runs the systolic array at
+    # full rate; fp32 operands would halve it) and accumulate fp32 via
+    # preferred_element_type — same recipe as the Pallas flash kernels
+    qf = (q * jnp.asarray(scale, q.dtype)).transpose(0, 2, 1, 3)  # [B,H,ls,D]
 
     def combine(carry, kv_and_src):
         """One ring step: attend local q to the currently-held kv chunk."""
         out, m_prev, l_prev = carry
         k_cur, v_cur, src_chunk = kv_and_src
-        kf = k_cur.astype(jnp.float32).transpose(0, 2, 1, 3)
-        vf = v_cur.astype(jnp.float32).transpose(0, 2, 1, 3)
-        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf)
+        kf = k_cur.transpose(0, 2, 1, 3)
+        vf = v_cur.transpose(0, 2, 1, 3)
+        s = jnp.einsum(
+            "bhqd,bhkd->bhqk", qf, kf, preferred_element_type=jnp.float32
+        )
         q_pos = my_chunk * local_s + lax.broadcasted_iota(jnp.int32, s.shape, 2)
         k_pos = src_chunk * local_s + lax.broadcasted_iota(jnp.int32, s.shape, 3)
         mask = q_pos >= k_pos
@@ -65,7 +70,13 @@ def ring_attention(
         p = jnp.where(mask, jnp.exp(s - m_new), 0.0)
         alpha = jnp.exp(m_prev - m_new)
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        out = out * alpha + jnp.einsum("bhqk,bhkd->bhqd", p, vf)
+        # probs cast to the K/V dtype for the MXU; fp32 accumulate
+        out = out * alpha + jnp.einsum(
+            "bhqk,bhkd->bhqd",
+            p.astype(vf.dtype),
+            vf,
+            preferred_element_type=jnp.float32,
+        )
         return (out, m_new, l_new)
 
     if use_checkpoint:

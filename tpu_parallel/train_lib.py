@@ -290,7 +290,10 @@ class Trainer:
                         self.state, metrics, batch
                     )
                     jax.block_until_ready(new_state)
-                except Exception:  # noqa: BLE001 — device/transport failure
+                except Exception as exc:  # noqa: BLE001 — device/transport failure
+                    from tpu_parallel.utils.logging_utils import print_exception
+
+                    print_exception(exc)
                     failures += 1
                     if failures > max_failures or ckpt.latest_step is None:
                         raise

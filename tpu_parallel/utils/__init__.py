@@ -1,4 +1,4 @@
-from tpu_parallel.utils.logging_utils import MetricLogger
+from tpu_parallel.utils.logging_utils import MetricLogger, print_exception
 from tpu_parallel.utils.profiling import (
     mfu,
     peak_flops,
@@ -10,6 +10,7 @@ from tpu_parallel.utils.profiling import (
 
 __all__ = [
     "MetricLogger",
+    "print_exception",
     "mfu",
     "peak_flops",
     "sync",

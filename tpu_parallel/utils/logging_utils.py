@@ -16,6 +16,28 @@ from typing import Dict, Optional
 import jax
 
 
+def print_exception(exc: BaseException, *, width: int = 100) -> str:
+    """Compact, colored one-glance rendering of an exception.
+
+    Capability parity with the reference's ``print_exception``
+    (``util.py:12-14``: red exception type via termcolor + textwrap) — here
+    with plain ANSI codes (no termcolor dependency), the wrapped message
+    included, and TTY detection so piped logs stay clean.  Returns the
+    rendered string (also printed); ``Trainer.fit`` calls this on step
+    failures before deciding whether to roll back.
+    """
+    import sys
+    import textwrap
+
+    name = type(exc).__name__
+    use_color = hasattr(sys.stderr, "isatty") and sys.stderr.isatty()
+    title = f"\033[91m{name}\033[0m" if use_color else name
+    body = textwrap.fill(str(exc), width=width) or "(no message)"
+    rendered = f"{title}\n{body}"
+    print(rendered, file=sys.stderr, flush=True)
+    return rendered
+
+
 class MetricLogger:
     def __init__(self, logdir: Optional[str] = None, name: str = "train"):
         self.is_main = jax.process_index() == 0
