@@ -1,0 +1,75 @@
+"""Tokenize text into the flat .bin format ``data.TokenDataset`` reads.
+
+The reference trains on inline random tensors only (SURVEY.md §1 "no
+data-loading layer"); this closes the loop from real text to the training
+CLI:
+
+    python scripts/prepare_data.py corpus.txt corpus.bin --tokenizer gpt2
+    python train.py --config=configs/gpt2_125m_dp.py \
+        --config.data_path=corpus.bin
+
+Uses a Hugging Face tokenizer when one is available locally (no downloads
+are attempted unless the files are already cached); otherwise falls back to
+byte-level tokenization (vocab 256), which needs no assets and is exactly
+reproducible.
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def tokenize(text: str, tokenizer_name: str) -> np.ndarray:
+    if tokenizer_name == "bytes":
+        return np.frombuffer(text.encode("utf-8"), dtype=np.uint8).astype(
+            np.uint16
+        )
+    try:
+        from transformers import AutoTokenizer
+
+        tok = AutoTokenizer.from_pretrained(tokenizer_name)
+    except Exception as e:  # no cached assets / no network
+        print(
+            f"tokenizer {tokenizer_name!r} unavailable ({type(e).__name__}); "
+            "falling back to byte-level tokens",
+            file=sys.stderr,
+        )
+        return tokenize(text, "bytes")
+    ids = tok(text, return_attention_mask=False)["input_ids"]
+    arr = np.asarray(ids, dtype=np.uint32)
+    if arr.max(initial=0) >= 2**16:
+        raise ValueError(
+            f"vocab too large for the uint16 .bin format: max id {arr.max()}"
+        )
+    return arr.astype(np.uint16)
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("input", help="UTF-8 text file")
+    ap.add_argument("output", help="output .bin token stream (uint16)")
+    ap.add_argument(
+        "--tokenizer",
+        default="gpt2",
+        help='HF tokenizer name, or "bytes" for byte-level (default: gpt2)',
+    )
+    args = ap.parse_args()
+
+    from tpu_parallel.data import TokenDataset
+
+    with open(args.input, encoding="utf-8") as f:
+        text = f.read()
+    tokens = tokenize(text, args.tokenizer)
+    TokenDataset.write_bin(args.output, tokens)
+    print(
+        f"wrote {len(tokens):,} tokens ({os.path.getsize(args.output):,} bytes) "
+        f"to {args.output}"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -12,8 +12,6 @@ e.g.
 """
 
 import glob
-import gzip
-import json
 import os
 import sys
 
@@ -97,15 +95,15 @@ def summarize(logdir, top=30):
         printed = True
         print(f"\n=== plane: {plane.name} ===")
         totals = {}
-        line_span = 0
         for line in plane.lines:
-            # XLA op lines carry the per-op schedule; sum self durations
-            span = 0
+            # only the per-op schedule lines: device planes also carry
+            # "XLA Modules" / "Steps" lines whose whole-step spans would
+            # double-count every op into the totals
+            if "Modules" in line.name or "Steps" in line.name:
+                continue
             for ev in line.events:
                 name = plane.event_metadata[ev.metadata_id].name
                 totals[name] = totals.get(name, 0) + ev.duration_ps
-                span += ev.duration_ps
-            line_span = max(line_span, span)
         if not totals:
             continue
         grand = sum(totals.values())
