@@ -84,6 +84,8 @@ _SLOW_TESTS = {
     "test_tp_training_loss_decreases",
     "test_tp_training_grads_match_dense",
     "test_loader_trains_gpt",
+    "test_interleaved_pipeline_matches_sequential",
+    "test_gpt_interleaved_pp_training",
 }
 
 

@@ -66,6 +66,10 @@ def test_config_one_step(path):
     overrides.setdefault("num_microbatches", 2 if mesh.pipe > 1 else 1)
     if overrides.get("fsdp"):
         overrides.setdefault("fsdp_min_size", 0)
+    # tiny has 4 layers; an interleaved config needs pipe*interleave chunks
+    chunks = mesh.pipe * overrides.get("pipe_interleave", 1)
+    if chunks > 4:
+        overrides["n_layers"] = chunks
     d["model"] = "tiny"
     d["steps"] = 1
     d["log_every"] = 1

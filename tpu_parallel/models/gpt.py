@@ -48,6 +48,12 @@ class GPTConfig(TransformerConfig):
     """TransformerConfig plus pipeline degree (static model knobs only)."""
 
     pipe_size: int = 1  # number of pipeline stages the block stack is cut into
+    # virtual stages per pipe rank (circular schedule).  >1 cuts the GPipe
+    # bubble ~interleave-fold: rank r holds layer chunks r, r+pipe,
+    # r+2*pipe, ... and activations lap the ring `interleave` times.  Not
+    # yet composable with MoE (nn.switch requires identical variable
+    # writes across branches; each chunk sows its own balance loss).
+    pipe_interleave: int = 1
     # chunked lm_head + CE: compute logits ``loss_chunk`` sequence positions
     # at a time inside the loss (rematerialized in the backward), so the full
     # [B, S, vocab] logits tensor never exists in HBM.  0 = off.  The
@@ -110,6 +116,11 @@ class GPTLM(nn.Module):
             )
         x = embed_cls(cfg, name="embed")(tokens, positions=positions)
 
+        if cfg.pipe_interleave > 1 and cfg.pipe_size <= 1:
+            raise ValueError(
+                "pipe_interleave > 1 requires pipe_size > 1 (a pipe mesh "
+                "axis); on a pipe=1 mesh the knob would be silently ignored"
+            )
         if cfg.pipe_size > 1:
             # positions are consumed by the (pre-pipeline) embedding; inside
             # the pipeline, RoPE blocks fall back to default arange positions.
@@ -119,18 +130,26 @@ class GPTLM(nn.Module):
                     "pipeline parallelism currently requires unpacked sequences "
                     "(segment_ids must be None)"
                 )
-            if cfg.n_layers % cfg.pipe_size != 0:
+            chunks = cfg.pipe_size * cfg.pipe_interleave
+            if cfg.n_layers % chunks != 0:
                 raise ValueError(
-                    f"n_layers={cfg.n_layers} not divisible by pipe_size={cfg.pipe_size}"
+                    f"n_layers={cfg.n_layers} not divisible by pipe_size*"
+                    f"pipe_interleave={chunks}"
                 )
-            layers_per_stage = cfg.n_layers // cfg.pipe_size
+            if cfg.pipe_interleave > 1 and cfg.moe_experts > 0:
+                raise NotImplementedError(
+                    "MoE under the interleaved pipeline schedule (chunk "
+                    "branches would sow mismatched loss collections)"
+                )
+            layers_per_chunk = cfg.n_layers // chunks
             x = pp.PipelineModule(
-                stage_fn=functools.partial(BlockStack, cfg, layers_per_stage),
+                stage_fn=functools.partial(BlockStack, cfg, layers_per_chunk),
                 num_microbatches=cfg.num_microbatches,
                 axis_name=cfg.pipe_axis,
                 # BlockStack accepts aux_scale: bubble ticks contribute
                 # exactly zero to sown losses (MoE balance)
                 pass_validity=True,
+                interleave=cfg.pipe_interleave,
                 name="pipeline",
             )(x, train=train)
         else:

@@ -163,6 +163,182 @@ def execute_pipeline(
     return outputs
 
 
+class _ChunkStack(nn.Module):
+    """``interleave`` virtual-stage chunks on one rank; applies chunk
+    ``vidx`` per call via ``nn.switch`` (all chunks' params exist; one runs
+    per tick)."""
+
+    module_fn: Callable[[], nn.Module]
+    interleave: int
+
+    @nn.compact
+    def __call__(self, x, vidx, **kwargs):
+        kw = kwargs
+        chunks = [
+            self.module_fn(name=f"chunk{j}") for j in range(self.interleave)
+        ]
+        if self.is_initializing():
+            # nn.switch demands identical variable structures across
+            # branches; create every chunk's params up front (apply-time
+            # branches then only read them)
+            outs = [c(x, **kw) for c in chunks]
+            return outs[0]
+
+        def make_branch(c):
+            def branch(mdl, x_):
+                return c(x_, **kw)
+
+            return branch
+
+        return nn.switch(
+            vidx, [make_branch(c) for c in chunks], self, x
+        )
+
+
+@jax.named_scope("execute_interleaved_pipeline")
+def execute_interleaved_pipeline(
+    module: nn.Module,
+    x: jax.Array,
+    *,
+    num_microbatches: int,
+    interleave: int,
+    axis_name: str,
+    pass_validity: bool = False,
+    **kwargs,
+) -> jax.Array:
+    """Circular (interleaved) pipeline: ``interleave`` virtual stages/rank.
+
+    Chunk ``c`` (of ``n * v`` total, ``v = interleave``) lives on rank
+    ``c % n`` as its virtual stage ``c // n``; activations ride the same
+    +1 ring every tick, wrapping from the last rank back to rank 0 between
+    virtual-stage groups.  Rank 0 injects a fresh microbatch whenever the
+    arriving item is finished (or the warmup hole), giving total ticks
+    ``m*v + n - 1`` of chunk-sized work versus GPipe's ``(m + n - 1) * v``
+    (exact when ``n`` divides ``m``; a partial final round adds its unfilled
+    injection slots): the bubble fraction drops from ``(n-1)/(m+n-1)`` to
+    ``(n-1)/(m*v + n - 1)`` — divided by ~``v`` — at the cost of ``v``
+    ppermute hops per chunk instead of one per stage.
+
+    Scheduling invariants (rank ``r``, tick ``t``, ``vn = v * n``):
+
+    - virtual index ``j = ((t - r) mod vn) // n``, so the item this rank
+      holds has age ``a = r + j*n`` — exactly the chunk index owned here;
+    - the item was injected at ``tau = t - a`` (valid iff ``tau >= 0``) and
+      is microbatch ``i = (tau // vn) * n + (tau mod vn)`` (valid iff
+      ``i < m``);
+    - the last chunk (``j == v - 1``) finishes on rank ``n - 1``, where the
+      result is collected at tick ``tau + vn - 1`` — a static mapping the
+      caller uses to reorder outputs after the scan.
+    """
+    num_stages = lax.psum(1, axis_name)  # static under shard_map
+    v = interleave
+    vn = v * num_stages
+    batch_size = x.shape[0]
+    if batch_size % num_microbatches != 0:
+        raise ValueError(
+            f"per-device batch {batch_size} not divisible by "
+            f"num_microbatches={num_microbatches}"
+        )
+    microbatch_size = batch_size // num_microbatches
+    microbatches = x.reshape(num_microbatches, microbatch_size, *x.shape[1:])
+
+    # static schedule: injection tick of microbatch i, collection tick of
+    # its final output
+    def inject_tick(i):
+        return (i // num_stages) * vn + (i % num_stages)
+
+    total_ticks = inject_tick(num_microbatches - 1) + vn
+    # rank-0 feed: the microbatch injected at tick t (zeros off-schedule)
+    feed_index = []
+    for t in range(total_ticks):
+        slot = t % vn
+        i = (t // vn) * num_stages + slot
+        feed_index.append(i if slot < num_stages and i < num_microbatches else -1)
+    # scan over the int32 indices, not a pre-gathered [T, ...] feed tensor:
+    # total_ticks ~ m*v, so materializing the feed would hold ~interleave x
+    # the pipeline-entry activations in HBM for nothing
+    feed_index = jnp.asarray(feed_index, jnp.int32)
+
+    from tpu_parallel.core.metrics import pvary_missing
+
+    carry_init = pvary_missing(jnp.zeros_like(microbatches[0]), (axis_name,))
+    ticks = jnp.arange(total_ticks, dtype=jnp.int32)
+    _, outputs = nn.scan(
+        _InterleavedScanWrapper,
+        variable_broadcast="params",
+        variable_axes={"losses": 0},
+        split_rngs={"params": False, "dropout": True},
+    )(
+        module,
+        axis_name=axis_name,
+        num_microbatches=num_microbatches,
+        interleave=interleave,
+        pass_validity=pass_validity,
+        static_kwargs=tuple(sorted(kwargs.items())),
+        microbatches=microbatches,
+    )(carry_init, (feed_index, ticks))
+    # outputs[t] holds microbatch i's result when t == inject_tick(i)+vn-1
+    collect = jnp.asarray(
+        [inject_tick(i) + vn - 1 for i in range(num_microbatches)], jnp.int32
+    )
+    outputs = outputs[collect]
+    return outputs.reshape(batch_size, *outputs.shape[2:])
+
+
+class _InterleavedScanWrapper(nn.Module):
+    """nn.scan target for the circular schedule: one chunk application per
+    tick, chunk picked by the arriving item's age."""
+
+    module: nn.Module  # a _ChunkStack (wrapped in ModuleShard)
+    axis_name: str
+    num_microbatches: int
+    interleave: int
+    pass_validity: bool = False
+    static_kwargs: Tuple[Tuple[str, Any], ...] = ()
+    # closed-over (scan-broadcast) microbatch stack; the per-tick xs carry
+    # only an int32 index into it
+    microbatches: Optional[jax.Array] = None
+
+    def __call__(self, carry, xs):
+        feed_idx, t = xs
+        feed_t = jnp.where(
+            feed_idx >= 0,
+            self.microbatches[jnp.clip(feed_idx, 0)],
+            jnp.zeros_like(self.microbatches[0]),
+        )
+        num_stages = lax.psum(1, self.axis_name)
+        stage = lax.axis_index(self.axis_name)
+        vn = self.interleave * num_stages
+        j = ((t - stage) % vn) // num_stages
+        age = stage + j * num_stages
+        tau = t - age
+        item = (tau // vn) * num_stages + (tau % vn)
+        valid = jnp.logical_and(tau >= 0, item < self.num_microbatches)
+        inputs = jnp.where(
+            jnp.logical_and(stage == 0, j == 0), feed_t, carry
+        )
+        kwargs = dict(self.static_kwargs)
+        if self.pass_validity:
+            kwargs["aux_scale"] = valid.astype(jnp.float32)
+        outputs = self.module(inputs, j, **kwargs)
+        if outputs.shape != inputs.shape:
+            raise ValueError(
+                f"pipeline chunks must preserve activation shape; got "
+                f"{inputs.shape} -> {outputs.shape}"
+            )
+        done = jnp.logical_and(
+            jnp.logical_and(stage == num_stages - 1, j == self.interleave - 1),
+            valid,
+        )
+        collected = jnp.where(done, outputs, jnp.zeros_like(outputs))
+        carry_next = lax.ppermute(
+            outputs,
+            self.axis_name,
+            perm=[(i, (i + 1) % num_stages) for i in range(num_stages)],
+        )
+        return carry_next, collected
+
+
 class _ScanWrapper(nn.Module):
     """nn.scan target: applies the wrapped stage module once per tick.
 
@@ -224,9 +400,36 @@ class PipelineModule(nn.Module):
     # hand the stage a per-tick aux_scale validity scalar (see
     # execute_pipeline_step); the stage must accept the keyword
     pass_validity: bool = False
+    # >1 = circular schedule with this many virtual stages per rank;
+    # stage_fn then builds ONE chunk (1/interleave of a GPipe stage) and the
+    # bubble shrinks ~interleave-fold (see execute_interleaved_pipeline)
+    interleave: int = 1
 
     @nn.compact
     def __call__(self, x: jax.Array, **kwargs) -> jax.Array:
+        if self.interleave > 1:
+            if self.broadcast_outputs:
+                raise NotImplementedError(
+                    "broadcast_outputs under the interleaved schedule"
+                )
+            import functools
+
+            stage = ModuleShard(
+                module_fn=functools.partial(
+                    _ChunkStack, self.stage_fn, self.interleave
+                ),
+                axis_name=self.axis_name,
+                name="stage",
+            )
+            return execute_interleaved_pipeline(
+                stage,
+                x,
+                num_microbatches=self.num_microbatches,
+                interleave=self.interleave,
+                axis_name=self.axis_name,
+                pass_validity=self.pass_validity,
+                **kwargs,
+            )
         stage = ModuleShard(
             module_fn=self.stage_fn, axis_name=self.axis_name, name="stage"
         )
