@@ -18,6 +18,9 @@ import jax.numpy as jnp
 
 
 def main():
+    from tpu_parallel.runtime import enable_compilation_cache
+
+    enable_compilation_cache()  # warm re-runs skip the 20-40s TPU compile
     device = jax.devices()[0]
     on_tpu = device.platform == "tpu"
     n_chips = jax.device_count()

@@ -174,3 +174,14 @@ def test_eval_view_requires_holdout(token_file, mesh_data8):
     ds = TokenDataset(path, seq_len=16)
     with pytest.raises(ValueError, match="holdout_fraction"):
         DataLoader(ds, mesh_data8, global_batch_size=8).eval_view()
+
+
+def test_prefetch_matches_sequential(token_file, mesh_data8):
+    path, _ = token_file
+    ds = TokenDataset(path, seq_len=16)
+    dl = DataLoader(ds, mesh_data8, global_batch_size=8, seed=5)
+    it = dl.prefetch(lookahead=3)
+    for step in range(5):
+        np.testing.assert_array_equal(
+            np.asarray(next(it).tokens), np.asarray(dl.batch_at(step).tokens)
+        )

@@ -189,3 +189,29 @@ class DataLoader:
         while True:
             yield self.batch_at(step)
             step += 1
+
+    def prefetch(self, lookahead: int = 2) -> Iterator[TextBatch]:
+        """Iterate with ``lookahead`` batches assembled ahead of consumption.
+
+        ``make_global_batch`` dispatches host-to-device transfers
+        asynchronously, so holding the next batches in flight overlaps
+        window assembly + H2D with the device's current step — the standard
+        input-pipeline trick the reference (inline random tensors) never
+        needed.  ``lookahead <= 0`` degrades to plain iteration.
+        """
+        import collections
+        import itertools
+
+        it = iter(self)
+        if lookahead <= 0:
+            return it
+
+        def gen():
+            queue = collections.deque(itertools.islice(it, lookahead))
+            while queue:
+                yield queue.popleft()
+                nxt = next(it, None)
+                if nxt is not None:
+                    queue.append(nxt)
+
+        return gen()

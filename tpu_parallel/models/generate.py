@@ -41,25 +41,17 @@ def _sample(logits: jax.Array, rng: jax.Array, temperature: float, top_k: int):
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
-@functools.partial(
-    jax.jit, static_argnums=(0,), static_argnames=("max_new_tokens", "temperature", "top_k")
-)
-def generate(
+def _generate_core(
     model: GPTLM,
     params,
     prompt: jax.Array,
-    rng: Optional[jax.Array] = None,
-    *,
-    max_new_tokens: int = 32,
-    temperature: float = 0.0,
-    top_k: int = 0,
+    rng: jax.Array,
+    max_new_tokens: int,
+    temperature: float,
+    top_k: int,
 ) -> jax.Array:
-    """Generate ``max_new_tokens`` continuations of ``prompt`` [batch, P].
-
-    Returns [batch, max_new_tokens] of sampled tokens (greedy when
-    ``temperature == 0``).  The prompt must fit the model's ``seq_len``
-    together with the new tokens (the cache is allocated at ``seq_len``).
-    """
+    """The traceable prefill + decode-scan body shared by :func:`generate`
+    (jit, one device) and :func:`generate_sharded` (shard_map, any mesh)."""
     cfg = model.config
     b, prompt_len = prompt.shape
     if prompt_len + max_new_tokens > cfg.seq_len:
@@ -67,8 +59,6 @@ def generate(
             f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
             f"exceeds seq_len ({cfg.seq_len})"
         )
-    if rng is None:
-        rng = jax.random.PRNGKey(0)
 
     # Prefill: one batched forward over the prompt creates and fills the
     # cache ('cache' is created on the fly because it is marked mutable).
@@ -102,3 +92,141 @@ def generate(
     (_, last, _, _), toks = lax.scan(step, init, None, length=max_new_tokens - 1)
     # scan emits the *input* token of each step; append the final sample
     return jnp.concatenate([toks.T, last[:, None]], axis=1)
+
+
+@functools.partial(
+    jax.jit, static_argnums=(0,), static_argnames=("max_new_tokens", "temperature", "top_k")
+)
+def generate(
+    model: GPTLM,
+    params,
+    prompt: jax.Array,
+    rng: Optional[jax.Array] = None,
+    *,
+    max_new_tokens: int = 32,
+    temperature: float = 0.0,
+    top_k: int = 0,
+) -> jax.Array:
+    """Generate ``max_new_tokens`` continuations of ``prompt`` [batch, P].
+
+    Returns [batch, max_new_tokens] of sampled tokens (greedy when
+    ``temperature == 0``).  The prompt must fit the model's ``seq_len``
+    together with the new tokens (the cache is allocated at ``seq_len``).
+    Single-device params layout — for mesh-sharded states use
+    :func:`generate_sharded` (or ``export_single_device_params`` when the
+    weights aren't split over tp/pipe).
+    """
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    return _generate_core(
+        model, params, prompt, rng, max_new_tokens, temperature, top_k
+    )
+
+
+def generate_sharded(
+    model: GPTLM,
+    params,
+    prompt: jax.Array,
+    mesh,
+    rng: Optional[jax.Array] = None,
+    *,
+    max_new_tokens: int = 32,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    param_specs=None,
+    batch_spec=None,
+) -> jax.Array:
+    """Generate under a mesh: TP-split weights stay split, batch shards DP.
+
+    The serving path for states whose weights live on multiple devices
+    (``export_single_device_params`` refuses tp degree > 1 by design).  Runs
+    the same prefill + decode scan inside one ``shard_map``: the KV cache
+    shards over heads exactly as activations do, TP collectives run per
+    decode step, and each data shard generates its rows.  Pipeline-parallel
+    decode is not supported (the model raises).
+
+    ``params`` is the (possibly ``nn.Partitioned``-boxed) params tree from a
+    mesh init/training state; ``param_specs`` defaults to its partition
+    spec.  Sampling RNG folds over the data axis so shards draw independent
+    noise; it must NOT fold over the model axis (TP ranks must sample the
+    same token).
+    """
+    import flax.linen as nn
+    from jax.sharding import PartitionSpec as P
+
+    if param_specs is None:
+        param_specs = nn.get_partition_spec(params)
+    if batch_spec is None:
+        batch_spec = P(model.config.data_axis)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    fn = _sharded_generate_fn(
+        model,
+        mesh,
+        _HashableTree.of(param_specs),
+        batch_spec,
+        max_new_tokens,
+        temperature,
+        top_k,
+    )
+    return fn(params, prompt, rng)
+
+
+class _HashableTree:
+    """Hashable wrapper for a pytree of hashable leaves (PartitionSpecs) —
+    lets the compiled sharded-generate closures live in an lru_cache, so a
+    serving loop pays trace + XLA compile once, not per call."""
+
+    __slots__ = ("treedef", "leaves")
+
+    def __init__(self, treedef, leaves):
+        self.treedef = treedef
+        self.leaves = leaves
+
+    @classmethod
+    def of(cls, tree):
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        return cls(treedef, tuple(leaves))
+
+    def tree(self):
+        return jax.tree_util.tree_unflatten(self.treedef, list(self.leaves))
+
+    def __hash__(self):
+        return hash((self.treedef, self.leaves))
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, _HashableTree)
+            and self.treedef == other.treedef
+            and self.leaves == other.leaves
+        )
+
+
+@functools.lru_cache(maxsize=32)
+def _sharded_generate_fn(
+    model, mesh, specs: _HashableTree, batch_spec, max_new_tokens, temperature, top_k
+):
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_parallel.core.rng import fold_rng_over_axis
+
+    param_specs = specs.tree()
+
+    def body(params, prompt, rng):
+        rng = fold_rng_over_axis(rng, (model.config.data_axis,))
+        return _generate_core(
+            model, params, prompt, rng, max_new_tokens, temperature, top_k
+        )
+
+    return jax.jit(
+        jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(param_specs, batch_spec, P()),
+            out_specs=batch_spec,
+            # sampled tokens are replicated over the model axis by
+            # construction (every TP rank computes identical full logits
+            # after the lm_head gather); the checker cannot prove it
+            check_vma=False,
+        )
+    )

@@ -261,9 +261,16 @@ def execute_interleaved_pipeline(
 
     from tpu_parallel.core.metrics import pvary_missing
 
-    carry_init = pvary_missing(jnp.zeros_like(microbatches[0]), (axis_name,))
+    # Completed microbatches accumulate into an [m, ...] carry buffer at
+    # their collection tick — per-tick stacked outputs would hold
+    # ~interleave-fold the needed output activations across the scan's
+    # total_ticks (the same blowup the int32 feed_index avoids on input).
+    carry_init = (
+        pvary_missing(jnp.zeros_like(microbatches[0]), (axis_name,)),
+        pvary_missing(jnp.zeros_like(microbatches), (axis_name,)),
+    )
     ticks = jnp.arange(total_ticks, dtype=jnp.int32)
-    _, outputs = nn.scan(
+    (_, outputs), _ = nn.scan(
         _InterleavedScanWrapper,
         variable_broadcast="params",
         variable_axes={"losses": 0},
@@ -277,11 +284,6 @@ def execute_interleaved_pipeline(
         static_kwargs=tuple(sorted(kwargs.items())),
         microbatches=microbatches,
     )(carry_init, (feed_index, ticks))
-    # outputs[t] holds microbatch i's result when t == inject_tick(i)+vn-1
-    collect = jnp.asarray(
-        [inject_tick(i) + vn - 1 for i in range(num_microbatches)], jnp.int32
-    )
-    outputs = outputs[collect]
     return outputs.reshape(batch_size, *outputs.shape[2:])
 
 
@@ -300,6 +302,7 @@ class _InterleavedScanWrapper(nn.Module):
     microbatches: Optional[jax.Array] = None
 
     def __call__(self, carry, xs):
+        act, out_buf = carry
         feed_idx, t = xs
         feed_t = jnp.where(
             feed_idx >= 0,
@@ -315,7 +318,7 @@ class _InterleavedScanWrapper(nn.Module):
         item = (tau // vn) * num_stages + (tau % vn)
         valid = jnp.logical_and(tau >= 0, item < self.num_microbatches)
         inputs = jnp.where(
-            jnp.logical_and(stage == 0, j == 0), feed_t, carry
+            jnp.logical_and(stage == 0, j == 0), feed_t, act
         )
         kwargs = dict(self.static_kwargs)
         if self.pass_validity:
@@ -330,13 +333,20 @@ class _InterleavedScanWrapper(nn.Module):
             jnp.logical_and(stage == num_stages - 1, j == self.interleave - 1),
             valid,
         )
-        collected = jnp.where(done, outputs, jnp.zeros_like(outputs))
+        # write the finished microbatch into its slot (each valid item is
+        # collected exactly once; off-schedule ticks rewrite their slot with
+        # its current value)
+        idx = jnp.clip(item, 0, self.num_microbatches - 1)
+        cur = lax.dynamic_index_in_dim(out_buf, idx, axis=0, keepdims=False)
+        out_buf = lax.dynamic_update_index_in_dim(
+            out_buf, jnp.where(done, outputs, cur), idx, axis=0
+        )
         carry_next = lax.ppermute(
             outputs,
             self.axis_name,
             perm=[(i, (i + 1) % num_stages) for i in range(num_stages)],
         )
-        return carry_next, collected
+        return (carry_next, out_buf), None
 
 
 class _ScanWrapper(nn.Module):

@@ -1,4 +1,5 @@
 from tpu_parallel.runtime.bootstrap import (
+    enable_compilation_cache,
     initialize,
     is_simulated,
     process_info,
@@ -17,6 +18,7 @@ from tpu_parallel.runtime.mesh import (
 )
 
 __all__ = [
+    "enable_compilation_cache",
     "initialize",
     "is_simulated",
     "process_info",

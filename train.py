@@ -13,6 +13,8 @@ Any config field can be overridden on the CLI (``--config.steps=100``,
 never wired up (SURVEY.md §5, config/flag row).
 """
 
+import os
+
 from absl import app, flags, logging
 from ml_collections import config_flags
 
@@ -29,6 +31,10 @@ def main(argv):
     # the first backend touch (simulate_cpu_devices initializes the backend to
     # validate its post-condition).
     initialize()
+    if os.environ.get("TPU_PARALLEL_NO_COMPILE_CACHE", "") != "1":
+        from tpu_parallel.runtime import enable_compilation_cache
+
+        enable_compilation_cache()
     sim = cd.get("simulate_cpu_devices", 0)
     if sim:
         simulate_cpu_devices(sim)
@@ -83,7 +89,9 @@ def main(argv):
         )
     else:
         final = trainer.train(
-            batch_iter=iter(data_loader) if data_loader else None, log_fn=log_fn
+            # prefetch overlaps batch assembly + H2D with the device step
+            batch_iter=data_loader.prefetch() if data_loader else None,
+            log_fn=log_fn,
         )
     logging.info("final: %s", final)
     if eval_steps:
