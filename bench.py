@@ -36,13 +36,22 @@ def main():
 
     if on_tpu:
         # Defaults from the round-3 sweep (SWEEP_r03.json, scripts/
-        # sweep_bench.py): global_batch 16 with remat_policy="proj" and XLA
-        # attention measured best on v5e-1 (0.2852 MFU vs 0.2669 for the old
-        # batch-8 full-remat config; the Pallas flash kernel measured ~5%
-        # slower than XLA attention at seq 1024, and batch 32 only fits via
-        # loss_chunk whose extra lm_head backward pass nets out slower).
+        # sweep_bench.py): 0.4344 MFU on v5e-1 vs 0.2852 for the previous
+        # batch-16/proj/XLA-attn/scan config.  The three levers, measured by
+        # substitution (scripts/bisect_step.py, scripts/attn_wrap_bisect.py):
+        # the Pallas flash kernel at 512x512 tiles (XLA attention costs ~2x
+        # more inside shard_map than standalone; flash is immune), the
+        # "proj_attn" remat policy (saves flash's out+lse so the backward
+        # never re-runs the forward kernel), and unrolled layers (the layer
+        # scan cost ~25ms/step at this depth).
         model, batch, steps, minib = "gpt2_125m", 16 * n_chips, 20, 1
-        overrides = dict(dropout_rate=0.0, remat=True, remat_policy="proj")
+        overrides = dict(
+            dropout_rate=0.0,
+            remat=True,
+            remat_policy="proj_attn",
+            attn_impl="flash",
+            scan_layers=False,
+        )
     else:
         model, batch, steps, minib = "tiny", 8 * n_chips, 10, 1
         overrides = dict(num_microbatches=1)

@@ -2,12 +2,19 @@
 
 from ml_collections import ConfigDict
 
+from configs.common import model_overrides
+
 
 def get_config():
     c = ConfigDict()
     c.simulate_cpu_devices = 0
     c.model = "gpt2_125m"
-    c.model_overrides = ConfigDict()
+    # round-3 tuned defaults: 0.4344 MFU on v5e-1 (SWEEP_r03.json,
+    # docs/05_performance.md) — flash 512x512 tiles, attention residuals
+    # saved by the proj_attn remat policy, layers unrolled
+    c.model_overrides = model_overrides(
+        attn_impl="flash", remat_policy="proj_attn", scan_layers=False
+    )
     c.mesh = ConfigDict(dict(data=-1, model=1, pipe=1, seq=1))
     c.global_batch_size = 64
     c.num_minibatches = 1

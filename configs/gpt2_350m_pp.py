@@ -2,6 +2,8 @@
 
 from ml_collections import ConfigDict
 
+from configs.common import model_overrides
+
 
 def get_config():
     c = ConfigDict()
@@ -9,7 +11,10 @@ def get_config():
     c.model = "gpt2_350m"
     # interleave=2: 24 layers as 4 ranks x 2 virtual stages of 3 layers —
     # bubble (4-1)/(8*2+3) = 16% vs GPipe's (4-1)/(8+3) = 27%
-    c.model_overrides = ConfigDict(dict(num_microbatches=8, pipe_interleave=2))
+    c.model_overrides = model_overrides(
+        num_microbatches=8, pipe_interleave=2,
+        attn_impl="flash", remat_policy="proj_attn",
+    )
     c.mesh = ConfigDict(dict(data=-1, model=1, pipe=4, seq=1))
     c.global_batch_size = 64
     c.num_minibatches = 1

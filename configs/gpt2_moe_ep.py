@@ -2,13 +2,15 @@
 
 from ml_collections import ConfigDict
 
+from configs.common import model_overrides
+
 
 def get_config():
     c = ConfigDict()
     c.simulate_cpu_devices = 0
     c.model = "gpt2_125m"
-    c.model_overrides = ConfigDict(
-        dict(moe_experts=8, moe_capacity_factor=1.25, dropout_rate=0.0)
+    c.model_overrides = model_overrides(
+        moe_experts=8, moe_capacity_factor=1.25, attn_impl="flash"
     )
     c.mesh = ConfigDict(dict(data=-1, model=4, pipe=1, seq=1))
     c.global_batch_size = 64

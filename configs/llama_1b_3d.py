@@ -2,12 +2,17 @@
 
 from ml_collections import ConfigDict
 
+from configs.common import model_overrides
+
 
 def get_config():
     c = ConfigDict()
     c.simulate_cpu_devices = 0
     c.model = "llama_1b"
-    c.model_overrides = ConfigDict(dict(num_microbatches=8, fsdp=True))
+    c.model_overrides = model_overrides(
+        num_microbatches=8, fsdp=True,
+        attn_impl="flash", remat_policy="proj_attn",
+    )
     c.mesh = ConfigDict(dict(data=-1, model=4, pipe=4, seq=1))
     c.global_batch_size = 64
     c.num_minibatches = 1

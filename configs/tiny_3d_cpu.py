@@ -2,12 +2,14 @@
 
 from ml_collections import ConfigDict
 
+from configs.common import model_overrides
+
 
 def get_config():
     c = ConfigDict()
     c.simulate_cpu_devices = 8
     c.model = "tiny"
-    c.model_overrides = ConfigDict(dict(num_microbatches=2))
+    c.model_overrides = model_overrides(num_microbatches=2)
     c.mesh = ConfigDict(dict(data=2, model=2, pipe=2, seq=1))
     c.global_batch_size = 16
     c.num_minibatches = 1

@@ -1,0 +1,112 @@
+"""Find which model wrapper ruins the flash kernel's standalone speed.
+
+Standalone, the Pallas flash kernel is ~8x faster than XLA attention at
+bench shapes, but inside the full train step it measures *slower*.  This
+wraps the bare attention call in each suspect layer — remat(policy),
+lax.scan over layers, shard_map — one at a time and times fwd+bwd.
+
+Usage: python scripts/attn_wrap_bisect.py
+"""
+
+import functools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+B, S, H, D = 16, 1024, 12, 64
+LAYERS = 12
+
+
+def time_fn(name, step, *args, **kw):
+    try:
+        out = step(*args)
+        jax.block_until_ready(out)
+        n = 10
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = step(*args)
+        jax.block_until_ready(out)
+        float(jnp.sum(jax.tree.leaves(out)[0].astype(jnp.float32)))
+        dt = (time.perf_counter() - t0) / n
+        print(json.dumps({"variant": name, **kw, "ms": round(dt * 1e3, 2)}), flush=True)
+    except Exception as e:
+        print(json.dumps({"variant": name, **kw, "error": repr(e)[:140]}), flush=True)
+
+
+def main():
+    from tpu_parallel.models.layers import causal_attention
+    from tpu_parallel.ops.flash_attention import flash_attention
+
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, S, H, D), jnp.bfloat16)
+    k = jax.random.normal(kk, (B, S, H, D), jnp.bfloat16)
+    v = jax.random.normal(kv, (B, S, H, D), jnp.bfloat16)
+
+    proj_policy = jax.checkpoint_policies.save_only_these_names("proj", "attn")
+
+    for impl_name, fn in [("xla", causal_attention), ("flash", flash_attention)]:
+
+        def plain_loss(q, k, v, fn=fn):
+            # LAYERS sequential attentions, python-unrolled
+            x = q
+            for _ in range(LAYERS):
+                x = fn(x, k, v)
+            return jnp.sum(x.astype(jnp.float32))
+
+        time_fn(f"{impl_name}:unrolled", jax.jit(jax.grad(plain_loss)), q, k, v)
+
+        def remat_loss(q, k, v, fn=fn):
+            x = q
+            body = jax.checkpoint(lambda x, k, v: fn(x, k, v), policy=proj_policy)
+            for _ in range(LAYERS):
+                x = body(x, k, v)
+            return jnp.sum(x.astype(jnp.float32))
+
+        time_fn(f"{impl_name}:remat-proj", jax.jit(jax.grad(remat_loss)), q, k, v)
+
+        def scan_loss(q, k, v, fn=fn):
+            def body(x, _):
+                return fn(x, k, v), None
+
+            x, _ = lax.scan(body, q, None, length=LAYERS)
+            return jnp.sum(x.astype(jnp.float32))
+
+        time_fn(f"{impl_name}:scan", jax.jit(jax.grad(scan_loss)), q, k, v)
+
+        def scan_remat_loss(q, k, v, fn=fn):
+            def body(x, _):
+                return jax.checkpoint(
+                    lambda x: fn(x, k, v), policy=proj_policy
+                )(x), None
+
+            x, _ = lax.scan(body, q, None, length=LAYERS)
+            return jnp.sum(x.astype(jnp.float32))
+
+        time_fn(
+            f"{impl_name}:scan+remat", jax.jit(jax.grad(scan_remat_loss)), q, k, v
+        )
+
+        # shard_map over a 1-device data mesh, like the Trainer's step
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        mesh = Mesh(jax.devices()[:1], ("data",))
+        smapped = jax.shard_map(
+            jax.grad(scan_remat_loss),
+            mesh=mesh,
+            in_specs=(P("data"), P("data"), P("data")),
+            out_specs=P("data"),
+            check_vma=False,
+        )
+        time_fn(f"{impl_name}:shmap+scan+remat", jax.jit(smapped), q, k, v)
+
+
+if __name__ == "__main__":
+    main()

@@ -28,7 +28,7 @@ def run_and_trace(batch, remat, attn, chunk, logdir):
     overrides = dict(
         dropout_rate=0.0, attn_impl=attn, loss_chunk=chunk,
     )
-    if remat in ("dots", "proj"):
+    if remat in ("dots", "proj", "proj_attn"):
         overrides.update(remat=True, remat_policy=remat)
     else:
         overrides.update(remat=remat in ("1", "full"))

@@ -14,7 +14,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 
 
-def run_one(batch, remat, attn_impl, steps=12, minib=1, scan_layers=True, chunk=0):
+def run_one(
+    batch, remat, attn_impl, steps=12, minib=1, scan_layers=True, chunk=0,
+    extra=None,
+):
     from tpu_parallel.core import compute as compute_metrics
     from tpu_parallel.runtime import MeshConfig
     from tpu_parallel.train_lib import Trainer, TrainerConfig
@@ -26,10 +29,10 @@ def run_one(batch, remat, attn_impl, steps=12, minib=1, scan_layers=True, chunk=
 
     overrides = dict(
         dropout_rate=0.0, attn_impl=attn_impl, scan_layers=scan_layers,
-        loss_chunk=chunk,
+        loss_chunk=chunk, **(extra or {}),
     )
     # remat spec: "0" = off, "1"/"full" = full remat, "proj"/"dots" = that policy
-    if remat in ("dots", "proj"):
+    if remat in ("dots", "proj", "proj_attn"):
         overrides.update(remat=True, remat_policy=remat)
     else:
         overrides.update(remat=remat in ("1", "full"))
@@ -79,15 +82,28 @@ def main():
         minib = int(parts[3]) if len(parts) > 3 else 1
         scan = parts[4] != "0" if len(parts) > 4 else True
         chunk = int(parts[5]) if len(parts) > 5 else 0
-        combos.append((int(b), r, a, minib, scan, chunk))
+        # trailing key=value pairs become raw model-config overrides,
+        # e.g. 24,proj_attn,flash,1,1,0,flash_block_q=1024
+        extra = {}
+        for kv in parts[6:]:
+            key, val = kv.split("=", 1)
+            try:
+                val = int(val)
+            except ValueError:
+                pass
+            extra[key] = val
+        combos.append((int(b), r, a, minib, scan, chunk, extra))
     if not combos:
-        combos = [(16, "1", "xla", 1, True, 0), (32, "1", "xla", 1, True, 0)]
-    for batch, remat, attn, minib, scan, chunk in combos:
+        combos = [(16, "1", "xla", 1, True, 0, {}), (32, "1", "xla", 1, True, 0, {})]
+    for batch, remat, attn, minib, scan, chunk, extra in combos:
         try:
             result = run_one(
-                batch, remat, attn, minib=minib, scan_layers=scan, chunk=chunk
+                batch, remat, attn, minib=minib, scan_layers=scan, chunk=chunk,
+                extra=extra,
             )
             result["minib"], result["scan"], result["chunk"] = minib, scan, chunk
+            if extra:
+                result["extra"] = extra
             print(json.dumps(result), flush=True)
         except Exception as e:  # OOM etc — report and keep sweeping
             print(

@@ -18,7 +18,12 @@ from tpu_parallel.runtime import MeshConfig, factor_mesh
 from tpu_parallel.train_lib import Trainer, TrainerConfig
 
 CONFIG_DIR = os.path.join(os.path.dirname(os.path.dirname(__file__)), "configs")
-CONFIG_FILES = sorted(glob.glob(os.path.join(CONFIG_DIR, "*.py")))
+CONFIG_FILES = [
+    p
+    for p in sorted(glob.glob(os.path.join(CONFIG_DIR, "*.py")))
+    # shared plumbing, not runnable configs
+    if os.path.basename(p) not in ("common.py", "__init__.py")
+]
 
 
 def load_config(path):

@@ -62,6 +62,10 @@ class TransformerConfig:
     # "proj" saves only the named projection outputs (qkv/out/up/down) so the
     # backward recomputes just norms, elementwise ops, and attention probs —
     # most of full-remat's memory win without re-running the big matmuls;
+    # "proj_attn" additionally saves the attention context and the flash
+    # kernel's logsumexp ("attn" names), so the backward never re-runs the
+    # attention forward — the fastest policy with attn_impl="flash" (the
+    # saved tensors are O(seq), not O(seq^2));
     # "dots" saves every matmul output (includes O(seq^2) attention scores —
     # only viable at short sequence or small batch)
     remat_policy: str = "full"
@@ -69,6 +73,10 @@ class TransformerConfig:
     fsdp: bool = False  # shard big params over the data axis (ZeRO-3)
     fsdp_min_size: int = 2**18
     attn_impl: str = "xla"  # "xla" | "flash" | "ring" | "ulysses"
+    # flash kernel tile sizes; 512x512 measured fastest on v5e at seq 1024
+    # (scripts/attn_microbench.py: 10.5ms vs 17.2ms fwd+bwd at 128x128)
+    flash_block_q: int = 512
+    flash_block_k: int = 512
     # mixture-of-experts: 0 = dense MLP; >0 replaces every block's MLP with
     # top-1 routed experts, expert-parallel over the model axis
     moe_experts: int = 0
@@ -292,6 +300,13 @@ class Attention(nn.Module):
                 k = jnp.repeat(k, group, axis=2)
                 v = jnp.repeat(v, group, axis=2)
             out = self._attend(q, k, v, segment_ids)
+        if cfg.attn_impl != "flash":
+            # let the "proj_attn" remat policy keep the attention context so
+            # the backward never recomputes it — an O(seq) residual.  The
+            # flash path already names its kernel-layout out+lse inside
+            # ops/flash_attention.py; naming this transpose too would save
+            # the same tensor twice.
+            out = checkpoint_name(out, "attn")
         out = out.reshape(*x.shape[:-1], local_heads * cfg.head_dim)
         out = TPDense(
             features=cfg.d_model,
@@ -312,7 +327,11 @@ class Attention(nn.Module):
             if cfg.attn_impl == "flash":
                 from tpu_parallel.ops.flash_attention import flash_attention
 
-                attn_fn = flash_attention
+                attn_fn = functools.partial(
+                    flash_attention,
+                    block_q=cfg.flash_block_q,
+                    block_k=cfg.flash_block_k,
+                )
             elif cfg.attn_impl == "ring":
                 from tpu_parallel.ops.ring_attention import ring_attention
 
@@ -469,6 +488,10 @@ class BlockStack(nn.Module):
             remat_kwargs["policy"] = jax.checkpoint_policies.save_only_these_names(
                 "proj"
             )
+        elif cfg.remat_policy == "proj_attn":
+            remat_kwargs["policy"] = jax.checkpoint_policies.save_only_these_names(
+                "proj", "attn"
+            )
         if cfg.scan_layers:
             scan_target = _ScanBlock
             if cfg.remat and not decode:
@@ -483,17 +506,18 @@ class BlockStack(nn.Module):
             )(cfg, train, decode, name="layers")
             (x, _, _, _), _ = stacked((x, positions, segment_ids, aux_scale), None)
         else:
+            # static_argnums: train/decode are Python bools branching the
+            # trace (self=0, x=1, positions=2, segment_ids=3, train=4,
+            # decode=5) — without it nn.remat traces them as jnp bools and
+            # every `if train` raises TracerBoolConversionError
             block_cls = (
-                nn.remat(Block, **remat_kwargs) if cfg.remat and not decode else Block
+                nn.remat(Block, static_argnums=(4, 5), **remat_kwargs)
+                if cfg.remat and not decode
+                else Block
             )
             for i in range(self.n_layers):
                 x = block_cls(cfg, name=f"layer_{i}")(
-                    x,
-                    positions=positions,
-                    segment_ids=segment_ids,
-                    train=train,
-                    decode=decode,
-                    aux_scale=aux_scale,
+                    x, positions, segment_ids, train, decode, aux_scale
                 )
         return x
 

@@ -357,31 +357,59 @@ def _flash_bwd(
 # --- public API with custom VJP ----------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _flash_attention_bhsd(q, k, v, seg, block_q, block_k, interpret):
-    out, _ = _flash_fwd(
-        q, k, v, seg, block_q=block_q, block_k=block_k, interpret=interpret
-    )
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8))
+def _flash_finalize(q, k, v, seg, out, lse, block_q, block_k, interpret):
+    """Identity on ``out``; exists to attach the backward kernels.
+
+    The forward kernel runs *outside* this custom_vjp (see
+    ``_flash_attention_bhsd``) so its outputs are ordinary named values in
+    the surrounding jaxpr: a ``save_only_these_names(..., "attn")`` remat
+    policy can then keep them, and the backward never re-runs the forward
+    kernel.  Residuals hidden inside a custom_vjp are invisible to remat
+    policies — measured as a full forward-kernel re-run per layer
+    (scripts/attn_wrap_bisect.py).
+    """
+    del q, k, v, seg, lse
     return out
 
 
-def _fwd_rule(q, k, v, seg, block_q, block_k, interpret):
-    out, lse = _flash_fwd(
-        q, k, v, seg, block_q=block_q, block_k=block_k, interpret=interpret
-    )
+def _finalize_fwd(q, k, v, seg, out, lse, block_q, block_k, interpret):
     return out, (q, k, v, seg, out, lse)
 
 
-def _bwd_rule(block_q, block_k, interpret, residuals, do):
+def _finalize_bwd(block_q, block_k, interpret, residuals, do):
     q, k, v, seg, out, lse = residuals
     dq, dk, dv = _flash_bwd(
         q, k, v, seg, out, lse, do,
         block_q=block_q, block_k=block_k, interpret=interpret,
     )
-    return dq, dk, dv, None  # integer segment ids carry no gradient
+    # seg (int) carries no gradient; out/lse arrive behind stop_gradient, so
+    # their zero cotangents are discarded by the caller
+    return dq, dk, dv, None, jnp.zeros_like(out), jnp.zeros_like(lse)
 
 
-_flash_attention_bhsd.defvjp(_fwd_rule, _bwd_rule)
+_flash_finalize.defvjp(_finalize_fwd, _finalize_bwd)
+
+
+def _flash_attention_bhsd(q, k, v, seg, block_q, block_k, interpret):
+    from jax.ad_checkpoint import checkpoint_name
+
+    # stop_gradient on the *inputs*: the forward kernel then sees all-zero
+    # tangents and AD bypasses it entirely (all q/k/v gradient flows through
+    # _flash_finalize's backward kernels).  Stopping only the outputs is too
+    # late — JVP would still trace into the pallas forward kernel.
+    out, lse = _flash_fwd(
+        lax.stop_gradient(q),
+        lax.stop_gradient(k),
+        lax.stop_gradient(v),
+        seg,
+        block_q=block_q,
+        block_k=block_k,
+        interpret=interpret,
+    )
+    out = checkpoint_name(out, "attn")
+    lse = checkpoint_name(lse, "attn")
+    return _flash_finalize(q, k, v, seg, out, lse, block_q, block_k, interpret)
 
 
 def flash_attention(

@@ -117,6 +117,8 @@ def enable_compilation_cache(cache_dir: str = "~/.cache/tpu_parallel_xla") -> st
     """
     import jax
 
+    if os.environ.get("TPU_PARALLEL_NO_COMPILE_CACHE", "") == "1":
+        return ""  # escape hatch: some transports stall on large cache writes
     path = os.path.expanduser(cache_dir)
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)

@@ -90,7 +90,12 @@ class Trainer:
         self.config = config
         self.mesh = mesh if mesh is not None else make_mesh(config.mesh)
         mesh_sizes = dict(self.mesh.shape)
-        overrides = dict(config.model_overrides)
+        # None = "keep the model's default": shipped configs declare shape
+        # knobs (vocab_size, n_layers, ...) as ml_collections placeholders
+        # so they are CLI-addressable without pinning per-model values
+        overrides = {
+            k: v for k, v in dict(config.model_overrides).items() if v is not None
+        }
         # the model's pipeline degree is dictated by the mesh
         overrides.setdefault("pipe_size", mesh_sizes.get("pipe", 1))
         self.model_config: GPTConfig = MODEL_REGISTRY[config.model](**overrides)

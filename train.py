@@ -31,10 +31,9 @@ def main(argv):
     # the first backend touch (simulate_cpu_devices initializes the backend to
     # validate its post-condition).
     initialize()
-    if os.environ.get("TPU_PARALLEL_NO_COMPILE_CACHE", "") != "1":
-        from tpu_parallel.runtime import enable_compilation_cache
+    from tpu_parallel.runtime import enable_compilation_cache
 
-        enable_compilation_cache()
+    enable_compilation_cache()  # no-op when TPU_PARALLEL_NO_COMPILE_CACHE=1
     sim = cd.get("simulate_cpu_devices", 0)
     if sim:
         simulate_cpu_devices(sim)
