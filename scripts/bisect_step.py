@@ -31,8 +31,8 @@ def run_one(batch, remat, attn_variant, steps=12):
         attn_impl = "flash"
     elif attn_variant == "none":
         layers.causal_attention = lambda q, k, v, segment_ids=None: v
-    elif attn_variant.startswith("flash"):
-        attn_impl = "flash"
+    elif attn_variant != "xla":
+        raise ValueError(f"unknown attention variant: {attn_variant!r}")
 
     overrides = dict(dropout_rate=0.0, attn_impl=attn_impl)
     if remat in ("dots", "proj", "proj_attn"):

@@ -109,3 +109,169 @@ def test_gpt_ring_attention_training(mesh_seq4, rng):
     assert compute(m)["loss"] < first
     # token counts: 8 x 64 global tokens
     assert float(m["loss"][1]) == 8 * 64
+
+
+# --- flash-composed ring -----------------------------------------------------
+
+
+def test_chunk_attention_full_matches_reference(rng):
+    """Non-causal chunk kernel == plain softmax attention over the chunk."""
+    from tpu_parallel.ops.flash_attention import flash_chunk_attention
+
+    b, s, h, d = 2, 128, 2, 32
+    ks = jax.random.split(rng, 3)
+    q, k, v = (jax.random.normal(kk, (b, s, h, d)) for kk in ks)
+    out, lse = flash_chunk_attention(
+        q, k, v, causal=False, block_q=64, block_k=64, interpret=True
+    )
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) / (d**0.5)
+    ref = jnp.einsum(
+        "bhqk,bhkd->bhqd", jax.nn.softmax(scores, axis=-1), vt
+    ).transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+    ref_lse = jax.nn.logsumexp(scores, axis=-1)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse), rtol=1e-3, atol=1e-3)
+
+
+def test_chunk_combine_equals_full_attention(rng):
+    """Two half-sequence partials combined == attention over both halves."""
+    from tpu_parallel.ops.flash_attention import flash_chunk_attention
+    from tpu_parallel.ops.ring_attention import combine_chunks
+
+    b, s, h, d = 1, 128, 2, 16
+    ks = jax.random.split(rng, 3)
+    q = jax.random.normal(ks[0], (b, s // 2, h, d))  # second-half queries
+    k = jax.random.normal(ks[1], (b, s, h, d))
+    v = jax.random.normal(ks[2], (b, s, h, d))
+    k1, k2 = k[:, : s // 2], k[:, s // 2 :]
+    v1, v2 = v[:, : s // 2], v[:, s // 2 :]
+
+    o1, l1 = flash_chunk_attention(q, k1, v1, causal=False, block_q=32, block_k=32, interpret=True)
+    o2, l2 = flash_chunk_attention(q, k2, v2, causal=True, block_q=32, block_k=32, interpret=True)
+    out, _ = combine_chunks(o1.astype(jnp.float32), l1, o2.astype(jnp.float32), l2)
+
+    # reference: q (global positions s/2..s) attends causally over all of k
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) / (d**0.5)
+    q_pos = s // 2 + jnp.arange(s // 2)[:, None]
+    k_pos = jnp.arange(s)[None, :]
+    scores = jnp.where(q_pos >= k_pos, scores, -1e30)
+    ref = jnp.einsum(
+        "bhqk,bhkd->bhqd", jax.nn.softmax(scores, axis=-1), vt
+    ).transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
+def test_chunk_combine_gradients(rng):
+    """Gradients through combine_chunks (incl. the lse cotangent path) match
+    differentiating the equivalent dense attention."""
+    from tpu_parallel.ops.flash_attention import flash_chunk_attention
+    from tpu_parallel.ops.ring_attention import combine_chunks
+
+    b, s, h, d = 1, 64, 2, 16
+    ks = jax.random.split(rng, 3)
+    q = jax.random.normal(ks[0], (b, s // 2, h, d))
+    k = jax.random.normal(ks[1], (b, s, h, d))
+    v = jax.random.normal(ks[2], (b, s, h, d))
+
+    def chunked_loss(q, k, v):
+        k1, k2 = k[:, : s // 2], k[:, s // 2 :]
+        v1, v2 = v[:, : s // 2], v[:, s // 2 :]
+        o1, l1 = flash_chunk_attention(q, k1, v1, causal=False, block_q=32, block_k=32, interpret=True)
+        o2, l2 = flash_chunk_attention(q, k2, v2, causal=True, block_q=32, block_k=32, interpret=True)
+        out, _ = combine_chunks(o1.astype(jnp.float32), l1, o2.astype(jnp.float32), l2)
+        return jnp.sum(out**2)
+
+    def dense_loss(q, k, v):
+        qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) / (d**0.5)
+        q_pos = s // 2 + jnp.arange(s // 2)[:, None]
+        scores = jnp.where(q_pos >= jnp.arange(s)[None, :], scores, -1e30)
+        out = jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, axis=-1), vt)
+        return jnp.sum(out.transpose(0, 2, 1, 3) ** 2)
+
+    g_c = jax.grad(chunked_loss, argnums=(0, 1, 2))(q, k, v)
+    g_d = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, bb, name in zip(g_c, g_d, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(bb), rtol=5e-3, atol=5e-3,
+            err_msg=f"d{name} mismatch",
+        )
+
+
+def test_ring_flash_matches_reference(mesh_seq4, rng):
+    from tpu_parallel.ops.ring_attention import ring_flash_attention
+
+    b, s, h, d = 2, 128, 2, 32
+    ks = jax.random.split(rng, 3)
+    q, k, v = (jax.random.normal(kk, (b, s, h, d)) for kk in ks)
+
+    f = jax.jit(
+        jax.shard_map(
+            lambda q, k, v: ring_flash_attention(
+                q, k, v, axis_name="seq", block_q=16, block_k=16, interpret=True
+            ),
+            mesh=mesh_seq4,
+            in_specs=P(None, "seq"),
+            out_specs=P(None, "seq"),
+            check_vma=False,
+        )
+    )
+    out = f(q, k, v)
+    ref = _ref_bshd(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
+def test_ring_flash_gradients_match_ring(mesh_seq4, rng):
+    from tpu_parallel.ops.ring_attention import ring_flash_attention
+
+    b, s, h, d = 1, 64, 2, 16
+    ks = jax.random.split(rng, 3)
+    q, k, v = (jax.random.normal(kk, (b, s, h, d)) for kk in ks)
+
+    def make_loss(fn):
+        def loss(q, k, v):
+            out = jax.shard_map(
+                fn, mesh=mesh_seq4, in_specs=P(None, "seq"),
+                out_specs=P(None, "seq"), check_vma=False,
+            )(q, k, v)
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+
+        return loss
+
+    flash_fn = lambda q, k, v: ring_flash_attention(
+        q, k, v, axis_name="seq", block_q=16, block_k=16, interpret=True
+    )
+    jnp_fn = lambda q, k, v: ring_attention(q, k, v, axis_name="seq")
+    g_f = jax.grad(make_loss(flash_fn), argnums=(0, 1, 2))(q, k, v)
+    g_j = jax.grad(make_loss(jnp_fn), argnums=(0, 1, 2))(q, k, v)
+    for a, bb, name in zip(g_f, g_j, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(bb), rtol=5e-3, atol=5e-3,
+            err_msg=f"d{name} mismatch",
+        )
+
+
+def test_chunk_attention_non_divisible_lengths(rng):
+    """Tiles auto-shrink (with a warning) instead of silently corrupting
+    output when chunk lengths don't divide the requested block sizes."""
+    import warnings as _w
+
+    from tpu_parallel.ops.flash_attention import flash_chunk_attention
+
+    b, s, h, d = 1, 96, 2, 16  # 96 not divisible by 64
+    ks = jax.random.split(rng, 3)
+    q, k, v = (jax.random.normal(kk, (b, s, h, d)) for kk in ks)
+    with _w.catch_warnings(record=True) as caught:
+        _w.simplefilter("always")
+        out, lse = flash_chunk_attention(
+            q, k, v, causal=False, block_q=64, block_k=64, interpret=True
+        )
+    assert any("shrank tiles" in str(c.message) for c in caught)
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qt, kt) / (d**0.5)
+    ref = jnp.einsum(
+        "bhqk,bhkd->bhqd", jax.nn.softmax(scores, axis=-1), vt
+    ).transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)

@@ -88,6 +88,9 @@ def test_trainer_seq_parallel_ring():
     )
     trainer = Trainer(config)
     assert str(trainer.batch_spec) == "PartitionSpec('data', 'seq')"
+    # regression: num_params' mesh-free abstract init must not trace ring
+    # attention (psum on the unbound seq axis) — train.py logs it at startup
+    assert trainer.num_params > 0
     result = trainer.train()
     assert result["loss"] > 0 and result["accuracy"] >= 0
     first = trainer.train(steps=1)  # continues from trained state

@@ -333,26 +333,50 @@ class Attention(nn.Module):
                     block_k=cfg.flash_block_k,
                 )
             elif cfg.attn_impl == "ring":
-                from tpu_parallel.ops.ring_attention import ring_attention
+                from tpu_parallel.ops.ring_attention import (
+                    ring_attention,
+                    ring_flash_attention,
+                )
 
                 if segment_ids is not None:
                     raise NotImplementedError(
                         "ring attention does not support packed sequences yet"
                     )
+                # flash-composed ring on TPU; the jnp path elsewhere (the
+                # interpret-mode kernels can't declare vma for the trainer's
+                # replication checker, and CPU gains nothing from them)
+                if jax.default_backend() == "tpu":
 
-                def attn_fn(q, k, v, segment_ids=None):
-                    return ring_attention(q, k, v, axis_name=cfg.seq_axis)
+                    def attn_fn(q, k, v, segment_ids=None):
+                        return ring_flash_attention(
+                            q, k, v, axis_name=cfg.seq_axis,
+                            block_q=cfg.flash_block_q,
+                            block_k=cfg.flash_block_k,
+                        )
+
+                else:
+
+                    def attn_fn(q, k, v, segment_ids=None):
+                        return ring_attention(q, k, v, axis_name=cfg.seq_axis)
 
             elif cfg.attn_impl == "ulysses":
+                from tpu_parallel.ops.flash_attention import flash_attention
                 from tpu_parallel.ops.ulysses import ulysses_attention
 
                 if segment_ids is not None:
                     raise NotImplementedError(
                         "ulysses attention does not support packed sequences yet"
                     )
+                inner = functools.partial(
+                    flash_attention,
+                    block_q=cfg.flash_block_q,
+                    block_k=cfg.flash_block_k,
+                )
 
                 def attn_fn(q, k, v, segment_ids=None):
-                    return ulysses_attention(q, k, v, axis_name=cfg.seq_axis)
+                    return ulysses_attention(
+                        q, k, v, axis_name=cfg.seq_axis, attn_fn=inner
+                    )
 
             else:
                 attn_fn = causal_attention

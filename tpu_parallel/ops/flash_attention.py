@@ -77,7 +77,8 @@ def _sds(shape, dtype, like):
 
 
 def _fwd_kernel(
-    q_ref, k_ref, v_ref, *rest, block_q, block_k, scale, has_segments
+    q_ref, k_ref, v_ref, *rest, block_q, block_k, scale, has_segments,
+    causal=True,
 ):
     if has_segments:
         seg_ref, o_ref, lse_ref = rest
@@ -90,20 +91,28 @@ def _fwd_kernel(
     q = (q_ref[0] * jnp.asarray(scale, q_ref.dtype)).astype(q_ref.dtype)
     if has_segments:
         seg_q = seg_ref[0, pl.ds(qi * block_q, block_q), :]  # [bq, 1]
-    num_k_blocks = (qi + 1) * block_q // block_k  # causal: only blocks <= qi
+    if causal:
+        num_k_blocks = (qi + 1) * block_q // block_k  # only blocks <= qi
+    else:
+        # full (non-causal) mode: ring attention's fully-visible K/V chunks
+        num_k_blocks = k_ref.shape[1] // block_k
 
     def body(ki, carry):
         acc, m_prev, l_prev = carry
         k = k_ref[0, pl.ds(ki * block_k, block_k), :]
         v = v_ref[0, pl.ds(ki * block_k, block_k), :]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
-        q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        mask = q_pos >= k_pos
+        mask = None
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            mask = q_pos >= k_pos
         if has_segments:
             seg_k = seg_ref[0, pl.ds(ki * block_k, block_k), :]  # [bk, 1]
-            mask = jnp.logical_and(mask, seg_q == seg_k.T)
-        s = jnp.where(mask, s, NEG_INF)
+            same = seg_q == seg_k.T
+            mask = same if mask is None else jnp.logical_and(mask, same)
+        if mask is not None:
+            s = jnp.where(mask, s, NEG_INF)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
@@ -135,24 +144,26 @@ def _flash_fwd(
     block_q: int,
     block_k: int,
     interpret: bool,
+    causal: bool = True,
 ) -> Tuple[jax.Array, jax.Array]:
     b, h, s, d = q.shape
+    s_kv = k.shape[2]
     scale = 1.0 / (d**0.5)
     bh = b * h
     qf = q.reshape(bh, s, d)
-    kf = k.reshape(bh, s, d)
-    vf = v.reshape(bh, s, d)
+    kf = k.reshape(bh, s_kv, d)
+    vf = v.reshape(bh, s_kv, d)
     grid = (bh, s // block_q)
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0)),
-        pl.BlockSpec((1, s, d), lambda bh_, qi: (bh_, 0, 0)),
-        pl.BlockSpec((1, s, d), lambda bh_, qi: (bh_, 0, 0)),
+        pl.BlockSpec((1, s_kv, d), lambda bh_, qi: (bh_, 0, 0)),
+        pl.BlockSpec((1, s_kv, d), lambda bh_, qi: (bh_, 0, 0)),
     ]
     args = [qf, kf, vf]
     if seg is not None:
         # seg is [B, S, 1]; all H heads of batch row b read the same block
         in_specs.append(
-            pl.BlockSpec((1, s, 1), lambda bh_, qi: (bh_ // h, 0, 0))
+            pl.BlockSpec((1, s_kv, 1), lambda bh_, qi: (bh_ // h, 0, 0))
         )
         args.append(seg)
     out, lse = pl.pallas_call(
@@ -162,6 +173,7 @@ def _flash_fwd(
             block_k=block_k,
             scale=scale,
             has_segments=seg is not None,
+            causal=causal,
         ),
         grid=grid,
         in_specs=in_specs,
@@ -183,7 +195,7 @@ def _flash_fwd(
 
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-    block_q, block_k, scale, has_segments,
+    block_q, block_k, scale, has_segments, causal=True,
 ):
     if has_segments:
         seg_ref, dq_ref = rest
@@ -196,19 +208,26 @@ def _bwd_dq_kernel(
     delta = delta_ref[0]  # [bq, 1]
     if has_segments:
         seg_q = seg_ref[0, pl.ds(qi * block_q, block_q), :]
-    num_k_blocks = (qi + 1) * block_q // block_k
+    if causal:
+        num_k_blocks = (qi + 1) * block_q // block_k
+    else:
+        num_k_blocks = k_ref.shape[1] // block_k
 
     def body(ki, dq):
         k = k_ref[0, pl.ds(ki * block_k, block_k), :]
         v = v_ref[0, pl.ds(ki * block_k, block_k), :]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
-        q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        mask = q_pos >= k_pos
+        mask = None
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            mask = q_pos >= k_pos
         if has_segments:
             seg_k = seg_ref[0, pl.ds(ki * block_k, block_k), :]
-            mask = jnp.logical_and(mask, seg_q == seg_k.T)
-        s = jnp.where(mask, s, NEG_INF)
+            same = seg_q == seg_k.T
+            mask = same if mask is None else jnp.logical_and(mask, same)
+        if mask is not None:
+            s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse)  # [bq, bk]
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = (p * (dp - delta)).astype(k.dtype)
@@ -221,7 +240,7 @@ def _bwd_dq_kernel(
 
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-    block_q, block_k, scale, seq_len, has_segments,
+    block_q, block_k, scale, seq_len, has_segments, causal=True,
 ):
     if has_segments:
         seg_ref, dk_ref, dv_ref = rest
@@ -233,7 +252,8 @@ def _bwd_dkv_kernel(
     if has_segments:
         seg_k = seg_ref[0, pl.ds(ki * block_k, block_k), :]  # [bk, 1]
     num_q_blocks = seq_len // block_q
-    first_q_block = ki * block_k // block_q  # causal: q blocks >= diag only
+    # causal: q blocks >= the diagonal only; full mode: every q block
+    first_q_block = ki * block_k // block_q if causal else 0
 
     def body(qi, carry):
         dk, dv = carry
@@ -245,13 +265,17 @@ def _bwd_dkv_kernel(
         lse = lse_ref[0, pl.ds(qi * block_q, block_q), :]
         delta = delta_ref[0, pl.ds(qi * block_q, block_q), :]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
-        q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        mask = q_pos >= k_pos
+        mask = None
+        if causal:
+            q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            mask = q_pos >= k_pos
         if has_segments:
             seg_q = seg_ref[0, pl.ds(qi * block_q, block_q), :]
-            mask = jnp.logical_and(mask, seg_q == seg_k.T)
-        s = jnp.where(mask, s, NEG_INF)
+            same = seg_q == seg_k.T
+            mask = same if mask is None else jnp.logical_and(mask, same)
+        if mask is not None:
+            s = jnp.where(mask, s, NEG_INF)
         p = jnp.exp(s - lse)
         dv = dv + jnp.dot(
             p.astype(do.dtype).T, do, preferred_element_type=jnp.float32
@@ -270,13 +294,21 @@ def _bwd_dkv_kernel(
 
 
 def _flash_bwd(
-    q, k, v, seg, out, lse, do, *, block_q, block_k, interpret
+    q, k, v, seg, out, lse, do, *, block_q, block_k, interpret,
+    causal=True, dlse=None,
 ):
     b, h, s, d = q.shape
+    s_kv = k.shape[2]
     scale = 1.0 / (d**0.5)
     bh = b * h
     delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)
-    qf, kf, vf = (x.reshape(bh, s, d) for x in (q, k, v))
+    if dlse is not None:
+        # chunked/ring combine: a nonzero cotangent on lse folds into the
+        # same per-row correction the probs already use —
+        # ds = p * (dp - (delta - dlse))
+        delta = delta - dlse
+    qf = q.reshape(bh, s, d)
+    kf, vf = (x.reshape(bh, s_kv, d) for x in (k, v))
     dof = do.reshape(bh, s, d)
     lsef = lse.reshape(bh, s, 1)
     deltaf = delta.reshape(bh, s, 1)
@@ -284,8 +316,8 @@ def _flash_bwd(
 
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0)),
-        pl.BlockSpec((1, s, d), lambda bh_, qi: (bh_, 0, 0)),
-        pl.BlockSpec((1, s, d), lambda bh_, qi: (bh_, 0, 0)),
+        pl.BlockSpec((1, s_kv, d), lambda bh_, qi: (bh_, 0, 0)),
+        pl.BlockSpec((1, s_kv, d), lambda bh_, qi: (bh_, 0, 0)),
         pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0)),
         pl.BlockSpec((1, block_q, 1), lambda bh_, qi: (bh_, qi, 0)),
         pl.BlockSpec((1, block_q, 1), lambda bh_, qi: (bh_, qi, 0)),
@@ -293,7 +325,7 @@ def _flash_bwd(
     args = [qf, kf, vf, dof, lsef, deltaf]
     if has_segments:
         in_specs.append(
-            pl.BlockSpec((1, s, 1), lambda bh_, qi: (bh_ // h, 0, 0))
+            pl.BlockSpec((1, s_kv, 1), lambda bh_, qi: (bh_ // h, 0, 0))
         )
         args.append(seg)
     dq = pl.pallas_call(
@@ -303,6 +335,7 @@ def _flash_bwd(
             block_k=block_k,
             scale=scale,
             has_segments=has_segments,
+            causal=causal,
         ),
         grid=(bh, s // block_q),
         in_specs=in_specs,
@@ -322,7 +355,7 @@ def _flash_bwd(
     args = [qf, kf, vf, dof, lsef, deltaf]
     if has_segments:
         in_specs.append(
-            pl.BlockSpec((1, s, 1), lambda bh_, ki: (bh_ // h, 0, 0))
+            pl.BlockSpec((1, s_kv, 1), lambda bh_, ki: (bh_ // h, 0, 0))
         )
         args.append(seg)
     dk, dv = pl.pallas_call(
@@ -333,24 +366,25 @@ def _flash_bwd(
             scale=scale,
             seq_len=s,
             has_segments=has_segments,
+            causal=causal,
         ),
-        grid=(bh, s // block_k),
+        grid=(bh, s_kv // block_k),
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda bh_, ki: (bh_, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh_, ki: (bh_, ki, 0)),
         ],
         out_shape=[
-            _sds((bh, s, d), q.dtype, qf),
-            _sds((bh, s, d), q.dtype, qf),
+            _sds((bh, s_kv, d), q.dtype, qf),
+            _sds((bh, s_kv, d), q.dtype, qf),
         ],
         interpret=interpret,
     )(*args)
 
     return (
         dq.reshape(b, h, s, d),
-        dk.reshape(b, h, s, d),
-        dv.reshape(b, h, s, d),
+        dk.reshape(b, h, s_kv, d),
+        dv.reshape(b, h, s_kv, d),
     )
 
 
@@ -410,6 +444,86 @@ def _flash_attention_bhsd(q, k, v, seg, block_q, block_k, interpret):
     out = checkpoint_name(out, "attn")
     lse = checkpoint_name(lse, "attn")
     return _flash_finalize(q, k, v, seg, out, lse, block_q, block_k, interpret)
+
+
+# --- chunk attention for ring/sequence parallelism ---------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _chunk_attention_bhsd(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_fwd(
+        q, k, v, None, block_q=block_q, block_k=block_k,
+        interpret=interpret, causal=causal,
+    )
+
+
+def _chunk_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _flash_fwd(
+        q, k, v, None, block_q=block_q, block_k=block_k,
+        interpret=interpret, causal=causal,
+    )
+    return (out, lse), (q, k, v, out, lse)
+
+
+def _chunk_bwd(causal, block_q, block_k, interpret, residuals, cotangents):
+    q, k, v, out, lse = residuals
+    do, dlse = cotangents
+    dq, dk, dv = _flash_bwd(
+        q, k, v, None, out, lse, do,
+        block_q=block_q, block_k=block_k, interpret=interpret,
+        causal=causal, dlse=dlse,
+    )
+    return dq, dk, dv
+
+
+_chunk_attention_bhsd.defvjp(_chunk_fwd, _chunk_bwd)
+
+
+def flash_chunk_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: Optional[bool] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """One flash-attention partial over a K/V chunk, for ring combining.
+
+    ``q, k, v``: [batch, seq_q, heads, head_dim] / [batch, seq_kv, ...].
+    Returns ``(out, lse)`` with ``out`` [batch, seq_q, heads, head_dim]
+    normalized *within the chunk* and ``lse`` [batch, heads, seq_q] its
+    log-sum-exp; partials from different chunks combine exactly via
+    :func:`tpu_parallel.ops.ring_attention.combine_chunks`.  Differentiable
+    in both outputs — the lse cotangent folds into the backward kernels'
+    delta correction, which is what makes the combine's gradient exact.
+
+    ``causal=True`` is the diagonal chunk of a sequence-sharded causal
+    attention (q and k index the same positions); ``causal=False`` is a
+    fully-visible (strictly-past) chunk.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    # exact-divisor tiles: a grid of s // bq with s % bq != 0 would leave
+    # query rows unwritten and key rows unattended — silent corruption, not
+    # an error.  gcd shrinks to the largest legal tile; warn when it bites.
+    import math
+
+    bq = math.gcd(q.shape[1], min(block_q, q.shape[1]))
+    bk = math.gcd(k.shape[1], min(block_k, k.shape[1]))
+    if causal:
+        bk = math.gcd(bq, bk)  # causal num_k_blocks needs block_q % block_k == 0
+    if bq < min(block_q, q.shape[1]) or bk < min(block_k, k.shape[1]):
+        warnings.warn(
+            f"flash_chunk_attention shrank tiles to {bq}x{bk}: chunk lengths "
+            f"q={q.shape[1]}/kv={k.shape[1]} are not divisible by the "
+            f"requested {block_q}x{block_k}",
+            stacklevel=2,
+        )
+    qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    out, lse = _chunk_attention_bhsd(qt, kt, vt, causal, bq, bk, interpret)
+    return out.transpose(0, 2, 1, 3), lse
 
 
 def flash_attention(

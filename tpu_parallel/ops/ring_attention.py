@@ -107,3 +107,124 @@ def ring_attention(
     ((out, m, l), _), _ = lax.scan(step, init, None, length=n_chunks)
     out = out / jnp.maximum(l, 1e-20)
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+def combine_chunks(out_a, lse_a, out_b, lse_b):
+    """Exactly merge two attention partials (chunk-normalized out + lse).
+
+    ``out``: [batch, seq, heads, head_dim] fp32; ``lse``: [batch, heads,
+    seq].  An empty partial is represented by ``lse <= NEG_INF/2`` (its out
+    must be zeros); ``NEG_INF`` is finite (-1e30) so the arithmetic never
+    produces nan — the weight just underflows to exactly 0.
+    """
+    m = jnp.maximum(lse_a, lse_b)
+    # guard the all-empty row (both partials masked): keep weights at 0
+    m_safe = jnp.where(m <= NEG_INF / 2, 0.0, m)
+    w_a = jnp.exp(lse_a - m_safe)[:, :, :, None].transpose(0, 2, 1, 3)
+    w_b = jnp.exp(lse_b - m_safe)[:, :, :, None].transpose(0, 2, 1, 3)
+    # each partial is normalized within its chunk, so the merge renormalizes:
+    # out = (o_a e^{lse_a} + o_b e^{lse_b}) / (e^{lse_a} + e^{lse_b})
+    denom = jnp.maximum(w_a + w_b, 1e-38)
+    out = (out_a * w_a + out_b * w_b) / denom
+    lse = m_safe + jnp.log(
+        jnp.exp(lse_a - m_safe) + jnp.exp(lse_b - m_safe)
+    )
+    lse = jnp.where(m <= NEG_INF / 2, NEG_INF, lse)
+    return out, lse
+
+
+@jax.named_scope("ring_flash_attention")
+def ring_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "seq",
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
+    use_checkpoint: bool = True,
+) -> jax.Array:
+    """Ring attention with the per-chunk math on the Pallas flash kernels.
+
+    Same contract as :func:`ring_attention` (causal, seq-sharded
+    [batch, local_seq, heads, head_dim] inside shard_map), but each ring
+    step runs :func:`~tpu_parallel.ops.flash_attention.flash_chunk_attention`
+    instead of materializing fp32 [*, local_s, local_s] score tensors — the
+    jnp path runs the MXU well below peak (docs/05_performance.md measures
+    the same gap for plain flash vs XLA attention).  Per step the diagonal
+    chunk uses the causal kernel, strictly-past chunks the full kernel, and
+    future chunks contribute an empty partial without running a kernel
+    (``lax.cond``; SPMD-legal under shard_map since control flow is
+    per-device there).
+
+    Gradients flow through the chunk kernels' custom VJP — the lse
+    cotangent of :func:`combine_chunks` folds into the backward delta —
+    and ``use_checkpoint`` remats each step so rotated K/V chunks are not
+    stored (same memory contract as :func:`ring_attention`).
+    """
+    from tpu_parallel.ops.flash_attention import flash_chunk_attention
+
+    n_chunks = lax.psum(1, axis_name)
+    my_chunk = lax.axis_index(axis_name)
+    b, local_s, h, d = q.shape
+
+    def one_chunk(carry, kv_and_src):
+        out, lse = carry
+        k_cur, v_cur, src_chunk = kv_and_src
+
+        def diag(_):
+            o, s = flash_chunk_attention(
+                q, k_cur, v_cur, causal=True,
+                block_q=block_q, block_k=block_k, interpret=interpret,
+            )
+            return o.astype(jnp.float32), s
+
+        def full(_):
+            o, s = flash_chunk_attention(
+                q, k_cur, v_cur, causal=False,
+                block_q=block_q, block_k=block_k, interpret=interpret,
+            )
+            return o.astype(jnp.float32), s
+
+        def skip(_):
+            from tpu_parallel.core.metrics import pvary_missing, vma_of
+
+            zeros = jnp.zeros((b, local_s, h, d), jnp.float32)
+            empty = jnp.full((b, h, local_s), NEG_INF, jnp.float32)
+            # promote to q's varying axes so the cond branches type-match
+            # under shard_map's replication checker
+            return (
+                pvary_missing(zeros, vma_of(q)),
+                pvary_missing(empty, vma_of(q)),
+            )
+
+        o_c, lse_c = lax.cond(
+            src_chunk == my_chunk,
+            diag,
+            lambda op: lax.cond(src_chunk < my_chunk, full, skip, op),
+            None,
+        )
+        return combine_chunks(out, lse, o_c, lse_c)
+
+    if use_checkpoint:
+        one_chunk = jax.checkpoint(one_chunk)
+
+    def step(carry, _):
+        acc, (k_cur, v_cur, src_chunk) = carry
+        acc = one_chunk(acc, (k_cur, v_cur, src_chunk))
+        perm = [(i, (i + 1) % n_chunks) for i in range(n_chunks)]
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return (acc, (k_next, v_next, (src_chunk - 1) % n_chunks)), None
+
+    out0 = jnp.zeros((b, local_s, h, d), jnp.float32)
+    lse0 = jnp.full((b, h, local_s), NEG_INF, jnp.float32)
+    from tpu_parallel.core.metrics import pvary_missing, vma_of
+
+    q_vma = vma_of(q)
+    out0, lse0 = (pvary_missing(x, q_vma) for x in (out0, lse0))
+    ((out, _), _), _ = lax.scan(
+        step, ((out0, lse0), (k, v, my_chunk)), None, length=n_chunks
+    )
+    return out.astype(q.dtype)

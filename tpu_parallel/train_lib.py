@@ -362,7 +362,12 @@ class Trainer:
         """
         import numpy as np
 
-        cfg1 = dataclasses.replace(self.model_config, pipe_size=1)
+        # attn_impl="xla": ring/ulysses need their seq axis bound even for
+        # shape inference (psum/axis_index at trace time); the attention
+        # implementation never affects the parameter count
+        cfg1 = dataclasses.replace(
+            self.model_config, pipe_size=1, attn_impl="xla"
+        )
         model1 = GPTLM(cfg1)
         shapes = jax.eval_shape(
             lambda r: model1.init(
