@@ -10,7 +10,7 @@ def get_config():
     c.simulate_cpu_devices = 0
     c.model = "gpt2_125m"
     c.model_overrides = model_overrides(
-        moe_experts=8, moe_capacity_factor=1.25, attn_impl="flash"
+        moe_experts=8, moe_top_k=1, moe_capacity_factor=1.25, attn_impl="flash"
     )
     c.mesh = ConfigDict(dict(data=-1, model=4, pipe=1, seq=1))
     c.global_batch_size = 64

@@ -78,8 +78,11 @@ class TransformerConfig:
     flash_block_q: int = 512
     flash_block_k: int = 512
     # mixture-of-experts: 0 = dense MLP; >0 replaces every block's MLP with
-    # top-1 routed experts, expert-parallel over the model axis
+    # routed experts, expert-parallel over the model axis
     moe_experts: int = 0
+    # experts per token: 1 = Switch (gate = router prob), >1 = GShard-style
+    # (gates renormalized over the chosen experts)
+    moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
     moe_balance_weight: float = 0.01
 

@@ -1,4 +1,8 @@
-"""Mixture-of-Experts MLP with expert parallelism (Switch-style top-1).
+"""Mixture-of-Experts MLP with expert parallelism (top-k routing).
+
+``moe_top_k=1`` is Switch (gate = raw router probability); ``>1`` is
+GShard-style with gates renormalized over the chosen experts and capacity
+claimed choice-major under the same static-shape dispatch.
 
 No reference capability exists (SURVEY.md §2.2: EP "Absent"); built for the
 framework's EP slot, TPU-first:
@@ -57,7 +61,7 @@ class ExpertFFN(nn.Module):
 
 
 class MoEMLP(nn.Module):
-    """Drop-in MLP replacement: top-1 routed experts, EP over ``model``."""
+    """Drop-in MLP replacement: top-k routed experts, EP over ``model``."""
 
     config: "TransformerConfig"  # noqa: F821
 
@@ -78,18 +82,37 @@ class MoEMLP(nn.Module):
         xf = x.reshape(tokens, d)
 
         # --- route (fp32) ---------------------------------------------------
+        top_k = cfg.moe_top_k
+        if not 1 <= top_k <= n_experts:
+            # moe_experts=0 disables MoE entirely (dense MLP); top_k has no
+            # analogous "off" value, so reject rather than silently clamp
+            raise ValueError(
+                f"moe_top_k={top_k} must be in [1, moe_experts={n_experts}]"
+            )
         logits = nn.Dense(
             n_experts, use_bias=False, dtype=jnp.float32, name="router"
         )(xf.astype(jnp.float32))
         probs = jax.nn.softmax(logits, axis=-1)  # [T, E]
-        gate = jnp.max(probs, axis=-1)  # [T]
-        expert_idx = jnp.argmax(probs, axis=-1)  # [T]
-        onehot = jax.nn.one_hot(expert_idx, n_experts, dtype=jnp.float32)
+        gate_vals, expert_idx = lax.top_k(probs, top_k)  # [T, k] each
+        if top_k == 1:
+            gates = gate_vals  # Switch: the raw router probability
+        else:
+            # GShard: renormalize over the chosen experts so the combined
+            # output is a convex mixture regardless of how much mass the
+            # un-chosen experts held
+            gates = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+        onehots = [
+            jax.nn.one_hot(expert_idx[:, j], n_experts, dtype=jnp.float32)
+            for j in range(top_k)
+        ]
 
-        # Switch load-balance loss: E * sum_i fraction_i * router_prob_i.
-        # aux_scale (0.0 on pipeline bubble ticks) zeroes both the value and,
-        # through the multiply, its gradient into the router.
-        balance = n_experts * jnp.sum(onehot.mean(axis=0) * probs.mean(axis=0))
+        # Load-balance loss: E * sum_i fraction_i * router_prob_i, with
+        # fraction_i the share of (token, choice) assignments to expert i
+        # (Switch's f_i at top_k=1).  aux_scale (0.0 on pipeline bubble
+        # ticks) zeroes both the value and, through the multiply, its
+        # gradient into the router.
+        assign_frac = sum(oh.mean(axis=0) for oh in onehots) / top_k
+        balance = n_experts * jnp.sum(assign_frac * probs.mean(axis=0))
         if aux_scale is not None:
             balance = balance * jnp.asarray(aux_scale, jnp.float32)
         self.sow(
@@ -102,15 +125,24 @@ class MoEMLP(nn.Module):
 
         # --- capacity + dispatch masks (static shapes) ----------------------
         capacity = max(
-            1, int(cfg.moe_capacity_factor * tokens / n_experts + 0.999)
+            1, int(cfg.moe_capacity_factor * top_k * tokens / n_experts + 0.999)
         )
-        position = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # [T, E]
-        in_capacity = (position < capacity).astype(jnp.float32) * onehot
-        pos_idx = jnp.sum(position, axis=-1).astype(jnp.int32)  # [T]
-        pos_onehot = jax.nn.one_hot(pos_idx, capacity, dtype=jnp.float32)
-        # [T, E, C]: 1 where token t landed in slot c of expert e
-        dispatch = in_capacity[:, :, None] * pos_onehot[:, None, :]
-        combine = dispatch * gate[:, None, None]
+        # choices claim capacity slots choice-major (every token's first
+        # choice before any second choice), tracked by a running per-expert
+        # count so the slot index stays unique across choices
+        count = jnp.zeros((n_experts,), jnp.float32)
+        dispatch = jnp.zeros((tokens, n_experts, capacity), jnp.float32)
+        combine = jnp.zeros((tokens, n_experts, capacity), jnp.float32)
+        for j, onehot in enumerate(onehots):
+            position = (jnp.cumsum(onehot, axis=0) - 1.0 + count[None, :]) * onehot
+            in_capacity = (position < capacity).astype(jnp.float32) * onehot
+            pos_idx = jnp.sum(position, axis=-1).astype(jnp.int32)  # [T]
+            pos_onehot = jax.nn.one_hot(pos_idx, capacity, dtype=jnp.float32)
+            # [T, E, C]: 1 where token t's choice j landed in slot c of expert e
+            dispatch_j = in_capacity[:, :, None] * pos_onehot[:, None, :]
+            dispatch = dispatch + dispatch_j
+            combine = combine + dispatch_j * gates[:, j, None, None]
+            count = count + jnp.sum(onehot, axis=0)
 
         # --- expert parallelism: slice my experts, partial-combine, psum ----
         # Each rank materializes only its own experts' [E/ep, C] masks, so the
