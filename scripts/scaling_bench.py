@@ -1,4 +1,4 @@
-"""Scaling-efficiency harness: DP / TP / PP step time vs device count.
+"""Scaling-efficiency harness: DP / TP / PP / SP step time vs device count.
 
 BASELINE.json's metric is "tokens/sec/chip AND DP/TP/PP scaling efficiency"
 — this harness produces the scaling half.  For each strategy it runs the
@@ -17,6 +17,10 @@ Scaling regimes (efficiency definitions):
 - **PP — strong scaling with the GPipe bubble**: fixed batch cut into
   microbatches over n stages; ideal includes the bubble factor
   (m + n - 1) / m, reported separately as ``ideal_fraction``.
+- **SP — strong scaling over the token axis**: fixed batch x seq, ring
+  attention rotates K/V around the seq axis.  Same efficiency definition
+  as TP; the communication is the ring rotation, not projection
+  all-reduces.
 
 Without 8 local accelerators the harness simulates 8 CPU devices — the
 numbers then measure *structural* overhead (collective count, schedule
@@ -77,6 +81,12 @@ def main():
         elif strategy == "pp":
             mesh_cfg, batch = MeshConfig(data=1, pipe=n), per_chip_batch
             overrides["num_microbatches"] = per_chip_batch
+        elif strategy == "sp":
+            # sequence parallelism: fixed batch x seq, tokens sharded over
+            # the ring — strong scaling like TP, communication is the K/V
+            # rotation instead of the projection all-reduces
+            mesh_cfg, batch = MeshConfig(data=1, seq=n), per_chip_batch
+            overrides["attn_impl"] = "ring"
         else:
             raise ValueError(strategy)
         config = TrainerConfig(
@@ -114,7 +124,7 @@ def main():
         )
 
     results = []
-    for strategy in ("dp", "tp", "pp"):
+    for strategy in ("dp", "tp", "pp", "sp"):
         t1 = None
         for n in (1, 2, 4, 8):
             r = run(strategy, n)

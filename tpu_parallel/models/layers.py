@@ -91,6 +91,14 @@ class TransformerConfig:
         return self.d_model // self.n_heads
 
 
+def seq_parallel_active(config: TransformerConfig) -> bool:
+    """True when attention shards the token axis: a seq-parallel impl is
+    selected AND the seq mesh axis is actually bound (shard_map region)."""
+    return config.attn_impl in ("ring", "ulysses") and bool(
+        axis_size_or_none(config.seq_axis)
+    )
+
+
 def make_norm(config: TransformerConfig, name: str):
     """fp32 norm (LayerNorm or RMSNorm) — small, precision-critical."""
     if config.norm == "rmsnorm":
@@ -239,10 +247,7 @@ class Attention(nn.Module):
             )
             k, v = jnp.split(kv, 2, axis=-1)
         if decode:
-            if axis_size_or_none(cfg.seq_axis) and cfg.attn_impl in (
-                "ring",
-                "ulysses",
-            ):
+            if seq_parallel_active(cfg):
                 raise NotImplementedError(
                     "incremental decoding under sequence parallelism"
                 )
@@ -278,9 +283,7 @@ class Attention(nn.Module):
         if cfg.positional == "rope":
             if positions is None:
                 local = jnp.arange(x.shape[1])
-                if cfg.attn_impl in ("ring", "ulysses") and axis_size_or_none(
-                    cfg.seq_axis
-                ):
+                if seq_parallel_active(cfg):
                     # seq-sharded: offset local positions to global ones
                     local = local + lax.axis_index(cfg.seq_axis) * x.shape[1]
                 positions = jnp.broadcast_to(local, x.shape[:2])
@@ -520,6 +523,16 @@ class BlockStack(nn.Module):
                 "proj", "attn"
             )
         if cfg.scan_layers:
+            if seq_parallel_active(cfg):
+                # seq-parallel attention output is seq-varying (axis_index /
+                # all_to_all inside), so the layer-scan carry must enter
+                # seq-varying too — otherwise a size-1 seq axis trips the
+                # replication checker (inputs replicated, body output varying)
+                from tpu_parallel.core.metrics import pvary_missing, vma_of
+
+                x = pvary_missing(
+                    x, vma_of(jax.lax.axis_index(cfg.seq_axis))
+                )
             scan_target = _ScanBlock
             if cfg.remat and not decode:
                 scan_target = nn.remat(_ScanBlock, **remat_kwargs)

@@ -97,13 +97,22 @@ def ring_attention(
     m0 = jnp.full((b, h, local_s, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, local_s, 1), jnp.float32)
     # the accumulators come out of `combine` varying over every axis q varies
-    # on; promote the zero inits to the same type so the scan carry
-    # type-checks under shard_map's replication checker
+    # on PLUS the ring axis itself (axis_index makes the body's outputs
+    # ring-varying even when the inputs are replicated, e.g. on a size-1
+    # axis); promote the whole init carry so the scan type-checks under
+    # shard_map's replication checker.  vma_of(my_chunk) is {axis_name}
+    # exactly when variance is being tracked — empty under check_vma=False,
+    # where promotion would only plant an invalid psum in the backward.
     from tpu_parallel.core.metrics import pvary_missing, vma_of
 
+    # ordered tuple, not a set: the axes feed pcast, and a nondeterministic
+    # order would make the jaxpr differ run-to-run (compile-cache poison)
     q_vma = vma_of(q)
-    out0, m0, l0 = (pvary_missing(x, q_vma) for x in (out0, m0, l0))
-    init = ((out0, m0, l0), (k, v, my_chunk))
+    ring_vma = q_vma + tuple(a for a in vma_of(my_chunk) if a not in q_vma)
+    out0, m0, l0, k0, v0 = (
+        pvary_missing(x, ring_vma) for x in (out0, m0, l0, k, v)
+    )
+    init = ((out0, m0, l0), (k0, v0, my_chunk))
     ((out, m, l), _), _ = lax.scan(step, init, None, length=n_chunks)
     out = out / jnp.maximum(l, 1e-20)
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
@@ -222,9 +231,12 @@ def ring_flash_attention(
     lse0 = jnp.full((b, h, local_s), NEG_INF, jnp.float32)
     from tpu_parallel.core.metrics import pvary_missing, vma_of
 
+    # include the ring axis itself, in deterministic order — see the
+    # matching notes in ring_attention
     q_vma = vma_of(q)
-    out0, lse0 = (pvary_missing(x, q_vma) for x in (out0, lse0))
+    ring_vma = q_vma + tuple(a for a in vma_of(my_chunk) if a not in q_vma)
+    out0, lse0, k0, v0 = (pvary_missing(x, ring_vma) for x in (out0, lse0, k, v))
     ((out, _), _), _ = lax.scan(
-        step, ((out0, lse0), (k, v, my_chunk)), None, length=n_chunks
+        step, ((out0, lse0), (k0, v0, my_chunk)), None, length=n_chunks
     )
     return out.astype(q.dtype)
