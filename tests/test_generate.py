@@ -221,3 +221,27 @@ def test_generate_sharded_tp_matches_full_forward(mesh_data4_model2, rng):
         toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
     want = jnp.stack(want, axis=1)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_generate_top_p_restricts_support(rng):
+    """Nucleus sampling never emits tokens outside the top-p prefix; a tiny
+    top_p degenerates to greedy."""
+    from tpu_parallel.models.generate import _sample
+
+    logits = jnp.log(
+        jnp.asarray([[0.5, 0.3, 0.15, 0.04, 0.01]], jnp.float32)
+    )
+    # p=0.6: mass-before-token is (0, .5, .8, ...) -> keep {0, 1}
+    seen = set()
+    for i in range(50):
+        tok = _sample(
+            logits, jax.random.PRNGKey(i), temperature=1.0, top_k=0, top_p=0.6
+        )
+        seen.add(int(tok[0]))
+    assert seen <= {0, 1} and len(seen) == 2
+    # tiny p keeps only the argmax
+    for i in range(10):
+        tok = _sample(
+            logits, jax.random.PRNGKey(i), temperature=1.0, top_k=0, top_p=1e-6
+        )
+        assert int(tok[0]) == 0

@@ -24,7 +24,9 @@ def vma_of(x) -> Tuple[str, ...]:
     Single home for the version-sensitive vma introspection — works on
     traced arrays and on ``jax.eval_shape`` results.
     """
-    return tuple(getattr(jax.typeof(x), "vma", ()) or ())
+    # sorted: .vma is a frozenset, and hash-randomized iteration order would
+    # vary the axes tuples baked into jaxprs run-to-run (compile-cache poison)
+    return tuple(sorted(getattr(jax.typeof(x), "vma", ()) or ()))
 
 
 def _cast_varying(x: jax.Array, axis_names: Sequence[str]) -> jax.Array:

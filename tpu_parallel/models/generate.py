@@ -27,8 +27,17 @@ from tpu_parallel.models.gpt import GPTLM
 from tpu_parallel.parallel.tp import export_single_device_params  # noqa: F401  (re-export: mesh-trained state -> generate-able params)
 
 
-def _sample(logits: jax.Array, rng: jax.Array, temperature: float, top_k: int):
-    """One token per row from [batch, vocab] logits."""
+def _sample(
+    logits: jax.Array, rng: jax.Array, temperature: float, top_k: int,
+    top_p: float = 0.0,
+):
+    """One token per row from [batch, vocab] logits.
+
+    ``top_k`` keeps the k highest logits; ``top_p`` in (0, 1) keeps the
+    smallest prefix of the sorted distribution whose mass reaches p
+    (nucleus sampling; the argmax token always survives).  Both filters
+    compose (intersection) and apply after the temperature scale.
+    """
     # models emit cfg.dtype (bf16) logits; sample in fp32 so the temperature
     # scale and the categorical's gumbel trick don't round at bf16
     logits = logits.astype(jnp.float32)
@@ -38,6 +47,15 @@ def _sample(logits: jax.Array, rng: jax.Array, temperature: float, top_k: int):
     if top_k > 0:
         kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if 0.0 < top_p < 1.0:
+        desc = jnp.sort(logits, axis=-1)[:, ::-1]
+        cum = jnp.cumsum(jax.nn.softmax(desc, axis=-1), axis=-1)
+        # keep tokens whose mass BEFORE them is < p (so top-1 always stays)
+        keep = cum - jax.nn.softmax(desc, axis=-1) < top_p
+        cutoff = jnp.min(
+            jnp.where(keep, desc, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
@@ -49,6 +67,7 @@ def _generate_core(
     max_new_tokens: int,
     temperature: float,
     top_k: int,
+    top_p: float = 0.0,
 ) -> jax.Array:
     """The traceable prefill + decode-scan body shared by :func:`generate`
     (jit, one device) and :func:`generate_sharded` (shard_map, any mesh)."""
@@ -72,7 +91,7 @@ def _generate_core(
         mutable=["cache"],
     )
     rng, sub = jax.random.split(rng)
-    first = _sample(logits[:, -1], sub, temperature, top_k)
+    first = _sample(logits[:, -1], sub, temperature, top_k, top_p)
 
     def step(carry, _):
         cache, tok, pos, rng = carry
@@ -85,7 +104,7 @@ def _generate_core(
             mutable=["cache"],
         )
         rng, sub = jax.random.split(rng)
-        nxt = _sample(logits[:, -1], sub, temperature, top_k)
+        nxt = _sample(logits[:, -1], sub, temperature, top_k, top_p)
         return (updated["cache"], nxt, pos + 1, rng), tok
 
     init = (variables["cache"], first, jnp.int32(prompt_len), rng)
@@ -95,7 +114,8 @@ def _generate_core(
 
 
 @functools.partial(
-    jax.jit, static_argnums=(0,), static_argnames=("max_new_tokens", "temperature", "top_k")
+    jax.jit, static_argnums=(0,),
+    static_argnames=("max_new_tokens", "temperature", "top_k", "top_p"),
 )
 def generate(
     model: GPTLM,
@@ -106,6 +126,7 @@ def generate(
     max_new_tokens: int = 32,
     temperature: float = 0.0,
     top_k: int = 0,
+    top_p: float = 0.0,
 ) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt`` [batch, P].
 
@@ -119,7 +140,7 @@ def generate(
     if rng is None:
         rng = jax.random.PRNGKey(0)
     return _generate_core(
-        model, params, prompt, rng, max_new_tokens, temperature, top_k
+        model, params, prompt, rng, max_new_tokens, temperature, top_k, top_p
     )
 
 
@@ -133,6 +154,7 @@ def generate_sharded(
     max_new_tokens: int = 32,
     temperature: float = 0.0,
     top_k: int = 0,
+    top_p: float = 0.0,
     param_specs=None,
     batch_spec=None,
 ) -> jax.Array:
@@ -168,6 +190,7 @@ def generate_sharded(
         max_new_tokens,
         temperature,
         top_k,
+        top_p,
     )
     return fn(params, prompt, rng)
 
@@ -204,7 +227,8 @@ class _HashableTree:
 
 @functools.lru_cache(maxsize=32)
 def _sharded_generate_fn(
-    model, mesh, specs: _HashableTree, batch_spec, max_new_tokens, temperature, top_k
+    model, mesh, specs: _HashableTree, batch_spec, max_new_tokens, temperature,
+    top_k, top_p=0.0,
 ):
     from jax.sharding import PartitionSpec as P
 
@@ -215,7 +239,7 @@ def _sharded_generate_fn(
     def body(params, prompt, rng):
         rng = fold_rng_over_axis(rng, (model.config.data_axis,))
         return _generate_core(
-            model, params, prompt, rng, max_new_tokens, temperature, top_k
+            model, params, prompt, rng, max_new_tokens, temperature, top_k, top_p
         )
 
     return jax.jit(
