@@ -19,6 +19,7 @@ def get_config():
     c.global_batch_size = 64
     c.num_minibatches = 1
     c.steps = 100
+    c.optimizer = "adamw"  # adamw | lion | sgd
     c.learning_rate = 6e-4
     c.warmup_steps = 20
     c.weight_decay = 0.1

@@ -16,6 +16,7 @@ def get_config():
     c.global_batch_size = 32
     c.num_minibatches = 4
     c.steps = 15
+    c.optimizer = "adamw"  # adamw | lion | sgd
     c.learning_rate = 1e-3
     c.warmup_steps = 5
     c.weight_decay = 0.01

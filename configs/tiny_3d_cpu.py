@@ -14,6 +14,7 @@ def get_config():
     c.global_batch_size = 16
     c.num_minibatches = 1
     c.steps = 20
+    c.optimizer = "adamw"  # adamw | lion | sgd
     c.learning_rate = 3e-3
     c.warmup_steps = 5
     c.weight_decay = 0.1

@@ -42,6 +42,12 @@ class TrainerConfig:
     global_batch_size: int = 32
     num_minibatches: int = 1
     steps: int = 20
+    # "adamw" | "lion" | "sgd" — all elementwise, hence exact under any
+    # parameter sharding.  (adafactor is deliberately not offered: optax's
+    # FactoredState carries rank-changed/placeholder leaves that break the
+    # nn.Partitioned spec-discovery pipeline; supporting it needs T5X-style
+    # logical-axis metadata.)
+    optimizer: str = "adamw"
     learning_rate: float = 3e-4
     warmup_steps: int = 10
     weight_decay: float = 0.1
@@ -62,11 +68,13 @@ class TrainerConfig:
 
 
 def make_optimizer(config: TrainerConfig) -> optax.GradientTransformation:
-    """AdamW + linear warmup / cosine decay + sharded global-norm clipping.
+    """``config.optimizer`` + warmup/cosine schedule + sharded grad clipping.
 
     The clip must be the sharding-aware variant: the stock optax one computes
     the norm from local shards only, giving each rank a different clip factor
-    (see ``core.optim``).
+    (see ``core.optim``).  adamw/lion/sgd are elementwise and therefore exact
+    on partitioned parameters; adafactor's factored statistics are per-shard
+    under TP/FSDP (see TrainerConfig.optimizer).
     """
     from tpu_parallel.core.optim import clip_by_global_norm_sharded
 
@@ -77,10 +85,29 @@ def make_optimizer(config: TrainerConfig) -> optax.GradientTransformation:
         decay_steps=max(config.steps, config.warmup_steps + 1),
         end_value=config.learning_rate * 0.1,
     )
-    return optax.chain(
-        clip_by_global_norm_sharded(config.grad_clip),
-        optax.adamw(schedule, weight_decay=config.weight_decay),
-    )
+    if config.optimizer == "adamw":
+        tx = optax.adamw(schedule, weight_decay=config.weight_decay)
+    elif config.optimizer == "lion":
+        tx = optax.lion(schedule, weight_decay=config.weight_decay)
+    elif config.optimizer == "sgd":
+        # configs advertise weight_decay for every optimizer family; honor it
+        tx = optax.chain(
+            optax.add_decayed_weights(config.weight_decay),
+            optax.sgd(schedule, momentum=0.9),
+        )
+    elif config.optimizer == "adafactor":
+        raise ValueError(
+            "adafactor is not supported: optax's FactoredState carries "
+            "rank-changed placeholder leaves that break nn.Partitioned spec "
+            "discovery (needs T5X-style logical-axis metadata); use "
+            "adamw | lion | sgd"
+        )
+    else:
+        raise ValueError(
+            f"unknown optimizer {config.optimizer!r} "
+            "(expected adamw | lion | sgd)"
+        )
+    return optax.chain(clip_by_global_norm_sharded(config.grad_clip), tx)
 
 
 class Trainer:
