@@ -18,17 +18,14 @@ import jax.numpy as jnp
 
 
 def main():
+    from tpu_parallel.runtime import enable_compilation_cache
+
+    # warm re-runs skip the first compile; a no-op on remote-compile
+    # transports, where persisting large executables stalls (see
+    # enable_compilation_cache)
+    enable_compilation_cache()
     device = jax.devices()[0]
     on_tpu = device.platform == "tpu"
-    if not on_tpu:
-        # warm re-runs skip the first compile.  NOT enabled on TPU: with a
-        # remote-compile transport (PALLAS_AXON_REMOTE_COMPILE-style setups)
-        # persisting the large unrolled-layer gpt2_125m executable stalled
-        # the process indefinitely before the first step; a ~2-minute cold
-        # compile is the reliable price.
-        from tpu_parallel.runtime import enable_compilation_cache
-
-        enable_compilation_cache()
     n_chips = jax.device_count()
 
     from tpu_parallel.core import compute as compute_metrics

@@ -18,6 +18,8 @@ def get_config():
     c.num_minibatches = 1
     c.steps = 100
     c.optimizer = "adamw"  # adamw | lion | sgd
+    c.lr_schedule = "cosine"  # cosine | linear | constant
+    c.ema_decay = 0.0  # >0 keeps an EMA shadow of params (eval prefers it)
     c.learning_rate = 3e-4
     c.warmup_steps = 20
     c.weight_decay = 0.1

@@ -17,6 +17,8 @@ def get_config():
     c.num_minibatches = 4
     c.steps = 15
     c.optimizer = "adamw"  # adamw | lion | sgd
+    c.lr_schedule = "cosine"  # cosine | linear | constant
+    c.ema_decay = 0.0  # >0 keeps an EMA shadow of params (eval prefers it)
     c.learning_rate = 1e-3
     c.warmup_steps = 5
     c.weight_decay = 0.01

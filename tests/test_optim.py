@@ -96,3 +96,29 @@ def test_unknown_optimizer_rejected():
     # spec discovery) — must fail loudly, not at trace time
     with pytest.raises(ValueError, match="adafactor"):
         make_optimizer(TrainerConfig(optimizer="adafactor"))
+
+
+@pytest.mark.fast
+@pytest.mark.parametrize("name", ["cosine", "linear", "constant"])
+def test_lr_schedules(name):
+    """Each schedule warms up linearly, then follows its decay shape."""
+    from tpu_parallel.train_lib import TrainerConfig, make_optimizer
+
+    from tpu_parallel.train_lib import make_lr_schedule
+
+    cfg = TrainerConfig(lr_schedule=name, learning_rate=1e-3, warmup_steps=10, steps=100)
+    make_optimizer(cfg)  # must construct
+    sched = make_lr_schedule(cfg)
+    assert float(sched(0)) == 0.0
+    assert abs(float(sched(10)) - 1e-3) < 1e-9
+    if name == "constant":
+        assert abs(float(sched(99)) - 1e-3) < 1e-9
+    else:
+        assert float(sched(99)) < 1e-3 / 2
+
+
+def test_unknown_lr_schedule_rejected():
+    from tpu_parallel.train_lib import TrainerConfig, make_optimizer
+
+    with pytest.raises(ValueError, match="unknown lr_schedule"):
+        make_optimizer(TrainerConfig(lr_schedule="cyclical"))

@@ -33,6 +33,10 @@ class TrainState(train_state.TrainState):
             )
         )
     )
+    # Polyak/EMA shadow of ``params`` (None = disabled).  Updated by the
+    # train step when ``ema_decay > 0``; evaluation prefers it when present.
+    # Elementwise, so it shards exactly like params under any mesh.
+    ema_params: Optional[Pytree] = None
 
 
 @struct.dataclass

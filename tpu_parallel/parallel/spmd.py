@@ -87,6 +87,7 @@ def build_train_functions(
     donate: bool = True,
     init_rng: Optional[jax.Array] = None,
     eval_loss_fn: Optional[LossFn] = None,
+    ema_decay: float = 0.0,
     check_vma: bool = True,
 ) -> TrainFunctions:
     """Build matched (init, train_step) functions for ``mesh``.
@@ -140,6 +141,19 @@ def build_train_functions(
     if init_rng is None:
         init_rng = jax.random.PRNGKey(0)
 
+    if ema_decay:
+        if not 0.0 < ema_decay < 1.0:
+            raise ValueError(f"ema_decay={ema_decay} must be in (0, 1)")
+        base_init = model_init
+
+        def model_init(rng, batch):  # noqa: F811 — deliberate wrap
+            state = base_init(rng, batch)
+            if state.ema_params is None:
+                # seed the shadow with the initial params; it inherits their
+                # nn.Partitioned layout, so spec discovery shards it alike
+                state = state.replace(ema_params=state.params)
+            return state
+
     # Phase 1: abstract init to discover the partitioning.  check_vma must be
     # off HERE AND ONLY HERE: the whole point of the probe is that the true
     # out_specs are unknown until this trace reads the nn.Partitioned
@@ -178,6 +192,14 @@ def build_train_functions(
                 replicated_loss_axes=replicated_loss_axes,
             )
         new_state = state.apply_gradients(grads=grads, rng=rng)
+        if ema_decay:
+            with jax.named_scope("ema_update"):
+                new_ema = jax.tree_util.tree_map(
+                    lambda e, p: e * ema_decay + p.astype(e.dtype) * (1 - ema_decay),
+                    state.ema_params,
+                    new_state.params,
+                )
+            new_state = new_state.replace(ema_params=new_ema)
         if metric_axes or metric_mean_axes:
             step_metrics = sync_metrics(step_metrics, metric_axes, metric_mean_axes)
         step_metrics = accumulate_metrics(metrics, step_metrics)
@@ -196,8 +218,13 @@ def build_train_functions(
     if eval_loss_fn is not None:
 
         def eval_step(state: TrainState, metrics: Optional[Metrics], batch):
+            # evaluate the EMA shadow when one is maintained (the standard
+            # reason to keep it); ema_params is None statically otherwise
+            eval_params = (
+                state.ema_params if state.ema_params is not None else state.params
+            )
             _, step_metrics = eval_loss_fn(
-                state.params, state.apply_fn, batch, state.rng
+                eval_params, state.apply_fn, batch, state.rng
             )
             if metric_axes or metric_mean_axes:
                 step_metrics = sync_metrics(step_metrics, metric_axes, metric_mean_axes)

@@ -112,13 +112,21 @@ def enable_compilation_cache(cache_dir: str = "~/.cache/tpu_parallel_xla") -> st
     """Persist XLA compilations across processes (first TPU compile of the
     125M step is 20-40s; a warm cache makes re-runs near-instant).
 
-    Safe to call any time before the first compilation; returns the
-    resolved cache path.
+    Safe to call any time before the first compilation; returns the resolved
+    cache path, or "" when the cache stays off.  Off in two cases:
+    ``TPU_PARALLEL_NO_COMPILE_CACHE=1`` (manual escape hatch), and
+    remote-compile transports (``PALLAS_AXON_REMOTE_COMPILE=1``), where
+    persisting the large unrolled-layer gpt2_125m executable was observed to
+    stall the process indefinitely before the first step — on those, a
+    ~2-minute cold compile is the reliable price.  Normal TPU VMs (local
+    XLA compile) keep the cache.
     """
     import jax
 
     if os.environ.get("TPU_PARALLEL_NO_COMPILE_CACHE", "") == "1":
-        return ""  # escape hatch: some transports stall on large cache writes
+        return ""
+    if os.environ.get("PALLAS_AXON_REMOTE_COMPILE", "") == "1":
+        return ""
     path = os.path.expanduser(cache_dir)
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)

@@ -48,7 +48,13 @@ class TrainerConfig:
     # nn.Partitioned spec-discovery pipeline; supporting it needs T5X-style
     # logical-axis metadata.)
     optimizer: str = "adamw"
+    # "cosine" (decay to 10% of peak) | "linear" (decay to 0) | "constant";
+    # all include the linear warmup over warmup_steps
+    lr_schedule: str = "cosine"
     learning_rate: float = 3e-4
+    # >0 maintains an EMA (Polyak) shadow of the parameters in the train
+    # state, updated every step and preferred by evaluation.  0 = off.
+    ema_decay: float = 0.0
     warmup_steps: int = 10
     weight_decay: float = 0.1
     grad_clip: float = 1.0
@@ -67,6 +73,41 @@ class TrainerConfig:
         return cls(mesh=mesh, model_overrides=overrides, **d)
 
 
+def make_lr_schedule(config: TrainerConfig) -> optax.Schedule:
+    """``config.lr_schedule`` with a linear warmup over ``warmup_steps``."""
+    decay_steps = max(config.steps, config.warmup_steps + 1)
+    if config.lr_schedule == "cosine":
+        return optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=config.learning_rate,
+            warmup_steps=config.warmup_steps,
+            decay_steps=decay_steps,
+            end_value=config.learning_rate * 0.1,
+        )
+    if config.lr_schedule == "linear":
+        return optax.join_schedules(
+            [
+                optax.linear_schedule(0.0, config.learning_rate, config.warmup_steps),
+                optax.linear_schedule(
+                    config.learning_rate, 0.0, decay_steps - config.warmup_steps
+                ),
+            ],
+            boundaries=[config.warmup_steps],
+        )
+    if config.lr_schedule == "constant":
+        return optax.join_schedules(
+            [
+                optax.linear_schedule(0.0, config.learning_rate, config.warmup_steps),
+                optax.constant_schedule(config.learning_rate),
+            ],
+            boundaries=[config.warmup_steps],
+        )
+    raise ValueError(
+        f"unknown lr_schedule {config.lr_schedule!r} "
+        "(expected cosine | linear | constant)"
+    )
+
+
 def make_optimizer(config: TrainerConfig) -> optax.GradientTransformation:
     """``config.optimizer`` + warmup/cosine schedule + sharded grad clipping.
 
@@ -78,13 +119,7 @@ def make_optimizer(config: TrainerConfig) -> optax.GradientTransformation:
     """
     from tpu_parallel.core.optim import clip_by_global_norm_sharded
 
-    schedule = optax.warmup_cosine_decay_schedule(
-        init_value=0.0,
-        peak_value=config.learning_rate,
-        warmup_steps=config.warmup_steps,
-        decay_steps=max(config.steps, config.warmup_steps + 1),
-        end_value=config.learning_rate * 0.1,
-    )
+    schedule = make_lr_schedule(config)
     if config.optimizer == "adamw":
         tx = optax.adamw(schedule, weight_decay=config.weight_decay)
     elif config.optimizer == "lion":
@@ -181,6 +216,7 @@ class Trainer:
             num_minibatches=config.num_minibatches,
             donate=config.donate,
             eval_loss_fn=make_gpt_loss(self.model_config, train=False),
+            ema_decay=config.ema_decay,
             # interpret-mode pallas (flash/ulysses off-TPU) trips a JAX
             # vma-inference limitation; the checker stays on everywhere else
             # (see build_train_functions docstring)

@@ -31,18 +31,13 @@ def main(argv):
     # the first backend touch (simulate_cpu_devices initializes the backend to
     # validate its post-condition).
     initialize()
+    from tpu_parallel.runtime import enable_compilation_cache
+
+    # no-op on remote-compile transports / with TPU_PARALLEL_NO_COMPILE_CACHE=1
+    enable_compilation_cache()
     sim = cd.get("simulate_cpu_devices", 0)
     if sim:
         simulate_cpu_devices(sim)
-    # after the platform is settled (simulate_cpu_devices must win first).
-    # Skipped on TPU for the same reason as bench.py: persisting large
-    # executables through a remote-compile transport can stall indefinitely.
-    import jax
-
-    if jax.devices()[0].platform != "tpu":
-        from tpu_parallel.runtime import enable_compilation_cache
-
-        enable_compilation_cache()  # no-op when TPU_PARALLEL_NO_COMPILE_CACHE=1
     logging.info("topology: %s", process_info())
 
     trainer_cd = dict(cd)
