@@ -84,3 +84,105 @@ def test_metric_logger(tmp_path, devices):
     lines = [json.loads(l) for l in open(tmp_path / "t.jsonl")]
     assert [l["step"] for l in lines] == [1, 2]
     assert lines[1]["loss"] == 1.2
+
+
+def test_restore_across_structure_drift(tmp_path):
+    """Checkpoints written before an optional state field existed must still
+    restore: the new field keeps its template default (regression: adding
+    TrainState.ema_params broke restoring every pre-existing checkpoint)."""
+    import numpy as np
+    from typing import Any, Optional
+
+    from flax import struct
+
+    from tpu_parallel.checkpoint.io import Checkpointer
+
+    @struct.dataclass
+    class StateV1:
+        step: jax.Array
+        params: Any
+
+    @struct.dataclass
+    class StateV2:
+        step: jax.Array
+        params: Any
+        ema_params: Optional[Any] = None
+
+    params = {"w": jnp.arange(8, dtype=jnp.float32)}
+    ck = Checkpointer(str(tmp_path / "ckpt"))
+    ck.save(3, StateV1(step=jnp.int32(3), params=params), wait=True)
+
+    abstract_v2 = jax.eval_shape(
+        lambda: StateV2(step=jnp.int32(0), params={"w": jnp.zeros(8)})
+    )
+    restored = ck.restore(abstract_v2)
+    assert int(restored.step) == 3
+    np.testing.assert_array_equal(np.asarray(restored.params["w"]), np.arange(8))
+    assert restored.ema_params is None
+    ck.close()
+
+
+def test_checkpoint_roundtrip_with_ema(tmp_path, devices):
+    """ema_params survives the save/restore roundtrip bit-for-bit."""
+    import numpy as np
+
+    from tpu_parallel.runtime import MeshConfig
+    from tpu_parallel.train_lib import Trainer, TrainerConfig
+
+    config = TrainerConfig(
+        model="tiny",
+        mesh=MeshConfig(data=-1),
+        global_batch_size=16,
+        steps=3,
+        ema_decay=0.9,
+        log_every=10,
+        donate=False,
+    )
+    trainer = Trainer(config)
+    final = trainer.fit(str(tmp_path / "run"), checkpoint_every=3)
+    assert "loss" in final
+    state = trainer.state
+
+    trainer2 = Trainer(config)
+    trainer2.fit(str(tmp_path / "run"), checkpoint_every=10**9)
+    for (p1, a), (p2, b) in zip(
+        jax.tree_util.tree_leaves_with_path(state.ema_params),
+        jax.tree_util.tree_leaves_with_path(trainer2.state.ema_params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=str(p1))
+
+
+def test_resume_toggling_ema_both_directions(tmp_path, devices):
+    """EMA can be turned on or off across resumes of the same run directory.
+
+    off -> on: the pre-EMA checkpoint restores and the shadow seeds from
+    the restored params; on -> off: the EMA-bearing checkpoint restores
+    into a no-EMA config with the shadow dropped."""
+    import numpy as np
+
+    from tpu_parallel.runtime import MeshConfig
+    from tpu_parallel.train_lib import Trainer, TrainerConfig
+
+    base = dict(
+        model="tiny",
+        mesh=MeshConfig(data=-1),
+        global_batch_size=16,
+        log_every=10,
+        donate=False,
+    )
+    run = str(tmp_path / "run")
+
+    t1 = Trainer(TrainerConfig(steps=2, ema_decay=0.0, **base))
+    t1.fit(run, checkpoint_every=2)
+
+    # off -> on
+    t2 = Trainer(TrainerConfig(steps=4, ema_decay=0.9, **base))
+    t2.fit(run, checkpoint_every=2)
+    assert int(t2.state.step) == 4
+    assert t2.state.ema_params is not None
+
+    # on -> off
+    t3 = Trainer(TrainerConfig(steps=6, ema_decay=0.0, **base))
+    t3.fit(run, checkpoint_every=10**9)
+    assert int(t3.state.step) == 6
+    assert t3.state.ema_params is None

@@ -245,3 +245,76 @@ def test_generate_top_p_restricts_support(rng):
             logits, jax.random.PRNGKey(i), temperature=1.0, top_k=0, top_p=1e-6
         )
         assert int(tok[0]) == 0
+
+
+def test_generate_int8_kv_cache_close_to_bf16(rng):
+    """int8-quantized KV cache: decode logits stay close to the exact
+    cache's (a random-init model's argmax margins sit below the ~1/127
+    quantization noise, so token equality is the wrong assertion — logit
+    closeness catches real wiring bugs: wrong scales, misplaced writes)."""
+    cfg16 = tiny_test(dtype=jnp.float32, remat=False)
+    cfg8 = tiny_test(dtype=jnp.float32, remat=False, kv_cache_dtype="int8")
+    model16, model8 = GPTLM(cfg16), GPTLM(cfg8)
+    prompt = jax.random.randint(rng, (2, 5), 0, cfg16.vocab_size)
+    params = model16.init(
+        {"params": jax.random.PRNGKey(1)}, prompt, train=False
+    )["params"]
+
+    def prefill(model):
+        logits, vs = model.apply(
+            {"params": params}, prompt, train=False, decode=True,
+            mutable=["cache"],
+        )
+        return logits[:, -1], vs
+
+    pre16, vs16 = prefill(model16)
+    pre8, vs8 = prefill(model8)
+    # same next token for both (a near-tie argmax would otherwise send the
+    # two decodes down different branches and compare unrelated logits)
+    nxt = jnp.argmax(pre16, axis=-1).astype(jnp.int32)
+
+    def one_step(model, vs):
+        step_logits, _ = model.apply(
+            {"params": params, **vs}, nxt[:, None], train=False, decode=True,
+            mutable=["cache"],
+        )
+        return step_logits[:, -1]
+
+    step16 = one_step(model16, vs16)
+    step8 = one_step(model8, vs8)
+    np.testing.assert_allclose(np.asarray(pre8), np.asarray(pre16), rtol=0.1, atol=0.05)
+    np.testing.assert_allclose(np.asarray(step8), np.asarray(step16), rtol=0.1, atol=0.05)
+
+
+def test_int8_kv_cache_halves_storage(rng):
+    """The int8 cache's payload bytes are ~half the bf16 cache's.
+
+    head_dim=64 (the shipped models' width) so the per-(position, head)
+    fp32 scale amortizes to 6% — the tiny default head_dim would make the
+    overhead look artificially large."""
+    cfg = tiny_test(kv_cache_dtype="int8", d_model=256, n_heads=4)
+    model = GPTLM(cfg)
+    prompt = jax.random.randint(rng, (1, 4), 0, cfg.vocab_size)
+    _, variables = model.apply(
+        {"params": model.init({"params": jax.random.PRNGKey(0)}, prompt, train=False)["params"]},
+        prompt,
+        train=False,
+        decode=True,
+        mutable=["cache"],
+    )
+
+    def nbytes(tree):
+        return sum(
+            x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(tree)
+        )
+
+    total = nbytes(variables["cache"])
+    # bf16 equivalent: 2 bytes per K/V element, no scales
+    kv_elems = sum(
+        x.size
+        for path, x in jax.tree_util.tree_leaves_with_path(variables["cache"])
+        if x.dtype == jnp.int8
+    )
+    assert kv_elems > 0
+    bf16_total = kv_elems * 2
+    assert total < 0.65 * bf16_total, (total, bf16_total)

@@ -45,9 +45,81 @@ class Checkpointer:
         step = step if step is not None else self.manager.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint found in {self.directory}")
-        return self.manager.restore(
-            step, args=ocp.args.StandardRestore(abstract_state)
-        )
+        try:
+            return self.manager.restore(
+                step, args=ocp.args.StandardRestore(abstract_state)
+            )
+        except ValueError as e:
+            if "do not match" not in str(e):
+                raise
+            return self._restore_with_drift(abstract_state, step)
+
+    def _restore_with_drift(self, abstract_state: Pytree, step: int) -> Pytree:
+        """Restore a checkpoint whose structure drifted from the live state:
+        optional fields added since it was written (e.g. a pre-``ema_params``
+        checkpoint into the current ``TrainState``) or written with fields
+        the current config no longer carries (EMA turned off on resume).
+
+        Orbax keys the saved tree by dataclass field name; each overlapping
+        field restores through its own dict-shaped ``PyTreeRestore`` with
+        ``partial_restore=True`` (so the on-disk tree may hold more than the
+        target), and fields absent on disk keep their template defaults.
+        """
+        import dataclasses
+
+        if not dataclasses.is_dataclass(abstract_state):
+            raise ValueError(
+                f"cannot drift-restore a non-dataclass state "
+                f"({type(abstract_state).__name__})"
+            )
+        # the manager's registered handler is StandardCheckpointHandler and
+        # refuses PyTreeRestore args; a bare PyTreeCheckpointer on the step
+        # directory accepts partial_restore (the on-disk layout is the same)
+        step_dir = os.path.join(self.directory, str(step), "default")
+        restored = {}
+        for f in dataclasses.fields(abstract_state):
+            if not f.metadata.get("pytree_node", True):
+                continue  # apply_fn/tx: functions, never serialized
+            value = getattr(abstract_state, f.name)
+            if value is None:
+                continue  # disabled optional field: ignore any on-disk copy
+            item = {f.name: value}
+            try:
+                with ocp.PyTreeCheckpointer() as ptc:
+                    out = ptc.restore(
+                        step_dir,
+                        args=ocp.args.PyTreeRestore(
+                            item=item,
+                            restore_args=(
+                                ocp.checkpoint_utils.construct_restore_args(item)
+                            ),
+                            partial_restore=True,
+                        ),
+                    )
+            except (ValueError, KeyError, TypeError):
+                # TypeError: the checkpoint stores the field as a None
+                # marker (saved with the feature disabled) while the target
+                # wants a subtree.  Either way the checkpoint has no usable
+                # value: None, NOT the abstract template
+                # (leaving ShapeDtypeStructs in the state would poison the
+                # first step) — the caller re-seeds, e.g. Trainer.fit seeds
+                # a missing ema_params from the restored params
+                import warnings
+
+                warnings.warn(
+                    f"checkpoint at step {step} has no {f.name!r}; "
+                    "restoring it as None",
+                    stacklevel=2,
+                )
+                restored[f.name] = None
+                continue
+            restored[f.name] = out[f.name]
+        if all(v is None for v in restored.values()):
+            raise ValueError(
+                f"checkpoint at step {step} shares no fields with the "
+                "restore target — structure drift too large"
+            )
+        return abstract_state.replace(**restored)
 
     @property
     def latest_step(self) -> Optional[int]:

@@ -77,6 +77,10 @@ class TransformerConfig:
     # (scripts/attn_microbench.py: 10.5ms vs 17.2ms fwd+bwd at 128x128)
     flash_block_q: int = 512
     flash_block_k: int = 512
+    # decode KV-cache storage: "bf16" (= cfg.dtype) or "int8" — int8 halves
+    # the cache HBM (the decode-memory hog) with one fp32 scale per
+    # (position, kv-head); dequantization is a transient per layer per step
+    kv_cache_dtype: str = "bf16"
     # mixture-of-experts: 0 = dense MLP; >0 replaces every block's MLP with
     # routed experts, expert-parallel over the model axis
     moe_experts: int = 0
@@ -256,6 +260,12 @@ class Attention(nn.Module):
                     "incremental decoding with packed sequences (segment_ids)"
                 )
             b = x.shape[0]
+            if cfg.kv_cache_dtype not in ("bf16", "int8"):
+                raise ValueError(
+                    f"kv_cache_dtype={cfg.kv_cache_dtype!r} (bf16 | int8)"
+                )
+            quant_cache = cfg.kv_cache_dtype == "int8"
+            cache_store_dtype = jnp.int8 if quant_cache else cfg.dtype
             # cache at K/V-head width (local_kv): under GQA this is the whole
             # point — n_heads/n_kv less cache HBM; groups expand after read
             cached_k = self.variable(
@@ -263,15 +273,32 @@ class Attention(nn.Module):
                 "cached_key",
                 jnp.zeros,
                 (b, cfg.seq_len, local_kv, cfg.head_dim),
-                cfg.dtype,
+                cache_store_dtype,
             )
             cached_v = self.variable(
                 "cache",
                 "cached_value",
                 jnp.zeros,
                 (b, cfg.seq_len, local_kv, cfg.head_dim),
-                cfg.dtype,
+                cache_store_dtype,
             )
+            if quant_cache:
+                # one fp32 scale per (position, kv-head): int8 payload + a
+                # head_dim-th of fp32 ≈ half the bf16 cache HBM
+                cached_k_scale = self.variable(
+                    "cache",
+                    "cached_key_scale",
+                    jnp.zeros,
+                    (b, cfg.seq_len, local_kv, 1),
+                    jnp.float32,
+                )
+                cached_v_scale = self.variable(
+                    "cache",
+                    "cached_value_scale",
+                    jnp.zeros,
+                    (b, cfg.seq_len, local_kv, 1),
+                    jnp.float32,
+                )
             cache_index = self.variable(
                 "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
             )
@@ -291,9 +318,38 @@ class Attention(nn.Module):
             k = apply_rope(k, positions, cfg.rope_theta)
         group = local_heads // local_kv
         if decode:
-            k_all = lax.dynamic_update_slice_in_dim(cached_k.value, k, idx, axis=1)
-            v_all = lax.dynamic_update_slice_in_dim(cached_v.value, v, idx, axis=1)
-            cached_k.value, cached_v.value = k_all, v_all
+            if quant_cache:
+
+                def q8(t):
+                    a = t.astype(jnp.float32)
+                    scale = jnp.max(jnp.abs(a), axis=-1, keepdims=True) / 127.0
+                    q = jnp.where(scale > 0, a / jnp.maximum(scale, 1e-30), 0.0)
+                    return jnp.round(q).astype(jnp.int8), scale
+
+                kq, ks = q8(k)
+                vq, vs = q8(v)
+                upd = lambda buf, new: lax.dynamic_update_slice_in_dim(
+                    buf, new, idx, axis=1
+                )
+                cached_k.value = upd(cached_k.value, kq)
+                cached_v.value = upd(cached_v.value, vq)
+                cached_k_scale.value = upd(cached_k_scale.value, ks)
+                cached_v_scale.value = upd(cached_v_scale.value, vs)
+                # dequantize transiently for this layer's attention read
+                k_all = (
+                    cached_k.value.astype(jnp.float32) * cached_k_scale.value
+                ).astype(cfg.dtype)
+                v_all = (
+                    cached_v.value.astype(jnp.float32) * cached_v_scale.value
+                ).astype(cfg.dtype)
+            else:
+                k_all = lax.dynamic_update_slice_in_dim(
+                    cached_k.value, k, idx, axis=1
+                )
+                v_all = lax.dynamic_update_slice_in_dim(
+                    cached_v.value, v, idx, axis=1
+                )
+                cached_k.value, cached_v.value = k_all, v_all
             cache_index.value = idx + x.shape[1]
             if group != 1:
                 k_all = jnp.repeat(k_all, group, axis=2)

@@ -335,6 +335,10 @@ class Trainer:
             # be writing when a failure triggers rollback
             ckpt.wait()
             self.state = ckpt.restore(target)
+            if self.config.ema_decay and self.state.ema_params is None:
+                # EMA turned on mid-run (the checkpoint predates it): seed
+                # the shadow from the restored params, as init would
+                self.state = self.state.replace(ema_params=self.state.params)
 
         try:
             if ckpt.latest_step is not None:
