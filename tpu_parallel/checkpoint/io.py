@@ -50,9 +50,14 @@ class Checkpointer:
                 step, args=ocp.args.StandardRestore(abstract_state)
             )
         except ValueError as e:
-            if "do not match" not in str(e):
-                raise
-            return self._restore_with_drift(abstract_state, step)
+            # possibly structure drift (optional state fields added/removed
+            # since the checkpoint was written).  The drift path re-raises
+            # for anything it cannot soundly absorb, so chain back to the
+            # original error when it fails too — no message parsing.
+            try:
+                return self._restore_with_drift(abstract_state, step)
+            except Exception:
+                raise e
 
     def _restore_with_drift(self, abstract_state: Pytree, step: int) -> Pytree:
         """Restore a checkpoint whose structure drifted from the live state:
@@ -97,17 +102,18 @@ class Checkpointer:
                         ),
                     )
             except (ValueError, KeyError, TypeError):
-                # TypeError: the checkpoint stores the field as a None
-                # marker (saved with the feature disabled) while the target
-                # wants a subtree.  Either way the checkpoint has no usable
-                # value: None, NOT the abstract template
-                # (leaving ShapeDtypeStructs in the state would poison the
-                # first step) — the caller re-seeds, e.g. Trainer.fit seeds
-                # a missing ema_params from the restored params
+                # Only fields that are optional *by construction* (dataclass
+                # default None, like ema_params) may degrade to None —
+                # TypeError covers the on-disk None marker saved while the
+                # feature was off.  A restore failure on a required field
+                # (params, opt_state, ...) is corruption or intra-field
+                # drift and must surface, not silently null the state.
+                if f.default is not None:
+                    raise
                 import warnings
 
                 warnings.warn(
-                    f"checkpoint at step {step} has no {f.name!r}; "
+                    f"checkpoint at step {step} has no usable {f.name!r}; "
                     "restoring it as None",
                     stacklevel=2,
                 )

@@ -9,8 +9,18 @@ from tpu_parallel.models.gpt import (
 )
 from tpu_parallel.models.layers import TransformerConfig
 from tpu_parallel.models.mlp import MLPClassifier, MLPConfig
+from tpu_parallel.models.quantize import (
+    QuantizedTensor,
+    dequantize_params,
+    quantize_params,
+    quantized_nbytes,
+)
 
 __all__ = [
+    "QuantizedTensor",
+    "dequantize_params",
+    "quantize_params",
+    "quantized_nbytes",
     "GPTConfig",
     "GPTLM",
     "gpt2_125m",

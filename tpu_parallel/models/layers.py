@@ -319,15 +319,10 @@ class Attention(nn.Module):
         group = local_heads // local_kv
         if decode:
             if quant_cache:
+                from tpu_parallel.models.quantize import absmax_int8
 
-                def q8(t):
-                    a = t.astype(jnp.float32)
-                    scale = jnp.max(jnp.abs(a), axis=-1, keepdims=True) / 127.0
-                    q = jnp.where(scale > 0, a / jnp.maximum(scale, 1e-30), 0.0)
-                    return jnp.round(q).astype(jnp.int8), scale
-
-                kq, ks = q8(k)
-                vq, vs = q8(v)
+                kq, ks = absmax_int8(k, axis=-1)
+                vq, vs = absmax_int8(v, axis=-1)
                 upd = lambda buf, new: lax.dynamic_update_slice_in_dim(
                     buf, new, idx, axis=1
                 )
