@@ -31,5 +31,7 @@ def get_config():
     c.checkpoint_dir = ""
     c.checkpoint_every = 100
     c.data_path = ""
+    c.data_format = "flat"  # flat | packed (EOS-delimited docs + segment_ids)
+    c.eos_id = 50256
     c.eval_steps = 0
     return c

@@ -40,7 +40,15 @@ def test_config_one_step(path):
     cd = load_config(path)
     d = dict(cd)
     d.pop("simulate_cpu_devices", None)  # conftest already simulated 8 devices
-    for plumbing in ("checkpoint_dir", "checkpoint_every", "data_path", "eval_steps"):
+    for plumbing in (
+        "checkpoint_dir",
+        "checkpoint_every",
+        "data_path",
+        "data_format",
+        "eos_id",
+        "eval_steps",
+        "eval_fraction",
+    ):
         d.pop(plumbing, None)
 
     # Resolve the declared mesh against the 8 simulated devices.  Configs that

@@ -78,7 +78,9 @@ def cluster_outputs(tmp_path_factory):
 def _window_ids(ds, rows):
     """Recover window indices from actual token rows (content-matched, so
     assertions on them are not circular with loader internals)."""
-    stream = np.asarray(ds.tokens[: ds.num_windows * ds.seq_len]).astype(np.int32)
+    stream = np.asarray(ds.shards[0][: ds.num_windows * ds.seq_len]).astype(
+        np.int32
+    )
     ids = []
     for row in rows:
         starts = np.flatnonzero(stream[:: ds.seq_len] == row[0])

@@ -1,9 +1,11 @@
 from tpu_parallel.data.loader import DataLoader, TokenDataset, make_global_batch
+from tpu_parallel.data.packed import PackedDataset
 from tpu_parallel.data.synthetic import classification_batch, lm_batch
 
 __all__ = [
     "DataLoader",
     "TokenDataset",
+    "PackedDataset",
     "make_global_batch",
     "classification_batch",
     "lm_batch",

@@ -33,7 +33,12 @@ from tpu_parallel.core.state import TextBatch
 
 
 class TokenDataset:
-    """Windows over a flat token stream (memmap file or in-memory array).
+    """Windows over flat token streams (memmap file(s) or in-memory array).
+
+    ``tokens`` may be one path / array, or a **list** of paths/arrays — a
+    sharded corpus.  Windows never cross shard boundaries (each shard
+    contributes ``(len - 1) // seq_len`` windows); shards stay memmapped,
+    so corpus size never hits RAM.
 
     ``sample(epoch_rng, index)`` is deterministic: the same seed and index
     always give the same window, so a resumed run (checkpointed step count)
@@ -41,14 +46,22 @@ class TokenDataset:
     """
 
     def __init__(self, tokens, seq_len: int):
-        if isinstance(tokens, (str,)):
-            tokens = np.memmap(tokens, dtype=np.uint16, mode="r")
-        self.tokens = tokens
+        if not isinstance(tokens, (list, tuple)):
+            tokens = [tokens]
+        self.shards = [
+            np.memmap(t, dtype=np.uint16, mode="r") if isinstance(t, str) else t
+            for t in tokens
+        ]
         self.seq_len = seq_len
-        self.num_windows = (len(tokens) - 1) // seq_len
+        per_shard = [max(0, (len(s) - 1) // seq_len) for s in self.shards]
+        # cumulative window counts: window i lives in the shard whose
+        # cumulative range contains i
+        self._cum = np.cumsum([0] + per_shard)
+        self.num_windows = int(self._cum[-1])
         if self.num_windows <= 0:
             raise ValueError(
-                f"stream of {len(tokens)} tokens too short for seq_len={seq_len}"
+                f"streams of {[len(s) for s in self.shards]} tokens too "
+                f"short for seq_len={seq_len}"
             )
 
     @staticmethod
@@ -58,8 +71,11 @@ class TokenDataset:
 
     def window(self, i: int) -> np.ndarray:
         """Window ``i``: ``seq_len + 1`` tokens (inputs + shifted targets)."""
-        start = i * self.seq_len
-        return np.asarray(self.tokens[start : start + self.seq_len + 1], np.int32)
+        shard = int(np.searchsorted(self._cum, i, side="right")) - 1
+        start = (i - int(self._cum[shard])) * self.seq_len
+        return np.asarray(
+            self.shards[shard][start : start + self.seq_len + 1], np.int32
+        )
 
     def batch(self, order: np.ndarray) -> TextBatch:
         """Assemble the windows in ``order`` into a TextBatch (numpy)."""

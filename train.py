@@ -45,6 +45,10 @@ def main(argv):
     checkpoint_dir = trainer_cd.pop("checkpoint_dir", "")
     checkpoint_every = trainer_cd.pop("checkpoint_every", 100)
     data_path = trainer_cd.pop("data_path", "")
+    # "flat": contiguous seq_len windows; "packed": EOS-delimited documents
+    # packed whole into rows with segment_ids (in-kernel attention masking)
+    data_format = trainer_cd.pop("data_format", "flat")
+    eos_id = trainer_cd.pop("eos_id", 50256)  # GPT-2's <|endoftext|>
     eval_steps = trainer_cd.pop("eval_steps", 0)
     # fraction of the token stream held out for eval (never trained on);
     # defaults on whenever eval is requested over a real dataset
@@ -60,10 +64,24 @@ def main(argv):
 
     data_loader = None
     if data_path:
-        from tpu_parallel.data import DataLoader, TokenDataset
+        from tpu_parallel.data import DataLoader, PackedDataset, TokenDataset
 
+        paths = data_path.split(",") if "," in data_path else data_path
+        if data_format == "packed":
+            if isinstance(paths, list):
+                raise NotImplementedError(
+                    "packed datasets read a single .bin stream "
+                    "(concatenate shards at prepare time)"
+                )
+            dataset = PackedDataset(
+                paths, trainer.model_config.seq_len, eos_id=eos_id
+            )
+        elif data_format == "flat":
+            dataset = TokenDataset(paths, trainer.model_config.seq_len)
+        else:
+            raise ValueError(f"data_format={data_format!r} (flat | packed)")
         data_loader = DataLoader(
-            TokenDataset(data_path, trainer.model_config.seq_len),
+            dataset,
             trainer.mesh,
             config.global_batch_size,
             seed=config.seed,
