@@ -11,13 +11,44 @@ driver, or CPU (with a tiny model) when no accelerator is present.
 """
 
 import json
+import os
+import threading
 import time
 
 import jax
 import jax.numpy as jnp
 
 
+def _arm_watchdog(seconds: float) -> threading.Timer:
+    """Hard-exit if the benchmark wedges (e.g. a dead TPU transport hangs
+    jax.devices() in C++ before any Python timeout can fire).  A failed
+    bench run must be an error, not an eternal hang.  The caller cancels
+    the returned timer once the result is printed."""
+
+    def bite():
+        print(
+            json.dumps(
+                {
+                    "metric": "tokens/sec/chip",
+                    "value": 0,
+                    "unit": "tokens/sec/chip",
+                    "vs_baseline": 0,
+                    "error": f"watchdog: no result within {seconds:.0f}s "
+                    "(wedged transport?)",
+                }
+            ),
+            flush=True,
+        )
+        os._exit(3)
+
+    t = threading.Timer(seconds, bite)
+    t.daemon = True
+    t.start()
+    return t
+
+
 def main():
+    watchdog = _arm_watchdog(float(os.environ.get("BENCH_WATCHDOG_SECS", "900")))
     from tpu_parallel.runtime import enable_compilation_cache
 
     # warm re-runs skip the first compile; a no-op on remote-compile
@@ -115,6 +146,7 @@ def main():
             }
         )
     )
+    watchdog.cancel()
 
 
 if __name__ == "__main__":

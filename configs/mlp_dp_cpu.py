@@ -33,4 +33,6 @@ def get_config():
     c.data_format = "flat"  # flat | packed (EOS-delimited docs + segment_ids)
     c.eos_id = 50256
     c.eval_steps = 0
+    c.eval_every = 0  # >0: periodic eval during fit (uses the held-out split)
+    c.keep_best = False  # snapshot lowest-eval-loss state to {checkpoint_dir}/best
     return c

@@ -47,6 +47,8 @@ def test_config_one_step(path):
         "data_format",
         "eos_id",
         "eval_steps",
+        "eval_every",
+        "keep_best",
         "eval_fraction",
     ):
         d.pop(plumbing, None)

@@ -119,3 +119,57 @@ def test_fit_gives_up_after_max_failures(tmp_path):
     t.funcs = dataclasses.replace(t.funcs, step_fn=always_fails)
     with pytest.raises(RuntimeError, match="permanent failure"):
         t.fit(ckpt_dir, checkpoint_every=1, steps=4, max_failures=2)
+
+
+def test_fit_keeps_best_checkpoint(tmp_path, devices):
+    """keep_best snapshots the lowest-eval-loss state under best/ and the
+    snapshot restores."""
+    import numpy as np
+
+    from tpu_parallel.checkpoint import Checkpointer, abstract_state_of
+    from tpu_parallel.runtime import MeshConfig
+    from tpu_parallel.train_lib import Trainer, TrainerConfig
+
+    config = TrainerConfig(
+        model="tiny",
+        mesh=MeshConfig(data=-1),
+        global_batch_size=16,
+        steps=6,
+        learning_rate=1e-2,
+        log_every=10,
+        donate=False,
+    )
+    run = str(tmp_path / "run")
+    trainer = Trainer(config)
+
+    # eval_every needs a real held-out split (fit rejects synthetic eval)
+    from tpu_parallel.data import DataLoader, TokenDataset
+
+    stream = np.arange(50_000, dtype=np.uint16) % trainer.model_config.vocab_size
+    loader = DataLoader(
+        TokenDataset(stream, trainer.model_config.seq_len),
+        trainer.mesh,
+        config.global_batch_size,
+        holdout_fraction=0.2,
+    )
+    seen = []
+    trainer.fit(
+        run,
+        data_loader=loader,
+        checkpoint_every=3,
+        eval_every=2,
+        eval_steps=1,
+        keep_best=True,
+        log_fn=lambda s, m: seen.append((s, m)),
+    )
+    evals = [(s, m) for s, m in seen if "eval_loss" in m]
+    assert evals, "no eval logs emitted"
+
+    best = Checkpointer(str(tmp_path / "run" / "best"))
+    assert best.latest_step is not None
+    target = abstract_state_of(
+        trainer.funcs.init_fn, jax.random.PRNGKey(0), trainer.example_batch
+    )
+    restored = best.restore(target)
+    assert int(restored.step) == best.latest_step
+    best.close()

@@ -43,21 +43,32 @@ from tpu_parallel.parallel.tp import ModuleShard, axis_size_or_none
 
 
 class ExpertFFN(nn.Module):
-    """One expert: the standard transformer FFN at model dtype."""
+    """One expert: the standard transformer FFN at model dtype.
+
+    Projection outputs carry the same ``"proj"`` checkpoint names as the
+    dense MLP (layers.py), so the proj/proj_attn remat policies save the
+    expert matmuls instead of recomputing them in the backward.
+    """
 
     config: "TransformerConfig"  # noqa: F821 — forward ref, see layers.py
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
+        from jax.ad_checkpoint import checkpoint_name
+
         cfg = self.config
         hidden = cfg.mlp_ratio * cfg.d_model
         if cfg.mlp == "swiglu":
             gate = nn.Dense(hidden, use_bias=False, dtype=cfg.dtype, name="gate")(x)
             up = nn.Dense(hidden, use_bias=False, dtype=cfg.dtype, name="up")(x)
-            h = nn.silu(gate) * up
+            h = nn.silu(checkpoint_name(gate, "proj")) * checkpoint_name(up, "proj")
         else:
-            h = nn.gelu(nn.Dense(hidden, dtype=cfg.dtype, name="up")(x))
-        return nn.Dense(cfg.d_model, dtype=cfg.dtype, name="down")(h)
+            h = nn.gelu(
+                checkpoint_name(nn.Dense(hidden, dtype=cfg.dtype, name="up")(x), "proj")
+            )
+        return checkpoint_name(
+            nn.Dense(cfg.d_model, dtype=cfg.dtype, name="down")(h), "proj"
+        )
 
 
 class MoEMLP(nn.Module):

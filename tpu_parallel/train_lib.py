@@ -299,8 +299,20 @@ class Trainer:
         max_failures: int = 3,
         max_to_keep: int = 3,
         log_fn: Callable[[int, Dict[str, float]], None] = None,
+        eval_every: int = 0,
+        eval_steps: int = 10,
+        keep_best: bool = False,
     ) -> Dict[str, float]:
         """Fault-tolerant training: auto-resume, periodic async checkpoints.
+
+        ``eval_every > 0`` runs :meth:`evaluate` on the held-out split every
+        that many steps (requires a ``data_loader`` with ``eval_view()`` —
+        a synthetic or unsplittable stream would make the eval meaningless)
+        and logs ``eval_*`` metrics; with ``keep_best=True`` the
+        lowest-eval-loss state is additionally saved under
+        ``{checkpoint_dir}/best`` (one kept), with the best loss persisted
+        beside it so a resumed run never overwrites a better snapshot with
+        a worse one.
 
         The failure-detection / elastic-recovery layer the reference lacks
         (SURVEY.md §5): on start, restores the latest checkpoint in
@@ -319,8 +331,35 @@ class Trainer:
         """
         from tpu_parallel.checkpoint import Checkpointer, abstract_state_of
 
+        import json as _json
+        import os as _os
+
         steps = steps if steps is not None else self.config.steps
+        if keep_best and not eval_every:
+            raise ValueError("keep_best=True requires eval_every > 0")
+        eval_iter_fn = None
+        if eval_every:
+            if data_loader is None or not hasattr(data_loader, "eval_view"):
+                raise ValueError(
+                    "eval_every > 0 needs a data_loader with eval_view() "
+                    "(a held-out split); evaluating the training stream or "
+                    "a synthetic batch would make the numbers meaningless"
+                )
+            eval_loader = data_loader.eval_view()
+            eval_iter_fn = lambda: iter(eval_loader)
         ckpt = Checkpointer(checkpoint_dir, max_to_keep=max_to_keep)
+        best_ckpt = None
+        best_loss = float("inf")
+        best_loss_path = _os.path.join(checkpoint_dir, "best", "best_loss.json")
+        if keep_best:
+            best_ckpt = Checkpointer(
+                _os.path.join(checkpoint_dir, "best"), max_to_keep=1
+            )
+            if _os.path.exists(best_loss_path):
+                # resumed run: never let a worse post-resume eval overwrite
+                # the surviving best snapshot
+                with open(best_loss_path) as fh:
+                    best_loss = _json.load(fh)["loss"]
         target = None
 
         def restore_latest():
@@ -377,14 +416,27 @@ class Trainer:
                 step += 1
                 if step % checkpoint_every == 0 or step == steps:
                     ckpt.save(step, self.state, wait=False)
+                if eval_every and (step % eval_every == 0 or step == steps):
+                    ev = self.evaluate(batch_iter=eval_iter_fn(), steps=eval_steps)
+                    if log_fn is not None:
+                        log_fn(step, {f"eval_{k}": v for k, v in ev.items()})
+                    if best_ckpt is not None and ev["loss"] < best_loss:
+                        best_loss = ev["loss"]
+                        best_ckpt.save(step, self.state, wait=False)
+                        with open(best_loss_path, "w") as fh:
+                            _json.dump({"loss": best_loss, "step": step}, fh)
                 if step % self.config.log_every == 0 or step == steps:
                     last = compute_metrics(metrics)
                     if log_fn is not None:
                         log_fn(step, last)
             ckpt.wait()
+            if best_ckpt is not None:
+                best_ckpt.wait()
             return last
         finally:
             ckpt.close()
+            if best_ckpt is not None:
+                best_ckpt.close()
 
     def evaluate(self, batch_iter=None, steps: int = 10) -> Dict[str, float]:
         """Mean metrics over ``steps`` eval batches (dropout off, no update)."""

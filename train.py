@@ -50,9 +50,16 @@ def main(argv):
     data_format = trainer_cd.pop("data_format", "flat")
     eos_id = trainer_cd.pop("eos_id", 50256)  # GPT-2's <|endoftext|>
     eval_steps = trainer_cd.pop("eval_steps", 0)
+    # >0: evaluate on the held-out split every N steps during fit;
+    # keep_best then also snapshots the lowest-eval-loss state to
+    # {checkpoint_dir}/best
+    eval_every = trainer_cd.pop("eval_every", 0)
+    keep_best = trainer_cd.pop("keep_best", False)
     # fraction of the token stream held out for eval (never trained on);
     # defaults on whenever eval is requested over a real dataset
-    eval_fraction = trainer_cd.pop("eval_fraction", 0.1 if eval_steps else 0.0)
+    eval_fraction = trainer_cd.pop(
+        "eval_fraction", 0.1 if (eval_steps or eval_every) else 0.0
+    )
     config = TrainerConfig.from_config_dict(trainer_cd)
     trainer = Trainer(config)
     logging.info(
@@ -97,6 +104,11 @@ def main(argv):
         parts = " ".join(f"{k}={v:.4f}" for k, v in sorted(metrics.items()))
         logging.info("step %d: %s", step, parts)
 
+    if (eval_every or keep_best) and not checkpoint_dir:
+        raise ValueError(
+            "eval_every/keep_best run inside the fault-tolerant fit loop — "
+            "set checkpoint_dir too"
+        )
     if checkpoint_dir:
         # fault-tolerant path: auto-resume + periodic saves + exact data replay
         final = trainer.fit(
@@ -104,6 +116,9 @@ def main(argv):
             data_loader=data_loader,
             checkpoint_every=checkpoint_every,
             log_fn=log_fn,
+            eval_every=eval_every,
+            eval_steps=eval_steps or 10,
+            keep_best=keep_best,
         )
     else:
         final = trainer.train(
