@@ -1,0 +1,217 @@
+"""HuggingFace GPT-2 weight interop.
+
+``from_hf_gpt2`` converts a ``transformers`` GPT-2 checkpoint (model or
+state dict) into this framework's single-device param layout, so pretrained
+GPT-2 weights drop into :class:`~tpu_parallel.models.gpt.GPTLM` /
+:func:`~tpu_parallel.models.generate.generate`; ``to_hf_gpt2`` goes the
+other way for ecosystem hand-off.  The round-trip is exact (no
+re-quantization), and logit equivalence against the canonical torch
+implementation is pinned in ``tests/test_hf.py`` — which doubles as an
+architecture-parity proof for the transformer itself (pre-norm residuals,
+tanh-approximate GELU, 1e-5 layernorm epsilon, per-head QKV packing).
+
+Layout notes:
+- HF ``Conv1D`` weights are already [in, out] — same as flax kernels, no
+  transpose.
+- HF packs ``c_attn`` columns as [q(all heads) | k | v]; this model fuses
+  QKV per head ([head, 3*head_dim] blocks).  ``_qkv_to_ours`` /
+  ``_qkv_to_hf`` permute between the two.
+- GPT-2 ties ``lm_head`` to ``wte``; this model keeps a separate lm_head
+  kernel, set to ``wte.T`` on import and written back from ``wte`` (the
+  framework may untie during fine-tuning — ``to_hf_gpt2`` refuses if the
+  two have drifted, rather than silently dropping one).
+
+Reference capability: none (the reference has no model zoo or interop —
+SURVEY.md §2.4 covers only its inline MLP).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+Pytree = Any
+
+
+def _to_np(x) -> np.ndarray:
+    if hasattr(x, "detach"):  # torch tensor
+        return x.detach().cpu().numpy()
+    return np.asarray(x)
+
+
+def _qkv_to_ours(w: np.ndarray, n_heads: int) -> np.ndarray:
+    """[*, 3*D] HF (q|k|v blocks) -> [*, H, 3, dh] fused-per-head, flattened."""
+    lead = w.shape[:-1]
+    d3 = w.shape[-1]
+    d = d3 // 3
+    dh = d // n_heads
+    w = w.reshape(*lead, 3, n_heads, dh)  # [., 3, H, dh]
+    w = np.moveaxis(w, -3, -2)  # [., H, 3, dh]
+    return w.reshape(*lead, d3)
+
+
+def _qkv_to_hf(w: np.ndarray, n_heads: int) -> np.ndarray:
+    lead = w.shape[:-1]
+    d3 = w.shape[-1]
+    d = d3 // 3
+    dh = d // n_heads
+    w = w.reshape(*lead, n_heads, 3, dh)  # [., H, 3, dh]
+    w = np.moveaxis(w, -2, -3)  # [., 3, H, dh]
+    return w.reshape(*lead, d3)
+
+
+def _state_dict(hf_model_or_dict) -> Dict[str, np.ndarray]:
+    sd = (
+        hf_model_or_dict.state_dict()
+        if hasattr(hf_model_or_dict, "state_dict")
+        else hf_model_or_dict
+    )
+    out = {}
+    for k, v in sd.items():
+        k = k.removeprefix("transformer.")
+        out[k] = _to_np(v)
+    return out
+
+
+def from_hf_gpt2(hf_model_or_dict, config, dtype=jnp.float32) -> Pytree:
+    """HF GPT-2 weights -> this framework's (unrolled-layout) params.
+
+    ``config`` must structurally match the checkpoint (n_layers, n_heads,
+    d_model, vocab_size, learned positions, gelu MLP, layernorm) — checked
+    against tensor shapes as we go.  Returns the layout a mesh-free
+    ``GPTLM(config).init`` produces with ``scan_layers=False``; for a
+    scan-layers model, stack the per-layer leaves (tests show the recipe).
+    """
+    if (
+        config.positional != "learned"
+        or config.mlp != "gelu"
+        or config.norm != "layernorm"
+    ):
+        raise ValueError(
+            "GPT-2 interop needs positional='learned', mlp='gelu', "
+            "norm='layernorm'"
+        )
+    if config.scan_layers:
+        raise ValueError(
+            "from_hf_gpt2 emits the unrolled layout; build the config with "
+            "scan_layers=False (stack leaves yourself for a scanned model)"
+        )
+    sd = _state_dict(hf_model_or_dict)
+    ckpt_layers = 1 + max(
+        int(k.split(".")[1]) for k in sd if k.startswith("h.")
+    )
+    if ckpt_layers != config.n_layers:
+        raise ValueError(
+            f"checkpoint has {ckpt_layers} layers, config.n_layers="
+            f"{config.n_layers} — refusing to silently truncate/underfill"
+        )
+    if sd["wpe.weight"].shape[0] < config.seq_len:
+        raise ValueError(
+            f"checkpoint position table covers {sd['wpe.weight'].shape[0]} "
+            f"positions < config.seq_len={config.seq_len} (longer sequences "
+            "would silently reuse clipped rows under jit)"
+        )
+    h = config.n_heads
+    cast = lambda x: jnp.asarray(x, dtype)
+
+    def norm(prefix):
+        return {"scale": cast(sd[f"{prefix}.weight"]), "bias": cast(sd[f"{prefix}.bias"])}
+
+    wte = sd["wte.weight"]
+    if wte.shape != (config.vocab_size, config.d_model):
+        raise ValueError(
+            f"wte {wte.shape} != (vocab={config.vocab_size}, d={config.d_model})"
+        )
+    params: Dict[str, Any] = {
+        "embed": {
+            "tok": {"embedding": cast(wte)},
+            "pos": {"embedding": cast(sd["wpe.weight"][: config.seq_len])},
+        },
+        "norm_final": norm("ln_f"),
+        # GPT-2 ties the lm_head to wte
+        "lm_head": {"shard": {"kernel": cast(wte.T)}},
+        "blocks": {},
+    }
+    for i in range(config.n_layers):
+        p = f"h.{i}"
+        params["blocks"][f"layer_{i}"] = {
+            "norm_attn": norm(f"{p}.ln_1"),
+            "norm_mlp": norm(f"{p}.ln_2"),
+            "attn": {
+                "qkv": {
+                    "shard": {
+                        "kernel": cast(
+                            _qkv_to_ours(sd[f"{p}.attn.c_attn.weight"], h)
+                        ),
+                        "bias": cast(_qkv_to_ours(sd[f"{p}.attn.c_attn.bias"], h)),
+                    }
+                },
+                "out": {
+                    "shard": {"kernel": cast(sd[f"{p}.attn.c_proj.weight"])},
+                    "bias": cast(sd[f"{p}.attn.c_proj.bias"]),
+                },
+            },
+            "mlp": {
+                "up": {
+                    "shard": {
+                        "kernel": cast(sd[f"{p}.mlp.c_fc.weight"]),
+                        "bias": cast(sd[f"{p}.mlp.c_fc.bias"]),
+                    }
+                },
+                "down": {
+                    "shard": {"kernel": cast(sd[f"{p}.mlp.c_proj.weight"])},
+                    "bias": cast(sd[f"{p}.mlp.c_proj.bias"]),
+                },
+            },
+        }
+    return params
+
+
+def to_hf_gpt2(params: Pytree, config) -> Dict[str, np.ndarray]:
+    """This framework's (unrolled, mesh-free) params -> an HF GPT-2 state
+    dict (``transformer.``-prefixed keys plus ``lm_head.weight``) loadable
+    with ``GPT2LMHeadModel.load_state_dict``."""
+    h = config.n_heads
+    g = lambda *path: np.asarray(_dig(params, path), np.float32)
+    wte = g("embed", "tok", "embedding")
+    head = g("lm_head", "shard", "kernel").T
+    if not np.allclose(wte, head, atol=1e-6):
+        raise ValueError(
+            "lm_head and wte have drifted apart (untied fine-tune?) — "
+            "GPT-2's format ties them; refusing to drop one silently"
+        )
+    sd: Dict[str, np.ndarray] = {
+        "transformer.wte.weight": wte,
+        "transformer.wpe.weight": g("embed", "pos", "embedding"),
+        "transformer.ln_f.weight": g("norm_final", "scale"),
+        "transformer.ln_f.bias": g("norm_final", "bias"),
+        "lm_head.weight": wte,
+    }
+    for i in range(config.n_layers):
+        b = ("blocks", f"layer_{i}")
+        p = f"transformer.h.{i}"
+        sd[f"{p}.ln_1.weight"] = g(*b, "norm_attn", "scale")
+        sd[f"{p}.ln_1.bias"] = g(*b, "norm_attn", "bias")
+        sd[f"{p}.ln_2.weight"] = g(*b, "norm_mlp", "scale")
+        sd[f"{p}.ln_2.bias"] = g(*b, "norm_mlp", "bias")
+        sd[f"{p}.attn.c_attn.weight"] = _qkv_to_hf(
+            g(*b, "attn", "qkv", "shard", "kernel"), h
+        )
+        sd[f"{p}.attn.c_attn.bias"] = _qkv_to_hf(
+            g(*b, "attn", "qkv", "shard", "bias"), h
+        )
+        sd[f"{p}.attn.c_proj.weight"] = g(*b, "attn", "out", "shard", "kernel")
+        sd[f"{p}.attn.c_proj.bias"] = g(*b, "attn", "out", "bias")
+        sd[f"{p}.mlp.c_fc.weight"] = g(*b, "mlp", "up", "shard", "kernel")
+        sd[f"{p}.mlp.c_fc.bias"] = g(*b, "mlp", "up", "shard", "bias")
+        sd[f"{p}.mlp.c_proj.weight"] = g(*b, "mlp", "down", "shard", "kernel")
+        sd[f"{p}.mlp.c_proj.bias"] = g(*b, "mlp", "down", "bias")
+    return sd
+
+
+def _dig(tree, path):
+    for k in path:
+        tree = tree[k]
+    return tree

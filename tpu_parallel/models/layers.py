@@ -49,6 +49,9 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     # norm: "layernorm" (GPT-2) or "rmsnorm" (Llama)
     norm: str = "layernorm"
+    # canonical GPT-2/Llama epsilon (flax's default is 1e-6; 1e-5 matches
+    # the reference implementations bit-for-bit — models/hf.py interop)
+    norm_eps: float = 1e-5
     # mlp: "gelu" (GPT-2) or "swiglu" (Llama)
     mlp: str = "gelu"
     # parallelism
@@ -106,8 +109,8 @@ def seq_parallel_active(config: TransformerConfig) -> bool:
 def make_norm(config: TransformerConfig, name: str):
     """fp32 norm (LayerNorm or RMSNorm) — small, precision-critical."""
     if config.norm == "rmsnorm":
-        return nn.RMSNorm(dtype=jnp.float32, name=name)
-    return nn.LayerNorm(dtype=jnp.float32, name=name)
+        return nn.RMSNorm(epsilon=config.norm_eps, dtype=jnp.float32, name=name)
+    return nn.LayerNorm(epsilon=config.norm_eps, dtype=jnp.float32, name=name)
 
 
 def apply_rope(

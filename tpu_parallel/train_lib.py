@@ -417,12 +417,30 @@ class Trainer:
                 if step % checkpoint_every == 0 or step == steps:
                     ckpt.save(step, self.state, wait=False)
                 if eval_every and (step % eval_every == 0 or step == steps):
-                    ev = self.evaluate(batch_iter=eval_iter_fn(), steps=eval_steps)
+                    try:
+                        ev = self.evaluate(
+                            batch_iter=eval_iter_fn(), steps=eval_steps
+                        )
+                    except Exception as exc:  # noqa: BLE001 — same contract as the step
+                        from tpu_parallel.utils.logging_utils import print_exception
+
+                        print_exception(exc)
+                        failures += 1
+                        if failures > max_failures or ckpt.latest_step is None:
+                            raise
+                        restore_latest()
+                        metrics = None
+                        step = int(self.state.step)
+                        continue
                     if log_fn is not None:
                         log_fn(step, {f"eval_{k}": v for k, v in ev.items()})
                     if best_ckpt is not None and ev["loss"] < best_loss:
                         best_loss = ev["loss"]
-                        best_ckpt.save(step, self.state, wait=False)
+                        # wait=True: the loss marker below must never
+                        # outlive its snapshot (a crash between an async
+                        # save and the marker would block every later,
+                        # worse-but-real best save after resume)
+                        best_ckpt.save(step, self.state, wait=True)
                         with open(best_loss_path, "w") as fh:
                             _json.dump({"loss": best_loss, "step": step}, fh)
                 if step % self.config.log_every == 0 or step == steps:
