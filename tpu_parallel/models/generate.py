@@ -254,3 +254,128 @@ def _sharded_generate_fn(
             check_vma=False,
         )
     )
+
+
+# --- beam search --------------------------------------------------------------
+
+
+@functools.partial(
+    jax.jit, static_argnums=(0,),
+    static_argnames=("max_new_tokens", "num_beams", "length_penalty"),
+)
+def generate_beam(
+    model: GPTLM,
+    params,
+    prompt: jax.Array,
+    *,
+    max_new_tokens: int = 32,
+    num_beams: int = 4,
+    length_penalty: float = 0.0,
+) -> Tuple[jax.Array, jax.Array]:
+    """Beam-search decoding: the highest-scoring continuation per prompt row.
+
+    Returns ``(tokens [batch, max_new_tokens], scores [batch])`` where
+    ``scores`` is the winning beam's total log-probability divided by
+    ``len**length_penalty`` (0 = pure log-prob, 1 = per-token mean).
+
+    Beams ride as extra batch rows through the same prefill + decode scan
+    as :func:`generate`; each step takes the top ``num_beams`` of the
+    ``num_beams * vocab`` joint continuations per prompt and reorders the
+    KV cache rows to follow their originating beams (a batched gather over
+    the cache pytree).  No early-termination/EOS handling — fixed-length
+    decoding, the same contract as :func:`generate`.
+    """
+    cfg = model.config
+    b, prompt_len = prompt.shape
+    if prompt_len + max_new_tokens > cfg.seq_len:
+        raise ValueError(
+            f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
+            f"exceeds seq_len ({cfg.seq_len})"
+        )
+    k = num_beams
+    vocab = cfg.vocab_size
+
+    # prefill ONCE per prompt row, then replicate the cache k ways (beam j
+    # of prompt i is row i*k + j) — beams are identical until the first
+    # expansion, so prefilling b*k rows would waste (k-1)/k of the FLOPs
+    positions = jnp.broadcast_to(jnp.arange(prompt_len), (b, prompt_len))
+    logits, variables = model.apply(
+        {"params": params},
+        prompt,
+        positions=positions,
+        train=False,
+        decode=True,
+        mutable=["cache"],
+    )
+
+    def expand(path, x):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name.startswith(("cached_key", "cached_value")):
+            return jnp.repeat(x, k, axis=x.ndim - 4)
+        return x
+
+    cache0 = jax.tree_util.tree_map_with_path(expand, variables["cache"])
+    first_logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))  # [b, V]
+    scores, first = jax.lax.top_k(first_logp, k)  # [b, k] each
+    tok = first.reshape(b * k).astype(jnp.int32)
+
+    def step(carry, _):
+        cache, tok, scores, pos = carry
+        logits, updated = model.apply(
+            {"params": params, "cache": cache},
+            tok[:, None],
+            positions=jnp.full((b * k, 1), pos, jnp.int32),
+            train=False,
+            decode=True,
+            mutable=["cache"],
+        )
+        logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))
+        # joint scores over (beam, next-token) per prompt row
+        joint = scores[:, :, None] + logp.reshape(b, k, vocab)  # [b, k, V]
+        new_scores, flat_idx = jax.lax.top_k(joint.reshape(b, k * vocab), k)
+        src_beam = flat_idx // vocab  # [b, k] originating beam per winner
+        next_tok = (flat_idx % vocab).astype(jnp.int32)
+        # reorder cache rows + emit bookkeeping to follow winning beams.
+        # K/V payloads (and their int8 scales) are [..., rows, S, kv, dh]-
+        # shaped with the batch axis at ndim-4 — a leading layer axis when
+        # the model scans its layers; the cache_index counter carries no
+        # batch dim and passes through.
+        row_idx = (src_beam + jnp.arange(b)[:, None] * k).reshape(b * k)
+
+        def reorder(path, x):
+            name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+            if name.startswith(("cached_key", "cached_value")):
+                return jnp.take(x, row_idx, axis=x.ndim - 4)
+            return x
+
+        cache = jax.tree_util.tree_map_with_path(reorder, updated["cache"])
+        return (
+            (cache, next_tok.reshape(b * k), new_scores, pos + 1),
+            (next_tok, src_beam),
+        )
+
+    init = (cache0, tok, scores, jnp.int32(prompt_len))
+    (cache, tok, scores, _), (toks, src_beams) = lax.scan(
+        step, init, None, length=max_new_tokens - 1
+    )
+
+    # backtrack: follow each final beam to its token at every step.
+    # toks/src_beams: [T-1, b, k]; the first token table is `first` [b, k].
+    def backtrack(carry, xs):
+        beam = carry  # [b] current beam index per row
+        step_toks, step_src = xs  # [b, k] each
+        tok_here = jnp.take_along_axis(step_toks, beam[:, None], axis=1)[:, 0]
+        beam = jnp.take_along_axis(step_src, beam[:, None], axis=1)[:, 0]
+        return beam, tok_here
+
+    best = jnp.argmax(scores, axis=-1)  # [b] winning beam at the end
+    beam0, rev_toks = lax.scan(
+        backtrack, best, (toks[::-1], src_beams[::-1])
+    )
+    first_tok = jnp.take_along_axis(first, beam0[:, None], axis=1)[:, 0]
+    out = jnp.concatenate([first_tok[:, None], rev_toks[::-1].T], axis=1)
+    best_scores = jnp.max(scores, axis=-1)
+    if length_penalty:
+        total_len = jnp.float32(max_new_tokens)
+        best_scores = best_scores / (total_len**length_penalty)
+    return out.astype(jnp.int32), best_scores

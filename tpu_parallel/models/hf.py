@@ -98,6 +98,17 @@ def from_hf_gpt2(hf_model_or_dict, config, dtype=jnp.float32) -> Pytree:
             "from_hf_gpt2 emits the unrolled layout; build the config with "
             "scan_layers=False (stack leaves yourself for a scanned model)"
         )
+    hf_config = getattr(hf_model_or_dict, "config", None)
+    if hf_config is not None and getattr(hf_config, "n_head", None) not in (
+        None,
+        config.n_heads,
+    ):
+        # n_heads is NOT derivable from any tensor shape — a mismatch would
+        # silently permute QKV into garbage
+        raise ValueError(
+            f"checkpoint has n_head={hf_config.n_head}, config.n_heads="
+            f"{config.n_heads}"
+        )
     sd = _state_dict(hf_model_or_dict)
     ckpt_layers = 1 + max(
         int(k.split(".")[1]) for k in sd if k.startswith("h.")

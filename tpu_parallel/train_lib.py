@@ -389,6 +389,23 @@ class Trainer:
             metrics = None
             last: Dict[str, float] = {}
             step = int(self.state.step)
+
+            def rollback_or_reraise(exc):
+                """Shared failure protocol for train and eval steps: log,
+                count, roll back to the last checkpoint (re-raising when the
+                budget is spent or nothing was ever saved).  Returns the
+                step to continue from."""
+                nonlocal failures, metrics
+                from tpu_parallel.utils.logging_utils import print_exception
+
+                print_exception(exc)
+                failures += 1
+                if failures > max_failures or ckpt.latest_step is None:
+                    raise exc
+                restore_latest()
+                metrics = None
+                return int(self.state.step)
+
             while step < steps:
                 if data_loader is not None:
                     batch = data_loader.batch_at(step)
@@ -402,15 +419,7 @@ class Trainer:
                     )
                     jax.block_until_ready(new_state)
                 except Exception as exc:  # noqa: BLE001 — device/transport failure
-                    from tpu_parallel.utils.logging_utils import print_exception
-
-                    print_exception(exc)
-                    failures += 1
-                    if failures > max_failures or ckpt.latest_step is None:
-                        raise
-                    restore_latest()
-                    metrics = None
-                    step = int(self.state.step)
+                    step = rollback_or_reraise(exc)
                     continue
                 self.state = new_state
                 step += 1
@@ -422,15 +431,7 @@ class Trainer:
                             batch_iter=eval_iter_fn(), steps=eval_steps
                         )
                     except Exception as exc:  # noqa: BLE001 — same contract as the step
-                        from tpu_parallel.utils.logging_utils import print_exception
-
-                        print_exception(exc)
-                        failures += 1
-                        if failures > max_failures or ckpt.latest_step is None:
-                            raise
-                        restore_latest()
-                        metrics = None
-                        step = int(self.state.step)
+                        step = rollback_or_reraise(exc)
                         continue
                     if log_fn is not None:
                         log_fn(step, {f"eval_{k}": v for k, v in ev.items()})
