@@ -9,7 +9,7 @@ from tpu_parallel.models.gpt import (
 )
 from tpu_parallel.models.layers import TransformerConfig
 from tpu_parallel.models.mlp import MLPClassifier, MLPConfig
-from tpu_parallel.models.hf import from_hf_gpt2, to_hf_gpt2
+from tpu_parallel.models.hf import from_hf_gpt2, from_hf_llama, to_hf_gpt2
 from tpu_parallel.models.quantize import (
     QuantizedTensor,
     dequantize_params,
@@ -19,6 +19,7 @@ from tpu_parallel.models.quantize import (
 
 __all__ = [
     "from_hf_gpt2",
+    "from_hf_llama",
     "to_hf_gpt2",
     "QuantizedTensor",
     "dequantize_params",

@@ -226,3 +226,161 @@ def _dig(tree, path):
     for k in path:
         tree = tree[k]
     return tree
+
+
+# --- Llama --------------------------------------------------------------------
+
+
+def _rope_perm(head_dim: int) -> np.ndarray:
+    """HF-Llama -> this framework's RoPE dimension order.
+
+    HF rotates pairs ``(j, j + dh/2)`` (rotate_half); this model rotates
+    interleaved pairs ``(2j, 2j+1)`` (models/layers.py apply_rope).  Both
+    use the same per-pair frequency ``theta^(-2j/dh)``, so permuting each
+    head's q/k output dims with ``perm[2j] = j, perm[2j+1] = j + dh/2``
+    makes the two rotations identical.  V and the output projection are
+    untouched (no rotation on that path).
+    """
+    half = head_dim // 2
+    perm = np.empty(head_dim, np.int64)
+    perm[0::2] = np.arange(half)
+    perm[1::2] = np.arange(half) + half
+    return perm
+
+
+def from_hf_llama(hf_model_or_dict, config, dtype=jnp.float32) -> Pytree:
+    """HF Llama weights -> this framework's (unrolled-layout) params.
+
+    Handles MHA (fused qkv) and GQA (separate q + fused kv) layouts, the
+    rotate_half -> interleaved RoPE permutation, RMSNorm scales, and the
+    bias-free SwiGLU MLP.  This model's attention projections carry bias
+    parameters Llama lacks; they import as zeros (numerically identical).
+    """
+    if (
+        config.positional != "rope"
+        or config.mlp != "swiglu"
+        or config.norm != "rmsnorm"
+    ):
+        raise ValueError(
+            "Llama interop needs positional='rope', mlp='swiglu', "
+            "norm='rmsnorm'"
+        )
+    if config.scan_layers:
+        raise ValueError("from_hf_llama emits the unrolled layout")
+    hf_config = getattr(hf_model_or_dict, "config", None)
+    if hf_config is not None:
+        if getattr(hf_config, "num_attention_heads", config.n_heads) != config.n_heads:
+            raise ValueError(
+                f"checkpoint heads {hf_config.num_attention_heads} != "
+                f"config.n_heads {config.n_heads}"
+            )
+        ckpt_kv = getattr(hf_config, "num_key_value_heads", None)
+        ours_kv = config.n_kv_heads or config.n_heads
+        if ckpt_kv is not None and ckpt_kv != ours_kv:
+            raise ValueError(
+                f"checkpoint kv heads {ckpt_kv} != config {ours_kv}"
+            )
+        ckpt_eps = getattr(hf_config, "rms_norm_eps", None)
+        if ckpt_eps is not None and abs(ckpt_eps - config.norm_eps) > 1e-12:
+            raise ValueError(
+                f"checkpoint rms_norm_eps={ckpt_eps} != config.norm_eps="
+                f"{config.norm_eps} — logits would drift by ~1e-3; set "
+                "norm_eps to match"
+            )
+        ckpt_theta = getattr(hf_config, "rope_theta", None)
+        if ckpt_theta is not None and abs(ckpt_theta - config.rope_theta) > 1e-6:
+            raise ValueError(
+                f"checkpoint rope_theta={ckpt_theta} != config "
+                f"{config.rope_theta}"
+            )
+    sd = _state_dict(hf_model_or_dict)
+    sd = {k.removeprefix("model."): v for k, v in sd.items()}
+    ckpt_layers = 1 + max(
+        int(k.split(".")[1]) for k in sd if k.startswith("layers.")
+    )
+    if ckpt_layers != config.n_layers:
+        raise ValueError(
+            f"checkpoint has {ckpt_layers} layers, config.n_layers="
+            f"{config.n_layers}"
+        )
+    wte = sd["embed_tokens.weight"]
+    if wte.shape != (config.vocab_size, config.d_model):
+        raise ValueError(
+            f"embed_tokens {wte.shape} != (vocab={config.vocab_size}, "
+            f"d={config.d_model})"
+        )
+    if "lm_head.weight" not in sd:
+        # tied-embedding checkpoints omit lm_head (it aliases embed_tokens)
+        sd["lm_head.weight"] = wte
+    d = config.d_model
+    h = config.n_heads
+    kv = config.n_kv_heads or config.n_heads
+    dh = config.head_dim
+    perm = _rope_perm(dh)
+    cast = lambda x: jnp.asarray(x, dtype)
+
+    def heads_T(w, n):  # HF [n*dh, D] -> ours [D, n, dh]
+        return w.T.reshape(d, n, dh)
+
+    params: Dict[str, Any] = {
+        "embed": {"tok": {"embedding": cast(sd["embed_tokens.weight"])}},
+        "norm_final": {"scale": cast(sd["norm.weight"])},
+        "lm_head": {"shard": {"kernel": cast(sd["lm_head.weight"].T)}},
+        "blocks": {},
+    }
+    for i in range(config.n_layers):
+        p = f"layers.{i}"
+        q = heads_T(sd[f"{p}.self_attn.q_proj.weight"], h)[:, :, perm]
+        k = heads_T(sd[f"{p}.self_attn.k_proj.weight"], kv)[:, :, perm]
+        v = heads_T(sd[f"{p}.self_attn.v_proj.weight"], kv)
+        if kv == h:
+            # MHA: fused qkv, per-head [q | k | v] blocks
+            qkv = np.concatenate([q, k, v], axis=-1).reshape(d, 3 * d)
+            attn = {
+                "qkv": {
+                    "shard": {
+                        "kernel": cast(qkv),
+                        "bias": jnp.zeros((3 * d,), dtype),
+                    }
+                }
+            }
+        else:
+            # GQA: separate q + fused per-kv-head [k | v]
+            kvw = np.concatenate([k, v], axis=-1).reshape(d, kv * 2 * dh)
+            attn = {
+                "q": {
+                    "shard": {
+                        "kernel": cast(q.reshape(d, h * dh)),
+                        "bias": jnp.zeros((h * dh,), dtype),
+                    }
+                },
+                "kv": {
+                    "shard": {
+                        "kernel": cast(kvw),
+                        "bias": jnp.zeros((kv * 2 * dh,), dtype),
+                    }
+                },
+            }
+        attn["out"] = {
+            "shard": {"kernel": cast(sd[f"{p}.self_attn.o_proj.weight"].T)},
+            "bias": jnp.zeros((d,), dtype),
+        }
+        params["blocks"][f"layer_{i}"] = {
+            "norm_attn": {"scale": cast(sd[f"{p}.input_layernorm.weight"])},
+            "norm_mlp": {
+                "scale": cast(sd[f"{p}.post_attention_layernorm.weight"])
+            },
+            "attn": attn,
+            "mlp": {
+                "gate": {
+                    "shard": {"kernel": cast(sd[f"{p}.mlp.gate_proj.weight"].T)}
+                },
+                "up": {
+                    "shard": {"kernel": cast(sd[f"{p}.mlp.up_proj.weight"].T)}
+                },
+                "down": {
+                    "shard": {"kernel": cast(sd[f"{p}.mlp.down_proj.weight"].T)}
+                },
+            },
+        }
+    return params
