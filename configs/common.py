@@ -23,6 +23,8 @@ def model_overrides(**kw) -> ConfigDict:
         scan_layers=True,
         dropout_rate=0.0,
         loss_chunk=0,
+        # MoE routing family (only meaningful with moe_experts > 0)
+        moe_router="topk",
         # model-shape knobs: placeholders (None = keep the model's default;
         # the Trainer drops None-valued overrides) so e.g.
         # --config.model_overrides.n_layers=2 works on any config

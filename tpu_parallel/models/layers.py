@@ -87,6 +87,12 @@ class TransformerConfig:
     # mixture-of-experts: 0 = dense MLP; >0 replaces every block's MLP with
     # routed experts, expert-parallel over the model axis
     moe_experts: int = 0
+    # routing family: "topk" (tokens choose experts; see moe_top_k) or
+    # "expert_choice" (experts choose their top-capacity tokens — perfectly
+    # balanced by construction, no aux loss; NOT causal: a token's routing
+    # depends on the whole batch, including later positions, so use for
+    # encoders/non-AR objectives or accept the leak knowingly)
+    moe_router: str = "topk"
     # experts per token: 1 = Switch (gate = router prob), >1 = GShard-style
     # (gates renormalized over the chosen experts)
     moe_top_k: int = 1

@@ -104,6 +104,15 @@ class MoEMLP(nn.Module):
             n_experts, use_bias=False, dtype=jnp.float32, name="router"
         )(xf.astype(jnp.float32))
         probs = jax.nn.softmax(logits, axis=-1)  # [T, E]
+
+        if cfg.moe_router == "expert_choice":
+            return self._expert_choice(
+                x, xf, probs, aux_scale, ep_size, local_experts, train
+            )
+        if cfg.moe_router != "topk":
+            raise ValueError(
+                f"moe_router={cfg.moe_router!r} (topk | expert_choice)"
+            )
         gate_vals, expert_idx = lax.top_k(probs, top_k)  # [T, k] each
         if top_k == 1:
             gates = gate_vals  # Switch: the raw router probability
@@ -156,8 +165,53 @@ class MoEMLP(nn.Module):
             count = count + jnp.sum(onehot, axis=0)
 
         # --- expert parallelism: slice my experts, partial-combine, psum ----
-        # Each rank materializes only its own experts' [E/ep, C] masks, so the
-        # dispatch/combine einsums and the expert FFNs all run at 1/ep cost.
+        return self._apply_experts(
+            x, xf, dispatch, combine, ep_size, local_experts, train
+        )
+
+    def _expert_choice(
+        self, x, xf, probs, aux_scale, ep_size, local_experts, train
+    ):
+        """Expert-choice routing: each expert takes its top-``capacity``
+        tokens by router probability (Zhou et al., 2022).  Every expert is
+        exactly full, so there is no balance loss to tune — a zero is still
+        sown to keep the losses collection shape stable for the pipeline's
+        bubble masking."""
+        cfg = self.config
+        n_experts = cfg.moe_experts
+        tokens = xf.shape[0]
+        capacity = max(1, int(cfg.moe_capacity_factor * tokens / n_experts + 0.999))
+        if capacity > tokens:
+            raise ValueError(
+                f"expert capacity {capacity} > {tokens} tokens — lower "
+                "moe_capacity_factor or use more tokens per batch"
+            )
+        # gates [E, C]: the chosen tokens' router probs; idx [E, C] token ids
+        gates, idx = lax.top_k(probs.T, capacity)
+        picked = jax.nn.one_hot(idx, tokens, dtype=jnp.float32)  # [E, C, T]
+        dispatch = picked.transpose(2, 0, 1)  # [T, E, C]
+        combine = (picked * gates[:, :, None]).transpose(2, 0, 1)
+
+        del aux_scale  # EC has no balance loss to gate; the sown zero keeps
+        # the losses collection shape stable for the pipeline bubble masking
+        self.sow(
+            "losses",
+            "moe_balance",
+            jnp.float32(0.0),
+            reduce_fn=lambda a, b_: a + b_,
+            init_fn=lambda: jnp.float32(0.0),
+        )
+        return self._apply_experts(
+            x, xf, dispatch, combine, ep_size, local_experts, train
+        )
+
+    def _apply_experts(
+        self, x, xf, dispatch, combine, ep_size, local_experts, train
+    ):
+        """Shared tail: slice my experts' masks, run the expert FFNs at
+        1/ep cost, partial-combine, close with one psum."""
+        cfg = self.config
+        b, s, d = x.shape
         if ep_size > 1:
             rank = lax.axis_index(cfg.model_axis)
             dispatch = lax.dynamic_slice_in_dim(
