@@ -17,6 +17,8 @@ def model_overrides(**kw) -> ConfigDict:
         attn_impl="xla",
         flash_block_q=512,
         flash_block_k=512,
+        # sliding-window attention (0 = full causal)
+        attn_window=0,
         # remat: "full" | "proj" | "proj_attn" | "dots" (remat=False to disable)
         remat=True,
         remat_policy="full",

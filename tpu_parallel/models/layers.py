@@ -80,6 +80,11 @@ class TransformerConfig:
     # (scripts/attn_microbench.py: 10.5ms vs 17.2ms fwd+bwd at 128x128)
     flash_block_q: int = 512
     flash_block_k: int = 512
+    # sliding-window attention: 0 = full causal; >0 = each query sees only
+    # the last `attn_window` positions (Mistral-style).  Applies to the xla
+    # and flash paths (whole out-of-window key blocks are skipped in-kernel)
+    # and to decode; ring/ulysses reject it for now.
+    attn_window: int = 0
     # decode KV-cache storage: "bf16" (= cfg.dtype) or "int8" — int8 halves
     # the cache HBM (the decode-memory hog) with one fp32 scale per
     # (position, kv-head); dequantization is a transient per layer per step
@@ -145,6 +150,7 @@ def causal_attention(
     v: jax.Array,
     *,
     segment_ids: Optional[jax.Array] = None,
+    window: int = 0,
 ) -> jax.Array:
     """Reference causal attention: fp32 softmax, bf16 matmuls on the MXU.
 
@@ -159,6 +165,9 @@ def causal_attention(
     q_pos = lax.broadcasted_iota(jnp.int32, scores.shape, 2)
     k_pos = lax.broadcasted_iota(jnp.int32, scores.shape, 3)
     mask = q_pos >= k_pos
+    if window:
+        # sliding window: query t attends keys in (t - window, t] only
+        mask = jnp.logical_and(mask, q_pos - k_pos < window)
     if segment_ids is not None:
         same_seg = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
         mask = jnp.logical_and(mask, same_seg)
@@ -168,7 +177,8 @@ def causal_attention(
 
 
 def decode_attention(
-    q: jax.Array, k_all: jax.Array, v_all: jax.Array, positions: jax.Array
+    q: jax.Array, k_all: jax.Array, v_all: jax.Array, positions: jax.Array,
+    window: int = 0,
 ) -> jax.Array:
     """Attention of new queries against a full KV cache.
 
@@ -182,6 +192,11 @@ def decode_attention(
     scores = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k_all).astype(jnp.float32)
     k_pos = jnp.arange(k_all.shape[1])
     mask = k_pos[None, None, None, :] <= positions[:, None, :, None]
+    if window:
+        mask = jnp.logical_and(
+            mask,
+            positions[:, None, :, None] - k_pos[None, None, None, :] < window,
+        )
     scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v_all)
@@ -358,7 +373,7 @@ class Attention(nn.Module):
             if group != 1:
                 k_all = jnp.repeat(k_all, group, axis=2)
                 v_all = jnp.repeat(v_all, group, axis=2)
-            out = decode_attention(q, k_all, v_all, positions)
+            out = decode_attention(q, k_all, v_all, positions, window=cfg.attn_window)
         else:
             if group != 1:
                 # expand K/V groups to one head each; XLA fuses the broadcast
@@ -397,12 +412,18 @@ class Attention(nn.Module):
                     flash_attention,
                     block_q=cfg.flash_block_q,
                     block_k=cfg.flash_block_k,
+                    window=cfg.attn_window,
                 )
             elif cfg.attn_impl == "ring":
                 from tpu_parallel.ops.ring_attention import (
                     ring_attention,
                     ring_flash_attention,
                 )
+
+                if cfg.attn_window:
+                    raise NotImplementedError(
+                        "sliding-window attention under ring SP"
+                    )
 
                 if segment_ids is not None:
                     raise NotImplementedError(
@@ -429,6 +450,11 @@ class Attention(nn.Module):
                 from tpu_parallel.ops.flash_attention import flash_attention
                 from tpu_parallel.ops.ulysses import ulysses_attention
 
+                if cfg.attn_window:
+                    raise NotImplementedError(
+                        "sliding-window attention under ulysses SP"
+                    )
+
                 if segment_ids is not None:
                     raise NotImplementedError(
                         "ulysses attention does not support packed sequences yet"
@@ -445,7 +471,9 @@ class Attention(nn.Module):
                     )
 
             else:
-                attn_fn = causal_attention
+                attn_fn = functools.partial(
+                    causal_attention, window=cfg.attn_window
+                )
         return attn_fn(q, k, v, segment_ids=segment_ids)
 
 
@@ -501,6 +529,14 @@ class Block(nn.Module):
         aux_scale: Optional[jax.Array] = None,
     ) -> jax.Array:
         cfg = self.config
+        if decode and cfg.moe_experts > 0 and cfg.moe_router == "expert_choice":
+            # EC routes over the whole token pool; a single-token decode
+            # step degenerates to a dense all-expert mixture that resembles
+            # nothing the model trained on — refuse loudly
+            raise NotImplementedError(
+                "incremental decoding with expert-choice routing "
+                "(the routing pool collapses to one token per row)"
+            )
         h = make_norm(cfg, "norm_attn")(x).astype(cfg.dtype)
         x = x + Attention(cfg, name="attn")(
             h,

@@ -73,12 +73,37 @@ def _sds(shape, dtype, like):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
+
+def _window_first_k_block(qi, block_q: int, block_k: int, window: int):
+    """First key block that can intersect the sliding window of query block
+    ``qi`` (tracer-safe: ``qi`` is a pallas program_id)."""
+    return jnp.maximum(0, qi * block_q - window + 1) // block_k
+
+
+def _band_mask(qi, ki, shape, block_q: int, block_k: int, causal: bool, window: int):
+    """Causal and/or sliding-window mask for one [block_q, block_k] score
+    tile, or None when neither applies — the ONE definition all three
+    kernels (fwd, dq, dkv) share, so forward and backward can never
+    desynchronize on the band geometry."""
+    if not (causal or window):
+        return None
+    q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, shape, 0)
+    k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, shape, 1)
+    mask = None
+    if causal:
+        mask = q_pos >= k_pos
+    if window:
+        near = q_pos - k_pos < window
+        mask = near if mask is None else jnp.logical_and(mask, near)
+    return mask
+
+
 # --- forward kernel -----------------------------------------------------------
 
 
 def _fwd_kernel(
     q_ref, k_ref, v_ref, *rest, block_q, block_k, scale, has_segments,
-    causal=True,
+    causal=True, window=0,
 ):
     if has_segments:
         seg_ref, o_ref, lse_ref = rest
@@ -96,17 +121,16 @@ def _fwd_kernel(
     else:
         # full (non-causal) mode: ring attention's fully-visible K/V chunks
         num_k_blocks = k_ref.shape[1] // block_k
+    first_k_block = (
+        _window_first_k_block(qi, block_q, block_k, window) if window else 0
+    )
 
     def body(ki, carry):
         acc, m_prev, l_prev = carry
         k = k_ref[0, pl.ds(ki * block_k, block_k), :]
         v = v_ref[0, pl.ds(ki * block_k, block_k), :]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
-        mask = None
-        if causal:
-            q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            mask = q_pos >= k_pos
+        mask = _band_mask(qi, ki, s.shape, block_q, block_k, causal, window)
         if has_segments:
             seg_k = seg_ref[0, pl.ds(ki * block_k, block_k), :]  # [bk, 1]
             same = seg_q == seg_k.T
@@ -126,7 +150,7 @@ def _fwd_kernel(
     acc = jnp.zeros((block_q, d), jnp.float32)
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc, m, l = lax.fori_loop(0, num_k_blocks, body, (acc, m0, l0))
+    acc, m, l = lax.fori_loop(first_k_block, num_k_blocks, body, (acc, m0, l0))
     o_ref[0] = (acc / l).astype(o_ref.dtype)
     # log-sum-exp per query row, needed by the backward pass.  Kept as a
     # trailing length-1 lane dim: TPU blocks need the last two dims to be
@@ -145,6 +169,7 @@ def _flash_fwd(
     block_k: int,
     interpret: bool,
     causal: bool = True,
+    window: int = 0,
 ) -> Tuple[jax.Array, jax.Array]:
     b, h, s, d = q.shape
     s_kv = k.shape[2]
@@ -174,6 +199,7 @@ def _flash_fwd(
             scale=scale,
             has_segments=seg is not None,
             causal=causal,
+            window=window,
         ),
         grid=grid,
         in_specs=in_specs,
@@ -195,7 +221,7 @@ def _flash_fwd(
 
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-    block_q, block_k, scale, has_segments, causal=True,
+    block_q, block_k, scale, has_segments, causal=True, window=0,
 ):
     if has_segments:
         seg_ref, dq_ref = rest
@@ -212,16 +238,15 @@ def _bwd_dq_kernel(
         num_k_blocks = (qi + 1) * block_q // block_k
     else:
         num_k_blocks = k_ref.shape[1] // block_k
+    first_k_block = (
+        _window_first_k_block(qi, block_q, block_k, window) if window else 0
+    )
 
     def body(ki, dq):
         k = k_ref[0, pl.ds(ki * block_k, block_k), :]
         v = v_ref[0, pl.ds(ki * block_k, block_k), :]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
-        mask = None
-        if causal:
-            q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            mask = q_pos >= k_pos
+        mask = _band_mask(qi, ki, s.shape, block_q, block_k, causal, window)
         if has_segments:
             seg_k = seg_ref[0, pl.ds(ki * block_k, block_k), :]
             same = seg_q == seg_k.T
@@ -234,13 +259,15 @@ def _bwd_dq_kernel(
         return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
 
     d = q_ref.shape[-1]
-    dq = lax.fori_loop(0, num_k_blocks, body, jnp.zeros((block_q, d), jnp.float32))
+    dq = lax.fori_loop(
+        first_k_block, num_k_blocks, body, jnp.zeros((block_q, d), jnp.float32)
+    )
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-    block_q, block_k, scale, seq_len, has_segments, causal=True,
+    block_q, block_k, scale, seq_len, has_segments, causal=True, window=0,
 ):
     if has_segments:
         seg_ref, dk_ref, dv_ref = rest
@@ -254,6 +281,13 @@ def _bwd_dkv_kernel(
     num_q_blocks = seq_len // block_q
     # causal: q blocks >= the diagonal only; full mode: every q block
     first_q_block = ki * block_k // block_q if causal else 0
+    if window:
+        # queries beyond (k_block_end + window - 1) see none of this block
+        # (ki is traced: jnp.minimum, and -(-x // y) is a tracer-safe ceil)
+        num_q_blocks = jnp.minimum(
+            num_q_blocks,
+            -(-((ki + 1) * block_k + window - 1) // block_q),
+        )
 
     def body(qi, carry):
         dk, dv = carry
@@ -265,11 +299,7 @@ def _bwd_dkv_kernel(
         lse = lse_ref[0, pl.ds(qi * block_q, block_q), :]
         delta = delta_ref[0, pl.ds(qi * block_q, block_q), :]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
-        mask = None
-        if causal:
-            q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            mask = q_pos >= k_pos
+        mask = _band_mask(qi, ki, s.shape, block_q, block_k, causal, window)
         if has_segments:
             seg_q = seg_ref[0, pl.ds(qi * block_q, block_q), :]
             same = seg_q == seg_k.T
@@ -295,7 +325,7 @@ def _bwd_dkv_kernel(
 
 def _flash_bwd(
     q, k, v, seg, out, lse, do, *, block_q, block_k, interpret,
-    causal=True, dlse=None,
+    causal=True, window=0, dlse=None,
 ):
     b, h, s, d = q.shape
     s_kv = k.shape[2]
@@ -336,6 +366,7 @@ def _flash_bwd(
             scale=scale,
             has_segments=has_segments,
             causal=causal,
+            window=window,
         ),
         grid=(bh, s // block_q),
         in_specs=in_specs,
@@ -367,6 +398,7 @@ def _flash_bwd(
             seq_len=s,
             has_segments=has_segments,
             causal=causal,
+            window=window,
         ),
         grid=(bh, s_kv // block_k),
         in_specs=in_specs,
@@ -391,8 +423,8 @@ def _flash_bwd(
 # --- public API with custom VJP ----------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8))
-def _flash_finalize(q, k, v, seg, out, lse, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9))
+def _flash_finalize(q, k, v, seg, out, lse, block_q, block_k, interpret, window):
     """Identity on ``out``; exists to attach the backward kernels.
 
     The forward kernel runs *outside* this custom_vjp (see
@@ -407,15 +439,15 @@ def _flash_finalize(q, k, v, seg, out, lse, block_q, block_k, interpret):
     return out
 
 
-def _finalize_fwd(q, k, v, seg, out, lse, block_q, block_k, interpret):
+def _finalize_fwd(q, k, v, seg, out, lse, block_q, block_k, interpret, window):
     return out, (q, k, v, seg, out, lse)
 
 
-def _finalize_bwd(block_q, block_k, interpret, residuals, do):
+def _finalize_bwd(block_q, block_k, interpret, window, residuals, do):
     q, k, v, seg, out, lse = residuals
     dq, dk, dv = _flash_bwd(
         q, k, v, seg, out, lse, do,
-        block_q=block_q, block_k=block_k, interpret=interpret,
+        block_q=block_q, block_k=block_k, interpret=interpret, window=window,
     )
     # seg (int) carries no gradient; out/lse arrive behind stop_gradient, so
     # their zero cotangents are discarded by the caller
@@ -425,7 +457,7 @@ def _finalize_bwd(block_q, block_k, interpret, residuals, do):
 _flash_finalize.defvjp(_finalize_fwd, _finalize_bwd)
 
 
-def _flash_attention_bhsd(q, k, v, seg, block_q, block_k, interpret):
+def _flash_attention_bhsd(q, k, v, seg, block_q, block_k, interpret, window=0):
     from jax.ad_checkpoint import checkpoint_name
 
     # stop_gradient on the *inputs*: the forward kernel then sees all-zero
@@ -440,10 +472,13 @@ def _flash_attention_bhsd(q, k, v, seg, block_q, block_k, interpret):
         block_q=block_q,
         block_k=block_k,
         interpret=interpret,
+        window=window,
     )
     out = checkpoint_name(out, "attn")
     lse = checkpoint_name(lse, "attn")
-    return _flash_finalize(q, k, v, seg, out, lse, block_q, block_k, interpret)
+    return _flash_finalize(
+        q, k, v, seg, out, lse, block_q, block_k, interpret, window
+    )
 
 
 # --- chunk attention for ring/sequence parallelism ---------------------------
@@ -534,9 +569,14 @@ def flash_attention(
     segment_ids: Optional[jax.Array] = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
+    window: int = 0,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Causal flash attention on [batch, seq, heads, head_dim] inputs.
+
+    ``window > 0`` adds sliding-window masking: query t sees keys in
+    (t - window, t] only, and whole key blocks outside the window are
+    skipped, not masked — O(seq * window) compute at long sequence.
 
     Drop-in replacement for
     :func:`tpu_parallel.models.layers.causal_attention` (the ``attn_fn``
@@ -559,12 +599,14 @@ def flash_attention(
         )
         from tpu_parallel.models.layers import causal_attention
 
-        return causal_attention(q, k, v, segment_ids=segment_ids)
+        return causal_attention(q, k, v, segment_ids=segment_ids, window=window)
     seg = None
     if segment_ids is not None:
         # one int32 lane per batch row ([B, S, 1]); the kernels' BlockSpec
         # index maps route all H heads of row b to the same block
         seg = segment_ids.astype(jnp.int32)[:, :, None]
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
-    out = _flash_attention_bhsd(qt, kt, vt, seg, block_q, block_k, interpret)
+    out = _flash_attention_bhsd(
+        qt, kt, vt, seg, block_q, block_k, interpret, window
+    )
     return out.transpose(0, 2, 1, 3)
