@@ -30,7 +30,7 @@ def run_one(batch, remat, attn_variant, steps=12):
     if attn_variant == "flash":
         attn_impl = "flash"
     elif attn_variant == "none":
-        layers.causal_attention = lambda q, k, v, segment_ids=None: v
+        layers.causal_attention = lambda q, k, v, segment_ids=None, window=0: v
     elif attn_variant != "xla":
         raise ValueError(f"unknown attention variant: {attn_variant!r}")
 

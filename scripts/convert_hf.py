@@ -1,0 +1,137 @@
+"""Convert a local HuggingFace checkpoint into this framework's formats.
+
+Reads a ``save_pretrained`` directory (GPT-2 or Llama family, auto-detected
+from its config.json), converts the weights with
+:mod:`tpu_parallel.models.hf`, and writes either
+
+- ``--format orbax`` (default): an orbax checkpoint of the params that
+  ``Checkpointer.restore`` / ``generate`` consume, or
+- ``--format int8``: the :func:`quantize_params` int8 export artifact
+  (``numpy .npz``; ~4x smaller than fp32) that
+  :func:`dequantize_params` restores.
+
+Usage:
+    python scripts/convert_hf.py /path/to/hf_model /path/to/out \
+        [--format orbax|int8] [--seq-len N]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_config(hf_dir: str, seq_len):
+    with open(os.path.join(hf_dir, "config.json")) as fh:
+        hc = json.load(fh)
+    model_type = hc.get("model_type")
+    from tpu_parallel.models import tiny_test
+
+    if model_type == "gpt2":
+        return (
+            tiny_test(
+                vocab_size=hc["vocab_size"],
+                d_model=hc["n_embd"],
+                n_layers=hc["n_layer"],
+                n_heads=hc["n_head"],
+                seq_len=seq_len or hc["n_positions"],
+                positional="learned",
+                norm="layernorm",
+                mlp="gelu",
+                norm_eps=hc.get("layer_norm_epsilon", 1e-5),
+                scan_layers=False,  # converters emit the unrolled layout
+                remat=False,
+                dropout_rate=0.0,
+            ),
+            "gpt2",
+        )
+    if model_type == "llama":
+        if hc["intermediate_size"] % hc["hidden_size"]:
+            raise SystemExit(
+                f"intermediate_size={hc['intermediate_size']} is not a "
+                f"multiple of hidden_size={hc['hidden_size']} — "
+                "TransformerConfig.mlp_ratio is an integer, so this "
+                "checkpoint's MLP width cannot be represented"
+            )
+        return (
+            tiny_test(
+                vocab_size=hc["vocab_size"],
+                d_model=hc["hidden_size"],
+                n_layers=hc["num_hidden_layers"],
+                n_heads=hc["num_attention_heads"],
+                n_kv_heads=(
+                    None
+                    if hc.get("num_key_value_heads", hc["num_attention_heads"])
+                    == hc["num_attention_heads"]
+                    else hc["num_key_value_heads"]
+                ),
+                mlp_ratio=hc["intermediate_size"] // hc["hidden_size"],
+                seq_len=seq_len or hc["max_position_embeddings"],
+                positional="rope",
+                norm="rmsnorm",
+                mlp="swiglu",
+                norm_eps=hc.get("rms_norm_eps", 1e-5),
+                rope_theta=hc.get("rope_theta", 10000.0),
+                scan_layers=False,  # converters emit the unrolled layout
+                remat=False,
+                dropout_rate=0.0,
+            ),
+            "llama",
+        )
+    raise SystemExit(f"unsupported model_type {model_type!r} (gpt2 | llama)")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("hf_dir", help="local save_pretrained directory")
+    ap.add_argument("out_dir", help="output directory")
+    ap.add_argument("--format", choices=("orbax", "int8"), default="orbax")
+    ap.add_argument("--seq-len", type=int, default=0, help="override seq_len")
+    args = ap.parse_args()
+
+    config, family = build_config(args.hf_dir, args.seq_len)
+
+    import transformers
+
+    from tpu_parallel.models.hf import from_hf_gpt2, from_hf_llama
+
+    if family == "gpt2":
+        hf = transformers.GPT2LMHeadModel.from_pretrained(args.hf_dir)
+        params = from_hf_gpt2(hf, config)
+    else:
+        hf = transformers.LlamaForCausalLM.from_pretrained(args.hf_dir)
+        params = from_hf_llama(hf, config)
+    n_params = sum(x.size for x in __import__("jax").tree_util.tree_leaves(params))
+    print(f"{family}: {n_params / 1e6:.1f}M params converted")
+
+    if args.format == "orbax":
+        import orbax.checkpoint as ocp
+
+        with ocp.PyTreeCheckpointer() as ck:
+            ck.save(os.path.abspath(args.out_dir), params)
+        print(f"orbax checkpoint written to {args.out_dir}")
+    else:
+        import jax
+        import numpy as np
+
+        from tpu_parallel.models import quantize_params, quantized_nbytes
+
+        q = quantize_params(params)
+        flat = {
+            "/".join(str(getattr(k, "key", k)) for k in path): np.asarray(leaf)
+            for path, leaf in jax.tree_util.tree_leaves_with_path(q)
+        }
+        os.makedirs(args.out_dir, exist_ok=True)
+        out = os.path.join(args.out_dir, "params_int8.npz")
+        np.savez(out, **flat)
+        print(
+            f"int8 artifact written to {out} "
+            f"({quantized_nbytes(q) / 1e6:.1f} MB vs "
+            f"{quantized_nbytes(params) / 1e6:.1f} MB dense)"
+        )
+
+
+if __name__ == "__main__":
+    main()
