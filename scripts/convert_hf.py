@@ -4,11 +4,13 @@ Reads a ``save_pretrained`` directory (GPT-2 or Llama family, auto-detected
 from its config.json), converts the weights with
 :mod:`tpu_parallel.models.hf`, and writes either
 
-- ``--format orbax`` (default): an orbax checkpoint of the params that
-  ``Checkpointer.restore`` / ``generate`` consume, or
-- ``--format int8``: the :func:`quantize_params` int8 export artifact
-  (``numpy .npz``; ~4x smaller than fp32) that
-  :func:`dequantize_params` restores.
+- ``--format orbax`` (default): a bare-params orbax checkpoint — restore
+  with ``ocp.PyTreeCheckpointer().restore(out_dir)`` and pass to
+  :func:`~tpu_parallel.models.generate.generate` (this is NOT a
+  ``Checkpointer``/TrainState run directory), or
+- ``--format int8``: the :func:`quantize_params` int8 export artifact,
+  reloaded with :func:`tpu_parallel.models.quantize.load_int8_npz` +
+  :func:`dequantize_params` (~4x smaller than fp32).
 
 Usage:
     python scripts/convert_hf.py /path/to/hf_model /path/to/out \
@@ -30,6 +32,14 @@ def build_config(hf_dir: str, seq_len):
     from tpu_parallel.models import tiny_test
 
     if model_type == "gpt2":
+        n_inner = hc.get("n_inner") or 4 * hc["n_embd"]
+        if n_inner != 4 * hc["n_embd"]:
+            raise SystemExit(
+                f"n_inner={n_inner} != 4*n_embd={4 * hc['n_embd']} — "
+                "TransformerConfig.mlp_ratio is an integer multiple of "
+                "d_model, so this checkpoint's MLP width cannot be "
+                "represented"
+            )
         return (
             tiny_test(
                 vocab_size=hc["vocab_size"],
@@ -48,6 +58,12 @@ def build_config(hf_dir: str, seq_len):
             "gpt2",
         )
     if model_type == "llama":
+        if hc.get("rope_scaling"):
+            raise SystemExit(
+                f"rope_scaling={hc['rope_scaling']} is not supported — the "
+                "framework implements plain RoPE (rope_theta only); "
+                "converting would produce silently wrong positions"
+            )
         if hc["intermediate_size"] % hc["hidden_size"]:
             raise SystemExit(
                 f"intermediate_size={hc['intermediate_size']} is not a "
@@ -97,13 +113,15 @@ def main():
 
     from tpu_parallel.models.hf import from_hf_gpt2, from_hf_llama
 
+    import jax
+
     if family == "gpt2":
         hf = transformers.GPT2LMHeadModel.from_pretrained(args.hf_dir)
         params = from_hf_gpt2(hf, config)
     else:
         hf = transformers.LlamaForCausalLM.from_pretrained(args.hf_dir)
         params = from_hf_llama(hf, config)
-    n_params = sum(x.size for x in __import__("jax").tree_util.tree_leaves(params))
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
     print(f"{family}: {n_params / 1e6:.1f}M params converted")
 
     if args.format == "orbax":
@@ -111,21 +129,18 @@ def main():
 
         with ocp.PyTreeCheckpointer() as ck:
             ck.save(os.path.abspath(args.out_dir), params)
-        print(f"orbax checkpoint written to {args.out_dir}")
+        print(
+            f"orbax params written to {args.out_dir} — restore with "
+            "ocp.PyTreeCheckpointer().restore(...)"
+        )
     else:
-        import jax
-        import numpy as np
-
         from tpu_parallel.models import quantize_params, quantized_nbytes
+        from tpu_parallel.models.quantize import save_int8_npz
 
         q = quantize_params(params)
-        flat = {
-            "/".join(str(getattr(k, "key", k)) for k in path): np.asarray(leaf)
-            for path, leaf in jax.tree_util.tree_leaves_with_path(q)
-        }
         os.makedirs(args.out_dir, exist_ok=True)
         out = os.path.join(args.out_dir, "params_int8.npz")
-        np.savez(out, **flat)
+        save_int8_npz(out, q)
         print(
             f"int8 artifact written to {out} "
             f"({quantized_nbytes(q) / 1e6:.1f} MB vs "

@@ -317,7 +317,6 @@ def test_chunked_loss_matches_full(mesh_data8, rng):
         )
 
 
-@pytest.mark.fast
 @pytest.mark.parametrize("policy", ["full", "proj", "proj_attn"])
 def test_gpt_unrolled_remat_policies(mesh_data8, rng, policy):
     """Unrolled layers + remat must trace and train under every policy.
@@ -331,7 +330,6 @@ def test_gpt_unrolled_remat_policies(mesh_data8, rng, policy):
     assert last < first
 
 
-@pytest.mark.fast
 def test_gpt_remat_proj_attn_matches_no_remat(mesh_data8, rng):
     """proj_attn-rematted training matches unrematted step-for-step.
 

@@ -57,7 +57,6 @@ def test_clip_factor_identical_across_ranks(mesh_data8):
     assert np.all(np.abs(per_rank) < 2.0)
 
 
-@pytest.mark.fast
 @pytest.mark.parametrize("name", ["lion", "sgd"])
 def test_optimizer_families_train(mesh_data8, name):
     """Every optimizer family wires through the sharded train step and

@@ -60,3 +60,28 @@ def test_quantized_model_generates_close(rng):
     # and the generate path accepts the restored tree
     out = generate(model, restored, prompt, max_new_tokens=4, temperature=0.0)
     assert out.shape == (2, 4)
+
+
+@pytest.mark.fast
+def test_int8_npz_roundtrip(rng, tmp_path):
+    """save_int8_npz -> load_int8_npz -> dequantize reproduces the dense
+    tree within quantization error (the serialized artifact is loadable,
+    not write-only)."""
+    from tpu_parallel.models.quantize import load_int8_npz, save_int8_npz
+
+    tree = {
+        "a": {"kernel": jax.random.normal(rng, (64, 128)), "bias": jnp.ones((8,))},
+        "b": {"kernel": jax.random.normal(jax.random.PRNGKey(1), (128, 64))},
+    }
+    q = quantize_params(tree, min_size=1024)
+    path = str(tmp_path / "p.npz")
+    save_int8_npz(path, q)
+    loaded = load_int8_npz(path)
+    back = dequantize_params(loaded, jnp.float32)
+    for name in ("a", "b"):
+        np.testing.assert_allclose(
+            np.asarray(back[name]["kernel"]),
+            np.asarray(tree[name]["kernel"]),
+            atol=float(np.abs(np.asarray(tree[name]["kernel"])).max()) / 100,
+        )
+    np.testing.assert_array_equal(np.asarray(back["a"]["bias"]), np.ones(8))

@@ -97,6 +97,62 @@ def dequantize_params(qparams: Pytree, dtype=jnp.bfloat16) -> Pytree:
     )
 
 
+def save_int8_npz(path: str, qparams: Pytree) -> None:
+    """Serialize a :func:`quantize_params` tree to one ``.npz`` file.
+
+    Quantized leaves store two entries (``<path>::q`` int8,
+    ``<path>::scale`` fp32); plain leaves store one.  The inverse is
+    :func:`load_int8_npz`.
+    """
+    import numpy as np
+
+    flat = {}
+
+    def walk(prefix, node):
+        if isinstance(node, QuantizedTensor):
+            flat[prefix + "::q"] = np.asarray(node.q)
+            flat[prefix + "::scale"] = np.asarray(node.scale)
+        elif isinstance(node, dict):
+            for k, v in node.items():
+                walk(f"{prefix}/{k}" if prefix else str(k), v)
+        else:
+            flat[prefix] = np.asarray(node)
+
+    walk("", qparams)
+    np.savez(path, **flat)
+
+
+def load_int8_npz(path: str) -> Pytree:
+    """Rebuild the :func:`quantize_params` tree a :func:`save_int8_npz`
+    file holds; pass the result to :func:`dequantize_params`."""
+    import numpy as np
+
+    data = np.load(path)
+
+    def set_at(tree, keys, value):
+        for k in keys[:-1]:
+            tree = tree.setdefault(k, {})
+        tree[keys[-1]] = value
+
+    tree: dict = {}
+    qparts: dict = {}
+    for key in data.files:
+        if key.endswith(("::q", "::scale")):
+            base, part = key.rsplit("::", 1)
+            qparts.setdefault(base, {})[part] = data[key]
+        else:
+            set_at(tree, key.split("/"), jnp.asarray(data[key]))
+    for base, parts in qparts.items():
+        set_at(
+            tree,
+            base.split("/"),
+            QuantizedTensor(
+                q=jnp.asarray(parts["q"]), scale=jnp.asarray(parts["scale"])
+            ),
+        )
+    return tree
+
+
 def quantized_nbytes(tree: Pytree) -> int:
     """Total serialized bytes of a (possibly quantized) param tree."""
     return sum(
