@@ -8,47 +8,48 @@ Prints ONE JSON line:
 BASELINE.json (the reference publishes no numbers of its own — BASELINE.md).
 Runs on whatever ``jax.devices()`` offers: the real TPU chip under the
 driver, or CPU (with a tiny model) when no accelerator is present.
+
+Process architecture (hardened after BENCH_r03, a watchdog zero caused by a
+wedged TPU transport, not by the code):
+
+    parent (this file, no jax import — importing jax dials the TPU relay
+    and can itself hang on a wedged transport)
+      ├─ phase "probe": tiny matmul in a subprocess, short timeout.
+      │    A healthy first touch takes seconds; a hang means the transport
+      │    is wedged *before* we spend the full watchdog on it.
+      ├─ phase "bench": the real measurement (BENCH_CHILD=1) under the
+      │    watchdog; ONE respawn on wedge/crash (the persistent compile
+      │    cache makes the retry far cheaper than the first attempt).
+      └─ on success: result echoed + saved to BENCH_LAST_GOOD.json.
+         on final failure: error JSON says which phase died and carries the
+         last good in-round result so a flaky transport can't erase the
+         round's measurement entirely.
+
+Watchdog budget: BENCH_WATCHDOG_SECS (default 1800 — the old 900s default
+equalled the worst measured fresh-compile time for the unrolled config, so a
+legitimate cold run could be killed right at the boundary).
 """
 
 import json
 import os
-import threading
+import subprocess
+import sys
 import time
 
-import jax
-import jax.numpy as jnp
+_SELF = os.path.abspath(__file__)
+_REPO = os.path.dirname(_SELF)
+_LAST_GOOD = os.path.join(_REPO, "BENCH_LAST_GOOD.json")
 
 
-def _arm_watchdog(seconds: float) -> threading.Timer:
-    """Hard-exit if the benchmark wedges (e.g. a dead TPU transport hangs
-    jax.devices() in C++ before any Python timeout can fire).  A failed
-    bench run must be an error, not an eternal hang.  The caller cancels
-    the returned timer once the result is printed."""
-
-    def bite():
-        print(
-            json.dumps(
-                {
-                    "metric": "tokens/sec/chip",
-                    "value": 0,
-                    "unit": "tokens/sec/chip",
-                    "vs_baseline": 0,
-                    "error": f"watchdog: no result within {seconds:.0f}s "
-                    "(wedged transport?)",
-                }
-            ),
-            flush=True,
-        )
-        os._exit(3)
-
-    t = threading.Timer(seconds, bite)
-    t.daemon = True
-    t.start()
-    return t
+# --------------------------------------------------------------------------
+# Child: the actual measurement.  Runs with BENCH_CHILD=1 in a subprocess so
+# the parent can kill/respawn it without wedging its own interpreter.
+# --------------------------------------------------------------------------
 
 
-def main():
-    watchdog = _arm_watchdog(float(os.environ.get("BENCH_WATCHDOG_SECS", "900")))
+def child_main():
+    import jax
+
     from tpu_parallel.runtime import enable_compilation_cache
 
     # warm re-runs skip the first compile; a no-op on remote-compile
@@ -144,10 +145,149 @@ def main():
                 "steps_timed": steps,
                 "final_loss": round(final_loss, 4),
             }
-        )
+        ),
+        flush=True,
     )
-    watchdog.cancel()
+
+
+# --------------------------------------------------------------------------
+# Parent: probe → bench (with one respawn) → report.  Pure stdlib.
+# --------------------------------------------------------------------------
+
+_PROBE_SRC = """
+import jax, jax.numpy as jnp
+x = jnp.ones((256, 256))
+(x @ x).block_until_ready()
+print("BENCH-PROBE-OK", jax.devices()[0].platform, flush=True)
+"""
+
+
+def _run(cmd, timeout, env=None):
+    """Run ``cmd``; return (rc, stdout, wedged).  rc is None on timeout."""
+    try:
+        proc = subprocess.run(
+            cmd,
+            stdout=subprocess.PIPE,
+            stderr=None,  # compile noise goes straight to our stderr
+            timeout=timeout,
+            env=env,
+            text=True,
+        )
+        return proc.returncode, proc.stdout, False
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        return None, out or "", True
+
+
+def _git_head():
+    try:
+        return subprocess.run(
+            ["git", "-C", _REPO, "rev-parse", "--short", "HEAD"],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+            timeout=10,
+            text=True,
+        ).stdout.strip() or "unknown"
+    except (OSError, subprocess.TimeoutExpired):
+        return "unknown"
+
+
+def _fail(phase, detail, elapsed):
+    payload = {
+        "metric": "tokens/sec/chip",
+        "value": 0,
+        "unit": "tokens/sec/chip",
+        "vs_baseline": 0,
+        "error": f"{phase}: {detail} (elapsed {elapsed:.0f}s)",
+        "phase": phase,
+    }
+    # A flaky transport must not erase the record entirely: carry the last
+    # successful TPU measurement by this benchmark.  Its "ts" and "commit"
+    # fields say when/what was measured — it may predate the current code
+    # state, so it documents hardware reachability, not current throughput.
+    try:
+        with open(_LAST_GOOD) as f:
+            payload["last_good"] = json.load(f)
+    except (OSError, ValueError):
+        pass
+    print(json.dumps(payload), flush=True)
+    sys.exit(3)
+
+
+def parent_main():
+    budget = float(os.environ.get("BENCH_WATCHDOG_SECS", "1800"))
+    t_start = time.monotonic()
+    py = sys.executable
+
+    # Phase 1: probe.  Healthy first touch is seconds; 300s of silence means
+    # the transport is wedged — killing the probe then leaks no claim a
+    # working run would need (the claim is already orphaned).
+    probe_timeout = min(300.0, budget / 3)
+    rc, out, wedged = _run([py, "-c", _PROBE_SRC], probe_timeout)
+    if wedged or rc != 0 or "BENCH-PROBE-OK" not in (out or ""):
+        # One retry after a pause: transient relay hiccups (mid-handoff
+        # claims) clear in under a minute; a real wedge does not.
+        time.sleep(60)
+        rc, out, wedged = _run([py, "-c", _PROBE_SRC], probe_timeout)
+        if wedged or rc != 0 or "BENCH-PROBE-OK" not in (out or ""):
+            detail = (
+                "transport wedged (probe hung)"
+                if wedged
+                else f"probe failed rc={rc}: {(out or '').strip()[-200:]}"
+            )
+            _fail("probe", detail, time.monotonic() - t_start)
+
+    # Phase 2: the measurement, with one respawn.  Attempt 1 gets the bulk
+    # of the budget (covers a fresh compile); the retry runs against a warm
+    # persistent compile cache and needs far less.
+    env = dict(os.environ, BENCH_CHILD="1")
+    for attempt in (1, 2):
+        remaining = budget - (time.monotonic() - t_start)
+        if remaining < 60:
+            _fail("bench", "budget exhausted before attempt "
+                  f"{attempt}", time.monotonic() - t_start)
+        timeout = remaining * (0.7 if attempt == 1 else 1.0)
+        rc, out, wedged = _run([py, _SELF], timeout, env=env)
+        # Honor a result even when the child wedged AFTER printing it
+        # (interpreter teardown can hang on the dead relay) — the
+        # measurement itself is complete and valid.
+        line = next(
+            (l for l in reversed((out or "").splitlines()) if l.startswith("{")),
+            None,
+        )
+        if (rc == 0 or wedged) and line:
+            try:
+                result = json.loads(line)
+            except ValueError:
+                result = None
+            if result and result.get("value"):
+                if result.get("device", "").lower() != "cpu":
+                    # only TPU runs are worth carrying into a wedge report —
+                    # a CPU number would misrepresent what the hardware did
+                    result["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+                    result["commit"] = _git_head()
+                    try:
+                        with open(_LAST_GOOD, "w") as f:
+                            json.dump(result, f, indent=1)
+                    except OSError:
+                        pass
+                print(line, flush=True)
+                return
+        if attempt == 1:
+            time.sleep(30)  # let a killed child's claim settle before respawn
+    if wedged:
+        detail = "child wedged (watchdog)"
+    elif rc == 0:
+        detail = f"child exited 0 but printed no usable result JSON: {(out or '').strip()[-200:]}"
+    else:
+        detail = f"child failed rc={rc}: {(out or '').strip()[-200:]}"
+    _fail("bench", detail, time.monotonic() - t_start)
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_CHILD"):
+        child_main()
+    else:
+        parent_main()
