@@ -281,3 +281,252 @@ def test_window_decode_matches_train_forward(rng):
     np.testing.assert_allclose(
         np.asarray(decoded), np.asarray(full), rtol=1e-4, atol=1e-4
     )
+
+
+# --- grouped-query attention (native: K/V never expanded) ---------------------
+
+
+def _gqa_ref(q, k, v, segment_ids=None):
+    """Expand K/V heads and run the dense reference — GQA ground truth."""
+    group = q.shape[2] // k.shape[2]
+    ke = jnp.repeat(k, group, axis=2)
+    ve = jnp.repeat(v, group, axis=2)
+    return reference_attention(
+        q.transpose(0, 2, 1, 3),
+        ke.transpose(0, 2, 1, 3),
+        ve.transpose(0, 2, 1, 3),
+        segment_ids=segment_ids,
+    ).transpose(0, 2, 1, 3)
+
+
+def _make_gqa(rng, b=2, s=256, h=4, h_kv=2, d=32, dtype=jnp.float32):
+    ks = jax.random.split(rng, 3)
+    q = jax.random.normal(ks[0], (b, s, h, d), dtype)
+    k = jax.random.normal(ks[1], (b, s, h_kv, d), dtype)
+    v = jax.random.normal(ks[2], (b, s, h_kv, d), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("stream", [False, True])
+def test_gqa_forward_matches_expanded_reference(rng, stream):
+    for h, h_kv in ((4, 2), (4, 1), (6, 3)):
+        q, k, v = _make_gqa(rng, h=h, h_kv=h_kv)
+        out = flash_attention(
+            q, k, v, block_q=64, block_k=64, interpret=True, stream=stream
+        )
+        ref = _gqa_ref(q, k, v)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3,
+            err_msg=f"h={h} h_kv={h_kv} stream={stream}",
+        )
+
+
+@pytest.mark.parametrize("stream", [False, True])
+def test_gqa_gradients_match_expanded_reference(rng, stream):
+    q, k, v = _make_gqa(rng, b=1, s=128, h=4, h_kv=2, d=32)
+
+    def loss_flash(q, k, v):
+        return (
+            flash_attention(
+                q, k, v, block_q=64, block_k=64, interpret=True, stream=stream
+            )
+            ** 2
+        ).sum()
+
+    def loss_ref(q, k, v):
+        return (_gqa_ref(q, k, v) ** 2).sum()
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-3, atol=5e-3,
+            err_msg=f"d{name} mismatch (stream={stream})",
+        )
+
+
+def test_gqa_packed_window_matches_reference(rng):
+    """GQA composes with segment ids and sliding window in-kernel."""
+    from tpu_parallel.models.layers import causal_attention
+
+    q, k, v = _make_gqa(rng, b=2, s=128, h=4, h_kv=2, d=32)
+    seg = _packed_segments(jax.random.PRNGKey(7), 2, 128)
+    out = flash_attention(
+        q, k, v, segment_ids=seg, block_q=64, block_k=64, interpret=True
+    )
+    ref = _gqa_ref(q, k, v, segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+    # window (no segments)
+    out_w = flash_attention(
+        q, k, v, block_q=32, block_k=32, window=48, interpret=True
+    )
+    group = 2
+    ref_w = causal_attention(
+        q, jnp.repeat(k, group, axis=2), jnp.repeat(v, group, axis=2), window=48
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_w), np.asarray(ref_w), rtol=2e-3, atol=2e-3
+    )
+
+
+def test_gqa_model_flash_matches_xla(rng):
+    """A GQA model forward agrees between attn_impl='flash' and 'xla'."""
+    from tpu_parallel.models import GPTLM, tiny_test
+
+    cfg_x = tiny_test(
+        n_kv_heads=2, dtype=jnp.float32, remat=False, scan_layers=False,
+        seq_len=64, attn_impl="xla",
+    )
+    cfg_f = tiny_test(
+        n_kv_heads=2, dtype=jnp.float32, remat=False, scan_layers=False,
+        seq_len=64, attn_impl="flash", flash_block_q=32, flash_block_k=32,
+    )
+    tokens = jax.random.randint(rng, (2, 64), 0, cfg_x.vocab_size)
+    params = GPTLM(cfg_x).init({"params": jax.random.PRNGKey(0)}, tokens, train=False)[
+        "params"
+    ]
+    lx = GPTLM(cfg_x).apply({"params": params}, tokens, train=False)
+    lf = GPTLM(cfg_f).apply({"params": params}, tokens, train=False)
+    np.testing.assert_allclose(np.asarray(lf), np.asarray(lx), rtol=2e-3, atol=2e-3)
+
+
+def test_gqa_decode_matches_train_forward(rng):
+    """GQA prefill-decode (kv-width cache, grouped einsum) == train forward."""
+    from tpu_parallel.models import GPTLM, tiny_test
+
+    cfg = tiny_test(n_kv_heads=2, dtype=jnp.float32, remat=False, seq_len=32)
+    model = GPTLM(cfg)
+    prompt = jax.random.randint(rng, (2, 20), 0, cfg.vocab_size)
+    params = model.init({"params": jax.random.PRNGKey(1)}, prompt, train=False)[
+        "params"
+    ]
+    full = model.apply({"params": params}, prompt, train=False)
+    decoded, _ = model.apply(
+        {"params": params}, prompt, train=False, decode=True, mutable=["cache"]
+    )
+    np.testing.assert_allclose(
+        np.asarray(decoded), np.asarray(full), rtol=1e-4, atol=1e-4
+    )
+
+
+# --- streamed (long-sequence) kernels ----------------------------------------
+
+
+@pytest.mark.parametrize("window", [0, 100])
+def test_stream_forward_matches_resident(rng, window):
+    q, k, v = _make_qkv(rng, b=1, s=256, h=2, d=32)
+    out_r = flash_attention(
+        q, k, v, block_q=64, block_k=64, window=window, interpret=True,
+        stream=False,
+    )
+    out_s = flash_attention(
+        q, k, v, block_q=64, block_k=64, window=window, interpret=True,
+        stream=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_s), np.asarray(out_r), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_stream_packed_matches_reference(rng):
+    q, k, v = _make_qkv(rng, b=2, s=256)
+    seg = _packed_segments(jax.random.PRNGKey(9), 2, 256)
+    out = flash_attention(
+        q, k, v, segment_ids=seg, block_q=64, block_k=64, interpret=True,
+        stream=True,
+    )
+    ref = reference_attention(
+        q.transpose(0, 2, 1, 3),
+        k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3),
+        segment_ids=seg,
+    ).transpose(0, 2, 1, 3)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("window", [0, 48])
+def test_stream_gradients_match_resident(rng, window):
+    q, k, v = _make_qkv(rng, b=1, s=128, h=2, d=32)
+
+    def loss(stream):
+        def f(q, k, v):
+            return (
+                flash_attention(
+                    q, k, v, block_q=32, block_k=32, window=window,
+                    interpret=True, stream=stream,
+                )
+                ** 2
+            ).sum()
+
+        return f
+
+    g_s = jax.grad(loss(True), argnums=(0, 1, 2))(q, k, v)
+    g_r = jax.grad(loss(False), argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g_s, g_r, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5,
+            err_msg=f"d{name} (window={window})",
+        )
+
+
+def test_stream_chunk_attention_combines(rng):
+    """flash_chunk_attention's streamed path (non-causal full chunks)."""
+    from tpu_parallel.ops.flash_attention import flash_chunk_attention
+
+    q, k, v = _make_qkv(rng, b=1, s=128, h=2, d=32)
+    out_r, lse_r = flash_chunk_attention(
+        q, k, v, causal=False, block_q=64, block_k=64, interpret=True,
+        stream=False,
+    )
+    out_s, lse_s = flash_chunk_attention(
+        q, k, v, causal=False, block_q=64, block_k=64, interpret=True,
+        stream=True,
+    )
+    np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_r), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(lse_s), np.asarray(lse_r), rtol=1e-5, atol=1e-5)
+
+
+def test_stream_auto_dispatch_long_seq(rng):
+    """seq 8192 > STREAM_SEQ_THRESHOLD auto-selects the streamed kernels and
+    fwd+bwd stay correct (spot-checked against the dense reference on a
+    slice-able size is impractical at 8k; instead check self-consistency of
+    the online softmax: output rows equal a direct jnp computation on a few
+    sampled query positions)."""
+    b, s, h, d = 1, 8192, 1, 64
+    ks = jax.random.split(rng, 3)
+    q, k, v = (
+        jax.random.normal(kk, (b, s, h, d), jnp.float32) * 0.1 for kk in ks
+    )
+    out = flash_attention(q, k, v, block_q=512, block_k=512, interpret=True)
+
+    # dense ground truth at a handful of query positions
+    for pos in (0, 511, 4096, 8191):
+        qi = q[:, pos, 0]  # [b, d]
+        scores = jnp.einsum("bd,bkd->bk", qi, k[:, : pos + 1, 0]) / jnp.sqrt(d)
+        probs = jax.nn.softmax(scores, axis=-1)
+        ref = jnp.einsum("bk,bkd->bd", probs, v[:, : pos + 1, 0])
+        np.testing.assert_allclose(
+            np.asarray(out[:, pos, 0]), np.asarray(ref), rtol=2e-3, atol=2e-3,
+            err_msg=f"pos={pos}",
+        )
+
+
+def test_stream_long_seq_backward_runs(rng):
+    """fwd+bwd at seq 8192 through the streamed kernels (grads finite)."""
+    b, s, h, d = 1, 8192, 1, 64
+    ks = jax.random.split(rng, 3)
+    q, k, v = (
+        jax.random.normal(kk, (b, s, h, d), jnp.float32) * 0.1 for kk in ks
+    )
+
+    def loss(q, k, v):
+        return (
+            flash_attention(q, k, v, block_q=512, block_k=512, interpret=True)
+            ** 2
+        ).sum()
+
+    gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for g, name in ((gq, "dq"), (gk, "dk"), (gv, "dv")):
+        arr = np.asarray(g)
+        assert np.isfinite(arr).all(), f"{name} has non-finite entries"
+        assert np.abs(arr).max() > 0, f"{name} is all zero"

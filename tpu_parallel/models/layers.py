@@ -38,7 +38,8 @@ class TransformerConfig:
     n_layers: int = 12
     n_heads: int = 12
     # grouped-query attention: number of K/V heads (None = MHA; 1 = MQA).
-    # Q heads are grouped onto the K/V heads by repetition after RoPE.
+    # Q heads are grouped onto the K/V heads after RoPE — natively (no K/V
+    # expansion) on the flash and decode paths, by repetition elsewhere.
     n_kv_heads: Optional[int] = None
     seq_len: int = 1024
     mlp_ratio: int = 4
@@ -180,26 +181,33 @@ def decode_attention(
     q: jax.Array, k_all: jax.Array, v_all: jax.Array, positions: jax.Array,
     window: int = 0,
 ) -> jax.Array:
-    """Attention of new queries against a full KV cache.
+    """Attention of new queries against a full KV cache, GQA-native.
 
     ``q``: [batch, new_len, heads, head_dim] at global ``positions``
-    [batch, new_len]; ``k_all``/``v_all``: [batch, cache_len, heads,
-    head_dim] where entries beyond the write index are zeros and masked out
-    by the position comparison (cache slot j holds global position j).
+    [batch, new_len]; ``k_all``/``v_all``: [batch, cache_len, kv_heads,
+    head_dim] where ``heads % kv_heads == 0`` (grouped queries contract
+    against their group's K/V directly — no repeated-K/V materialization)
+    and entries beyond the write index are zeros and masked out by the
+    position comparison (cache slot j holds global position j).
     """
-    head_dim = q.shape[-1]
+    b, nq, h, head_dim = q.shape
+    h_kv = k_all.shape[2]
+    group = h // h_kv
     scale = 1.0 / jnp.sqrt(head_dim).astype(q.dtype)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k_all).astype(jnp.float32)
+    qg = (q * scale).reshape(b, nq, h_kv, group, head_dim)
+    scores = jnp.einsum("bqngd,bknd->bngqk", qg, k_all).astype(jnp.float32)
     k_pos = jnp.arange(k_all.shape[1])
-    mask = k_pos[None, None, None, :] <= positions[:, None, :, None]
+    mask = k_pos[None, None, None, None, :] <= positions[:, None, None, :, None]
     if window:
         mask = jnp.logical_and(
             mask,
-            positions[:, None, :, None] - k_pos[None, None, None, :] < window,
+            positions[:, None, None, :, None] - k_pos[None, None, None, None, :]
+            < window,
         )
     scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v_all)
+    out = jnp.einsum("bngqk,bknd->bqngd", probs, v_all)
+    return out.reshape(b, nq, h, head_dim)
 
 
 class Attention(nn.Module):
@@ -291,7 +299,8 @@ class Attention(nn.Module):
             quant_cache = cfg.kv_cache_dtype == "int8"
             cache_store_dtype = jnp.int8 if quant_cache else cfg.dtype
             # cache at K/V-head width (local_kv): under GQA this is the whole
-            # point — n_heads/n_kv less cache HBM; groups expand after read
+            # point — n_heads/n_kv less cache HBM; decode_attention contracts
+            # grouped queries against it directly (no expansion)
             cached_k = self.variable(
                 "cache",
                 "cached_key",
@@ -340,7 +349,6 @@ class Attention(nn.Module):
                 positions = jnp.broadcast_to(local, x.shape[:2])
             q = apply_rope(q, positions, cfg.rope_theta)
             k = apply_rope(k, positions, cfg.rope_theta)
-        group = local_heads // local_kv
         if decode:
             if quant_cache:
                 from tpu_parallel.models.quantize import absmax_int8
@@ -370,16 +378,10 @@ class Attention(nn.Module):
                 )
                 cached_k.value, cached_v.value = k_all, v_all
             cache_index.value = idx + x.shape[1]
-            if group != 1:
-                k_all = jnp.repeat(k_all, group, axis=2)
-                v_all = jnp.repeat(v_all, group, axis=2)
+            # decode_attention contracts grouped queries against the
+            # kv-width cache directly — no K/V expansion
             out = decode_attention(q, k_all, v_all, positions, window=cfg.attn_window)
         else:
-            if group != 1:
-                # expand K/V groups to one head each; XLA fuses the broadcast
-                # into the attention matmuls, so HBM never holds the repeat
-                k = jnp.repeat(k, group, axis=2)
-                v = jnp.repeat(v, group, axis=2)
             out = self._attend(q, k, v, segment_ids)
         if cfg.attn_impl != "flash":
             # let the "proj_attn" remat policy keep the attention context so
@@ -403,6 +405,16 @@ class Attention(nn.Module):
 
     def _attend(self, q, k, v, segment_ids):
         cfg = self.config
+        group = q.shape[-2] // k.shape[-2]
+        if group != 1 and not (cfg.attn_impl == "flash" and self.attn_fn is None):
+            # GQA head expansion for the paths without native group routing
+            # (xla einsum, ring, ulysses, injected hooks).  XLA fuses this
+            # broadcast into the einsum contractions; the Pallas flash path
+            # must NOT take it — kernel operands are materialized buffers,
+            # so it routes groups via BlockSpec index maps instead and K/V
+            # stay at kv-head width end to end.
+            k = jnp.repeat(k, group, axis=2)
+            v = jnp.repeat(v, group, axis=2)
         attn_fn = self.attn_fn
         if attn_fn is None:
             if cfg.attn_impl == "flash":

@@ -11,11 +11,28 @@ structure is exploited twice: key blocks beyond the query block are skipped
 (not masked — skipped), and the backward kernels iterate only the triangle
 they need.
 
+Grouped-query attention is native: K/V may carry ``n_kv < n_heads`` heads and
+are NEVER expanded — the BlockSpec index maps route each query head to its
+K/V head's blocks, so GQA pays 1/group of MHA's K/V HBM traffic (the whole
+point of GQA; a pre-kernel ``jnp.repeat`` would materialize full-MHA K/V
+because Pallas operands are real buffers, not fusible broadcasts).
+
+Two kernel variants share the masking/band geometry:
+
+- **resident** (seq <= ``STREAM_SEQ_THRESHOLD``): one (batch, head) row's
+  whole K/V lives in VMEM; the K loop runs inside the kernel and skips
+  out-of-band blocks entirely.  This is the measured-fastest path at the
+  bench config (512x512 tiles, seq 1024 — SWEEP_r03.json).
+- **streamed** (longer seq): the K/V walk is a grid dimension; VMEM holds one
+  [block_k, d] tile plus fp32 online-softmax scratch carried across grid
+  steps, so residency is O(block) and seq 8k-32k fits v5e VMEM.  Out-of-band
+  grid steps clamp their index map to the previous block — Pallas skips the
+  DMA when the mapped block is unchanged — so causal still halves the
+  traffic, not just the FLOPs.
+
 Packed sequences: ``segment_ids`` [batch, seq] adds a same-segment condition
-to the causal mask in all three kernels (each query can always see itself, so
-no row is ever fully masked).  The segment mask rides the same fp32 score
-tile the causal mask uses — no extra HBM traffic beyond one int32 [seq] lane
-per batch row.
+to the causal mask in all kernels (each query can always see itself, so no
+row is ever fully masked).
 
 Falls back to the jnp reference implementation off-TPU (CPU tests run the
 kernels in interpret mode explicitly).
@@ -41,6 +58,10 @@ except ImportError:  # pragma: no cover
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
+# above this K/V length the streamed kernels take over (resident K/V at
+# 4096 x 64 x bf16 is ~0.5MB/operand — comfortable; 16k+ overflows v5e VMEM
+# once pipelining double-buffers the operands)
+STREAM_SEQ_THRESHOLD = 4096
 NEG_INF = -1e30
 
 
@@ -60,7 +81,6 @@ def reference_attention(
     return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(q.dtype), v)
 
 
-
 def _sds(shape, dtype, like):
     """ShapeDtypeStruct for pallas out_shape, inheriting ``like``'s varying
     axes — under shard_map's replication checker (check_vma=True) pallas
@@ -73,6 +93,15 @@ def _sds(shape, dtype, like):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
+def _kv_row_map(h: int, h_kv: int):
+    """Block-row index map routing query-head row ``bh`` of a [B*H, ...] grid
+    to its K/V head's row in the [B*H_KV, ...] K/V array — the native-GQA
+    mechanism (no K/V expansion anywhere)."""
+    if h == h_kv:
+        return lambda bh_: bh_
+    group = h // h_kv
+    return lambda bh_: (bh_ // h) * h_kv + (bh_ % h) // group
+
 
 def _window_first_k_block(qi, block_q: int, block_k: int, window: int):
     """First key block that can intersect the sliding window of query block
@@ -82,9 +111,9 @@ def _window_first_k_block(qi, block_q: int, block_k: int, window: int):
 
 def _band_mask(qi, ki, shape, block_q: int, block_k: int, causal: bool, window: int):
     """Causal and/or sliding-window mask for one [block_q, block_k] score
-    tile, or None when neither applies — the ONE definition all three
-    kernels (fwd, dq, dkv) share, so forward and backward can never
-    desynchronize on the band geometry."""
+    tile, or None when neither applies — the ONE definition all kernels
+    (fwd, dq, dkv; resident and streamed) share, so forward and backward can
+    never desynchronize on the band geometry."""
     if not (causal or window):
         return None
     q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, shape, 0)
@@ -98,7 +127,38 @@ def _band_mask(qi, ki, shape, block_q: int, block_k: int, causal: bool, window: 
     return mask
 
 
-# --- forward kernel -----------------------------------------------------------
+def _stream_k_range(qi, block_q, block_k, causal, window, num_ki):
+    """[first, last] K-block range query block ``qi`` actually needs.  Used
+    by both the streamed kernels (compute predicate) and their index maps
+    (DMA clamp) — they MUST agree, so it is one function."""
+    last = ((qi + 1) * block_q - 1) // block_k if causal else num_ki - 1
+    first = (
+        _window_first_k_block(qi, block_q, block_k, window) if window else 0
+    )
+    return first, last
+
+
+def _stream_q_range(ki, block_q, block_k, causal, window, num_qi):
+    """[first, last] Q-block range that sees key block ``ki`` — the q-side
+    mirror of :func:`_stream_k_range`, shared by the streamed dkv kernel's
+    compute predicate and its index maps for the same must-agree reason."""
+    first = ki * block_k // block_q if causal else 0
+    if window:
+        # queries beyond (k_block_end + window - 1) see none of this block
+        # (-(-x // y) is a tracer-safe ceil)
+        last = jnp.minimum(
+            num_qi - 1, -(-((ki + 1) * block_k + window - 1) // block_q) - 1
+        )
+    else:
+        last = num_qi - 1
+    return first, last
+
+
+def _use_stream(s_kv: int, stream: Optional[bool]) -> bool:
+    return s_kv > STREAM_SEQ_THRESHOLD if stream is None else bool(stream)
+
+
+# --- forward kernels ----------------------------------------------------------
 
 
 def _fwd_kernel(
@@ -159,6 +219,57 @@ def _fwd_kernel(
     lse_ref[0] = m + jnp.log(l)
 
 
+def _fwd_kernel_stream(
+    q_ref, k_ref, v_ref, *rest, block_q, block_k, scale, has_segments,
+    causal, window, num_ki,
+):
+    """Streamed forward: grid (bh, qi, ki); online-softmax state lives in
+    fp32 VMEM scratch carried across the ki grid dimension."""
+    if has_segments:
+        seg_q_ref, seg_k_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    first, last = _stream_k_range(qi, block_q, block_k, causal, window, num_ki)
+    # the block the index map actually fetched (clamped copy of ki)
+    kf = jnp.clip(ki, first, last)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when((ki >= first) & (ki <= last))
+    def _compute():
+        q = (q_ref[0] * jnp.asarray(scale, q_ref.dtype)).astype(q_ref.dtype)
+        k = k_ref[0]  # [block_k, d] — block kf
+        v = v_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        mask = _band_mask(qi, kf, s.shape, block_q, block_k, causal, window)
+        if has_segments:
+            same = seg_q_ref[0] == seg_k_ref[0].T  # [bq, bk]
+            mask = same if mask is None else jnp.logical_and(mask, same)
+        if mask is not None:
+            s = jnp.where(mask, s, NEG_INF)
+        m_prev, l_prev = m_ref[...], l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_ref[...] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32
+        )
+        m_ref[...] = m_new
+
+    @pl.when(ki == num_ki - 1)
+    def _finalize():
+        l = l_ref[...]
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[...] + jnp.log(l)
+
+
 def _flash_fwd(
     q: jax.Array,
     k: jax.Array,
@@ -170,19 +281,79 @@ def _flash_fwd(
     interpret: bool,
     causal: bool = True,
     window: int = 0,
+    stream: Optional[bool] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     b, h, s, d = q.shape
-    s_kv = k.shape[2]
+    h_kv, s_kv = k.shape[1], k.shape[2]
     scale = 1.0 / (d**0.5)
     bh = b * h
     qf = q.reshape(bh, s, d)
-    kf = k.reshape(bh, s_kv, d)
-    vf = v.reshape(bh, s_kv, d)
-    grid = (bh, s // block_q)
+    kf = k.reshape(b * h_kv, s_kv, d)
+    vf = v.reshape(b * h_kv, s_kv, d)
+    kv_row = _kv_row_map(h, h_kv)
+    kernel_kwargs = dict(
+        block_q=block_q,
+        block_k=block_k,
+        scale=scale,
+        has_segments=seg is not None,
+        causal=causal,
+        window=window,
+    )
+    out_shape = [
+        _sds((bh, s, d), q.dtype, qf),
+        _sds((bh, s, 1), jnp.float32, qf),
+    ]
+    if _use_stream(s_kv, stream):
+        num_ki = s_kv // block_k
+
+        def kv_map(bh_, qi, ki):
+            first, last = _stream_k_range(
+                qi, block_q, block_k, causal, window, num_ki
+            )
+            return (kv_row(bh_), jnp.clip(ki, first, last), 0)
+
+        in_specs = [
+            pl.BlockSpec((1, block_q, d), lambda bh_, qi, ki: (bh_, qi, 0)),
+            pl.BlockSpec((1, block_k, d), kv_map),
+            pl.BlockSpec((1, block_k, d), kv_map),
+        ]
+        args = [qf, kf, vf]
+        if seg is not None:
+            # seg is [B, S, 1], passed twice: a q-block view and a (clamped)
+            # k-block view
+            in_specs.append(
+                pl.BlockSpec((1, block_q, 1), lambda bh_, qi, ki: (bh_ // h, qi, 0))
+            )
+            in_specs.append(
+                pl.BlockSpec(
+                    (1, block_k, 1),
+                    lambda bh_, qi, ki: (bh_ // h,)
+                    + kv_map(bh_, qi, ki)[1:],
+                )
+            )
+            args += [seg, seg]
+        out, lse = pl.pallas_call(
+            functools.partial(_fwd_kernel_stream, num_ki=num_ki, **kernel_kwargs),
+            grid=(bh, s // block_q, num_ki),
+            in_specs=in_specs,
+            out_specs=[
+                pl.BlockSpec((1, block_q, d), lambda bh_, qi, ki: (bh_, qi, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda bh_, qi, ki: (bh_, qi, 0)),
+            ],
+            out_shape=out_shape,
+            scratch_shapes=[
+                pltpu.VMEM((block_q, d), jnp.float32),
+                pltpu.VMEM((block_q, 1), jnp.float32),
+                pltpu.VMEM((block_q, 1), jnp.float32),
+            ],
+            interpret=interpret,
+        )(*args)
+        return out.reshape(b, h, s, d), lse.reshape(b, h, s)
+
     in_specs = [
         pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0)),
-        pl.BlockSpec((1, s_kv, d), lambda bh_, qi: (bh_, 0, 0)),
-        pl.BlockSpec((1, s_kv, d), lambda bh_, qi: (bh_, 0, 0)),
+        pl.BlockSpec((1, s_kv, d), lambda bh_, qi: (kv_row(bh_), 0, 0)),
+        pl.BlockSpec((1, s_kv, d), lambda bh_, qi: (kv_row(bh_), 0, 0)),
     ]
     args = [qf, kf, vf]
     if seg is not None:
@@ -192,25 +363,14 @@ def _flash_fwd(
         )
         args.append(seg)
     out, lse = pl.pallas_call(
-        functools.partial(
-            _fwd_kernel,
-            block_q=block_q,
-            block_k=block_k,
-            scale=scale,
-            has_segments=seg is not None,
-            causal=causal,
-            window=window,
-        ),
-        grid=grid,
+        functools.partial(_fwd_kernel, **kernel_kwargs),
+        grid=(bh, s // block_q),
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0)),
             pl.BlockSpec((1, block_q, 1), lambda bh_, qi: (bh_, qi, 0)),
         ],
-        out_shape=[
-            _sds((bh, s, d), q.dtype, qf),
-            _sds((bh, s, 1), jnp.float32, qf),
-        ],
+        out_shape=out_shape,
         interpret=interpret,
     )(*args)
     return out.reshape(b, h, s, d), lse.reshape(b, h, s)
@@ -265,10 +425,61 @@ def _bwd_dq_kernel(
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
+def _bwd_dq_kernel_stream(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    block_q, block_k, scale, has_segments, causal, window, num_ki,
+):
+    """Streamed dq: grid (bh, qi, ki); fp32 dq accumulator in scratch."""
+    if has_segments:
+        seg_q_ref, seg_k_ref, dq_ref, dq_acc_ref = rest
+    else:
+        dq_ref, dq_acc_ref = rest
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    first, last = _stream_k_range(qi, block_q, block_k, causal, window, num_ki)
+    kf = jnp.clip(ki, first, last)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc_ref[...] = jnp.zeros_like(dq_acc_ref)
+
+    @pl.when((ki >= first) & (ki <= last))
+    def _compute():
+        q = (q_ref[0] * jnp.asarray(scale, q_ref.dtype)).astype(q_ref.dtype)
+        do = do_ref[0]
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        mask = _band_mask(qi, kf, s.shape, block_q, block_k, causal, window)
+        if has_segments:
+            same = seg_q_ref[0] == seg_k_ref[0].T
+            mask = same if mask is None else jnp.logical_and(mask, same)
+        if mask is not None:
+            s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta)).astype(k.dtype)
+        dq_acc_ref[...] = dq_acc_ref[...] + jnp.dot(
+            ds, k, preferred_element_type=jnp.float32
+        )
+
+    @pl.when(ki == num_ki - 1)
+    def _finalize():
+        dq_ref[0] = (dq_acc_ref[...] * scale).astype(dq_ref.dtype)
+
+
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
     block_q, block_k, scale, seq_len, has_segments, causal=True, window=0,
+    group=1,
 ):
+    """Resident dk/dv: grid (b*h_kv, ki).  Under GQA (group > 1) the
+    q/do/lse/delta operands arrive reshaped to [b*h_kv, group*seq, ...] and
+    the kernel statically unrolls over the group's query heads, summing their
+    contributions — the reduction over the group happens here, not via an
+    expanded K/V."""
     if has_segments:
         seg_ref, dk_ref, dv_ref = rest
     else:
@@ -289,48 +500,112 @@ def _bwd_dkv_kernel(
             -(-((ki + 1) * block_k + window - 1) // block_q),
         )
 
-    def body(qi, carry):
-        dk, dv = carry
-        q = (
-            q_ref[0, pl.ds(qi * block_q, block_q), :]
-            * jnp.asarray(scale, q_ref.dtype)
-        ).astype(q_ref.dtype)
-        do = do_ref[0, pl.ds(qi * block_q, block_q), :]
-        lse = lse_ref[0, pl.ds(qi * block_q, block_q), :]
-        delta = delta_ref[0, pl.ds(qi * block_q, block_q), :]
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
-        mask = _band_mask(qi, ki, s.shape, block_q, block_k, causal, window)
-        if has_segments:
-            seg_q = seg_ref[0, pl.ds(qi * block_q, block_q), :]
-            same = seg_q == seg_k.T
-            mask = same if mask is None else jnp.logical_and(mask, same)
-        if mask is not None:
-            s = jnp.where(mask, s, NEG_INF)
-        p = jnp.exp(s - lse)
-        dv = dv + jnp.dot(
-            p.astype(do.dtype).T, do, preferred_element_type=jnp.float32
-        )
-        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta)).astype(q.dtype)
-        dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
-        return dk, dv
+    def make_body(g):
+        base = g * seq_len
+
+        def body(qi, carry):
+            dk, dv = carry
+            q = (
+                q_ref[0, pl.ds(base + qi * block_q, block_q), :]
+                * jnp.asarray(scale, q_ref.dtype)
+            ).astype(q_ref.dtype)
+            do = do_ref[0, pl.ds(base + qi * block_q, block_q), :]
+            lse = lse_ref[0, pl.ds(base + qi * block_q, block_q), :]
+            delta = delta_ref[0, pl.ds(base + qi * block_q, block_q), :]
+            s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
+            mask = _band_mask(qi, ki, s.shape, block_q, block_k, causal, window)
+            if has_segments:
+                seg_q = seg_ref[0, pl.ds(qi * block_q, block_q), :]
+                same = seg_q == seg_k.T
+                mask = same if mask is None else jnp.logical_and(mask, same)
+            if mask is not None:
+                s = jnp.where(mask, s, NEG_INF)
+            p = jnp.exp(s - lse)
+            dv = dv + jnp.dot(
+                p.astype(do.dtype).T, do, preferred_element_type=jnp.float32
+            )
+            dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+            ds = (p * (dp - delta)).astype(q.dtype)
+            dk = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+            return dk, dv
+
+        return body
 
     d = k_ref.shape[-1]
     zeros = jnp.zeros((block_k, d), jnp.float32)
-    dk, dv = lax.fori_loop(first_q_block, num_q_blocks, body, (zeros, zeros))
+    carry = (zeros, zeros)
+    for g in range(group):  # static unroll: one pass per query head in group
+        carry = lax.fori_loop(first_q_block, num_q_blocks, make_body(g), carry)
+    dk, dv = carry
     # q was pre-scaled, so dk already carries one factor of `scale`
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
+def _bwd_dkv_kernel_stream(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+    block_q, block_k, scale, has_segments, causal, window, group, num_qi,
+):
+    """Streamed dk/dv: grid (b*h_kv, ki, g, qi).  The index maps feed the
+    (g, qi) walk one [block_q, ...] tile at a time; dk/dv accumulate in fp32
+    scratch across the two inner grid dims and flush once per (bkv, ki)."""
+    if has_segments:
+        seg_q_ref, seg_k_ref, dk_ref, dv_ref, dk_acc_ref, dv_acc_ref = rest
+    else:
+        dk_ref, dv_ref, dk_acc_ref, dv_acc_ref = rest
+    ki = pl.program_id(1)
+    g = pl.program_id(2)
+    qi = pl.program_id(3)
+    first_q, last_q = _stream_q_range(ki, block_q, block_k, causal, window, num_qi)
+    qf = jnp.clip(qi, first_q, last_q)
+
+    @pl.when((g == 0) & (qi == 0))
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
+
+    @pl.when((qi >= first_q) & (qi <= last_q))
+    def _compute():
+        k = k_ref[0]
+        v = v_ref[0]
+        q = (q_ref[0] * jnp.asarray(scale, q_ref.dtype)).astype(q_ref.dtype)
+        do = do_ref[0]
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
+        mask = _band_mask(qf, ki, s.shape, block_q, block_k, causal, window)
+        if has_segments:
+            same = seg_q_ref[0] == seg_k_ref[0].T
+            mask = same if mask is None else jnp.logical_and(mask, same)
+        if mask is not None:
+            s = jnp.where(mask, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dv_acc_ref[...] = dv_acc_ref[...] + jnp.dot(
+            p.astype(do.dtype).T, do, preferred_element_type=jnp.float32
+        )
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta)).astype(q.dtype)
+        dk_acc_ref[...] = dk_acc_ref[...] + jnp.dot(
+            ds.T, q, preferred_element_type=jnp.float32
+        )
+
+    @pl.when((g == pl.num_programs(2) - 1) & (qi == num_qi - 1))
+    def _finalize():
+        # q was pre-scaled, so dk already carries one factor of `scale`
+        dk_ref[0] = dk_acc_ref[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
+
+
 def _flash_bwd(
     q, k, v, seg, out, lse, do, *, block_q, block_k, interpret,
-    causal=True, window=0, dlse=None,
+    causal=True, window=0, dlse=None, stream: Optional[bool] = None,
 ):
     b, h, s, d = q.shape
-    s_kv = k.shape[2]
+    h_kv, s_kv = k.shape[1], k.shape[2]
+    group = h // h_kv
     scale = 1.0 / (d**0.5)
     bh = b * h
+    b_kv = b * h_kv
     delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)
     if dlse is not None:
         # chunked/ring combine: a nonzero cotangent on lse folds into the
@@ -338,93 +613,223 @@ def _flash_bwd(
         # ds = p * (dp - (delta - dlse))
         delta = delta - dlse
     qf = q.reshape(bh, s, d)
-    kf, vf = (x.reshape(bh, s_kv, d) for x in (k, v))
+    kf, vf = (x.reshape(b_kv, s_kv, d) for x in (k, v))
     dof = do.reshape(bh, s, d)
     lsef = lse.reshape(bh, s, 1)
     deltaf = delta.reshape(bh, s, 1)
     has_segments = seg is not None
+    kv_row = _kv_row_map(h, h_kv)
+    # the resident dkv kernel holds [group*s, d] q/do operands in VMEM, so
+    # under GQA the stream decision must budget for group*s, not just s_kv —
+    # e.g. group=8 at s=4096 is an 8MB bf16 q tile, past v5e VMEM
+    streamed = _use_stream(max(s_kv, group * s), stream)
 
-    in_specs = [
-        pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0)),
-        pl.BlockSpec((1, s_kv, d), lambda bh_, qi: (bh_, 0, 0)),
-        pl.BlockSpec((1, s_kv, d), lambda bh_, qi: (bh_, 0, 0)),
-        pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0)),
-        pl.BlockSpec((1, block_q, 1), lambda bh_, qi: (bh_, qi, 0)),
-        pl.BlockSpec((1, block_q, 1), lambda bh_, qi: (bh_, qi, 0)),
-    ]
-    args = [qf, kf, vf, dof, lsef, deltaf]
-    if has_segments:
-        in_specs.append(
-            pl.BlockSpec((1, s_kv, 1), lambda bh_, qi: (bh_ // h, 0, 0))
-        )
-        args.append(seg)
-    dq = pl.pallas_call(
-        functools.partial(
-            _bwd_dq_kernel,
-            block_q=block_q,
-            block_k=block_k,
-            scale=scale,
-            has_segments=has_segments,
-            causal=causal,
-            window=window,
-        ),
-        grid=(bh, s // block_q),
-        in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0)),
-        out_shape=_sds((bh, s, d), q.dtype, qf),
-        interpret=interpret,
-    )(*args)
+    # ---- dq ----
+    if streamed:
+        num_ki = s_kv // block_k
 
-    in_specs = [
-        pl.BlockSpec((1, s, d), lambda bh_, ki: (bh_, 0, 0)),
-        pl.BlockSpec((1, block_k, d), lambda bh_, ki: (bh_, ki, 0)),
-        pl.BlockSpec((1, block_k, d), lambda bh_, ki: (bh_, ki, 0)),
-        pl.BlockSpec((1, s, d), lambda bh_, ki: (bh_, 0, 0)),
-        pl.BlockSpec((1, s, 1), lambda bh_, ki: (bh_, 0, 0)),
-        pl.BlockSpec((1, s, 1), lambda bh_, ki: (bh_, 0, 0)),
+        def kv_map(bh_, qi, ki):
+            first, last = _stream_k_range(
+                qi, block_q, block_k, causal, window, num_ki
+            )
+            return (kv_row(bh_), jnp.clip(ki, first, last), 0)
+
+        in_specs = [
+            pl.BlockSpec((1, block_q, d), lambda bh_, qi, ki: (bh_, qi, 0)),
+            pl.BlockSpec((1, block_k, d), kv_map),
+            pl.BlockSpec((1, block_k, d), kv_map),
+            pl.BlockSpec((1, block_q, d), lambda bh_, qi, ki: (bh_, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh_, qi, ki: (bh_, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh_, qi, ki: (bh_, qi, 0)),
+        ]
+        args = [qf, kf, vf, dof, lsef, deltaf]
+        if has_segments:
+            in_specs.append(
+                pl.BlockSpec((1, block_q, 1), lambda bh_, qi, ki: (bh_ // h, qi, 0))
+            )
+            in_specs.append(
+                pl.BlockSpec(
+                    (1, block_k, 1),
+                    lambda bh_, qi, ki: (bh_ // h,) + kv_map(bh_, qi, ki)[1:],
+                )
+            )
+            args += [seg, seg]
+        dq = pl.pallas_call(
+            functools.partial(
+                _bwd_dq_kernel_stream,
+                block_q=block_q,
+                block_k=block_k,
+                scale=scale,
+                has_segments=has_segments,
+                causal=causal,
+                window=window,
+                num_ki=num_ki,
+            ),
+            grid=(bh, s // block_q, num_ki),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec(
+                (1, block_q, d), lambda bh_, qi, ki: (bh_, qi, 0)
+            ),
+            out_shape=_sds((bh, s, d), q.dtype, qf),
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+            interpret=interpret,
+        )(*args)
+    else:
+        in_specs = [
+            pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0)),
+            pl.BlockSpec((1, s_kv, d), lambda bh_, qi: (kv_row(bh_), 0, 0)),
+            pl.BlockSpec((1, s_kv, d), lambda bh_, qi: (kv_row(bh_), 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh_, qi: (bh_, qi, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda bh_, qi: (bh_, qi, 0)),
+        ]
+        args = [qf, kf, vf, dof, lsef, deltaf]
+        if has_segments:
+            in_specs.append(
+                pl.BlockSpec((1, s_kv, 1), lambda bh_, qi: (bh_ // h, 0, 0))
+            )
+            args.append(seg)
+        dq = pl.pallas_call(
+            functools.partial(
+                _bwd_dq_kernel,
+                block_q=block_q,
+                block_k=block_k,
+                scale=scale,
+                has_segments=has_segments,
+                causal=causal,
+                window=window,
+            ),
+            grid=(bh, s // block_q),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, block_q, d), lambda bh_, qi: (bh_, qi, 0)),
+            out_shape=_sds((bh, s, d), q.dtype, qf),
+            interpret=interpret,
+        )(*args)
+
+    # ---- dk/dv ----
+    dkv_out_specs = [
+        pl.BlockSpec((1, block_k, d), lambda bh_, ki, *_: (bh_, ki, 0)),
+        pl.BlockSpec((1, block_k, d), lambda bh_, ki, *_: (bh_, ki, 0)),
     ]
-    args = [qf, kf, vf, dof, lsef, deltaf]
-    if has_segments:
-        in_specs.append(
-            pl.BlockSpec((1, s_kv, 1), lambda bh_, ki: (bh_ // h, 0, 0))
-        )
-        args.append(seg)
-    dk, dv = pl.pallas_call(
-        functools.partial(
-            _bwd_dkv_kernel,
-            block_q=block_q,
-            block_k=block_k,
-            scale=scale,
-            seq_len=s,
-            has_segments=has_segments,
-            causal=causal,
-            window=window,
-        ),
-        grid=(bh, s_kv // block_k),
-        in_specs=in_specs,
-        out_specs=[
+    dkv_out_shape = [
+        _sds((b_kv, s_kv, d), q.dtype, qf),
+        _sds((b_kv, s_kv, d), q.dtype, qf),
+    ]
+    if streamed:
+        num_qi = s // block_q
+
+        def q_row(bkv_, g):
+            if group == 1:
+                return bkv_
+            return (bkv_ // h_kv) * h + (bkv_ % h_kv) * group + g
+
+        def qi_clip(ki, qi):
+            first_q, last_q = _stream_q_range(
+                ki, block_q, block_k, causal, window, num_qi
+            )
+            return jnp.clip(qi, first_q, last_q)
+
+        def q_map(bkv_, ki, g, qi):
+            return (q_row(bkv_, g), qi_clip(ki, qi), 0)
+
+        in_specs = [
+            pl.BlockSpec((1, block_q, d), q_map),
+            pl.BlockSpec((1, block_k, d), lambda bkv_, ki, g, qi: (bkv_, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bkv_, ki, g, qi: (bkv_, ki, 0)),
+            pl.BlockSpec((1, block_q, d), q_map),
+            pl.BlockSpec((1, block_q, 1), q_map),
+            pl.BlockSpec((1, block_q, 1), q_map),
+        ]
+        args = [qf, kf, vf, dof, lsef, deltaf]
+        if has_segments:
+            in_specs.append(
+                pl.BlockSpec(
+                    (1, block_q, 1),
+                    lambda bkv_, ki, g, qi: (bkv_ // h_kv, qi_clip(ki, qi), 0),
+                )
+            )
+            in_specs.append(
+                pl.BlockSpec(
+                    (1, block_k, 1),
+                    lambda bkv_, ki, g, qi: (bkv_ // h_kv, ki, 0),
+                )
+            )
+            args += [seg, seg]
+        dk, dv = pl.pallas_call(
+            functools.partial(
+                _bwd_dkv_kernel_stream,
+                block_q=block_q,
+                block_k=block_k,
+                scale=scale,
+                has_segments=has_segments,
+                causal=causal,
+                window=window,
+                group=group,
+                num_qi=num_qi,
+            ),
+            grid=(b_kv, s_kv // block_k, group, num_qi),
+            in_specs=in_specs,
+            out_specs=dkv_out_specs,
+            out_shape=dkv_out_shape,
+            scratch_shapes=[
+                pltpu.VMEM((block_k, d), jnp.float32),
+                pltpu.VMEM((block_k, d), jnp.float32),
+            ],
+            interpret=interpret,
+        )(*args)
+    else:
+        # group the query-head operands by K/V head: [b*h_kv, group*s, ...]
+        qg = q.reshape(b_kv, group * s, d)
+        dog = do.reshape(b_kv, group * s, d)
+        lseg = lse.reshape(b_kv, group * s, 1)
+        deltag = delta.reshape(b_kv, group * s, 1)
+        in_specs = [
+            pl.BlockSpec((1, group * s, d), lambda bh_, ki: (bh_, 0, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh_, ki: (bh_, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda bh_, ki: (bh_, ki, 0)),
-        ],
-        out_shape=[
-            _sds((bh, s_kv, d), q.dtype, qf),
-            _sds((bh, s_kv, d), q.dtype, qf),
-        ],
-        interpret=interpret,
-    )(*args)
+            pl.BlockSpec((1, group * s, d), lambda bh_, ki: (bh_, 0, 0)),
+            pl.BlockSpec((1, group * s, 1), lambda bh_, ki: (bh_, 0, 0)),
+            pl.BlockSpec((1, group * s, 1), lambda bh_, ki: (bh_, 0, 0)),
+        ]
+        args = [qg, kf, vf, dog, lseg, deltag]
+        if has_segments:
+            in_specs.append(
+                pl.BlockSpec((1, s_kv, 1), lambda bh_, ki: (bh_ // h_kv, 0, 0))
+            )
+            args.append(seg)
+        dk, dv = pl.pallas_call(
+            functools.partial(
+                _bwd_dkv_kernel,
+                block_q=block_q,
+                block_k=block_k,
+                scale=scale,
+                seq_len=s,
+                has_segments=has_segments,
+                causal=causal,
+                window=window,
+                group=group,
+            ),
+            grid=(b_kv, s_kv // block_k),
+            in_specs=in_specs,
+            out_specs=dkv_out_specs,
+            out_shape=dkv_out_shape,
+            interpret=interpret,
+        )(*args)
 
     return (
         dq.reshape(b, h, s, d),
-        dk.reshape(b, h, s_kv, d),
-        dv.reshape(b, h, s_kv, d),
+        dk.reshape(b, h_kv, s_kv, d),
+        dv.reshape(b, h_kv, s_kv, d),
     )
 
 
 # --- public API with custom VJP ----------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9))
-def _flash_finalize(q, k, v, seg, out, lse, block_q, block_k, interpret, window):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10))
+def _flash_finalize(
+    q, k, v, seg, out, lse, block_q, block_k, interpret, window, stream
+):
     """Identity on ``out``; exists to attach the backward kernels.
 
     The forward kernel runs *outside* this custom_vjp (see
@@ -439,15 +844,16 @@ def _flash_finalize(q, k, v, seg, out, lse, block_q, block_k, interpret, window)
     return out
 
 
-def _finalize_fwd(q, k, v, seg, out, lse, block_q, block_k, interpret, window):
+def _finalize_fwd(q, k, v, seg, out, lse, block_q, block_k, interpret, window, stream):
     return out, (q, k, v, seg, out, lse)
 
 
-def _finalize_bwd(block_q, block_k, interpret, window, residuals, do):
+def _finalize_bwd(block_q, block_k, interpret, window, stream, residuals, do):
     q, k, v, seg, out, lse = residuals
     dq, dk, dv = _flash_bwd(
         q, k, v, seg, out, lse, do,
         block_q=block_q, block_k=block_k, interpret=interpret, window=window,
+        stream=stream,
     )
     # seg (int) carries no gradient; out/lse arrive behind stop_gradient, so
     # their zero cotangents are discarded by the caller
@@ -457,7 +863,8 @@ def _finalize_bwd(block_q, block_k, interpret, window, residuals, do):
 _flash_finalize.defvjp(_finalize_fwd, _finalize_bwd)
 
 
-def _flash_attention_bhsd(q, k, v, seg, block_q, block_k, interpret, window=0):
+def _flash_attention_bhsd(q, k, v, seg, block_q, block_k, interpret, window=0,
+                          stream=None):
     from jax.ad_checkpoint import checkpoint_name
 
     # stop_gradient on the *inputs*: the forward kernel then sees all-zero
@@ -473,40 +880,41 @@ def _flash_attention_bhsd(q, k, v, seg, block_q, block_k, interpret, window=0):
         block_k=block_k,
         interpret=interpret,
         window=window,
+        stream=stream,
     )
     out = checkpoint_name(out, "attn")
     lse = checkpoint_name(lse, "attn")
     return _flash_finalize(
-        q, k, v, seg, out, lse, block_q, block_k, interpret, window
+        q, k, v, seg, out, lse, block_q, block_k, interpret, window, stream
     )
 
 
 # --- chunk attention for ring/sequence parallelism ---------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _chunk_attention_bhsd(q, k, v, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _chunk_attention_bhsd(q, k, v, causal, block_q, block_k, interpret, stream):
     return _flash_fwd(
         q, k, v, None, block_q=block_q, block_k=block_k,
-        interpret=interpret, causal=causal,
+        interpret=interpret, causal=causal, stream=stream,
     )
 
 
-def _chunk_fwd(q, k, v, causal, block_q, block_k, interpret):
+def _chunk_fwd(q, k, v, causal, block_q, block_k, interpret, stream):
     out, lse = _flash_fwd(
         q, k, v, None, block_q=block_q, block_k=block_k,
-        interpret=interpret, causal=causal,
+        interpret=interpret, causal=causal, stream=stream,
     )
     return (out, lse), (q, k, v, out, lse)
 
 
-def _chunk_bwd(causal, block_q, block_k, interpret, residuals, cotangents):
+def _chunk_bwd(causal, block_q, block_k, interpret, stream, residuals, cotangents):
     q, k, v, out, lse = residuals
     do, dlse = cotangents
     dq, dk, dv = _flash_bwd(
         q, k, v, None, out, lse, do,
         block_q=block_q, block_k=block_k, interpret=interpret,
-        causal=causal, dlse=dlse,
+        causal=causal, dlse=dlse, stream=stream,
     )
     return dq, dk, dv
 
@@ -523,6 +931,7 @@ def flash_chunk_attention(
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
     interpret: Optional[bool] = None,
+    stream: Optional[bool] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """One flash-attention partial over a K/V chunk, for ring combining.
 
@@ -538,6 +947,10 @@ def flash_chunk_attention(
     attention (q and k index the same positions); ``causal=False`` is a
     fully-visible (strictly-past) chunk.
     """
+    if q.shape[2] % k.shape[2] != 0:
+        raise ValueError(
+            f"q heads {q.shape[2]} not a multiple of k/v heads {k.shape[2]}"
+        )
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     # exact-divisor tiles: a grid of s // bq with s % bq != 0 would leave
@@ -557,7 +970,7 @@ def flash_chunk_attention(
             stacklevel=2,
         )
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
-    out, lse = _chunk_attention_bhsd(qt, kt, vt, causal, bq, bk, interpret)
+    out, lse = _chunk_attention_bhsd(qt, kt, vt, causal, bq, bk, interpret, stream)
     return out.transpose(0, 2, 1, 3), lse
 
 
@@ -571,12 +984,22 @@ def flash_attention(
     block_k: int = DEFAULT_BLOCK_K,
     window: int = 0,
     interpret: Optional[bool] = None,
+    stream: Optional[bool] = None,
 ) -> jax.Array:
     """Causal flash attention on [batch, seq, heads, head_dim] inputs.
+
+    ``k``/``v`` may carry fewer heads than ``q`` (grouped-query attention:
+    ``n_heads % n_kv_heads == 0``); the kernels route each query head to its
+    K/V head via BlockSpec index maps — K/V are never expanded, so GQA keeps
+    its 1/group HBM saving on the Pallas path.
 
     ``window > 0`` adds sliding-window masking: query t sees keys in
     (t - window, t] only, and whole key blocks outside the window are
     skipped, not masked — O(seq * window) compute at long sequence.
+
+    ``stream`` selects the long-sequence kernels (K/V walked as a grid
+    dimension, O(block_k) VMEM residency); ``None`` auto-selects them above
+    ``STREAM_SEQ_THRESHOLD`` tokens.
 
     Drop-in replacement for
     :func:`tpu_parallel.models.layers.causal_attention` (the ``attn_fn``
@@ -585,6 +1008,9 @@ def flash_attention(
     to True off-TPU so tests exercise the same kernel code on CPU.
     """
     b, s, h, d = q.shape
+    h_kv = k.shape[2]
+    if h % h_kv != 0:
+        raise ValueError(f"q heads {h} not a multiple of k/v heads {h_kv}")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     block_q = min(block_q, s)
@@ -599,6 +1025,9 @@ def flash_attention(
         )
         from tpu_parallel.models.layers import causal_attention
 
+        if h_kv != h:  # the dense path has no head routing — expand
+            k = jnp.repeat(k, h // h_kv, axis=2)
+            v = jnp.repeat(v, h // h_kv, axis=2)
         return causal_attention(q, k, v, segment_ids=segment_ids, window=window)
     seg = None
     if segment_ids is not None:
@@ -607,6 +1036,6 @@ def flash_attention(
         seg = segment_ids.astype(jnp.int32)[:, :, None]
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
     out = _flash_attention_bhsd(
-        qt, kt, vt, seg, block_q, block_k, interpret, window
+        qt, kt, vt, seg, block_q, block_k, interpret, window, stream
     )
     return out.transpose(0, 2, 1, 3)
