@@ -530,3 +530,39 @@ def test_stream_long_seq_backward_runs(rng):
         arr = np.asarray(g)
         assert np.isfinite(arr).all(), f"{name} has non-finite entries"
         assert np.abs(arr).max() > 0, f"{name} is all zero"
+
+
+@pytest.mark.parametrize("q_offset", [32, 100, 140])
+def test_stream_offset_chunk_matches_resident(rng, q_offset):
+    """Streamed kernels with a window q_offset (ring partial chunks) agree
+    with the resident kernels — including empty rows (at q_offset=140 with
+    window=40, rows past local index 26 see no keys at all: their partials
+    must come back (0, NEG_INF) with exactly-zero gradients)."""
+    from tpu_parallel.ops.flash_attention import flash_chunk_attention
+
+    b, s, h, d = 1, 128, 2, 32
+    ks = jax.random.split(rng, 3)
+    q, k, v = (jax.random.normal(kk, (b, s, h, d)) for kk in ks)
+    window = 40
+
+    def run(stream):
+        def f(q, k, v):
+            out, lse = flash_chunk_attention(
+                q, k, v, causal=False, window=window, q_offset=q_offset,
+                block_q=32, block_k=32, interpret=True, stream=stream,
+            )
+            return out, lse
+
+        (out, lse), vjp = jax.vjp(f, q, k, v)
+        grads = vjp((jnp.ones_like(out), jnp.ones_like(lse) * 0.1))
+        return out, lse, grads
+
+    out_r, lse_r, g_r = run(False)
+    out_s, lse_s, g_s = run(True)
+    np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_r), rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(lse_s), np.asarray(lse_r), rtol=1e-5, atol=1e-5)
+    for a, b_, name in zip(g_s, g_r, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), rtol=1e-5, atol=1e-5,
+            err_msg=f"d{name} (q_offset={q_offset})",
+        )

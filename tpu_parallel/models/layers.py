@@ -82,9 +82,10 @@ class TransformerConfig:
     flash_block_q: int = 512
     flash_block_k: int = 512
     # sliding-window attention: 0 = full causal; >0 = each query sees only
-    # the last `attn_window` positions (Mistral-style).  Applies to the xla
-    # and flash paths (whole out-of-window key blocks are skipped in-kernel)
-    # and to decode; ring/ulysses reject it for now.
+    # the last `attn_window` positions (Mistral-style).  Applies to every
+    # attention impl: xla, flash (whole out-of-window key blocks skipped
+    # in-kernel), ring (out-of-window chunks skip their kernels entirely),
+    # ulysses (band applied on the gathered sequence), and decode.
     attn_window: int = 0
     # decode KV-cache storage: "bf16" (= cfg.dtype) or "int8" — int8 halves
     # the cache HBM (the decode-memory hog) with one fp32 scale per
@@ -432,11 +433,6 @@ class Attention(nn.Module):
                     ring_flash_attention,
                 )
 
-                if cfg.attn_window:
-                    raise NotImplementedError(
-                        "sliding-window attention under ring SP"
-                    )
-
                 if segment_ids is not None:
                     raise NotImplementedError(
                         "ring attention does not support packed sequences yet"
@@ -451,30 +447,32 @@ class Attention(nn.Module):
                             q, k, v, axis_name=cfg.seq_axis,
                             block_q=cfg.flash_block_q,
                             block_k=cfg.flash_block_k,
+                            window=cfg.attn_window,
                         )
 
                 else:
 
                     def attn_fn(q, k, v, segment_ids=None):
-                        return ring_attention(q, k, v, axis_name=cfg.seq_axis)
+                        return ring_attention(
+                            q, k, v, axis_name=cfg.seq_axis,
+                            window=cfg.attn_window,
+                        )
 
             elif cfg.attn_impl == "ulysses":
                 from tpu_parallel.ops.flash_attention import flash_attention
                 from tpu_parallel.ops.ulysses import ulysses_attention
 
-                if cfg.attn_window:
-                    raise NotImplementedError(
-                        "sliding-window attention under ulysses SP"
-                    )
-
                 if segment_ids is not None:
                     raise NotImplementedError(
                         "ulysses attention does not support packed sequences yet"
                     )
+                # the inner attention sees the full gathered sequence, so the
+                # window band applies directly
                 inner = functools.partial(
                     flash_attention,
                     block_q=cfg.flash_block_q,
                     block_k=cfg.flash_block_k,
+                    window=cfg.attn_window,
                 )
 
                 def attn_fn(q, k, v, segment_ids=None):

@@ -103,20 +103,28 @@ def _kv_row_map(h: int, h_kv: int):
     return lambda bh_: (bh_ // h) * h_kv + (bh_ % h) // group
 
 
-def _window_first_k_block(qi, block_q: int, block_k: int, window: int):
+def _window_first_k_block(qi, block_q: int, block_k: int, window: int,
+                          q_offset: int = 0):
     """First key block that can intersect the sliding window of query block
     ``qi`` (tracer-safe: ``qi`` is a pallas program_id)."""
-    return jnp.maximum(0, qi * block_q - window + 1) // block_k
+    return jnp.maximum(0, q_offset + qi * block_q - window + 1) // block_k
 
 
-def _band_mask(qi, ki, shape, block_q: int, block_k: int, causal: bool, window: int):
+def _band_mask(qi, ki, shape, block_q: int, block_k: int, causal: bool,
+               window: int, q_offset: int = 0):
     """Causal and/or sliding-window mask for one [block_q, block_k] score
     tile, or None when neither applies — the ONE definition all kernels
     (fwd, dq, dkv; resident and streamed) share, so forward and backward can
-    never desynchronize on the band geometry."""
+    never desynchronize on the band geometry.
+
+    ``q_offset`` (static) shifts query positions relative to key positions:
+    in ring attention the q chunk starts ``j * local_seq`` tokens after the
+    K/V chunk it is attending, so the sliding-window band between them is
+    the same geometry translated by that constant.
+    """
     if not (causal or window):
         return None
-    q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, shape, 0)
+    q_pos = q_offset + qi * block_q + lax.broadcasted_iota(jnp.int32, shape, 0)
     k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, shape, 1)
     mask = None
     if causal:
@@ -127,27 +135,33 @@ def _band_mask(qi, ki, shape, block_q: int, block_k: int, causal: bool, window: 
     return mask
 
 
-def _stream_k_range(qi, block_q, block_k, causal, window, num_ki):
+def _stream_k_range(qi, block_q, block_k, causal, window, num_ki, q_offset=0):
     """[first, last] K-block range query block ``qi`` actually needs.  Used
     by both the streamed kernels (compute predicate) and their index maps
-    (DMA clamp) — they MUST agree, so it is one function."""
+    (DMA clamp) — they MUST agree, so it is one function.  The range may be
+    empty (first > last) for offset chunks whose window misses every key
+    block; callers must clamp before using it as an index."""
     last = ((qi + 1) * block_q - 1) // block_k if causal else num_ki - 1
     first = (
-        _window_first_k_block(qi, block_q, block_k, window) if window else 0
+        _window_first_k_block(qi, block_q, block_k, window, q_offset)
+        if window
+        else 0
     )
     return first, last
 
 
-def _stream_q_range(ki, block_q, block_k, causal, window, num_qi):
+def _stream_q_range(ki, block_q, block_k, causal, window, num_qi, q_offset=0):
     """[first, last] Q-block range that sees key block ``ki`` — the q-side
     mirror of :func:`_stream_k_range`, shared by the streamed dkv kernel's
-    compute predicate and its index maps for the same must-agree reason."""
+    compute predicate and its index maps for the same must-agree reason.
+    May be empty (last < first) — see _stream_k_range."""
     first = ki * block_k // block_q if causal else 0
     if window:
         # queries beyond (k_block_end + window - 1) see none of this block
-        # (-(-x // y) is a tracer-safe ceil)
+        # (-(-x // y) is a tracer-safe ceil); q_offset shifts the band
         last = jnp.minimum(
-            num_qi - 1, -(-((ki + 1) * block_k + window - 1) // block_q) - 1
+            num_qi - 1,
+            -(-((ki + 1) * block_k + window - q_offset - 1) // block_q) - 1,
         )
     else:
         last = num_qi - 1
@@ -161,9 +175,31 @@ def _use_stream(s_kv: int, stream: Optional[bool]) -> bool:
 # --- forward kernels ----------------------------------------------------------
 
 
+def _finalize_rows(acc, m, l, o_ref, lse_ref, causal):
+    """Write out/lse from online-softmax state.  Causal rows always see at
+    least themselves (l > 0); an offset-window ring chunk can leave rows
+    with NO visible keys — those must emit the empty-partial contract
+    (out = 0, lse = NEG_INF) instead of 0/0 = nan."""
+    if causal:
+        o_ref[0] = (acc / l).astype(o_ref.dtype)
+        # log-sum-exp per query row, needed by the backward pass.  Kept as a
+        # trailing length-1 lane dim: TPU blocks need the last two dims to be
+        # (8k, 128k) or full — [block_q, 1] against a [bh, s, 1] array is
+        # legal, [1, block_q] against [bh, s] is not.
+        lse_ref[0] = m + jnp.log(l)
+    else:
+        empty = l <= 0.0
+        o_ref[0] = jnp.where(
+            empty, 0.0, acc / jnp.where(empty, 1.0, l)
+        ).astype(o_ref.dtype)
+        lse_ref[0] = jnp.where(
+            empty, NEG_INF, m + jnp.log(jnp.where(empty, 1.0, l))
+        )
+
+
 def _fwd_kernel(
     q_ref, k_ref, v_ref, *rest, block_q, block_k, scale, has_segments,
-    causal=True, window=0,
+    causal=True, window=0, q_offset=0,
 ):
     if has_segments:
         seg_ref, o_ref, lse_ref = rest
@@ -182,7 +218,9 @@ def _fwd_kernel(
         # full (non-causal) mode: ring attention's fully-visible K/V chunks
         num_k_blocks = k_ref.shape[1] // block_k
     first_k_block = (
-        _window_first_k_block(qi, block_q, block_k, window) if window else 0
+        _window_first_k_block(qi, block_q, block_k, window, q_offset)
+        if window
+        else 0
     )
 
     def body(ki, carry):
@@ -190,7 +228,8 @@ def _fwd_kernel(
         k = k_ref[0, pl.ds(ki * block_k, block_k), :]
         v = v_ref[0, pl.ds(ki * block_k, block_k), :]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
-        mask = _band_mask(qi, ki, s.shape, block_q, block_k, causal, window)
+        mask = _band_mask(qi, ki, s.shape, block_q, block_k, causal, window,
+                          q_offset)
         if has_segments:
             seg_k = seg_ref[0, pl.ds(ki * block_k, block_k), :]  # [bk, 1]
             same = seg_q == seg_k.T
@@ -211,17 +250,12 @@ def _fwd_kernel(
     m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc, m, l = lax.fori_loop(first_k_block, num_k_blocks, body, (acc, m0, l0))
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
-    # log-sum-exp per query row, needed by the backward pass.  Kept as a
-    # trailing length-1 lane dim: TPU blocks need the last two dims to be
-    # (8k, 128k) or full — [block_q, 1] against a [bh, s, 1] array is legal,
-    # [1, block_q] against [bh, s] is not.
-    lse_ref[0] = m + jnp.log(l)
+    _finalize_rows(acc, m, l, o_ref, lse_ref, causal)
 
 
 def _fwd_kernel_stream(
     q_ref, k_ref, v_ref, *rest, block_q, block_k, scale, has_segments,
-    causal, window, num_ki,
+    causal, window, num_ki, q_offset=0,
 ):
     """Streamed forward: grid (bh, qi, ki); online-softmax state lives in
     fp32 VMEM scratch carried across the ki grid dimension."""
@@ -231,9 +265,13 @@ def _fwd_kernel_stream(
         o_ref, lse_ref, acc_ref, m_ref, l_ref = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
-    first, last = _stream_k_range(qi, block_q, block_k, causal, window, num_ki)
-    # the block the index map actually fetched (clamped copy of ki)
-    kf = jnp.clip(ki, first, last)
+    first, last = _stream_k_range(
+        qi, block_q, block_k, causal, window, num_ki, q_offset
+    )
+    # the block the index map actually fetched (clamped copy of ki; the
+    # range can be empty — min keeps the fetch index in bounds, the
+    # compute predicate below keeps the empty range compute-free)
+    kf = jnp.clip(ki, jnp.minimum(first, last), last)
 
     @pl.when(ki == 0)
     def _init():
@@ -247,7 +285,8 @@ def _fwd_kernel_stream(
         k = k_ref[0]  # [block_k, d] — block kf
         v = v_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
-        mask = _band_mask(qi, kf, s.shape, block_q, block_k, causal, window)
+        mask = _band_mask(qi, kf, s.shape, block_q, block_k, causal, window,
+                          q_offset)
         if has_segments:
             same = seg_q_ref[0] == seg_k_ref[0].T  # [bq, bk]
             mask = same if mask is None else jnp.logical_and(mask, same)
@@ -265,9 +304,8 @@ def _fwd_kernel_stream(
 
     @pl.when(ki == num_ki - 1)
     def _finalize():
-        l = l_ref[...]
-        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
-        lse_ref[0] = m_ref[...] + jnp.log(l)
+        _finalize_rows(acc_ref[...], m_ref[...], l_ref[...], o_ref, lse_ref,
+                       causal)
 
 
 def _flash_fwd(
@@ -282,6 +320,7 @@ def _flash_fwd(
     causal: bool = True,
     window: int = 0,
     stream: Optional[bool] = None,
+    q_offset: int = 0,
 ) -> Tuple[jax.Array, jax.Array]:
     b, h, s, d = q.shape
     h_kv, s_kv = k.shape[1], k.shape[2]
@@ -298,6 +337,7 @@ def _flash_fwd(
         has_segments=seg is not None,
         causal=causal,
         window=window,
+        q_offset=q_offset,
     )
     out_shape = [
         _sds((bh, s, d), q.dtype, qf),
@@ -308,9 +348,13 @@ def _flash_fwd(
 
         def kv_map(bh_, qi, ki):
             first, last = _stream_k_range(
-                qi, block_q, block_k, causal, window, num_ki
+                qi, block_q, block_k, causal, window, num_ki, q_offset
             )
-            return (kv_row(bh_), jnp.clip(ki, first, last), 0)
+            return (
+                kv_row(bh_),
+                jnp.clip(ki, jnp.minimum(first, last), last),
+                0,
+            )
 
         in_specs = [
             pl.BlockSpec((1, block_q, d), lambda bh_, qi, ki: (bh_, qi, 0)),
@@ -381,7 +425,7 @@ def _flash_fwd(
 
 def _bwd_dq_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-    block_q, block_k, scale, has_segments, causal=True, window=0,
+    block_q, block_k, scale, has_segments, causal=True, window=0, q_offset=0,
 ):
     if has_segments:
         seg_ref, dq_ref = rest
@@ -399,21 +443,27 @@ def _bwd_dq_kernel(
     else:
         num_k_blocks = k_ref.shape[1] // block_k
     first_k_block = (
-        _window_first_k_block(qi, block_q, block_k, window) if window else 0
+        _window_first_k_block(qi, block_q, block_k, window, q_offset)
+        if window
+        else 0
     )
 
     def body(ki, dq):
         k = k_ref[0, pl.ds(ki * block_k, block_k), :]
         v = v_ref[0, pl.ds(ki * block_k, block_k), :]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
-        mask = _band_mask(qi, ki, s.shape, block_q, block_k, causal, window)
+        mask = _band_mask(qi, ki, s.shape, block_q, block_k, causal, window,
+                          q_offset)
         if has_segments:
             seg_k = seg_ref[0, pl.ds(ki * block_k, block_k), :]
             same = seg_q == seg_k.T
             mask = same if mask is None else jnp.logical_and(mask, same)
         if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
-        p = jnp.exp(s - lse)  # [bq, bk]
+        # empty rows (lse == NEG_INF, only in offset-window chunk mode)
+        # must contribute zero: exp(s - lse) would be exp(0) = 1 on
+        # their masked entries
+        p = jnp.where(lse <= NEG_INF / 2, 0.0, jnp.exp(s - lse))
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = (p * (dp - delta)).astype(k.dtype)
         return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
@@ -427,7 +477,7 @@ def _bwd_dq_kernel(
 
 def _bwd_dq_kernel_stream(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-    block_q, block_k, scale, has_segments, causal, window, num_ki,
+    block_q, block_k, scale, has_segments, causal, window, num_ki, q_offset=0,
 ):
     """Streamed dq: grid (bh, qi, ki); fp32 dq accumulator in scratch."""
     if has_segments:
@@ -436,8 +486,10 @@ def _bwd_dq_kernel_stream(
         dq_ref, dq_acc_ref = rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
-    first, last = _stream_k_range(qi, block_q, block_k, causal, window, num_ki)
-    kf = jnp.clip(ki, first, last)
+    first, last = _stream_k_range(
+        qi, block_q, block_k, causal, window, num_ki, q_offset
+    )
+    kf = jnp.clip(ki, jnp.minimum(first, last), last)
 
     @pl.when(ki == 0)
     def _init():
@@ -452,13 +504,17 @@ def _bwd_dq_kernel_stream(
         k = k_ref[0]
         v = v_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
-        mask = _band_mask(qi, kf, s.shape, block_q, block_k, causal, window)
+        mask = _band_mask(qi, kf, s.shape, block_q, block_k, causal, window,
+                          q_offset)
         if has_segments:
             same = seg_q_ref[0] == seg_k_ref[0].T
             mask = same if mask is None else jnp.logical_and(mask, same)
         if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
-        p = jnp.exp(s - lse)
+        # empty rows (lse == NEG_INF, only in offset-window chunk mode)
+        # must contribute zero: exp(s - lse) would be exp(0) = 1 on
+        # their masked entries
+        p = jnp.where(lse <= NEG_INF / 2, 0.0, jnp.exp(s - lse))
         dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
         ds = (p * (dp - delta)).astype(k.dtype)
         dq_acc_ref[...] = dq_acc_ref[...] + jnp.dot(
@@ -473,7 +529,7 @@ def _bwd_dq_kernel_stream(
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
     block_q, block_k, scale, seq_len, has_segments, causal=True, window=0,
-    group=1,
+    group=1, q_offset=0,
 ):
     """Resident dk/dv: grid (b*h_kv, ki).  Under GQA (group > 1) the
     q/do/lse/delta operands arrive reshaped to [b*h_kv, group*seq, ...] and
@@ -489,16 +545,12 @@ def _bwd_dkv_kernel(
     v = v_ref[0]
     if has_segments:
         seg_k = seg_ref[0, pl.ds(ki * block_k, block_k), :]  # [bk, 1]
-    num_q_blocks = seq_len // block_q
-    # causal: q blocks >= the diagonal only; full mode: every q block
-    first_q_block = ki * block_k // block_q if causal else 0
-    if window:
-        # queries beyond (k_block_end + window - 1) see none of this block
-        # (ki is traced: jnp.minimum, and -(-x // y) is a tracer-safe ceil)
-        num_q_blocks = jnp.minimum(
-            num_q_blocks,
-            -(-((ki + 1) * block_k + window - 1) // block_q),
-        )
+    # shared q-range helper: [first, last] may be empty; fori_loop with
+    # lower >= upper simply runs zero iterations
+    first_q_block, last_q_block = _stream_q_range(
+        ki, block_q, block_k, causal, window, seq_len // block_q, q_offset
+    )
+    num_q_blocks = last_q_block + 1
 
     def make_body(g):
         base = g * seq_len
@@ -513,14 +565,18 @@ def _bwd_dkv_kernel(
             lse = lse_ref[0, pl.ds(base + qi * block_q, block_q), :]
             delta = delta_ref[0, pl.ds(base + qi * block_q, block_q), :]
             s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
-            mask = _band_mask(qi, ki, s.shape, block_q, block_k, causal, window)
+            mask = _band_mask(qi, ki, s.shape, block_q, block_k, causal, window,
+                              q_offset)
             if has_segments:
                 seg_q = seg_ref[0, pl.ds(qi * block_q, block_q), :]
                 same = seg_q == seg_k.T
                 mask = same if mask is None else jnp.logical_and(mask, same)
             if mask is not None:
                 s = jnp.where(mask, s, NEG_INF)
-            p = jnp.exp(s - lse)
+            # empty rows (lse == NEG_INF, only in offset-window chunk mode)
+            # must contribute zero: exp(s - lse) would be exp(0) = 1 on
+            # their masked entries
+            p = jnp.where(lse <= NEG_INF / 2, 0.0, jnp.exp(s - lse))
             dv = dv + jnp.dot(
                 p.astype(do.dtype).T, do, preferred_element_type=jnp.float32
             )
@@ -545,6 +601,7 @@ def _bwd_dkv_kernel(
 def _bwd_dkv_kernel_stream(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
     block_q, block_k, scale, has_segments, causal, window, group, num_qi,
+    q_offset=0,
 ):
     """Streamed dk/dv: grid (b*h_kv, ki, g, qi).  The index maps feed the
     (g, qi) walk one [block_q, ...] tile at a time; dk/dv accumulate in fp32
@@ -556,8 +613,10 @@ def _bwd_dkv_kernel_stream(
     ki = pl.program_id(1)
     g = pl.program_id(2)
     qi = pl.program_id(3)
-    first_q, last_q = _stream_q_range(ki, block_q, block_k, causal, window, num_qi)
-    qf = jnp.clip(qi, first_q, last_q)
+    first_q, last_q = _stream_q_range(
+        ki, block_q, block_k, causal, window, num_qi, q_offset
+    )
+    qf = jnp.clip(qi, first_q, jnp.maximum(last_q, first_q))
 
     @pl.when((g == 0) & (qi == 0))
     def _init():
@@ -573,13 +632,17 @@ def _bwd_dkv_kernel_stream(
         lse = lse_ref[0]
         delta = delta_ref[0]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
-        mask = _band_mask(qf, ki, s.shape, block_q, block_k, causal, window)
+        mask = _band_mask(qf, ki, s.shape, block_q, block_k, causal, window,
+                          q_offset)
         if has_segments:
             same = seg_q_ref[0] == seg_k_ref[0].T
             mask = same if mask is None else jnp.logical_and(mask, same)
         if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
-        p = jnp.exp(s - lse)
+        # empty rows (lse == NEG_INF, only in offset-window chunk mode)
+        # must contribute zero: exp(s - lse) would be exp(0) = 1 on
+        # their masked entries
+        p = jnp.where(lse <= NEG_INF / 2, 0.0, jnp.exp(s - lse))
         dv_acc_ref[...] = dv_acc_ref[...] + jnp.dot(
             p.astype(do.dtype).T, do, preferred_element_type=jnp.float32
         )
@@ -599,6 +662,7 @@ def _bwd_dkv_kernel_stream(
 def _flash_bwd(
     q, k, v, seg, out, lse, do, *, block_q, block_k, interpret,
     causal=True, window=0, dlse=None, stream: Optional[bool] = None,
+    q_offset: int = 0,
 ):
     b, h, s, d = q.shape
     h_kv, s_kv = k.shape[1], k.shape[2]
@@ -630,9 +694,13 @@ def _flash_bwd(
 
         def kv_map(bh_, qi, ki):
             first, last = _stream_k_range(
-                qi, block_q, block_k, causal, window, num_ki
+                qi, block_q, block_k, causal, window, num_ki, q_offset
             )
-            return (kv_row(bh_), jnp.clip(ki, first, last), 0)
+            return (
+                kv_row(bh_),
+                jnp.clip(ki, jnp.minimum(first, last), last),
+                0,
+            )
 
         in_specs = [
             pl.BlockSpec((1, block_q, d), lambda bh_, qi, ki: (bh_, qi, 0)),
@@ -664,6 +732,7 @@ def _flash_bwd(
                 causal=causal,
                 window=window,
                 num_ki=num_ki,
+                q_offset=q_offset,
             ),
             grid=(bh, s // block_q, num_ki),
             in_specs=in_specs,
@@ -698,6 +767,7 @@ def _flash_bwd(
                 has_segments=has_segments,
                 causal=causal,
                 window=window,
+                q_offset=q_offset,
             ),
             grid=(bh, s // block_q),
             in_specs=in_specs,
@@ -725,9 +795,9 @@ def _flash_bwd(
 
         def qi_clip(ki, qi):
             first_q, last_q = _stream_q_range(
-                ki, block_q, block_k, causal, window, num_qi
+                ki, block_q, block_k, causal, window, num_qi, q_offset
             )
-            return jnp.clip(qi, first_q, last_q)
+            return jnp.clip(qi, first_q, jnp.maximum(last_q, first_q))
 
         def q_map(bkv_, ki, g, qi):
             return (q_row(bkv_, g), qi_clip(ki, qi), 0)
@@ -766,6 +836,7 @@ def _flash_bwd(
                 window=window,
                 group=group,
                 num_qi=num_qi,
+                q_offset=q_offset,
             ),
             grid=(b_kv, s_kv // block_k, group, num_qi),
             in_specs=in_specs,
@@ -808,6 +879,7 @@ def _flash_bwd(
                 causal=causal,
                 window=window,
                 group=group,
+                q_offset=q_offset,
             ),
             grid=(b_kv, s_kv // block_k),
             in_specs=in_specs,
@@ -892,29 +964,36 @@ def _flash_attention_bhsd(q, k, v, seg, block_q, block_k, interpret, window=0,
 # --- chunk attention for ring/sequence parallelism ---------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _chunk_attention_bhsd(q, k, v, causal, block_q, block_k, interpret, stream):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _chunk_attention_bhsd(
+    q, k, v, causal, block_q, block_k, interpret, stream, window, q_offset
+):
     return _flash_fwd(
         q, k, v, None, block_q=block_q, block_k=block_k,
         interpret=interpret, causal=causal, stream=stream,
+        window=window, q_offset=q_offset,
     )
 
 
-def _chunk_fwd(q, k, v, causal, block_q, block_k, interpret, stream):
+def _chunk_fwd(q, k, v, causal, block_q, block_k, interpret, stream, window,
+               q_offset):
     out, lse = _flash_fwd(
         q, k, v, None, block_q=block_q, block_k=block_k,
         interpret=interpret, causal=causal, stream=stream,
+        window=window, q_offset=q_offset,
     )
     return (out, lse), (q, k, v, out, lse)
 
 
-def _chunk_bwd(causal, block_q, block_k, interpret, stream, residuals, cotangents):
+def _chunk_bwd(causal, block_q, block_k, interpret, stream, window, q_offset,
+               residuals, cotangents):
     q, k, v, out, lse = residuals
     do, dlse = cotangents
     dq, dk, dv = _flash_bwd(
         q, k, v, None, out, lse, do,
         block_q=block_q, block_k=block_k, interpret=interpret,
         causal=causal, dlse=dlse, stream=stream,
+        window=window, q_offset=q_offset,
     )
     return dq, dk, dv
 
@@ -932,6 +1011,8 @@ def flash_chunk_attention(
     block_k: int = DEFAULT_BLOCK_K,
     interpret: Optional[bool] = None,
     stream: Optional[bool] = None,
+    window: int = 0,
+    q_offset: int = 0,
 ) -> Tuple[jax.Array, jax.Array]:
     """One flash-attention partial over a K/V chunk, for ring combining.
 
@@ -946,6 +1027,13 @@ def flash_chunk_attention(
     ``causal=True`` is the diagonal chunk of a sequence-sharded causal
     attention (q and k index the same positions); ``causal=False`` is a
     fully-visible (strictly-past) chunk.
+
+    ``window``/``q_offset`` (both static) add a sliding-window band:
+    query i (global position ``q_offset + i`` relative to the chunk's keys)
+    sees key j iff ``q_offset + i - j < window``.  Ring attention passes
+    ``q_offset = j_back * local_seq`` for the chunk ``j_back`` ranks behind
+    — rows whose window misses the whole chunk come back as empty partials
+    (out 0, lse NEG_INF), which :func:`combine_chunks` weights to zero.
     """
     if q.shape[2] % k.shape[2] != 0:
         raise ValueError(
@@ -970,7 +1058,9 @@ def flash_chunk_attention(
             stacklevel=2,
         )
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
-    out, lse = _chunk_attention_bhsd(qt, kt, vt, causal, bq, bk, interpret, stream)
+    out, lse = _chunk_attention_bhsd(
+        qt, kt, vt, causal, bq, bk, interpret, stream, window, q_offset
+    )
     return out.transpose(0, 2, 1, 3), lse
 
 

@@ -34,6 +34,7 @@ def ring_attention(
     *,
     axis_name: str = "seq",
     use_checkpoint: bool = True,
+    window: int = 0,
 ) -> jax.Array:
     """Causal ring attention on seq-sharded [batch, local_seq, heads, hd].
 
@@ -41,6 +42,8 @@ def ring_attention(
     the local output chunk.  ``use_checkpoint`` remats the per-step combine
     so the backward pass replays the ring instead of storing every rotated
     K/V chunk (keeps the O(seq/n) memory promise under autodiff).
+    ``window > 0`` adds Mistral-style sliding-window masking on the global
+    positions (query t sees keys in (t - window, t] only).
     """
     n_chunks = lax.psum(1, axis_name)
     my_chunk = lax.axis_index(axis_name)
@@ -63,6 +66,11 @@ def ring_attention(
         q_pos = my_chunk * local_s + lax.broadcasted_iota(jnp.int32, s.shape, 2)
         k_pos = src_chunk * local_s + lax.broadcasted_iota(jnp.int32, s.shape, 3)
         mask = q_pos >= k_pos
+        if window:
+            # positions here are global, so the band needs no per-chunk
+            # offset bookkeeping — the flash ring path encodes the same
+            # geometry statically via flash_chunk_attention's q_offset
+            mask = jnp.logical_and(mask, q_pos - k_pos < window)
         s = jnp.where(mask, s, NEG_INF)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         # a fully-masked row keeps m == NEG_INF; exp(s - m) would be exp(0)=1
@@ -153,6 +161,7 @@ def ring_flash_attention(
     block_k: int = 512,
     interpret: Optional[bool] = None,
     use_checkpoint: bool = True,
+    window: int = 0,
 ) -> jax.Array:
     """Ring attention with the per-chunk math on the Pallas flash kernels.
 
@@ -171,6 +180,15 @@ def ring_flash_attention(
     cotangent of :func:`combine_chunks` folds into the backward delta —
     and ``use_checkpoint`` remats each step so rotated K/V chunks are not
     stored (same memory contract as :func:`ring_attention`).
+
+    ``window > 0`` adds sliding-window masking.  The kernel band geometry is
+    static, but a q chunk sits ``j * local_seq`` positions after the chunk
+    held at ring step ``j`` — a *static* offset per step-distance — so each
+    held chunk dispatches through ``lax.switch`` on ``my - src``: diagonal
+    (causal + window), one branch per partially-visible back-distance
+    (``q_offset = j * local_seq``), and skip for chunks the window misses
+    entirely (which also skips their kernels' FLOPs, keeping the
+    O(seq * window) compute promise).
     """
     from tpu_parallel.ops.flash_attention import flash_chunk_attention
 
@@ -184,10 +202,29 @@ def ring_flash_attention(
 
         def diag(_):
             o, s = flash_chunk_attention(
-                q, k_cur, v_cur, causal=True,
+                q, k_cur, v_cur, causal=True, window=window,
                 block_q=block_q, block_k=block_k, interpret=interpret,
             )
             return o.astype(jnp.float32), s
+
+        def back(j):
+            # chunk j ranks back: its keys start j*local_s before our
+            # queries.  Fully inside the window -> plain full kernel;
+            # straddling the band edge -> windowed kernel with the static
+            # offset
+            offset = j * local_s
+            fully_visible = offset + local_s - 1 < window
+
+            def run(_):
+                o, s = flash_chunk_attention(
+                    q, k_cur, v_cur, causal=False,
+                    window=0 if fully_visible else window,
+                    q_offset=0 if fully_visible else offset,
+                    block_q=block_q, block_k=block_k, interpret=interpret,
+                )
+                return o.astype(jnp.float32), s
+
+            return run
 
         def full(_):
             o, s = flash_chunk_attention(
@@ -208,12 +245,25 @@ def ring_flash_attention(
                 pvary_missing(empty, vma_of(q)),
             )
 
-        o_c, lse_c = lax.cond(
-            src_chunk == my_chunk,
-            diag,
-            lambda op: lax.cond(src_chunk < my_chunk, full, skip, op),
-            None,
-        )
+        if window:
+            # chunks more than max_back ranks back are fully out of window:
+            # chunk j's closest (q, k) pair sits (j-1)*local_s + 1 apart, so
+            # it contributes iff (j-1)*local_s + 1 < window
+            # <=> j <= ceil((window - 1) / local_s)
+            max_back = min(n_chunks - 1, -(-(window - 1) // local_s))
+            branches = [diag] + [back(j) for j in range(1, max_back + 1)] + [skip]
+            j_back = my_chunk - src_chunk  # < 0: future chunk (skip)
+            idx = jnp.where(
+                j_back < 0, max_back + 1, jnp.minimum(j_back, max_back + 1)
+            )
+            o_c, lse_c = lax.switch(idx, branches, None)
+        else:
+            o_c, lse_c = lax.cond(
+                src_chunk == my_chunk,
+                diag,
+                lambda op: lax.cond(src_chunk < my_chunk, full, skip, op),
+                None,
+            )
         return combine_chunks(out, lse, o_c, lse_c)
 
     if use_checkpoint:
