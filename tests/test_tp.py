@@ -298,3 +298,49 @@ def test_tp_training_grads_match_dense(mesh_data4_model2, rng):
         list(np.asarray(g["down"]["shard"]["sharded"]["kernel"].value)), axis=0
     )
     np.testing.assert_allclose(got_dn, np.asarray(tg["down"]), rtol=1e-4, atol=1e-6)
+
+
+def test_vocab_parallel_ce_matches_gathered(mesh_data4_model2, rng):
+    """vocab_parallel_cross_entropy on column-sharded logits == plain CE +
+    argmax on the gathered logits, for loss AND gradients."""
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_parallel.core.losses import vocab_parallel_cross_entropy
+
+    b, s, v = 2, 8, 64
+    logits = jax.random.normal(rng, (b, s, v), jnp.float32)
+    targets = jax.random.randint(jax.random.PRNGKey(1), (b, s), 0, v)
+
+    def sharded_loss(full_logits):
+        def body(full, t):
+            # slice this rank's vocab shard, exactly as a column-parallel
+            # lm_head would produce it
+            shard = tp.split_over_axis(full, "model", axis=-1)
+            ce, pred = vocab_parallel_cross_entropy(shard, t, "model")
+            return ce, pred
+
+        return jax.shard_map(
+            body, mesh=mesh_data4_model2,
+            in_specs=(P(), P()), out_specs=(P(), P()),
+        )(full_logits, targets)
+
+    ce_tp, pred_tp = jax.jit(sharded_loss)(logits)
+    ce_ref = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    np.testing.assert_allclose(
+        np.asarray(ce_tp), np.asarray(ce_ref), rtol=1e-5, atol=1e-5
+    )
+    np.testing.assert_array_equal(
+        np.asarray(pred_tp), np.asarray(logits.argmax(-1))
+    )
+
+    g_tp = jax.jit(jax.grad(lambda l: sharded_loss(l)[0].sum()))(logits)
+    g_ref = jax.grad(
+        lambda l: optax.softmax_cross_entropy_with_integer_labels(
+            l, targets
+        ).sum()
+    )(logits)
+    np.testing.assert_allclose(
+        np.asarray(g_tp), np.asarray(g_ref), rtol=1e-5, atol=1e-5
+    )

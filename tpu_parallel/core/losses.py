@@ -34,6 +34,48 @@ def token_cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:
     )
 
 
+def vocab_parallel_cross_entropy(
+    logits: jax.Array, targets: jax.Array, axis_name: str
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-token CE on vocab-sharded logits — no full-vocab gather, ever.
+
+    ``logits`` [..., vocab/tp] is this rank's column-parallel lm_head shard
+    (shard i owns the contiguous vocab range [i*vs, (i+1)*vs)); ``targets``
+    [...] are global token ids.  Megatron-style: the softmax statistics are
+    assembled from three scalar-per-token collectives over ``axis_name``
+    (pmax of the row max, psum of the shifted sum-of-exp, psum of the
+    owning shard's target logit) — O(batch*seq) communication instead of
+    the O(batch*seq*vocab) all_gather a gathered lm_head needs.
+
+    Returns ``(ce, pred)``: fp32 per-token loss and the global argmax token
+    id (ties across shards break to the lowest id, matching ``argmax``'s
+    first-occurrence convention on gathered logits).
+    """
+    vs = logits.shape[-1]
+    offset = jax.lax.axis_index(axis_name) * vs
+    lf = logits.astype(jnp.float32)  # fuses into the reductions on TPU
+    local_max = lf.max(axis=-1)
+    # stability shift only — lse is invariant to it in exact arithmetic, so
+    # a zero derivative is correct; stopping the *input* keeps AD from ever
+    # tracing pmax (which has no differentiation rule)
+    global_max = jax.lax.pmax(jax.lax.stop_gradient(local_max), axis_name)
+    sum_exp = jnp.exp(lf - global_max[..., None]).sum(axis=-1)
+    lse = global_max + jnp.log(jax.lax.psum(sum_exp, axis_name))
+    # the correct-class logit lives on exactly one shard; fetch via psum
+    t_local = targets - offset
+    owns = (t_local >= 0) & (t_local < vs)
+    safe_idx = jnp.clip(t_local, 0, vs - 1)
+    own_logit = jnp.take_along_axis(lf, safe_idx[..., None], axis=-1)[..., 0]
+    target_logit = jax.lax.psum(jnp.where(owns, own_logit, 0.0), axis_name)
+    ce = lse - target_logit
+    # global argmax: each shard nominates its local winner; the shard(s)
+    # holding the global max win, lowest id on ties
+    local_arg = lf.argmax(axis=-1).astype(jnp.int32) + offset
+    nominee = jnp.where(local_max == global_max, local_arg, jnp.int32(2**31 - 1))
+    pred = jax.lax.pmin(nominee, axis_name)
+    return ce, pred
+
+
 def make_classification_loss(fold_axes: AxisNames = "data") -> Callable:
     """Softmax-CE loss for ``Batch``; dropout rng folded over ``fold_axes``."""
 

@@ -64,15 +64,21 @@ class GPTConfig(TransformerConfig):
     loss_chunk: int = 0
 
 
-def _make_lm_head(cfg: "GPTConfig", name: Optional[str] = "lm_head") -> TPDense:
+def _make_lm_head(
+    cfg: "GPTConfig", name: Optional[str] = "lm_head", gather: bool = True
+) -> TPDense:
     """The vocab projection — one definition for the in-model call and the
-    standalone per-chunk apply in :func:`make_gpt_loss` (``name=None``; the
-    loss binds it directly to ``params["lm_head"]``)."""
+    standalone apply in :func:`make_gpt_loss` (``name=None``; the loss binds
+    it directly to ``params["lm_head"]``).  The loss path passes
+    ``gather=False``: logits stay column-sharded over the model axis and CE
+    runs vocab-parallel (``core.losses.vocab_parallel_cross_entropy``) —
+    the public model surface keeps full-vocab logits for generation/interop.
+    The parameter tree is identical either way."""
     return TPDense(
         features=cfg.vocab_size,
         axis_name=cfg.model_axis,
         style="column",
-        gather_output=True,
+        gather_output=gather,
         use_bias=False,
         dtype=cfg.dtype,
         name=name,
@@ -180,20 +186,45 @@ def make_gpt_loss(config: GPTConfig, train: bool = True):
     counts are masked to the last pipe rank (the only rank with real logits).
     ``train=False`` builds the evaluation variant (dropout off).
 
-    With ``config.loss_chunk > 0`` the model returns final hidden states and
-    the lm_head + CE run ``loss_chunk`` sequence positions at a time under a
-    rematerialized ``lax.scan`` — the full [B, S, vocab] logits tensor never
-    materializes (see ``GPTConfig.loss_chunk``).
+    The lm_head is applied here, not in the model: logits stay column-
+    sharded over the model axis and CE runs vocab-parallel — under TP the
+    full-vocab [B, S, vocab] logits tensor never materializes and the
+    per-microbatch all_gather (the largest TP collective) disappears;
+    the softmax statistics cost three O(B*S) scalar collectives instead.
+
+    With ``config.loss_chunk > 0`` the lm_head + CE additionally run
+    ``loss_chunk`` sequence positions at a time under a rematerialized
+    ``lax.scan`` — even the vocab-*sharded* logits never exist at full
+    sequence length (see ``GPTConfig.loss_chunk``).
     """
+    from tpu_parallel.core.losses import vocab_parallel_cross_entropy
+    from tpu_parallel.parallel.tp import axis_size_or_none
+
     fold_axes = (
         config.data_axis, config.model_axis, config.pipe_axis, config.seq_axis
     )
     chunk = config.loss_chunk
-    head = _make_lm_head(config, name=None) if chunk else None
+    head = _make_lm_head(config, name=None, gather=False)
+
+    def ce_block(params, h, targets, mask):
+        """lm_head + CE + accuracy on one block of hidden states; returns
+        (loss_sum, correct_sum).  Vocab-parallel when the model axis is
+        bound (mesh path), plain CE on full logits otherwise."""
+        logits = head.apply({"params": params["lm_head"]}, h)
+        if axis_size_or_none(config.model_axis) is not None:
+            ce, pred = vocab_parallel_cross_entropy(
+                logits, targets, config.model_axis
+            )
+        else:
+            ce = token_cross_entropy(logits, targets)
+            pred = logits.argmax(-1)
+        loss_sum = (ce * mask).sum()
+        correct = ((pred == targets) * mask).sum()
+        return loss_sum, correct
 
     def chunked_ce(params, h, targets, mask):
-        """scan over sequence chunks of the lm_head + CE; returns
-        (loss_sum, correct_sum) without materializing full logits."""
+        """scan ce_block over sequence chunks; logits exist only
+        [B, loss_chunk, vocab/tp] at a time."""
         b, s = targets.shape
         if s % chunk != 0:
             raise ValueError(f"seq_len={s} not divisible by loss_chunk={chunk}")
@@ -203,15 +234,12 @@ def make_gpt_loss(config: GPTConfig, train: bool = True):
         ms = mask.reshape(b, n, chunk).transpose(1, 0, 2)
 
         def body(carry, xs):
-            h_i, t_i, m_i = xs
-            logits = head.apply({"params": params["lm_head"]}, h_i)
-            ce = token_cross_entropy(logits, t_i) * m_i
-            correct = ((logits.argmax(-1) == t_i) * m_i).sum()
-            return (carry[0] + ce.sum(), carry[1] + correct), None
+            loss_sum, correct = ce_block(params, *xs)
+            return (carry[0] + loss_sum, carry[1] + correct), None
 
         # promote the zero carry to the body outputs' varying-axes type (the
-        # hidden states' axes plus the model axis, which the lm_head's
-        # gather_output all_gather introduces) so the scan type-checks under
+        # hidden states' axes plus the model axis, which the CE's psums over
+        # the sharded vocab introduce) so the scan type-checks under
         # shard_map's replication checker
         from tpu_parallel.core.metrics import pvary_missing, vma_of
 
@@ -232,11 +260,11 @@ def make_gpt_loss(config: GPTConfig, train: bool = True):
             segment_ids=None if config.pipe_size > 1 else batch.segment_ids,
             train=train,
             rngs={"dropout": dropout_rng},
-            hidden_only=chunk > 0,
+            hidden_only=True,
         )
         aux_loss = 0.0
         if config.moe_experts > 0:
-            logits, mods = apply_fn(
+            hidden, mods = apply_fn(
                 {"params": params}, batch.tokens, mutable=["losses"], **apply_kwargs
             )
             sown = jax.tree_util.tree_leaves(mods.get("losses", {}))
@@ -259,8 +287,7 @@ def make_gpt_loss(config: GPTConfig, train: bool = True):
                     denom = config.n_layers
                 aux_loss = sum(jnp.sum(leaf) for leaf in sown) / denom
         else:
-            logits = apply_fn({"params": params}, batch.tokens, **apply_kwargs)
-        # (with loss_chunk, ``logits`` holds the final hidden states instead)
+            hidden = apply_fn({"params": params}, batch.tokens, **apply_kwargs)
         mask = (
             batch.loss_mask
             if batch.loss_mask is not None
@@ -270,10 +297,9 @@ def make_gpt_loss(config: GPTConfig, train: bool = True):
             mask = mask * pp.last_stage_mask(config.pipe_axis)
         n_tok = mask.sum()
         if chunk:
-            loss_sum, correct = chunked_ce(params, logits, batch.targets, mask)
+            loss_sum, correct = chunked_ce(params, hidden, batch.targets, mask)
         else:
-            loss_sum = (token_cross_entropy(logits, batch.targets) * mask).sum()
-            correct = ((logits.argmax(-1) == batch.targets) * mask).sum()
+            loss_sum, correct = ce_block(params, hidden, batch.targets, mask)
         metrics: Metrics = {
             "loss": (loss_sum, n_tok),
             "accuracy": (correct.astype(jnp.float32), n_tok),

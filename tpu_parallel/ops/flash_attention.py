@@ -172,6 +172,22 @@ def _use_stream(s_kv: int, stream: Optional[bool]) -> bool:
     return s_kv > STREAM_SEQ_THRESHOLD if stream is None else bool(stream)
 
 
+def _stream_kv_map(kv_row, block_q, block_k, causal, window, num_ki, q_offset):
+    """Index map for streamed K/V (and seg-k) BlockSpecs on a (bh, qi, ki)
+    grid: clamps ki into the needed range so out-of-band grid steps re-map
+    to an already-fetched block (no DMA).  ONE builder shared by the forward
+    and dq kernels' pallas_calls — their fetch patterns must agree with the
+    kernels' _stream_k_range compute predicate."""
+
+    def kv_map(bh_, qi, ki):
+        first, last = _stream_k_range(
+            qi, block_q, block_k, causal, window, num_ki, q_offset
+        )
+        return (kv_row(bh_), jnp.clip(ki, jnp.minimum(first, last), last), 0)
+
+    return kv_map
+
+
 # --- forward kernels ----------------------------------------------------------
 
 
@@ -345,16 +361,9 @@ def _flash_fwd(
     ]
     if _use_stream(s_kv, stream):
         num_ki = s_kv // block_k
-
-        def kv_map(bh_, qi, ki):
-            first, last = _stream_k_range(
-                qi, block_q, block_k, causal, window, num_ki, q_offset
-            )
-            return (
-                kv_row(bh_),
-                jnp.clip(ki, jnp.minimum(first, last), last),
-                0,
-            )
+        kv_map = _stream_kv_map(
+            kv_row, block_q, block_k, causal, window, num_ki, q_offset
+        )
 
         in_specs = [
             pl.BlockSpec((1, block_q, d), lambda bh_, qi, ki: (bh_, qi, 0)),
@@ -691,16 +700,9 @@ def _flash_bwd(
     # ---- dq ----
     if streamed:
         num_ki = s_kv // block_k
-
-        def kv_map(bh_, qi, ki):
-            first, last = _stream_k_range(
-                qi, block_q, block_k, causal, window, num_ki, q_offset
-            )
-            return (
-                kv_row(bh_),
-                jnp.clip(ki, jnp.minimum(first, last), last),
-                0,
-            )
+        kv_map = _stream_kv_map(
+            kv_row, block_q, block_k, causal, window, num_ki, q_offset
+        )
 
         in_specs = [
             pl.BlockSpec((1, block_q, d), lambda bh_, qi, ki: (bh_, qi, 0)),
