@@ -9,9 +9,10 @@ against the cached prefix only — O(seq) per generated token instead of the
 O(seq^2) of re-running the full forward.
 
 Works for MHA and GQA, learned and RoPE positions, scan and unrolled layer
-stacks.  TP meshes work by wrapping :func:`generate` in ``shard_map`` (the
-cache shards over heads exactly as activations do).  Pipeline-parallel
-decoding is not supported.
+stacks.  Mesh serving goes through :func:`generate_sharded`: TP shards the
+cache over heads exactly as activations; pipeline meshes decode via the
+ring pass in :func:`tpu_parallel.parallel.pp.execute_pipeline_decode`
+(per-stage KV caches, writes gated to the owning tick).
 """
 
 from __future__ import annotations
@@ -161,11 +162,12 @@ def generate_sharded(
     """Generate under a mesh: TP-split weights stay split, batch shards DP.
 
     The serving path for states whose weights live on multiple devices
-    (``export_single_device_params`` refuses tp degree > 1 by design).  Runs
-    the same prefill + decode scan inside one ``shard_map``: the KV cache
-    shards over heads exactly as activations do, TP collectives run per
-    decode step, and each data shard generates its rows.  Pipeline-parallel
-    decode is not supported (the model raises).
+    (``export_single_device_params`` refuses tp/pipe degree > 1 by design).
+    Runs the same prefill + decode scan inside one ``shard_map``: the KV
+    cache shards over heads exactly as activations do, TP collectives run
+    per decode step, each data shard generates its rows, and pipe meshes
+    run each forward as a ring pass over the stages (interleaved-schedule
+    models excepted — the model raises).
 
     ``params`` is the (possibly ``nn.Partitioned``-boxed) params tree from a
     mesh init/training state; ``param_specs`` defaults to its partition
@@ -248,9 +250,10 @@ def _sharded_generate_fn(
             mesh=mesh,
             in_specs=(param_specs, batch_spec, P()),
             out_specs=batch_spec,
-            # sampled tokens are replicated over the model axis by
+            # sampled tokens are replicated over the model and pipe axes by
             # construction (every TP rank computes identical full logits
-            # after the lm_head gather); the checker cannot prove it
+            # after the lm_head gather; the decode ring psum-broadcasts over
+            # pipe); the checker cannot prove it
             check_vma=False,
         )
     )

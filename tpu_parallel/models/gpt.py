@@ -101,8 +101,6 @@ class GPTLM(nn.Module):
         hidden_only: bool = False,
     ) -> jax.Array:
         cfg = self.config
-        if decode and cfg.pipe_size > 1:
-            raise NotImplementedError("incremental decoding under pipeline parallelism")
         if decode and positions is None:
             # default decode positions from a model-level step counter, so
             # learned positional embeddings see global positions (Attention
@@ -148,7 +146,7 @@ class GPTLM(nn.Module):
                     "branches would sow mismatched loss collections)"
                 )
             layers_per_chunk = cfg.n_layers // chunks
-            x = pp.PipelineModule(
+            pipeline = pp.PipelineModule(
                 stage_fn=functools.partial(BlockStack, cfg, layers_per_chunk),
                 num_microbatches=cfg.num_microbatches,
                 axis_name=cfg.pipe_axis,
@@ -157,7 +155,24 @@ class GPTLM(nn.Module):
                 pass_validity=True,
                 interleave=cfg.pipe_interleave,
                 name="pipeline",
-            )(x, train=train)
+            )
+            if decode:
+                from tpu_parallel.parallel.tp import axis_size_or_none
+
+                if axis_size_or_none(cfg.pipe_axis) is None:
+                    # fail clearly here — otherwise the ring's collectives
+                    # die on an unbound-axis error deep in JAX
+                    raise ValueError(
+                        f"pipe_size={cfg.pipe_size} decoding needs the "
+                        f"{cfg.pipe_axis!r} mesh axis bound: serve through "
+                        "generate_sharded under the training mesh (plain "
+                        "generate()/generate_beam() run without a mesh)"
+                    )
+                # ring decode (pp.execute_pipeline_decode): positions ride
+                # through directly — no scan, so traced kwargs are fine
+                x = pipeline(x, train=train, decode=True, positions=positions)
+            else:
+                x = pipeline(x, train=train)
         else:
             x = BlockStack(cfg, cfg.n_layers, name="blocks")(
                 x,

@@ -236,6 +236,7 @@ class Attention(nn.Module):
         segment_ids: Optional[jax.Array] = None,
         train: bool = True,
         decode: bool = False,
+        cache_valid: Optional[jax.Array] = None,
     ) -> jax.Array:
         cfg = self.config
         tp_size = axis_size_or_none(cfg.model_axis) or 1
@@ -351,6 +352,15 @@ class Attention(nn.Module):
             q = apply_rope(q, positions, cfg.rope_theta)
             k = apply_rope(k, positions, cfg.rope_theta)
         if decode:
+            # cache_valid gates persistence (pipeline decode: only the rank
+            # whose tick this is may commit writes — other ranks run the
+            # same program on garbage activations and must leave their cache
+            # untouched).  The attention read uses the fresh buffers either
+            # way; invalid ticks' outputs are discarded downstream.
+            if cache_valid is None:
+                keep = lambda new, old: new
+            else:
+                keep = lambda new, old: jnp.where(cache_valid, new, old)
             if quant_cache:
                 from tpu_parallel.models.quantize import absmax_int8
 
@@ -359,17 +369,17 @@ class Attention(nn.Module):
                 upd = lambda buf, new: lax.dynamic_update_slice_in_dim(
                     buf, new, idx, axis=1
                 )
-                cached_k.value = upd(cached_k.value, kq)
-                cached_v.value = upd(cached_v.value, vq)
-                cached_k_scale.value = upd(cached_k_scale.value, ks)
-                cached_v_scale.value = upd(cached_v_scale.value, vs)
+                new_k = upd(cached_k.value, kq)
+                new_v = upd(cached_v.value, vq)
+                new_ks = upd(cached_k_scale.value, ks)
+                new_vs = upd(cached_v_scale.value, vs)
+                cached_k.value = keep(new_k, cached_k.value)
+                cached_v.value = keep(new_v, cached_v.value)
+                cached_k_scale.value = keep(new_ks, cached_k_scale.value)
+                cached_v_scale.value = keep(new_vs, cached_v_scale.value)
                 # dequantize transiently for this layer's attention read
-                k_all = (
-                    cached_k.value.astype(jnp.float32) * cached_k_scale.value
-                ).astype(cfg.dtype)
-                v_all = (
-                    cached_v.value.astype(jnp.float32) * cached_v_scale.value
-                ).astype(cfg.dtype)
+                k_all = (new_k.astype(jnp.float32) * new_ks).astype(cfg.dtype)
+                v_all = (new_v.astype(jnp.float32) * new_vs).astype(cfg.dtype)
             else:
                 k_all = lax.dynamic_update_slice_in_dim(
                     cached_k.value, k, idx, axis=1
@@ -377,8 +387,9 @@ class Attention(nn.Module):
                 v_all = lax.dynamic_update_slice_in_dim(
                     cached_v.value, v, idx, axis=1
                 )
-                cached_k.value, cached_v.value = k_all, v_all
-            cache_index.value = idx + x.shape[1]
+                cached_k.value = keep(k_all, cached_k.value)
+                cached_v.value = keep(v_all, cached_v.value)
+            cache_index.value = keep(idx + x.shape[1], idx)
             # decode_attention contracts grouped queries against the
             # kv-width cache directly — no K/V expansion
             out = decode_attention(q, k_all, v_all, positions, window=cfg.attn_window)
@@ -537,6 +548,7 @@ class Block(nn.Module):
         train: bool = True,
         decode: bool = False,
         aux_scale: Optional[jax.Array] = None,
+        cache_valid: Optional[jax.Array] = None,
     ) -> jax.Array:
         cfg = self.config
         if decode and cfg.moe_experts > 0 and cfg.moe_router == "expert_choice":
@@ -554,6 +566,7 @@ class Block(nn.Module):
             segment_ids=segment_ids,
             train=train,
             decode=decode,
+            cache_valid=cache_valid,
         )
         h = make_norm(cfg, "norm_mlp")(x).astype(cfg.dtype)
         if cfg.moe_experts > 0:
@@ -567,7 +580,7 @@ class Block(nn.Module):
 
 class _ScanBlock(nn.Module):
     """nn.scan target: one Block per tick, carrying (x, positions, segment_ids,
-    aux_scale)."""
+    aux_scale, cache_valid)."""
 
     config: TransformerConfig
     train: bool
@@ -575,7 +588,7 @@ class _ScanBlock(nn.Module):
 
     @nn.compact
     def __call__(self, carry, _):
-        x, positions, segment_ids, aux_scale = carry
+        x, positions, segment_ids, aux_scale, cache_valid = carry
         x = Block(self.config, name="block")(
             x,
             positions=positions,
@@ -583,8 +596,9 @@ class _ScanBlock(nn.Module):
             train=self.train,
             decode=self.decode,
             aux_scale=aux_scale,
+            cache_valid=cache_valid,
         )
-        return (x, positions, segment_ids, aux_scale), None
+        return (x, positions, segment_ids, aux_scale, cache_valid), None
 
 
 class BlockStack(nn.Module):
@@ -608,6 +622,7 @@ class BlockStack(nn.Module):
         train: bool = True,
         decode: bool = False,
         aux_scale: Optional[jax.Array] = None,
+        cache_valid: Optional[jax.Array] = None,
     ) -> jax.Array:
         cfg = self.config
         # prevent_cse=False is safe (and fastest) under scan for plain remat,
@@ -650,7 +665,9 @@ class BlockStack(nn.Module):
                 length=self.n_layers,
                 metadata_params={nn.PARTITION_NAME: None},
             )(cfg, train, decode, name="layers")
-            (x, _, _, _), _ = stacked((x, positions, segment_ids, aux_scale), None)
+            (x, _, _, _, _), _ = stacked(
+                (x, positions, segment_ids, aux_scale, cache_valid), None
+            )
         else:
             # static_argnums: train/decode are Python bools branching the
             # trace (self=0, x=1, positions=2, segment_ids=3, train=4,
@@ -663,7 +680,8 @@ class BlockStack(nn.Module):
             )
             for i in range(self.n_layers):
                 x = block_cls(cfg, name=f"layer_{i}")(
-                    x, positions, segment_ids, train, decode, aux_scale
+                    x, positions, segment_ids, train, decode, aux_scale,
+                    cache_valid,
                 )
         return x
 

@@ -377,6 +377,52 @@ class _ScanWrapper(nn.Module):
         )
 
 
+@jax.named_scope("execute_pipeline_decode")
+def execute_pipeline_decode(
+    module: nn.Module,
+    x: jax.Array,
+    *,
+    axis_name: str,
+    **kwargs,
+) -> jax.Array:
+    """One batch's trip around the pipe ring for incremental decoding.
+
+    No microbatch schedule: the (replicated) input enters rank 0, each tick
+    every rank applies its stage (SPMD — ranks off their tick compute on
+    garbage) and the activations rotate ``+1``; after ``num_stages`` ticks
+    the last rank holds the result, which a psum broadcasts so every rank
+    returns identical hidden states (sampling must agree across ranks).
+
+    Cache discipline: the stage receives ``cache_valid`` — true only on the
+    rank whose tick it is — and
+    :class:`~tpu_parallel.models.layers.Attention` commits KV-cache writes
+    (and the index advance) only then, so each stage's cache reflects
+    exactly the real activation's pass.  ``kwargs`` (positions, train,
+    decode) pass straight through to the stage — no scan, so traced values
+    are fine.
+    """
+    num_stages = lax.psum(1, axis_name)
+    stage_idx = lax.axis_index(axis_name)
+    from tpu_parallel.core.metrics import pvary_missing
+
+    # ppermute output varies over the pipe axis; enter the loop that way
+    act = pvary_missing(x, (axis_name,))
+    final = jnp.zeros_like(act)
+    for t in range(num_stages):  # static: pipe degree is a mesh constant
+        out = module(act, cache_valid=stage_idx == t, **kwargs)
+        if t == num_stages - 1:
+            final = jnp.where(
+                stage_idx == num_stages - 1, out, jnp.zeros_like(out)
+            )
+        act = lax.ppermute(
+            out,
+            axis_name,
+            perm=[(i, (i + 1) % num_stages) for i in range(num_stages)],
+        )
+    with jax.named_scope("pipeline_decode_broadcast"):
+        return lax.psum(final, axis_name)
+
+
 def last_stage_mask(axis_name: str = "pipe") -> jax.Array:
     """1.0 on the final pipe rank, 0.0 elsewhere.
 
@@ -417,6 +463,19 @@ class PipelineModule(nn.Module):
 
     @nn.compact
     def __call__(self, x: jax.Array, **kwargs) -> jax.Array:
+        if kwargs.get("decode"):
+            if self.interleave > 1:
+                raise NotImplementedError(
+                    "incremental decoding under the interleaved schedule "
+                    "(nn.switch chunks cannot lazily create their KV-cache "
+                    "variables branch-by-branch)"
+                )
+            stage = ModuleShard(
+                module_fn=self.stage_fn, axis_name=self.axis_name, name="stage"
+            )
+            return execute_pipeline_decode(
+                stage, x, axis_name=self.axis_name, **kwargs
+            )
         if self.interleave > 1:
             if self.broadcast_outputs:
                 raise NotImplementedError(
