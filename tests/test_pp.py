@@ -372,3 +372,150 @@ def test_interleaved_pipeline_matches_sequential(rng):
         unbox(g1["embed"]["tok"]["embedding"]),
         rtol=2e-4, atol=1e-6,
     )
+
+
+# --- packed sequences under PP -----------------------------------------------
+
+
+@pytest.mark.parametrize("interleave", [1, 2])
+def test_pp_packed_loss_equals_unpacked(mesh_2x2x2, rng, interleave):
+    """Two length-16 documents packed into one 32-token row (segment ids +
+    restarting positions) produce the same mean loss as the two rows
+    unpacked — attention may not cross the packing boundary, positions must
+    restart, and both must survive the microbatch split + schedule."""
+    import optax  # noqa: F401
+
+    from tpu_parallel.core.state import TextBatch
+    from tpu_parallel.models import GPTLM, make_gpt_loss, tiny_test
+
+    cfg = tiny_test(
+        positional="rope",  # no absolute-slot dependence: packing-invariant
+        pipe_size=2,
+        pipe_interleave=interleave,
+        num_microbatches=2,
+        remat=False,
+        dtype=jnp.float32,
+    )
+    model = GPTLM(cfg)
+    loss_fn = make_gpt_loss(cfg)
+    mesh = mesh_2x2x2
+
+    docs = jax.random.randint(rng, (8, 16), 0, cfg.vocab_size)
+    tgts = jax.random.randint(jax.random.PRNGKey(5), (8, 16), 0, cfg.vocab_size)
+    arange16 = jnp.broadcast_to(jnp.arange(16), (4, 16))
+    packed = TextBatch(
+        tokens=docs.reshape(4, 32),
+        targets=tgts.reshape(4, 32),
+        loss_mask=jnp.ones((4, 32), jnp.float32),
+        positions=jnp.concatenate([arange16, arange16], axis=1),
+        segment_ids=jnp.concatenate(
+            [jnp.zeros((4, 16), jnp.int32), jnp.ones((4, 16), jnp.int32)], axis=1
+        ),
+    )
+    unpacked = TextBatch(
+        tokens=docs,
+        targets=tgts,
+        loss_mask=jnp.ones((8, 16), jnp.float32),
+        positions=jnp.broadcast_to(jnp.arange(16), (8, 16)),
+        segment_ids=None,
+    )
+
+    def init(r, tokens):
+        return model.init({"params": r}, tokens, train=False)["params"]
+
+    probe = jax.shard_map(
+        init, mesh=mesh, in_specs=(P(), P("data")), out_specs=P(),
+        check_vma=False,
+    )
+    specs = nn.get_partition_spec(jax.eval_shape(probe, rng, packed.tokens))
+    params = jax.jit(
+        jax.shard_map(
+            init, mesh=mesh, in_specs=(P(), P("data")), out_specs=specs,
+            check_vma=False,
+        )
+    )(rng, packed.tokens)
+
+    def mean_loss(params, batch, rng_):
+        _, metrics = loss_fn(params, model.apply, batch, rng_)
+        s, c = metrics["loss"]
+        s = jax.lax.psum(s, ("data", "pipe", "model"))
+        c = jax.lax.psum(c, ("data", "pipe", "model"))
+        return s / c
+
+    losses = {}
+    for name, batch in (("packed", packed), ("unpacked", unpacked)):
+        f = jax.jit(
+            jax.shard_map(
+                mean_loss, mesh=mesh, in_specs=(specs, P("data"), P()),
+                out_specs=P(), check_vma=False,
+            )
+        )
+        losses[name] = float(f(params, batch, jax.random.PRNGKey(0)))
+    assert abs(losses["packed"] - losses["unpacked"]) < 2e-4, losses
+
+
+def test_pp_packed_leakage_blocked(mesh_pipe4_data2, rng):
+    """Under PP, perturbing segment 0's tokens must not change segment 1's
+    loss contribution (cross-document attention blocked through the
+    microbatch split and schedule)."""
+    from tpu_parallel.core.state import TextBatch
+    from tpu_parallel.models import GPTLM, make_gpt_loss, tiny_test
+
+    cfg = tiny_test(
+        positional="rope", pipe_size=4, num_microbatches=2, remat=False,
+        dtype=jnp.float32,
+    )
+    model = GPTLM(cfg)
+    loss_fn = make_gpt_loss(cfg)
+    mesh = mesh_pipe4_data2
+
+    tokens = jax.random.randint(rng, (4, 32), 0, cfg.vocab_size)
+    targets = jax.random.randint(jax.random.PRNGKey(5), (4, 32), 0, cfg.vocab_size)
+    arange16 = jnp.broadcast_to(jnp.arange(16), (4, 16))
+    seg = jnp.concatenate(
+        [jnp.zeros((4, 16), jnp.int32), jnp.ones((4, 16), jnp.int32)], axis=1
+    )
+    positions = jnp.concatenate([arange16, arange16], axis=1)
+    # mask the loss to segment 1 only, then perturb segment 0's tokens
+    seg1_mask = (seg == 1).astype(jnp.float32)
+
+    def make_batch(toks):
+        return TextBatch(
+            tokens=toks, targets=targets, loss_mask=seg1_mask,
+            positions=positions, segment_ids=seg,
+        )
+
+    def init(r, tokens):
+        return model.init({"params": r}, tokens, train=False)["params"]
+
+    probe = jax.shard_map(
+        init, mesh=mesh, in_specs=(P(), P("data")), out_specs=P(),
+        check_vma=False,
+    )
+    specs = nn.get_partition_spec(jax.eval_shape(probe, rng, tokens))
+    params = jax.jit(
+        jax.shard_map(
+            init, mesh=mesh, in_specs=(P(), P("data")), out_specs=specs,
+            check_vma=False,
+        )
+    )(rng, tokens)
+
+    def mean_loss(params, batch, rng_):
+        _, metrics = loss_fn(params, model.apply, batch, rng_)
+        s, c = metrics["loss"]
+        s = jax.lax.psum(s, ("data", "pipe"))
+        c = jax.lax.psum(c, ("data", "pipe"))
+        return s / c
+
+    f = jax.jit(
+        jax.shard_map(
+            mean_loss, mesh=mesh, in_specs=(specs, P("data"), P()),
+            out_specs=P(), check_vma=False,
+        )
+    )
+    base = float(f(params, make_batch(tokens), jax.random.PRNGKey(0)))
+    perturbed_toks = tokens.at[:, :16].set(
+        (tokens[:, :16] + 7) % cfg.vocab_size
+    )
+    pert = float(f(params, make_batch(perturbed_toks), jax.random.PRNGKey(0)))
+    assert abs(base - pert) < 1e-6, (base, pert)

@@ -13,8 +13,9 @@ the parallelism semantics follow the framework's strategy modules:
 - PP: ``pipe_size > 1`` runs the block stack as GPipe stages over the pipe
   axis.  Logits are then valid on the **last** pipe rank only — train with
   :func:`make_gpt_loss`, which masks by :func:`pp.last_stage_mask`.
-  Under PP, ``positions``/``segment_ids`` must be ``None`` (unpacked
-  sequences; blocks regenerate default positions per microbatch).
+  ``positions``/``segment_ids`` (packed sequences) ride as pipeline extras:
+  each rank indexes its current microbatch's slice of the replicated
+  arrays — no extra ring traffic.
 """
 
 from __future__ import annotations
@@ -126,14 +127,6 @@ class GPTLM(nn.Module):
                 "axis); on a pipe=1 mesh the knob would be silently ignored"
             )
         if cfg.pipe_size > 1:
-            # positions are consumed by the (pre-pipeline) embedding; inside
-            # the pipeline, RoPE blocks fall back to default arange positions.
-            # Packed sequences can't ride the activation ppermute yet:
-            if segment_ids is not None:
-                raise NotImplementedError(
-                    "pipeline parallelism currently requires unpacked sequences "
-                    "(segment_ids must be None)"
-                )
             chunks = cfg.pipe_size * cfg.pipe_interleave
             if cfg.n_layers % chunks != 0:
                 raise ValueError(
@@ -159,6 +152,13 @@ class GPTLM(nn.Module):
             if decode:
                 from tpu_parallel.parallel.tp import axis_size_or_none
 
+                if segment_ids is not None:
+                    # mirror the non-PP decode refusal (Attention raises) —
+                    # silently dropping them would attend across documents
+                    raise NotImplementedError(
+                        "incremental decoding with packed sequences "
+                        "(segment_ids)"
+                    )
                 if axis_size_or_none(cfg.pipe_axis) is None:
                     # fail clearly here — otherwise the ring's collectives
                     # die on an unbound-axis error deep in JAX
@@ -172,7 +172,15 @@ class GPTLM(nn.Module):
                 # through directly — no scan, so traced kwargs are fine
                 x = pipeline(x, train=train, decode=True, positions=positions)
             else:
-                x = pipeline(x, train=train)
+                # packed sequences / explicit positions ride as pipeline
+                # extras: every rank holds them replicated and indexes its
+                # current microbatch locally (pp.execute_pipeline_step)
+                extras = {}
+                if segment_ids is not None:
+                    extras["segment_ids"] = segment_ids
+                if positions is not None:
+                    extras["positions"] = positions
+                x = pipeline(x, train=train, extras=extras or None)
         else:
             x = BlockStack(cfg, cfg.n_layers, name="blocks")(
                 x,
@@ -272,7 +280,7 @@ def make_gpt_loss(config: GPTConfig, train: bool = True):
         dropout_rng = fold_rng_over_axis(rng, fold_axes)
         apply_kwargs = dict(
             positions=batch.positions,
-            segment_ids=None if config.pipe_size > 1 else batch.segment_ids,
+            segment_ids=batch.segment_ids,
             train=train,
             rngs={"dropout": dropout_rng},
             hidden_only=True,

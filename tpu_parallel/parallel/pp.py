@@ -35,6 +35,40 @@ from jax import lax
 from tpu_parallel.parallel.tp import ModuleShard
 
 
+def _stack_extras(extras: Optional[dict], num_microbatches: int,
+                  microbatch_size: int) -> Tuple[Tuple[str, jax.Array], ...]:
+    """Split per-token batch arrays ``[batch, ...]`` into the microbatch
+    layout ``[m, mb, ...]`` — ONE definition for both schedules so their
+    slicing can never diverge from the activation split."""
+    out = []
+    for name, arr in sorted((extras or {}).items()):
+        if arr.shape[0] != num_microbatches * microbatch_size:
+            raise ValueError(
+                f"extras[{name!r}] leading dim {arr.shape[0]} != batch "
+                f"{num_microbatches * microbatch_size}"
+            )
+        out.append(
+            (name, arr.reshape(num_microbatches, microbatch_size, *arr.shape[1:]))
+        )
+    return tuple(out)
+
+
+def _index_extras(extras: Tuple[Tuple[str, jax.Array], ...], mb_index,
+                  num_microbatches: int, kwargs: dict) -> dict:
+    """Inject the current microbatch's slice of each extra into ``kwargs``
+    (clamped: off-schedule ticks read a valid slot whose compute the
+    schedule masks anyway).  Shared by both schedules."""
+    if not extras:
+        return kwargs
+    kwargs = dict(kwargs)
+    safe = jnp.clip(mb_index, 0, num_microbatches - 1)
+    for name, stacked in extras:
+        kwargs[name] = lax.dynamic_index_in_dim(
+            stacked, safe, axis=0, keepdims=False
+        )
+    return kwargs
+
+
 def execute_pipeline_step(
     module: nn.Module,
     carry: jax.Array,
@@ -44,6 +78,7 @@ def execute_pipeline_step(
     tick: Optional[jax.Array] = None,
     num_microbatches: Optional[int] = None,
     pass_validity: bool = False,
+    extras: Tuple[Tuple[str, jax.Array], ...] = (),
     **kwargs,
 ) -> tuple[jax.Array, jax.Array]:
     """One schedule tick: select input, run the stage, rotate outputs.
@@ -57,18 +92,30 @@ def execute_pipeline_step(
     (fill/drain) — so sown regularizers (MoE balance loss) can exclude
     garbage activations exactly.  Requires the stage module to accept an
     ``aux_scale`` keyword (``models.layers.BlockStack`` does).
+
+    ``extras`` carries per-token batch inputs (packed-sequence segment_ids,
+    positions) as ``(name, [num_microbatches, mb, ...])`` pairs: these are
+    model *inputs* every rank already holds replicated, so instead of riding
+    the ppermute ring alongside activations, each rank just indexes the
+    microbatch it is working on (``tick - stage``) — zero extra
+    communication.  Off-schedule ticks index a clamped slot; their compute
+    is garbage that the schedule masks anyway.
     """
     num_stages = lax.psum(1, axis_name)
     stage = lax.axis_index(axis_name)
     # Stage 0 reads fresh microbatches; other stages read the rotated carry.
     inputs = jnp.where(stage == 0, microbatch, carry)
-    if pass_validity:
+    if pass_validity or extras:
         # Rank r works on microbatch (tick - r): real iff it is in range.
+        # (tick may stay None for plain schedules that need neither.)
         mb_index = tick - stage
+    if pass_validity:
         kwargs = dict(kwargs)
         kwargs["aux_scale"] = jnp.logical_and(
             mb_index >= 0, mb_index < num_microbatches
         ).astype(jnp.float32)
+    kwargs = _index_extras(extras, mb_index if extras else None,
+                           num_microbatches or 1, kwargs)
     outputs = module(inputs, **kwargs)
     if outputs.shape != inputs.shape:
         raise ValueError(
@@ -97,6 +144,7 @@ def execute_pipeline(
     axis_name: str,
     broadcast_outputs: bool = False,
     pass_validity: bool = False,
+    extras: Optional[dict] = None,
     **kwargs,
 ) -> jax.Array:
     """Run ``module`` as a pipeline stage over the full GPipe schedule.
@@ -108,6 +156,11 @@ def execute_pipeline(
     ``broadcast_outputs=True`` to psum the (zero-padded) result over the pipe
     axis so every rank holds the real output (costs one all-reduce of the
     activation — fine for small heads, avoid for large logits).
+
+    ``extras`` maps stage-kwarg names to per-token batch arrays
+    ``[batch, ...]`` (packed segment_ids, positions); they are microbatched
+    like ``x`` and each rank indexes its current microbatch's slice locally
+    (see :func:`execute_pipeline_step`).
     """
     num_stages = lax.psum(1, axis_name)
     stage = lax.axis_index(axis_name)
@@ -119,6 +172,7 @@ def execute_pipeline(
         )
     microbatch_size = batch_size // num_microbatches
     microbatches = x.reshape(num_microbatches, microbatch_size, *x.shape[1:])
+    extras_stacked = _stack_extras(extras, num_microbatches, microbatch_size)
     # Pad the schedule tail: after the real microbatches run out, stage 0
     # feeds zeros that never surface in a valid output slot.
     num_iterations = num_microbatches + num_stages - 1
@@ -151,6 +205,7 @@ def execute_pipeline(
         num_microbatches=num_microbatches,
         pass_validity=pass_validity,
         static_kwargs=tuple(sorted(kwargs.items())),
+        extras=extras_stacked,
     )(carry_init, (inputs, ticks))
     # outputs: [num_iterations, mb, ...]; valid last-stage outputs occupy the
     # final num_microbatches slots (earlier ticks were pipeline fill).  The
@@ -204,6 +259,7 @@ def execute_interleaved_pipeline(
     interleave: int,
     axis_name: str,
     pass_validity: bool = False,
+    extras: Optional[dict] = None,
     **kwargs,
 ) -> jax.Array:
     """Circular (interleaved) pipeline: ``interleave`` virtual stages/rank.
@@ -241,6 +297,7 @@ def execute_interleaved_pipeline(
         )
     microbatch_size = batch_size // num_microbatches
     microbatches = x.reshape(num_microbatches, microbatch_size, *x.shape[1:])
+    extras_stacked = _stack_extras(extras, num_microbatches, microbatch_size)
 
     # static schedule: injection tick of microbatch i, collection tick of
     # its final output
@@ -283,6 +340,7 @@ def execute_interleaved_pipeline(
         pass_validity=pass_validity,
         static_kwargs=tuple(sorted(kwargs.items())),
         microbatches=microbatches,
+        extras=extras_stacked,
     )(carry_init, (feed_index, ticks))
     return outputs.reshape(batch_size, *outputs.shape[2:])
 
@@ -300,6 +358,8 @@ class _InterleavedScanWrapper(nn.Module):
     # closed-over (scan-broadcast) microbatch stack; the per-tick xs carry
     # only an int32 index into it
     microbatches: Optional[jax.Array] = None
+    # (name, [m, mb, ...]) per-token inputs indexed by the held item
+    extras: Tuple[Tuple[str, jax.Array], ...] = ()
 
     def __call__(self, carry, xs):
         act, out_buf = carry
@@ -323,6 +383,7 @@ class _InterleavedScanWrapper(nn.Module):
         kwargs = dict(self.static_kwargs)
         if self.pass_validity:
             kwargs["aux_scale"] = valid.astype(jnp.float32)
+        kwargs = _index_extras(self.extras, item, self.num_microbatches, kwargs)
         outputs = self.module(inputs, j, **kwargs)
         if outputs.shape != inputs.shape:
             raise ValueError(
@@ -362,6 +423,8 @@ class _ScanWrapper(nn.Module):
     num_microbatches: Optional[int] = None
     pass_validity: bool = False
     static_kwargs: Tuple[Tuple[str, Any], ...] = ()
+    # (name, [m, mb, ...]) per-token inputs, scan-broadcast (closed over)
+    extras: Tuple[Tuple[str, jax.Array], ...] = ()
 
     def __call__(self, carry, xs):
         microbatch, tick = xs
@@ -373,6 +436,7 @@ class _ScanWrapper(nn.Module):
             tick=tick,
             num_microbatches=self.num_microbatches,
             pass_validity=self.pass_validity,
+            extras=self.extras,
             **dict(self.static_kwargs),
         )
 
@@ -462,7 +526,9 @@ class PipelineModule(nn.Module):
     interleave: int = 1
 
     @nn.compact
-    def __call__(self, x: jax.Array, **kwargs) -> jax.Array:
+    def __call__(
+        self, x: jax.Array, extras: Optional[dict] = None, **kwargs
+    ) -> jax.Array:
         if kwargs.get("decode"):
             if self.interleave > 1:
                 raise NotImplementedError(
@@ -473,8 +539,10 @@ class PipelineModule(nn.Module):
             stage = ModuleShard(
                 module_fn=self.stage_fn, axis_name=self.axis_name, name="stage"
             )
+            # decode runs whole-batch ring passes — extras (if any) apply
+            # directly, no microbatch indexing
             return execute_pipeline_decode(
-                stage, x, axis_name=self.axis_name, **kwargs
+                stage, x, axis_name=self.axis_name, **dict(extras or {}), **kwargs
             )
         if self.interleave > 1:
             if self.broadcast_outputs:
@@ -497,6 +565,7 @@ class PipelineModule(nn.Module):
                 interleave=self.interleave,
                 axis_name=self.axis_name,
                 pass_validity=self.pass_validity,
+                extras=extras,
                 **kwargs,
             )
         stage = ModuleShard(
@@ -509,5 +578,6 @@ class PipelineModule(nn.Module):
             axis_name=self.axis_name,
             broadcast_outputs=self.broadcast_outputs,
             pass_validity=self.pass_validity,
+            extras=extras,
             **kwargs,
         )
