@@ -124,3 +124,48 @@ def test_gpt_ulysses_attention_training(mesh_seq4, rng):
         state, m = funcs.step_fn(state, None, batch)
     assert compute(m)["loss"] < first
     assert float(m["loss"][1]) == 8 * 64
+
+
+def test_gpt_ulysses_packed_training(mesh_seq4, rng):
+    """Packed batches train under ulysses SP (segment ids all-gathered over
+    the seq axis for the full-sequence inner attention)."""
+    import numpy as np
+    import optax
+
+    from tpu_parallel.core import TrainState, compute
+    from tpu_parallel.core.state import TextBatch
+    from tpu_parallel.data import lm_batch
+    from tpu_parallel.models import GPTLM, make_gpt_loss, tiny_test
+
+    cfg = tiny_test(attn_impl="ulysses", seq_len=64)
+    base = lm_batch(jax.random.PRNGKey(0), 8, cfg.seq_len, cfg.vocab_size)
+    rng_np = np.random.default_rng(3)
+    cuts = np.sort(rng_np.integers(1, cfg.seq_len - 1, (8, 2)), axis=1)
+    pos = np.arange(cfg.seq_len)[None, :]
+    seg = (pos >= cuts[:, :1]).astype(np.int32) + (pos >= cuts[:, 1:]).astype(np.int32)
+    batch = TextBatch(
+        tokens=base.tokens, targets=base.targets, loss_mask=base.loss_mask,
+        positions=base.positions, segment_ids=jnp.asarray(seg),
+    )
+    model = GPTLM(cfg)
+    tx = optax.adamw(3e-3)
+
+    def model_init(rng_, b):
+        p = model.init({"params": rng_}, b.tokens, train=False)["params"]
+        return TrainState.create(apply_fn=model.apply, params=p, tx=tx, rng=rng_)
+
+    funcs = build_train_functions(
+        model_init, make_gpt_loss(cfg), mesh_seq4, batch,
+        batch_spec=P("data", "seq"),
+        grad_sync_axes=("data", "seq"), metric_axes=("data", "seq"),
+        donate=False,
+        # ulysses runs the flash kernel in interpret mode on CPU: JAX vma
+        # limitation (see build_train_functions docstring)
+        check_vma=False,
+    )
+    state = funcs.init_fn(rng, batch)
+    state, m0 = funcs.step_fn(state, None, batch)
+    first = compute(m0)["loss"]
+    for _ in range(5):
+        state, m = funcs.step_fn(state, None, batch)
+    assert compute(m)["loss"] < first

@@ -56,8 +56,10 @@ class Checkpointer:
             # original error when it fails too — no message parsing.
             try:
                 return self._restore_with_drift(abstract_state, step)
-            except Exception:
-                raise e
+            except Exception as drift_exc:
+                # chain so BOTH failures surface: the original Standard
+                # Restore mismatch and whatever broke the drift path
+                raise e from drift_exc
 
     def _restore_with_drift(self, abstract_state: Pytree, step: int) -> Pytree:
         """Restore a checkpoint whose structure drifted from the live state:

@@ -27,7 +27,7 @@ SURVEY.md §2.4 covers only its inline MLP).
 
 from __future__ import annotations
 
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import jax.numpy as jnp
 import numpy as np
@@ -180,10 +180,20 @@ def from_hf_gpt2(hf_model_or_dict, config, dtype=jnp.float32) -> Pytree:
     return params
 
 
-def to_hf_gpt2(params: Pytree, config) -> Dict[str, np.ndarray]:
+def to_hf_gpt2(
+    params: Pytree, config, n_positions: Optional[int] = None
+) -> Dict[str, np.ndarray]:
     """This framework's (unrolled, mesh-free) params -> an HF GPT-2 state
     dict (``transformer.``-prefixed keys plus ``lm_head.weight``) loadable
-    with ``GPT2LMHeadModel.load_state_dict``."""
+    with ``GPT2LMHeadModel.load_state_dict``.
+
+    ``n_positions``: the target HF model's position-table length.  An import
+    with ``seq_len < n_positions`` sliced the wpe table
+    (:func:`from_hf_gpt2`), and torch's ``load_state_dict`` rejects shape
+    mismatches even with ``strict=False`` — pass the original length to
+    zero-pad the table back out (rows beyond ``seq_len`` were never
+    trained; they export as zeros, not the discarded originals).
+    """
     h = config.n_heads
     g = lambda *path: np.asarray(_dig(params, path), np.float32)
     wte = g("embed", "tok", "embedding")
@@ -193,9 +203,21 @@ def to_hf_gpt2(params: Pytree, config) -> Dict[str, np.ndarray]:
             "lm_head and wte have drifted apart (untied fine-tune?) — "
             "GPT-2's format ties them; refusing to drop one silently"
         )
+    wpe = g("embed", "pos", "embedding")
+    if n_positions is not None:
+        if n_positions < wpe.shape[0]:
+            raise ValueError(
+                f"n_positions={n_positions} < trained position table "
+                f"{wpe.shape[0]} — refusing to truncate trained rows"
+            )
+        if n_positions > wpe.shape[0]:
+            wpe = np.concatenate(
+                [wpe, np.zeros((n_positions - wpe.shape[0], wpe.shape[1]),
+                               wpe.dtype)]
+            )
     sd: Dict[str, np.ndarray] = {
         "transformer.wte.weight": wte,
-        "transformer.wpe.weight": g("embed", "pos", "embedding"),
+        "transformer.wpe.weight": wpe,
         "transformer.ln_f.weight": g("norm_final", "scale"),
         "transformer.ln_f.bias": g("norm_final", "bias"),
         "lm_head.weight": wte,

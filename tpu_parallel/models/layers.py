@@ -444,13 +444,11 @@ class Attention(nn.Module):
                     ring_flash_attention,
                 )
 
-                if segment_ids is not None:
-                    raise NotImplementedError(
-                        "ring attention does not support packed sequences yet"
-                    )
                 # flash-composed ring on TPU; the jnp path elsewhere (the
                 # interpret-mode kernels can't declare vma for the trainer's
-                # replication checker, and CPU gains nothing from them)
+                # replication checker, and CPU gains nothing from them).
+                # segment_ids (packed sequences) are the LOCAL chunk's ids —
+                # both impls rotate them around the ring with their K/V.
                 if jax.default_backend() == "tpu":
 
                     def attn_fn(q, k, v, segment_ids=None):
@@ -459,6 +457,7 @@ class Attention(nn.Module):
                             block_q=cfg.flash_block_q,
                             block_k=cfg.flash_block_k,
                             window=cfg.attn_window,
+                            segment_ids=segment_ids,
                         )
 
                 else:
@@ -467,16 +466,13 @@ class Attention(nn.Module):
                         return ring_attention(
                             q, k, v, axis_name=cfg.seq_axis,
                             window=cfg.attn_window,
+                            segment_ids=segment_ids,
                         )
 
             elif cfg.attn_impl == "ulysses":
                 from tpu_parallel.ops.flash_attention import flash_attention
                 from tpu_parallel.ops.ulysses import ulysses_attention
 
-                if segment_ids is not None:
-                    raise NotImplementedError(
-                        "ulysses attention does not support packed sequences yet"
-                    )
                 # the inner attention sees the full gathered sequence, so the
                 # window band applies directly
                 inner = functools.partial(
@@ -487,8 +483,16 @@ class Attention(nn.Module):
                 )
 
                 def attn_fn(q, k, v, segment_ids=None):
+                    if segment_ids is not None:
+                        # packed sequences: the inner attention needs the
+                        # whole sequence's ids — a tiny int32 all_gather
+                        # (the activations already pay two all_to_alls)
+                        segment_ids = lax.all_gather(
+                            segment_ids, cfg.seq_axis, axis=1, tiled=True
+                        )
                     return ulysses_attention(
-                        q, k, v, axis_name=cfg.seq_axis, attn_fn=inner
+                        q, k, v, axis_name=cfg.seq_axis, attn_fn=inner,
+                        segment_ids=segment_ids,
                     )
 
             else:

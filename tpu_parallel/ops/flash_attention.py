@@ -218,7 +218,10 @@ def _fwd_kernel(
     causal=True, window=0, q_offset=0,
 ):
     if has_segments:
-        seg_ref, o_ref, lse_ref = rest
+        # separate q- and k-side segment refs: for self-attention both view
+        # the same array; ring chunks pass the local chunk's ids vs the
+        # rotating chunk's ids
+        seg_q_ref, seg_k_ref, o_ref, lse_ref = rest
     else:
         o_ref, lse_ref = rest
     qi = pl.program_id(1)
@@ -227,7 +230,7 @@ def _fwd_kernel(
     # the systolic array at a fraction of peak
     q = (q_ref[0] * jnp.asarray(scale, q_ref.dtype)).astype(q_ref.dtype)
     if has_segments:
-        seg_q = seg_ref[0, pl.ds(qi * block_q, block_q), :]  # [bq, 1]
+        seg_q = seg_q_ref[0]  # [bq, 1] — block qi via the index map
     if causal:
         num_k_blocks = (qi + 1) * block_q // block_k  # only blocks <= qi
     else:
@@ -247,7 +250,7 @@ def _fwd_kernel(
         mask = _band_mask(qi, ki, s.shape, block_q, block_k, causal, window,
                           q_offset)
         if has_segments:
-            seg_k = seg_ref[0, pl.ds(ki * block_k, block_k), :]  # [bk, 1]
+            seg_k = seg_k_ref[0, pl.ds(ki * block_k, block_k), :]  # [bk, 1]
             same = seg_q == seg_k.T
             mask = same if mask is None else jnp.logical_and(mask, same)
         if mask is not None:
@@ -328,7 +331,8 @@ def _flash_fwd(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
-    seg: Optional[jax.Array],
+    seg_q: Optional[jax.Array],
+    seg_k: Optional[jax.Array],
     *,
     block_q: int,
     block_k: int,
@@ -350,7 +354,7 @@ def _flash_fwd(
         block_q=block_q,
         block_k=block_k,
         scale=scale,
-        has_segments=seg is not None,
+        has_segments=seg_q is not None,
         causal=causal,
         window=window,
         q_offset=q_offset,
@@ -371,9 +375,9 @@ def _flash_fwd(
             pl.BlockSpec((1, block_k, d), kv_map),
         ]
         args = [qf, kf, vf]
-        if seg is not None:
-            # seg is [B, S, 1], passed twice: a q-block view and a (clamped)
-            # k-block view
+        if seg_q is not None:
+            # [B, S_q, 1] q-block view and [B, S_kv, 1] (clamped) k-block
+            # view; for self-attention both are the same array
             in_specs.append(
                 pl.BlockSpec((1, block_q, 1), lambda bh_, qi, ki: (bh_ // h, qi, 0))
             )
@@ -384,7 +388,7 @@ def _flash_fwd(
                     + kv_map(bh_, qi, ki)[1:],
                 )
             )
-            args += [seg, seg]
+            args += [seg_q, seg_k]
         out, lse = pl.pallas_call(
             functools.partial(_fwd_kernel_stream, num_ki=num_ki, **kernel_kwargs),
             grid=(bh, s // block_q, num_ki),
@@ -409,12 +413,16 @@ def _flash_fwd(
         pl.BlockSpec((1, s_kv, d), lambda bh_, qi: (kv_row(bh_), 0, 0)),
     ]
     args = [qf, kf, vf]
-    if seg is not None:
-        # seg is [B, S, 1]; all H heads of batch row b read the same block
+    if seg_q is not None:
+        # all H heads of batch row b read the same blocks: the q side one
+        # [block_q, 1] tile per grid step, the k side its full [S_kv, 1] lane
+        in_specs.append(
+            pl.BlockSpec((1, block_q, 1), lambda bh_, qi: (bh_ // h, qi, 0))
+        )
         in_specs.append(
             pl.BlockSpec((1, s_kv, 1), lambda bh_, qi: (bh_ // h, 0, 0))
         )
-        args.append(seg)
+        args += [seg_q, seg_k]
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, **kernel_kwargs),
         grid=(bh, s // block_q),
@@ -437,7 +445,7 @@ def _bwd_dq_kernel(
     block_q, block_k, scale, has_segments, causal=True, window=0, q_offset=0,
 ):
     if has_segments:
-        seg_ref, dq_ref = rest
+        seg_q_ref, seg_k_ref, dq_ref = rest
     else:
         (dq_ref,) = rest
     qi = pl.program_id(1)
@@ -446,7 +454,7 @@ def _bwd_dq_kernel(
     lse = lse_ref[0]  # [bq, 1]
     delta = delta_ref[0]  # [bq, 1]
     if has_segments:
-        seg_q = seg_ref[0, pl.ds(qi * block_q, block_q), :]
+        seg_q = seg_q_ref[0]  # [bq, 1] — block qi via the index map
     if causal:
         num_k_blocks = (qi + 1) * block_q // block_k
     else:
@@ -464,7 +472,7 @@ def _bwd_dq_kernel(
         mask = _band_mask(qi, ki, s.shape, block_q, block_k, causal, window,
                           q_offset)
         if has_segments:
-            seg_k = seg_ref[0, pl.ds(ki * block_k, block_k), :]
+            seg_k = seg_k_ref[0, pl.ds(ki * block_k, block_k), :]
             same = seg_q == seg_k.T
             mask = same if mask is None else jnp.logical_and(mask, same)
         if mask is not None:
@@ -546,14 +554,14 @@ def _bwd_dkv_kernel(
     contributions — the reduction over the group happens here, not via an
     expanded K/V."""
     if has_segments:
-        seg_ref, dk_ref, dv_ref = rest
+        seg_q_ref, seg_k_ref, dk_ref, dv_ref = rest
     else:
         dk_ref, dv_ref = rest
     ki = pl.program_id(1)
     k = k_ref[0]  # [block_k, D]
     v = v_ref[0]
     if has_segments:
-        seg_k = seg_ref[0, pl.ds(ki * block_k, block_k), :]  # [bk, 1]
+        seg_k = seg_k_ref[0]  # [bk, 1] — block ki via the index map
     # shared q-range helper: [first, last] may be empty; fori_loop with
     # lower >= upper simply runs zero iterations
     first_q_block, last_q_block = _stream_q_range(
@@ -577,7 +585,7 @@ def _bwd_dkv_kernel(
             mask = _band_mask(qi, ki, s.shape, block_q, block_k, causal, window,
                               q_offset)
             if has_segments:
-                seg_q = seg_ref[0, pl.ds(qi * block_q, block_q), :]
+                seg_q = seg_q_ref[0, pl.ds(qi * block_q, block_q), :]
                 same = seg_q == seg_k.T
                 mask = same if mask is None else jnp.logical_and(mask, same)
             if mask is not None:
@@ -669,7 +677,7 @@ def _bwd_dkv_kernel_stream(
 
 
 def _flash_bwd(
-    q, k, v, seg, out, lse, do, *, block_q, block_k, interpret,
+    q, k, v, seg_q, seg_k, out, lse, do, *, block_q, block_k, interpret,
     causal=True, window=0, dlse=None, stream: Optional[bool] = None,
     q_offset: int = 0,
 ):
@@ -690,7 +698,7 @@ def _flash_bwd(
     dof = do.reshape(bh, s, d)
     lsef = lse.reshape(bh, s, 1)
     deltaf = delta.reshape(bh, s, 1)
-    has_segments = seg is not None
+    has_segments = seg_q is not None
     kv_row = _kv_row_map(h, h_kv)
     # the resident dkv kernel holds [group*s, d] q/do operands in VMEM, so
     # under GQA the stream decision must budget for group*s, not just s_kv —
@@ -723,7 +731,7 @@ def _flash_bwd(
                     lambda bh_, qi, ki: (bh_ // h,) + kv_map(bh_, qi, ki)[1:],
                 )
             )
-            args += [seg, seg]
+            args += [seg_q, seg_k]
         dq = pl.pallas_call(
             functools.partial(
                 _bwd_dq_kernel_stream,
@@ -757,9 +765,12 @@ def _flash_bwd(
         args = [qf, kf, vf, dof, lsef, deltaf]
         if has_segments:
             in_specs.append(
+                pl.BlockSpec((1, block_q, 1), lambda bh_, qi: (bh_ // h, qi, 0))
+            )
+            in_specs.append(
                 pl.BlockSpec((1, s_kv, 1), lambda bh_, qi: (bh_ // h, 0, 0))
             )
-            args.append(seg)
+            args += [seg_q, seg_k]
         dq = pl.pallas_call(
             functools.partial(
                 _bwd_dq_kernel,
@@ -826,7 +837,7 @@ def _flash_bwd(
                     lambda bkv_, ki, g, qi: (bkv_ // h_kv, ki, 0),
                 )
             )
-            args += [seg, seg]
+            args += [seg_q, seg_k]
         dk, dv = pl.pallas_call(
             functools.partial(
                 _bwd_dkv_kernel_stream,
@@ -867,9 +878,12 @@ def _flash_bwd(
         args = [qg, kf, vf, dog, lseg, deltag]
         if has_segments:
             in_specs.append(
-                pl.BlockSpec((1, s_kv, 1), lambda bh_, ki: (bh_ // h_kv, 0, 0))
+                pl.BlockSpec((1, s, 1), lambda bh_, ki: (bh_ // h_kv, 0, 0))
             )
-            args.append(seg)
+            in_specs.append(
+                pl.BlockSpec((1, block_k, 1), lambda bh_, ki: (bh_ // h_kv, ki, 0))
+            )
+            args += [seg_q, seg_k]
         dk, dv = pl.pallas_call(
             functools.partial(
                 _bwd_dkv_kernel,
@@ -900,9 +914,9 @@ def _flash_bwd(
 # --- public API with custom VJP ----------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11))
 def _flash_finalize(
-    q, k, v, seg, out, lse, block_q, block_k, interpret, window, stream
+    q, k, v, seg_q, seg_k, out, lse, block_q, block_k, interpret, window, stream
 ):
     """Identity on ``out``; exists to attach the backward kernels.
 
@@ -914,24 +928,25 @@ def _flash_finalize(
     policies — measured as a full forward-kernel re-run per layer
     (scripts/attn_wrap_bisect.py).
     """
-    del q, k, v, seg, lse
+    del q, k, v, seg_q, seg_k, lse
     return out
 
 
-def _finalize_fwd(q, k, v, seg, out, lse, block_q, block_k, interpret, window, stream):
-    return out, (q, k, v, seg, out, lse)
+def _finalize_fwd(q, k, v, seg_q, seg_k, out, lse, block_q, block_k, interpret,
+                  window, stream):
+    return out, (q, k, v, seg_q, seg_k, out, lse)
 
 
 def _finalize_bwd(block_q, block_k, interpret, window, stream, residuals, do):
-    q, k, v, seg, out, lse = residuals
+    q, k, v, seg_q, seg_k, out, lse = residuals
     dq, dk, dv = _flash_bwd(
-        q, k, v, seg, out, lse, do,
+        q, k, v, seg_q, seg_k, out, lse, do,
         block_q=block_q, block_k=block_k, interpret=interpret, window=window,
         stream=stream,
     )
-    # seg (int) carries no gradient; out/lse arrive behind stop_gradient, so
-    # their zero cotangents are discarded by the caller
-    return dq, dk, dv, None, jnp.zeros_like(out), jnp.zeros_like(lse)
+    # segment ids (int) carry no gradient; out/lse arrive behind
+    # stop_gradient, so their zero cotangents are discarded by the caller
+    return dq, dk, dv, None, None, jnp.zeros_like(out), jnp.zeros_like(lse)
 
 
 _flash_finalize.defvjp(_finalize_fwd, _finalize_bwd)
@@ -941,6 +956,8 @@ def _flash_attention_bhsd(q, k, v, seg, block_q, block_k, interpret, window=0,
                           stream=None):
     from jax.ad_checkpoint import checkpoint_name
 
+    # self-attention: q and k index the same positions, so one segment
+    # array serves both sides of the kernels' (seg_q, seg_k) contract
     # stop_gradient on the *inputs*: the forward kernel then sees all-zero
     # tangents and AD bypasses it entirely (all q/k/v gradient flows through
     # _flash_finalize's backward kernels).  Stopping only the outputs is too
@@ -949,6 +966,7 @@ def _flash_attention_bhsd(q, k, v, seg, block_q, block_k, interpret, window=0,
         lax.stop_gradient(q),
         lax.stop_gradient(k),
         lax.stop_gradient(v),
+        seg,
         seg,
         block_q=block_q,
         block_k=block_k,
@@ -959,45 +977,46 @@ def _flash_attention_bhsd(q, k, v, seg, block_q, block_k, interpret, window=0,
     out = checkpoint_name(out, "attn")
     lse = checkpoint_name(lse, "attn")
     return _flash_finalize(
-        q, k, v, seg, out, lse, block_q, block_k, interpret, window, stream
+        q, k, v, seg, seg, out, lse, block_q, block_k, interpret, window, stream
     )
 
 
 # --- chunk attention for ring/sequence parallelism ---------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
 def _chunk_attention_bhsd(
-    q, k, v, causal, block_q, block_k, interpret, stream, window, q_offset
+    q, k, v, seg_q, seg_k, causal, block_q, block_k, interpret, stream, window,
+    q_offset
 ):
     return _flash_fwd(
-        q, k, v, None, block_q=block_q, block_k=block_k,
+        q, k, v, seg_q, seg_k, block_q=block_q, block_k=block_k,
         interpret=interpret, causal=causal, stream=stream,
         window=window, q_offset=q_offset,
     )
 
 
-def _chunk_fwd(q, k, v, causal, block_q, block_k, interpret, stream, window,
-               q_offset):
+def _chunk_fwd(q, k, v, seg_q, seg_k, causal, block_q, block_k, interpret,
+               stream, window, q_offset):
     out, lse = _flash_fwd(
-        q, k, v, None, block_q=block_q, block_k=block_k,
+        q, k, v, seg_q, seg_k, block_q=block_q, block_k=block_k,
         interpret=interpret, causal=causal, stream=stream,
         window=window, q_offset=q_offset,
     )
-    return (out, lse), (q, k, v, out, lse)
+    return (out, lse), (q, k, v, seg_q, seg_k, out, lse)
 
 
 def _chunk_bwd(causal, block_q, block_k, interpret, stream, window, q_offset,
                residuals, cotangents):
-    q, k, v, out, lse = residuals
+    q, k, v, seg_q, seg_k, out, lse = residuals
     do, dlse = cotangents
     dq, dk, dv = _flash_bwd(
-        q, k, v, None, out, lse, do,
+        q, k, v, seg_q, seg_k, out, lse, do,
         block_q=block_q, block_k=block_k, interpret=interpret,
         causal=causal, dlse=dlse, stream=stream,
         window=window, q_offset=q_offset,
     )
-    return dq, dk, dv
+    return dq, dk, dv, None, None
 
 
 _chunk_attention_bhsd.defvjp(_chunk_fwd, _chunk_bwd)
@@ -1015,6 +1034,8 @@ def flash_chunk_attention(
     stream: Optional[bool] = None,
     window: int = 0,
     q_offset: int = 0,
+    segment_ids_q: Optional[jax.Array] = None,
+    segment_ids_kv: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """One flash-attention partial over a K/V chunk, for ring combining.
 
@@ -1036,7 +1057,18 @@ def flash_chunk_attention(
     ``q_offset = j_back * local_seq`` for the chunk ``j_back`` ranks behind
     — rows whose window misses the whole chunk come back as empty partials
     (out 0, lse NEG_INF), which :func:`combine_chunks` weights to zero.
+
+    ``segment_ids_q``/``segment_ids_kv`` ([batch, seq_q] / [batch, seq_kv],
+    both or neither) mask packed sequences across chunks: queries attend
+    only same-segment keys.  Ring attention passes the local chunk's ids as
+    the q side and the currently-held (rotated) chunk's ids as the kv side.
+    A row whose segment matches nothing in the chunk is an empty partial,
+    handled as above.
     """
+    if (segment_ids_q is None) != (segment_ids_kv is None):
+        raise ValueError(
+            "segment_ids_q and segment_ids_kv must be passed together"
+        )
     if q.shape[2] % k.shape[2] != 0:
         raise ValueError(
             f"q heads {q.shape[2]} not a multiple of k/v heads {k.shape[2]}"
@@ -1060,8 +1092,13 @@ def flash_chunk_attention(
             stacklevel=2,
         )
     qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+    seg_q = seg_k = None
+    if segment_ids_q is not None:
+        seg_q = segment_ids_q.astype(jnp.int32)[:, :, None]
+        seg_k = segment_ids_kv.astype(jnp.int32)[:, :, None]
     out, lse = _chunk_attention_bhsd(
-        qt, kt, vt, causal, bq, bk, interpret, stream, window, q_offset
+        qt, kt, vt, seg_q, seg_k, causal, bq, bk, interpret, stream, window,
+        q_offset
     )
     return out.transpose(0, 2, 1, 3), lse
 

@@ -35,6 +35,7 @@ def ring_attention(
     axis_name: str = "seq",
     use_checkpoint: bool = True,
     window: int = 0,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Causal ring attention on seq-sharded [batch, local_seq, heads, hd].
 
@@ -44,6 +45,9 @@ def ring_attention(
     K/V chunk (keeps the O(seq/n) memory promise under autodiff).
     ``window > 0`` adds Mistral-style sliding-window masking on the global
     positions (query t sees keys in (t - window, t] only).
+    ``segment_ids`` (the LOCAL chunk's [batch, local_seq] ids) masks packed
+    sequences: the ids rotate around the ring with their K/V chunk, so each
+    step can mask cross-document pairs exactly.
     """
     n_chunks = lax.psum(1, axis_name)
     my_chunk = lax.axis_index(axis_name)
@@ -53,11 +57,14 @@ def ring_attention(
     # full rate; fp32 operands would halve it) and accumulate fp32 via
     # preferred_element_type — same recipe as the Pallas flash kernels
     qf = (q * jnp.asarray(scale, q.dtype)).transpose(0, 2, 1, 3)  # [B,H,ls,D]
+    seg_local = (
+        None if segment_ids is None else segment_ids.astype(jnp.int32)
+    )
 
     def combine(carry, kv_and_src):
         """One ring step: attend local q to the currently-held kv chunk."""
         out, m_prev, l_prev = carry
-        k_cur, v_cur, src_chunk = kv_and_src
+        k_cur, v_cur, seg_cur, src_chunk = kv_and_src
         kf = k_cur.transpose(0, 2, 1, 3)
         vf = v_cur.transpose(0, 2, 1, 3)
         s = jnp.einsum(
@@ -71,6 +78,9 @@ def ring_attention(
             # offset bookkeeping — the flash ring path encodes the same
             # geometry statically via flash_chunk_attention's q_offset
             mask = jnp.logical_and(mask, q_pos - k_pos < window)
+        if seg_cur is not None:
+            same = seg_local[:, None, :, None] == seg_cur[:, None, None, :]
+            mask = jnp.logical_and(mask, same)
         s = jnp.where(mask, s, NEG_INF)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         # a fully-masked row keeps m == NEG_INF; exp(s - m) would be exp(0)=1
@@ -91,15 +101,19 @@ def ring_attention(
         combine = jax.checkpoint(combine)
 
     def step(carry, _):
-        (out, m, l), (k_cur, v_cur, src_chunk) = carry
-        new_acc = combine((out, m, l), (k_cur, v_cur, src_chunk))
-        # rotate kv to the next rank (rank i's chunk moves to rank i+1), so
-        # after step t this rank holds chunk (my_chunk - t - 1) mod n.
+        (out, m, l), (k_cur, v_cur, seg_cur, src_chunk) = carry
+        new_acc = combine((out, m, l), (k_cur, v_cur, seg_cur, src_chunk))
+        # rotate kv (and its segment ids) to the next rank (rank i's chunk
+        # moves to rank i+1), so after step t this rank holds chunk
+        # (my_chunk - t - 1) mod n.
         perm = [(i, (i + 1) % n_chunks) for i in range(n_chunks)]
         k_next = lax.ppermute(k_cur, axis_name, perm)
         v_next = lax.ppermute(v_cur, axis_name, perm)
+        seg_next = (
+            None if seg_cur is None else lax.ppermute(seg_cur, axis_name, perm)
+        )
         src_next = (src_chunk - 1) % n_chunks
-        return (new_acc, (k_next, v_next, src_next)), None
+        return (new_acc, (k_next, v_next, seg_next, src_next)), None
 
     out0 = jnp.zeros((b, h, local_s, d), jnp.float32)
     m0 = jnp.full((b, h, local_s, 1), NEG_INF, jnp.float32)
@@ -120,7 +134,8 @@ def ring_attention(
     out0, m0, l0, k0, v0 = (
         pvary_missing(x, ring_vma) for x in (out0, m0, l0, k, v)
     )
-    init = ((out0, m0, l0), (k0, v0, my_chunk))
+    seg0 = None if seg_local is None else pvary_missing(seg_local, ring_vma)
+    init = ((out0, m0, l0), (k0, v0, seg0, my_chunk))
     ((out, m, l), _), _ = lax.scan(step, init, None, length=n_chunks)
     out = out / jnp.maximum(l, 1e-20)
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
@@ -162,6 +177,7 @@ def ring_flash_attention(
     interpret: Optional[bool] = None,
     use_checkpoint: bool = True,
     window: int = 0,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Ring attention with the per-chunk math on the Pallas flash kernels.
 
@@ -195,15 +211,24 @@ def ring_flash_attention(
     n_chunks = lax.psum(1, axis_name)
     my_chunk = lax.axis_index(axis_name)
     b, local_s, h, d = q.shape
+    seg_local = (
+        None if segment_ids is None else segment_ids.astype(jnp.int32)
+    )
 
     def one_chunk(carry, kv_and_src):
         out, lse = carry
-        k_cur, v_cur, src_chunk = kv_and_src
+        k_cur, v_cur, seg_cur, src_chunk = kv_and_src
+        seg_kw = (
+            {}
+            if seg_cur is None
+            else dict(segment_ids_q=seg_local, segment_ids_kv=seg_cur)
+        )
 
         def diag(_):
             o, s = flash_chunk_attention(
                 q, k_cur, v_cur, causal=True, window=window,
                 block_q=block_q, block_k=block_k, interpret=interpret,
+                **seg_kw,
             )
             return o.astype(jnp.float32), s
 
@@ -221,6 +246,7 @@ def ring_flash_attention(
                     window=0 if fully_visible else window,
                     q_offset=0 if fully_visible else offset,
                     block_q=block_q, block_k=block_k, interpret=interpret,
+                    **seg_kw,
                 )
                 return o.astype(jnp.float32), s
 
@@ -230,6 +256,7 @@ def ring_flash_attention(
             o, s = flash_chunk_attention(
                 q, k_cur, v_cur, causal=False,
                 block_q=block_q, block_k=block_k, interpret=interpret,
+                **seg_kw,
             )
             return o.astype(jnp.float32), s
 
@@ -270,12 +297,15 @@ def ring_flash_attention(
         one_chunk = jax.checkpoint(one_chunk)
 
     def step(carry, _):
-        acc, (k_cur, v_cur, src_chunk) = carry
-        acc = one_chunk(acc, (k_cur, v_cur, src_chunk))
+        acc, (k_cur, v_cur, seg_cur, src_chunk) = carry
+        acc = one_chunk(acc, (k_cur, v_cur, seg_cur, src_chunk))
         perm = [(i, (i + 1) % n_chunks) for i in range(n_chunks)]
         k_next = lax.ppermute(k_cur, axis_name, perm)
         v_next = lax.ppermute(v_cur, axis_name, perm)
-        return (acc, (k_next, v_next, (src_chunk - 1) % n_chunks)), None
+        seg_next = (
+            None if seg_cur is None else lax.ppermute(seg_cur, axis_name, perm)
+        )
+        return (acc, (k_next, v_next, seg_next, (src_chunk - 1) % n_chunks)), None
 
     out0 = jnp.zeros((b, local_s, h, d), jnp.float32)
     lse0 = jnp.full((b, h, local_s), NEG_INF, jnp.float32)
@@ -286,7 +316,8 @@ def ring_flash_attention(
     q_vma = vma_of(q)
     ring_vma = q_vma + tuple(a for a in vma_of(my_chunk) if a not in q_vma)
     out0, lse0, k0, v0 = (pvary_missing(x, ring_vma) for x in (out0, lse0, k, v))
+    seg0 = None if seg_local is None else pvary_missing(seg_local, ring_vma)
     ((out, _), _), _ = lax.scan(
-        step, ((out0, lse0), (k0, v0, my_chunk)), None, length=n_chunks
+        step, ((out0, lse0), (k0, v0, seg0, my_chunk)), None, length=n_chunks
     )
     return out.astype(q.dtype)

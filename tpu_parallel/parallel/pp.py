@@ -114,8 +114,12 @@ def execute_pipeline_step(
         kwargs["aux_scale"] = jnp.logical_and(
             mb_index >= 0, mb_index < num_microbatches
         ).astype(jnp.float32)
-    kwargs = _index_extras(extras, mb_index if extras else None,
-                           num_microbatches or 1, kwargs)
+    if extras:
+        if num_microbatches is None:
+            # fail loudly: clamping against an unknown count would silently
+            # feed every tick microbatch 0's segment_ids/positions
+            raise ValueError("extras require num_microbatches")
+        kwargs = _index_extras(extras, mb_index, num_microbatches, kwargs)
     outputs = module(inputs, **kwargs)
     if outputs.shape != inputs.shape:
         raise ValueError(
