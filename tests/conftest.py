@@ -19,8 +19,23 @@ from tpu_parallel.runtime import simulate_cpu_devices
 simulate_cpu_devices(8)
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
+
+# Persistent XLA compile cache: the round box has ONE cpu core, and the
+# suite's wall time is dominated by XLA compiles of near-identical tiny
+# trainers re-traced per test file.  The disk cache is keyed by HLO hash,
+# so identical programs compile once — across files AND across runs (a
+# re-run of the unchanged suite skips nearly every compile).  Kept under
+# the repo (gitignored) so it survives between gate runs.
+_CACHE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    ".pytest_xla_cache",
+)
+jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
 from tpu_parallel.runtime import MeshConfig, make_mesh
 
@@ -86,6 +101,25 @@ _SLOW_TESTS = {
     "test_loader_trains_gpt",
     "test_interleaved_pipeline_matches_sequential",
     "test_gpt_interleaved_pp_training",
+    # round-4 additions (model-level / gradient-parity tests > ~4s)
+    "test_pp_packed_loss_equals_unpacked",
+    "test_pp_packed_leakage_blocked",
+    "test_ring_window_matches_masked_reference",
+    "test_ring_flash_window_matches_masked_reference",
+    "test_ring_flash_window_gradients_match",
+    "test_gpt_ring_window_training",
+    "test_gpt_ulysses_window_training",
+    "test_ring_packed_matches_reference",
+    "test_gpt_ring_packed_training",
+    "test_gpt_ulysses_packed_training",
+    "test_gqa_model_flash_matches_xla",
+    "test_gqa_decode_matches_train_forward",
+    "test_gqa_gradients_match_expanded_reference",
+    "test_gqa_packed_window_matches_reference",
+    "test_stream_auto_dispatch_long_seq",
+    "test_stream_long_seq_backward_runs",
+    "test_stream_offset_chunk_matches_resident",
+    "test_to_hf_pads_truncated_position_table",
     # round-3 additions measured > ~8s
     "test_gpt_remat_proj_attn_matches_no_remat",
     "test_gpt_unrolled_remat_policies",
@@ -128,6 +162,17 @@ def pytest_collection_modifyitems(config, items):
         assert not stale, (
             f"_SLOW_TESTS entries no longer exist (renamed/deleted?): {stale}"
         )
+
+
+def make_packed_segments(rng_key, b, s):
+    """Random monotone segment ids: 3 segments of random lengths per row.
+    ONE definition for every suite that fabricates packed batches, so they
+    all test the same packing representation."""
+    cuts = jax.random.randint(rng_key, (b, 2), 1, s - 1)
+    lo = jnp.minimum(cuts[:, 0], cuts[:, 1])[:, None]
+    hi = jnp.maximum(cuts[:, 0], cuts[:, 1])[:, None]
+    pos = jnp.arange(s)[None, :]
+    return (pos >= lo).astype(jnp.int32) + (pos >= hi).astype(jnp.int32)
 
 
 @pytest.fixture(scope="session")

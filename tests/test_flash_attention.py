@@ -101,12 +101,9 @@ def test_attention_hook_in_model(rng):
 
 
 def _packed_segments(rng, b, s):
-    """Random monotone segment ids: 3 segments of random lengths per row."""
-    cuts = jax.random.randint(rng, (b, 2), 1, s - 1)
-    lo = jnp.minimum(cuts[:, 0], cuts[:, 1])[:, None]
-    hi = jnp.maximum(cuts[:, 0], cuts[:, 1])[:, None]
-    pos = jnp.arange(s)[None, :]
-    return (pos >= lo).astype(jnp.int32) + (pos >= hi).astype(jnp.int32)
+    from conftest import make_packed_segments
+
+    return make_packed_segments(rng, b, s)
 
 
 def test_packed_forward_matches_reference(rng):

@@ -429,11 +429,9 @@ def test_gpt_ulysses_window_training(rng):
 
 
 def _ring_packed_segments(rng_key, b, s):
-    cuts = jax.random.randint(rng_key, (b, 2), 1, s - 1)
-    lo = jnp.minimum(cuts[:, 0], cuts[:, 1])[:, None]
-    hi = jnp.maximum(cuts[:, 0], cuts[:, 1])[:, None]
-    pos = jnp.arange(s)[None, :]
-    return (pos >= lo).astype(jnp.int32) + (pos >= hi).astype(jnp.int32)
+    from conftest import make_packed_segments
+
+    return make_packed_segments(rng_key, b, s)
 
 
 @pytest.mark.parametrize("impl", ["jnp", "flash"])

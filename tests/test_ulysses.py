@@ -139,10 +139,9 @@ def test_gpt_ulysses_packed_training(mesh_seq4, rng):
 
     cfg = tiny_test(attn_impl="ulysses", seq_len=64)
     base = lm_batch(jax.random.PRNGKey(0), 8, cfg.seq_len, cfg.vocab_size)
-    rng_np = np.random.default_rng(3)
-    cuts = np.sort(rng_np.integers(1, cfg.seq_len - 1, (8, 2)), axis=1)
-    pos = np.arange(cfg.seq_len)[None, :]
-    seg = (pos >= cuts[:, :1]).astype(np.int32) + (pos >= cuts[:, 1:]).astype(np.int32)
+    from conftest import make_packed_segments
+
+    seg = make_packed_segments(jax.random.PRNGKey(3), 8, cfg.seq_len)
     batch = TextBatch(
         tokens=base.tokens, targets=base.targets, loss_mask=base.loss_mask,
         positions=base.positions, segment_ids=jnp.asarray(seg),

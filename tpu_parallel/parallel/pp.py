@@ -101,6 +101,13 @@ def execute_pipeline_step(
     communication.  Off-schedule ticks index a clamped slot; their compute
     is garbage that the schedule masks anyway.
     """
+    if (pass_validity or extras) and (tick is None or num_microbatches is None):
+        # fail loudly up front: both features index microbatches by
+        # (tick - stage), so a missing tick/count would otherwise die in an
+        # opaque TypeError (or silently clamp every tick to microbatch 0)
+        raise ValueError(
+            "pass_validity/extras require tick and num_microbatches"
+        )
     num_stages = lax.psum(1, axis_name)
     stage = lax.axis_index(axis_name)
     # Stage 0 reads fresh microbatches; other stages read the rotated carry.
@@ -115,10 +122,6 @@ def execute_pipeline_step(
             mb_index >= 0, mb_index < num_microbatches
         ).astype(jnp.float32)
     if extras:
-        if num_microbatches is None:
-            # fail loudly: clamping against an unknown count would silently
-            # feed every tick microbatch 0's segment_ids/positions
-            raise ValueError("extras require num_microbatches")
         kwargs = _index_extras(extras, mb_index, num_microbatches, kwargs)
     outputs = module(inputs, **kwargs)
     if outputs.shape != inputs.shape:
