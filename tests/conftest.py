@@ -102,6 +102,9 @@ _SLOW_TESTS = {
     "test_interleaved_pipeline_matches_sequential",
     "test_gpt_interleaved_pp_training",
     # round-4 additions (model-level / gradient-parity tests > ~4s)
+    "test_bidirectional_flash_matches_xla",
+    "test_mlm_training_decreases_loss",
+    "test_mlm_tp_training",
     "test_pp_packed_loss_equals_unpacked",
     "test_pp_packed_leakage_blocked",
     "test_ring_window_matches_masked_reference",

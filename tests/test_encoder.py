@@ -1,0 +1,144 @@
+"""Bidirectional (encoder / BERT-style) model family: attention semantics,
+flash-vs-xla parity, MLM training, mesh composition, refusals."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpu_parallel.core import TrainState, compute
+from tpu_parallel.data import lm_batch
+from tpu_parallel.models import GPTLM, make_mlm_loss, tiny_test
+from tpu_parallel.parallel.spmd import build_train_functions
+
+
+def _enc_cfg(**kw):
+    return tiny_test(
+        bidirectional=True, dtype=jnp.float32, remat=False, **kw
+    )
+
+
+def test_bidirectional_sees_future(rng):
+    """Perturbing a LATE token must change EARLY outputs (no causal mask)."""
+    cfg = _enc_cfg(seq_len=32, scan_layers=False, n_layers=1)
+    model = GPTLM(cfg)
+    tokens = jax.random.randint(rng, (1, 32), 0, cfg.vocab_size)
+    params = model.init({"params": jax.random.PRNGKey(0)}, tokens, train=False)[
+        "params"
+    ]
+    base = model.apply({"params": params}, tokens, train=False)
+    tokens2 = tokens.at[0, -1].set((tokens[0, -1] + 1) % cfg.vocab_size)
+    pert = model.apply({"params": params}, tokens2, train=False)
+    # early positions see the change — unlike the causal model
+    assert not np.allclose(np.asarray(base[:, 0]), np.asarray(pert[:, 0]))
+
+    causal_cfg = tiny_test(
+        dtype=jnp.float32, remat=False, seq_len=32, scan_layers=False, n_layers=1
+    )
+    causal_model = GPTLM(causal_cfg)
+    cbase = causal_model.apply({"params": params}, tokens, train=False)
+    cpert = causal_model.apply({"params": params}, tokens2, train=False)
+    np.testing.assert_allclose(
+        np.asarray(cbase[:, :-1]), np.asarray(cpert[:, :-1]), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_bidirectional_flash_matches_xla(rng):
+    """Encoder forward agrees between the flash (non-causal chunk kernel)
+    and xla paths, including GQA and packing."""
+    tokens = jax.random.randint(rng, (2, 64), 0, 256)
+    from conftest import make_packed_segments
+
+    seg = make_packed_segments(jax.random.PRNGKey(7), 2, 64)
+    for n_kv in (None, 2):
+        cfg_x = _enc_cfg(seq_len=64, attn_impl="xla", n_kv_heads=n_kv,
+                         scan_layers=False)
+        cfg_f = _enc_cfg(seq_len=64, attn_impl="flash", n_kv_heads=n_kv,
+                         scan_layers=False, flash_block_q=32, flash_block_k=32)
+        params = GPTLM(cfg_x).init(
+            {"params": jax.random.PRNGKey(0)}, tokens, train=False
+        )["params"]
+        for seg_arg in (None, seg):
+            lx = GPTLM(cfg_x).apply(
+                {"params": params}, tokens, segment_ids=seg_arg, train=False
+            )
+            lf = GPTLM(cfg_f).apply(
+                {"params": params}, tokens, segment_ids=seg_arg, train=False
+            )
+            np.testing.assert_allclose(
+                np.asarray(lf), np.asarray(lx), rtol=2e-3, atol=2e-3,
+                err_msg=f"n_kv={n_kv} packed={seg_arg is not None}",
+            )
+
+
+def test_mlm_training_decreases_loss(mesh_data8, rng):
+    """End-to-end MLM pretraining on the 8-device DP mesh."""
+    cfg = tiny_test(bidirectional=True, seq_len=32)
+    batch = lm_batch(jax.random.PRNGKey(0), 16, cfg.seq_len, cfg.vocab_size)
+    model = GPTLM(cfg)
+    tx = optax.adamw(3e-3)
+
+    def init(rng_, b):
+        p = model.init({"params": rng_}, b.tokens, train=False)["params"]
+        return TrainState.create(apply_fn=model.apply, params=p, tx=tx, rng=rng_)
+
+    funcs = build_train_functions(
+        init, make_mlm_loss(cfg, mask_rate=0.3), mesh_data8, batch,
+        batch_spec=P("data"), donate=False,
+    )
+    state = funcs.init_fn(rng, batch)
+    state, m0 = funcs.step_fn(state, None, batch)
+    first = compute(m0)["loss"]
+    for _ in range(8):
+        state, m = funcs.step_fn(state, None, batch)
+    last = compute(m)
+    assert last["loss"] < first
+    # only ~30% of tokens are scored per step
+    tokens_scored = float(m["loss"][1])
+    assert 0 < tokens_scored < 16 * 32
+
+
+def test_mlm_tp_training(mesh_data4_model2, rng):
+    """MLM composes with TP (vocab-parallel CE under the model axis)."""
+    cfg = tiny_test(bidirectional=True, seq_len=32)
+    batch = lm_batch(jax.random.PRNGKey(0), 8, cfg.seq_len, cfg.vocab_size)
+    model = GPTLM(cfg)
+    tx = optax.adamw(3e-3)
+
+    def init(rng_, b):
+        p = model.init({"params": rng_}, b.tokens, train=False)["params"]
+        return TrainState.create(apply_fn=model.apply, params=p, tx=tx, rng=rng_)
+
+    funcs = build_train_functions(
+        init, make_mlm_loss(cfg, mask_rate=0.3), mesh_data4_model2, batch,
+        batch_spec=P("data"), donate=False,
+    )
+    state = funcs.init_fn(rng, batch)
+    state, m0 = funcs.step_fn(state, None, batch)
+    first = compute(m0)["loss"]
+    for _ in range(5):
+        state, m = funcs.step_fn(state, None, batch)
+    assert compute(m)["loss"] < first
+
+
+def test_encoder_refusals(rng):
+    """Decode, window, and SP attention refuse loudly under bidirectional."""
+    tokens = jnp.zeros((1, 32), jnp.int32)
+    cfg = _enc_cfg(seq_len=32)
+    model = GPTLM(cfg)
+    params = model.init({"params": rng}, tokens, train=False)["params"]
+    with pytest.raises(NotImplementedError, match="bidirectional"):
+        model.apply(
+            {"params": params}, tokens, train=False, decode=True,
+            mutable=["cache"],
+        )
+    with pytest.raises(NotImplementedError, match="window"):
+        GPTLM(_enc_cfg(seq_len=32, attn_window=8)).init(
+            {"params": rng}, tokens, train=False
+        )
+    with pytest.raises(NotImplementedError, match="ring"):
+        GPTLM(_enc_cfg(seq_len=32, attn_impl="ring")).init(
+            {"params": rng}, tokens, train=False
+        )

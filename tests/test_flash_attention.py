@@ -5,6 +5,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from conftest import make_packed_segments as _packed_segments
 from tpu_parallel.ops.flash_attention import (
     flash_attention,
     reference_attention,
@@ -100,10 +101,7 @@ def test_attention_hook_in_model(rng):
     )
 
 
-def _packed_segments(rng, b, s):
-    from conftest import make_packed_segments
 
-    return make_packed_segments(rng, b, s)
 
 
 def test_packed_forward_matches_reference(rng):

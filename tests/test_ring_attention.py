@@ -7,6 +7,7 @@ import optax
 import pytest
 from jax.sharding import PartitionSpec as P
 
+from conftest import make_packed_segments as _ring_packed_segments
 from tpu_parallel.core import compute
 from tpu_parallel.data import lm_batch
 from tpu_parallel.models import GPTLM, make_gpt_loss, tiny_test
@@ -428,10 +429,7 @@ def test_gpt_ulysses_window_training(rng):
 # --- packed sequences under ring SP ------------------------------------------
 
 
-def _ring_packed_segments(rng_key, b, s):
-    from conftest import make_packed_segments
 
-    return make_packed_segments(rng_key, b, s)
 
 
 @pytest.mark.parametrize("impl", ["jnp", "flash"])

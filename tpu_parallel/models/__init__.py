@@ -3,8 +3,10 @@ from tpu_parallel.models.gpt import (
     GPTLM,
     gpt2_125m,
     gpt2_350m,
+    bert_base,
     llama_1b,
     make_gpt_loss,
+    make_mlm_loss,
     tiny_test,
 )
 from tpu_parallel.models.layers import TransformerConfig
@@ -29,8 +31,10 @@ __all__ = [
     "GPTLM",
     "gpt2_125m",
     "gpt2_350m",
+    "bert_base",
     "llama_1b",
     "make_gpt_loss",
+    "make_mlm_loss",
     "tiny_test",
     "TransformerConfig",
     "MLPClassifier",

@@ -343,6 +343,52 @@ def make_gpt_loss(config: GPTConfig, train: bool = True):
     return loss_fn
 
 
+def make_mlm_loss(
+    config: GPTConfig,
+    mask_rate: float = 0.15,
+    mask_token_id: Optional[int] = None,
+    train: bool = True,
+):
+    """Masked-LM objective for bidirectional (encoder) configs.
+
+    Wraps :func:`make_gpt_loss`'s CE machinery (vocab-parallel under TP,
+    chunked under ``loss_chunk``, PP-masked): each step corrupts
+    ``mask_rate`` of the input tokens to ``mask_token_id`` (default: the
+    last vocab id, by convention reserved for [MASK]) and scores the model
+    on recovering the originals at exactly those positions.
+
+    RNG discipline: the corruption pattern folds over the data and seq axes
+    only — model/pipe ranks hold replicated copies of the same tokens and
+    MUST corrupt them identically, while data/seq shards draw independent
+    masks.  (Dropout keeps its own all-axes fold inside the inner loss.)
+    """
+    from tpu_parallel.core.state import TextBatch
+
+    inner = make_gpt_loss(config, train=train)
+    mask_id = (
+        mask_token_id if mask_token_id is not None else config.vocab_size - 1
+    )
+    corrupt_axes = (config.data_axis, config.seq_axis)
+
+    def loss_fn(params, apply_fn, batch, rng):
+        mask_rng = fold_rng_over_axis(jax.random.fold_in(rng, 17), corrupt_axes)
+        masked = jax.random.bernoulli(mask_rng, mask_rate, batch.tokens.shape)
+        corrupted = jnp.where(masked, mask_id, batch.tokens)
+        loss_mask = masked.astype(jnp.float32)
+        if batch.loss_mask is not None:
+            loss_mask = loss_mask * batch.loss_mask
+        mlm_batch = TextBatch(
+            tokens=corrupted,
+            targets=batch.tokens,
+            loss_mask=loss_mask,
+            positions=batch.positions,
+            segment_ids=batch.segment_ids,
+        )
+        return inner(params, apply_fn, mlm_batch, rng)
+
+    return loss_fn
+
+
 # --- Named configurations (BASELINE.md matrix) --------------------------------
 
 
@@ -380,6 +426,27 @@ def llama_1b(**overrides) -> GPTConfig:
                 positional="rope",
                 norm="rmsnorm",
                 mlp="swiglu",
+            ),
+            **overrides,
+        }
+    )
+
+
+def bert_base(**overrides) -> GPTConfig:
+    """BERT-base-shaped bidirectional encoder (MLM via make_mlm_loss).
+
+    vocab 30522 padded to 30592 (multiple of 128 for MXU lanes; the last id
+    doubles as [MASK] by make_mlm_loss's default).
+    """
+    return GPTConfig(
+        **{
+            **dict(
+                vocab_size=30592,
+                d_model=768,
+                n_layers=12,
+                n_heads=12,
+                seq_len=512,
+                bidirectional=True,
             ),
             **overrides,
         }

@@ -91,6 +91,11 @@ class TransformerConfig:
     # the cache HBM (the decode-memory hog) with one fp32 scale per
     # (position, kv-head); dequantization is a transient per layer per step
     kv_cache_dtype: str = "bf16"
+    # bidirectional (encoder / BERT-style) attention: every position sees
+    # every same-segment position.  Composes with the xla and flash paths,
+    # GQA, packing, TP/FSDP/PP; refuses decode (encoders don't
+    # autoregress), window, and ring/ulysses SP (causal ring structure)
+    bidirectional: bool = False
     # mixture-of-experts: 0 = dense MLP; >0 replaces every block's MLP with
     # routed experts, expert-parallel over the model axis
     moe_experts: int = 0
@@ -153,27 +158,32 @@ def causal_attention(
     *,
     segment_ids: Optional[jax.Array] = None,
     window: int = 0,
+    causal: bool = True,
 ) -> jax.Array:
-    """Reference causal attention: fp32 softmax, bf16 matmuls on the MXU.
+    """Reference attention: fp32 softmax, bf16 matmuls on the MXU.
 
     ``q, k, v``: [batch, seq, heads, head_dim].  O(seq^2) memory — the
     Pallas flash kernel (``ops.flash_attention``) replaces this on TPU for
-    long sequences.
+    long sequences.  ``causal=False`` is the bidirectional (encoder) form:
+    every position attends every (same-segment) position.
     """
+    if window and not causal:
+        raise NotImplementedError("sliding window with bidirectional attention")
     head_dim = q.shape[-1]
     scale = 1.0 / jnp.sqrt(head_dim).astype(q.dtype)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k)
     scores = scores.astype(jnp.float32)
     q_pos = lax.broadcasted_iota(jnp.int32, scores.shape, 2)
     k_pos = lax.broadcasted_iota(jnp.int32, scores.shape, 3)
-    mask = q_pos >= k_pos
+    mask = q_pos >= k_pos if causal else None
     if window:
         # sliding window: query t attends keys in (t - window, t] only
         mask = jnp.logical_and(mask, q_pos - k_pos < window)
     if segment_ids is not None:
         same_seg = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
-        mask = jnp.logical_and(mask, same_seg)
-    scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+        mask = same_seg if mask is None else jnp.logical_and(mask, same_seg)
+    if mask is not None:
+        scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
@@ -250,6 +260,21 @@ class Attention(nn.Module):
             )
         local_heads = cfg.n_heads // tp_size
         local_kv = n_kv // tp_size
+        if cfg.bidirectional:
+            if decode:
+                raise NotImplementedError(
+                    "incremental decoding with bidirectional attention "
+                    "(encoders do not autoregress)"
+                )
+            if cfg.attn_window:
+                raise NotImplementedError(
+                    "sliding window with bidirectional attention"
+                )
+            if cfg.attn_impl in ("ring", "ulysses"):
+                raise NotImplementedError(
+                    f"bidirectional attention under {cfg.attn_impl} sequence "
+                    "parallelism"
+                )
         if n_kv == cfg.n_heads:
             qkv = TPDense(
                 features=3 * cfg.d_model,
@@ -429,7 +454,23 @@ class Attention(nn.Module):
             v = jnp.repeat(v, group, axis=2)
         attn_fn = self.attn_fn
         if attn_fn is None:
-            if cfg.attn_impl == "flash":
+            if cfg.attn_impl == "flash" and cfg.bidirectional:
+                from tpu_parallel.ops.flash_attention import (
+                    flash_chunk_attention,
+                )
+
+                # bidirectional flash = one non-causal "chunk" spanning the
+                # whole sequence (the chunk kernels already do full
+                # visibility + segment masking; the lse is discarded)
+                def attn_fn(q, k, v, segment_ids=None):
+                    out, _ = flash_chunk_attention(
+                        q, k, v, causal=False,
+                        block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
+                        segment_ids_q=segment_ids, segment_ids_kv=segment_ids,
+                    )
+                    return out
+
+            elif cfg.attn_impl == "flash":
                 from tpu_parallel.ops.flash_attention import flash_attention
 
                 attn_fn = functools.partial(
@@ -497,7 +538,8 @@ class Attention(nn.Module):
 
             else:
                 attn_fn = functools.partial(
-                    causal_attention, window=cfg.attn_window
+                    causal_attention, window=cfg.attn_window,
+                    causal=not cfg.bidirectional,
                 )
         return attn_fn(q, k, v, segment_ids=segment_ids)
 
