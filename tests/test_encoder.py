@@ -142,3 +142,64 @@ def test_encoder_refusals(rng):
         GPTLM(_enc_cfg(seq_len=32, attn_impl="ring")).init(
             {"params": rng}, tokens, train=False
         )
+
+
+def test_encoder_classifier_finetunes(mesh_data8, rng):
+    """EncoderClassifier memorizes a tiny labeled set through the standard
+    classification loss on the DP mesh (the BERT fine-tune shape)."""
+    from tpu_parallel.core.losses import make_classification_loss
+    from tpu_parallel.core.state import Batch
+    from tpu_parallel.models import EncoderClassifier
+
+    cfg = tiny_test(bidirectional=True, seq_len=16)
+    num_classes = 4
+    model = EncoderClassifier(cfg, num_classes=num_classes)
+    tokens = jax.random.randint(rng, (16, 16), 0, cfg.vocab_size)
+    labels = jax.random.randint(jax.random.PRNGKey(1), (16,), 0, num_classes)
+    batch = Batch(inputs=tokens, labels=labels)
+    tx = optax.adamw(1e-2)
+
+    def init(rng_, b):
+        p = model.init({"params": rng_}, b.inputs, train=False)["params"]
+        return TrainState.create(apply_fn=model.apply, params=p, tx=tx, rng=rng_)
+
+    funcs = build_train_functions(
+        init, make_classification_loss("data"), mesh_data8, batch,
+        batch_spec=P("data"), donate=False,
+    )
+    state = funcs.init_fn(rng, batch)
+    state, m0 = funcs.step_fn(state, None, batch)
+    first = compute(m0)
+    for _ in range(25):
+        state, m = funcs.step_fn(state, None, batch)
+    last = compute(m)
+    assert last["loss"] < first["loss"]
+    assert last["accuracy"] > 0.5, last
+
+
+def test_encoder_classifier_refuses_causal_and_masks_mean_pool(rng):
+    from tpu_parallel.models import EncoderClassifier
+
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    with pytest.raises(ValueError, match="bidirectional"):
+        EncoderClassifier(
+            tiny_test(dtype=jnp.float32, remat=False), num_classes=2
+        ).init({"params": rng}, tokens, train=False)
+
+    # mean pooling excludes positions outside the first segment: changing
+    # segment-1 tokens must not move the logits
+    cfg = _enc_cfg(seq_len=16, scan_layers=False, n_layers=1)
+    model = EncoderClassifier(cfg, num_classes=3, pool="mean")
+    toks = jax.random.randint(rng, (2, 16), 0, cfg.vocab_size)
+    seg = jnp.concatenate(
+        [jnp.zeros((2, 8), jnp.int32), jnp.ones((2, 8), jnp.int32)], axis=1
+    )
+    params = model.init(
+        {"params": jax.random.PRNGKey(0)}, toks, segment_ids=seg, train=False
+    )["params"]
+    base = model.apply({"params": params}, toks, segment_ids=seg, train=False)
+    toks2 = toks.at[:, 8:].set((toks[:, 8:] + 5) % cfg.vocab_size)
+    pert = model.apply({"params": params}, toks2, segment_ids=seg, train=False)
+    np.testing.assert_allclose(
+        np.asarray(base), np.asarray(pert), rtol=1e-5, atol=1e-5
+    )

@@ -3,6 +3,7 @@ from tpu_parallel.models.gpt import (
     GPTLM,
     gpt2_125m,
     gpt2_350m,
+    EncoderClassifier,
     bert_base,
     llama_1b,
     make_gpt_loss,
@@ -31,6 +32,7 @@ __all__ = [
     "GPTLM",
     "gpt2_125m",
     "gpt2_350m",
+    "EncoderClassifier",
     "bert_base",
     "llama_1b",
     "make_gpt_loss",

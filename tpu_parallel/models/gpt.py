@@ -343,6 +343,70 @@ def make_gpt_loss(config: GPTConfig, train: bool = True):
     return loss_fn
 
 
+class EncoderClassifier(nn.Module):
+    """Sequence classification head over the (bidirectional) trunk.
+
+    The BERT fine-tune shape: encoder hidden states -> pooled vector
+    (``"first"`` = CLS-style first token through a tanh pooler, ``"mean"``
+    = mean over the row's FIRST segment when ``segment_ids`` are given —
+    padding/foreign segments excluded — else over every position) -> class
+    logits.  Works with
+    :func:`~tpu_parallel.core.losses.make_classification_loss` unchanged
+    (``apply_fn(tokens)`` -> ``[batch, num_classes]``); the trunk composes
+    with TP/FSDP exactly as the LM does.  Requires ``bidirectional=True``:
+    under a causal mask the CLS position attends to nothing but itself.
+    """
+
+    config: GPTConfig
+    num_classes: int
+    pool: str = "first"  # "first" (CLS) | "mean"
+
+    @nn.compact
+    def __call__(
+        self,
+        tokens: jax.Array,
+        positions: Optional[jax.Array] = None,
+        segment_ids: Optional[jax.Array] = None,
+        train: bool = True,
+    ) -> jax.Array:
+        cfg = self.config
+        if not cfg.bidirectional:
+            raise ValueError(
+                "EncoderClassifier requires bidirectional=True — under a "
+                "causal mask the pooled position cannot see the sequence"
+            )
+        h = GPTLM(cfg, name="encoder")(
+            tokens,
+            positions=positions,
+            segment_ids=segment_ids,
+            train=train,
+            hidden_only=True,
+        )
+        if self.pool == "mean":
+            if segment_ids is not None:
+                # pool only the row's first segment: pad tokens (and any
+                # packed neighbours) must not shift the pooled vector
+                w = (segment_ids == segment_ids[:, :1]).astype(h.dtype)[..., None]
+                pooled = (h * w).sum(axis=1) / jnp.maximum(w.sum(axis=1), 1.0)
+            else:
+                pooled = h.mean(axis=1)
+        elif self.pool == "first":
+            pooled = h[:, 0]
+        else:
+            raise ValueError(f"pool={self.pool!r} (first | mean)")
+        pooled = jnp.tanh(
+            nn.Dense(cfg.d_model, dtype=cfg.dtype, name="pooler")(pooled)
+        )
+        if cfg.dropout_rate > 0.0:
+            pooled = nn.Dropout(
+                rate=cfg.dropout_rate, deterministic=not train
+            )(pooled)
+        # fp32 class logits: tiny tensor, and the CE upcast costs nothing
+        return nn.Dense(
+            self.num_classes, dtype=jnp.float32, name="classifier"
+        )(pooled)
+
+
 def make_mlm_loss(
     config: GPTConfig,
     mask_rate: float = 0.15,
