@@ -517,3 +517,74 @@ def test_pp_decode_prefill_logits_match_train_forward(mesh_pipe4_data2, rng):
         np.asarray(outs["decode"]), np.asarray(outs["train"]),
         rtol=1e-4, atol=1e-4,
     )
+
+
+def test_sample_sharded_matches_full_vocab(mesh_data4_model2, rng):
+    """Vocab-parallel sampling over the model axis is exact: greedy equals
+    argmax; top-k never leaves the global top-k and its empirical
+    distribution tracks the renormalized softmax; Gumbel-max temperature
+    sampling tracks the full softmax."""
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_parallel.models.generate import _sample_sharded
+    from tpu_parallel.parallel.tp import split_over_axis
+
+    mesh = mesh_data4_model2
+    vocab = 64
+    rows = 2048  # rows double as independent draws
+    logits = jnp.tile(
+        jax.random.normal(rng, (1, vocab)) * 2.0, (rows, 1)
+    )
+
+    def run(temperature, top_k, top_p, key):
+        def body(full, k_):
+            from tpu_parallel.core.rng import fold_rng_over_axis
+
+            # decorrelate the data shards (generate folds over data itself;
+            # this harness must too or the 4 shards draw identical tokens
+            # and the frequency checks lose 4x their statistical power)
+            k_ = fold_rng_over_axis(k_, "data")
+            shard = split_over_axis(full, "model", axis=-1)
+            return _sample_sharded(shard, k_, temperature, top_k, top_p, "model")
+
+        return jax.jit(
+            jax.shard_map(
+                body, mesh=mesh, in_specs=(P("data"), P()),
+                out_specs=P("data"), check_vma=False,
+            )
+        )(logits, key)
+
+    # greedy == argmax everywhere
+    greedy = run(0.0, 0, 0.0, jax.random.PRNGKey(0))
+    np.testing.assert_array_equal(
+        np.asarray(greedy), np.asarray(logits.argmax(-1))
+    )
+
+    probs = np.asarray(jax.nn.softmax(logits[0].astype(jnp.float32)))
+    # temperature=1: noise is drawn per [rows, vs] slice with the key
+    # folded over the data axis, so all 2048 rows are independent draws
+    temp = np.asarray(run(1.0, 0, 0.0, jax.random.PRNGKey(1)))
+    freq = np.bincount(temp, minlength=vocab) / rows
+    assert np.abs(freq - probs).max() < 0.05, "temperature sampling off"
+
+    # top-k: support restricted to the global top-k, frequencies track the
+    # renormalized distribution
+    k = 8
+    topk = np.asarray(run(1.0, k, 0.0, jax.random.PRNGKey(2)))
+    top_set = set(np.asarray(jax.lax.top_k(logits[0], k)[1]).tolist())
+    assert set(topk.tolist()) <= top_set
+    pk = probs.copy()
+    mask = np.ones(vocab, bool)
+    mask[list(top_set)] = False
+    pk[mask] = 0.0
+    pk = pk / pk.sum()
+    freq_k = np.bincount(topk, minlength=vocab) / rows
+    assert np.abs(freq_k - pk).max() < 0.05, "top-k sampling off"
+
+    # top-p falls back to the gathered path and still restricts support
+    topp = np.asarray(run(1.0, 0, 0.3, jax.random.PRNGKey(3)))
+    order = np.argsort(-probs)
+    cum = np.cumsum(probs[order])
+    nucleus = set(order[: int(np.searchsorted(cum, 0.3) + 1)].tolist())
+    assert set(topp.tolist()) <= nucleus

@@ -34,6 +34,21 @@ def token_cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:
     )
 
 
+def vocab_parallel_argmax(logits: jax.Array, axis_name: str) -> jax.Array:
+    """Global argmax over vocab-sharded logits [..., vocab/tp]: each shard
+    nominates its local winner; the shard(s) holding the global max win,
+    lowest id on ties (matching ``argmax``'s first-occurrence convention on
+    gathered logits).  Two scalar-per-row collectives, no gather."""
+    vs = logits.shape[-1]
+    offset = jax.lax.axis_index(axis_name) * vs
+    lf = logits.astype(jnp.float32)
+    local_max = lf.max(axis=-1)
+    global_max = jax.lax.pmax(jax.lax.stop_gradient(local_max), axis_name)
+    local_arg = lf.argmax(axis=-1).astype(jnp.int32) + offset
+    nominee = jnp.where(local_max == global_max, local_arg, jnp.int32(2**31 - 1))
+    return jax.lax.pmin(nominee, axis_name)
+
+
 def vocab_parallel_cross_entropy(
     logits: jax.Array, targets: jax.Array, axis_name: str
 ) -> Tuple[jax.Array, jax.Array]:
@@ -68,11 +83,7 @@ def vocab_parallel_cross_entropy(
     own_logit = jnp.take_along_axis(lf, safe_idx[..., None], axis=-1)[..., 0]
     target_logit = jax.lax.psum(jnp.where(owns, own_logit, 0.0), axis_name)
     ce = lse - target_logit
-    # global argmax: each shard nominates its local winner; the shard(s)
-    # holding the global max win, lowest id on ties
-    local_arg = lf.argmax(axis=-1).astype(jnp.int32) + offset
-    nominee = jnp.where(local_max == global_max, local_arg, jnp.int32(2**31 - 1))
-    pred = jax.lax.pmin(nominee, axis_name)
+    pred = vocab_parallel_argmax(logits, axis_name)
     return ce, pred
 
 

@@ -60,6 +60,59 @@ def _sample(
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
+def _sample_sharded(
+    logits: jax.Array, rng: jax.Array, temperature: float, top_k: int,
+    top_p: float, axis_name: str,
+):
+    """One token per row from vocab-SHARDED [batch, vocab/tp] logits, no
+    full-vocab gather.
+
+    - greedy: the two-collective global-argmax trick
+      (:func:`~tpu_parallel.core.losses.vocab_parallel_argmax`).
+    - temperature: Gumbel-max — each shard perturbs its slice with its own
+      Gumbel noise (rng folded over the model axis) and the global argmax
+      of ``logits/T + G`` is an exact softmax sample.
+    - top_k: each shard's local top-k is a superset contributor to the
+      global top-k; all_gather the ``tp * k`` candidates (tiny) and finish
+      there.
+    - top_p: needs the full sorted distribution — gathers the row
+      (one [batch, vocab] all_gather per step, still far below the old
+      every-step full-logits gather at [batch, seq, vocab] prefill).
+
+    Every rank returns the SAME token (all decisions go through
+    collectives), which TP decoding requires.
+    """
+    from tpu_parallel.core.losses import vocab_parallel_argmax
+    from tpu_parallel.core.rng import fold_rng_over_axis
+
+    if 0.0 < top_p < 1.0:
+        full = lax.all_gather(logits, axis_name, axis=-1, tiled=True)
+        # identical rng on every rank -> identical sample
+        return _sample(full, rng, temperature, top_k, top_p)
+    lf = logits.astype(jnp.float32)
+    if temperature == 0.0:
+        return vocab_parallel_argmax(lf, axis_name)
+    lf = lf / temperature
+    vs = lf.shape[-1]
+    offset = lax.axis_index(axis_name) * vs
+    if top_k > 0:
+        k = min(top_k, vs)
+        vals, idx = jax.lax.top_k(lf, k)  # [b, k] local
+        cand_vals = lax.all_gather(vals, axis_name, axis=-1, tiled=True)
+        cand_ids = lax.all_gather(
+            idx.astype(jnp.int32) + offset, axis_name, axis=-1, tiled=True
+        )
+        # global top-k lives inside the tp*k candidates; mask the rest and
+        # sample among candidates (identical rng/result on every rank)
+        kth = jnp.sort(cand_vals, axis=-1)[:, -top_k][:, None]
+        masked = jnp.where(cand_vals < kth, -jnp.inf, cand_vals)
+        choice = jax.random.categorical(rng, masked, axis=-1)
+        return jnp.take_along_axis(cand_ids, choice[:, None], axis=1)[:, 0]
+    # pure temperature: Gumbel-max over the shards
+    g = jax.random.gumbel(fold_rng_over_axis(rng, axis_name), lf.shape)
+    return vocab_parallel_argmax(lf + g, axis_name)
+
+
 def _generate_core(
     model: GPTLM,
     params,
@@ -71,7 +124,17 @@ def _generate_core(
     top_p: float = 0.0,
 ) -> jax.Array:
     """The traceable prefill + decode-scan body shared by :func:`generate`
-    (jit, one device) and :func:`generate_sharded` (shard_map, any mesh)."""
+    (jit, one device) and :func:`generate_sharded` (shard_map, any mesh).
+
+    The lm_head applies only to the LAST position's hidden state (the only
+    logits sampling reads — full-prompt prefill logits were pure waste),
+    column-sharded under TP: sampling then runs vocab-parallel
+    (:func:`_sample_sharded`) and the per-step full-vocab all_gather
+    disappears for greedy/temperature/top-k decoding.
+    """
+    from tpu_parallel.models.gpt import _make_lm_head
+    from tpu_parallel.parallel.tp import axis_size_or_none
+
     cfg = model.config
     b, prompt_len = prompt.shape
     if prompt_len + max_new_tokens > cfg.seq_len:
@@ -79,33 +142,45 @@ def _generate_core(
             f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
             f"exceeds seq_len ({cfg.seq_len})"
         )
+    head = _make_lm_head(cfg, name=None, gather=False)
+
+    def next_token(h, rng):
+        # h: [b, t, d] hidden states; head only the final position
+        logits = head.apply({"params": params["lm_head"]}, h[:, -1:])[:, 0]
+        if axis_size_or_none(cfg.model_axis) is not None:
+            return _sample_sharded(
+                logits, rng, temperature, top_k, top_p, cfg.model_axis
+            )
+        return _sample(logits, rng, temperature, top_k, top_p)
 
     # Prefill: one batched forward over the prompt creates and fills the
     # cache ('cache' is created on the fly because it is marked mutable).
     positions = jnp.broadcast_to(jnp.arange(prompt_len), (b, prompt_len))
-    logits, variables = model.apply(
+    hidden, variables = model.apply(
         {"params": params},
         prompt,
         positions=positions,
         train=False,
         decode=True,
+        hidden_only=True,
         mutable=["cache"],
     )
     rng, sub = jax.random.split(rng)
-    first = _sample(logits[:, -1], sub, temperature, top_k, top_p)
+    first = next_token(hidden, sub)
 
     def step(carry, _):
         cache, tok, pos, rng = carry
-        logits, updated = model.apply(
+        hidden, updated = model.apply(
             {"params": params, "cache": cache},
             tok[:, None],
             positions=jnp.full((b, 1), pos, jnp.int32),
             train=False,
             decode=True,
+            hidden_only=True,
             mutable=["cache"],
         )
         rng, sub = jax.random.split(rng)
-        nxt = _sample(logits[:, -1], sub, temperature, top_k, top_p)
+        nxt = next_token(hidden, sub)
         return (updated["cache"], nxt, pos + 1, rng), tok
 
     init = (variables["cache"], first, jnp.int32(prompt_len), rng)
@@ -251,9 +326,10 @@ def _sharded_generate_fn(
             in_specs=(param_specs, batch_spec, P()),
             out_specs=batch_spec,
             # sampled tokens are replicated over the model and pipe axes by
-            # construction (every TP rank computes identical full logits
-            # after the lm_head gather; the decode ring psum-broadcasts over
-            # pipe); the checker cannot prove it
+            # construction (every TP rank's sampling decision flows through
+            # the vocab-parallel collectives in _sample_sharded — or an
+            # identical-rng gathered sample on the top_p path; the decode
+            # ring psum-broadcasts over pipe); the checker cannot prove it
             check_vma=False,
         )
     )
