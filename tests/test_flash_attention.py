@@ -561,3 +561,37 @@ def test_stream_offset_chunk_matches_resident(rng, q_offset):
             np.asarray(a), np.asarray(b_), rtol=1e-5, atol=1e-5,
             err_msg=f"d{name} (q_offset={q_offset})",
         )
+
+
+@pytest.mark.fast
+def test_remat_policy_sees_kernel_outputs(rng):
+    """The finalize-pattern contract: the fwd kernels' out/lse are ordinary
+    named jaxpr values, so a save_only_these_names("attn") remat policy
+    keeps them and the backward graph contains NO forward-kernel re-run —
+    3 pallas calls (fwd + dq + dkv), not 4.  Guards against re-hiding the
+    forward inside the custom_vjp or dropping the checkpoint_name calls,
+    for both the self-attention path and the chunk (ring/encoder) path."""
+    from tpu_parallel.ops.flash_attention import flash_chunk_attention
+
+    q, k, v = _make_qkv(rng, b=1, s=64, h=1, d=16)
+    pol_save = jax.checkpoint_policies.save_only_these_names("attn")
+    pol_none = jax.checkpoint_policies.save_only_these_names("nothing-matches")
+
+    def chunk_block(q, k, v):
+        out, lse = flash_chunk_attention(
+            q, k, v, causal=True, block_q=32, block_k=32, interpret=True
+        )
+        return (out * 2).sum() + (lse * 0.1).sum()
+
+    def self_block(q, k, v):
+        out = flash_attention(q, k, v, block_q=32, block_k=32, interpret=True)
+        return (out * 2).sum()
+
+    for name, block in (("chunk", chunk_block), ("self", self_block)):
+        counts = {}
+        for pname, pol in (("saved", pol_save), ("unsaved", pol_none)):
+            f = jax.checkpoint(block, policy=pol, prevent_cse=True)
+            text = str(jax.make_jaxpr(jax.grad(f))(q, k, v))
+            counts[pname] = text.count("pallas_call")
+        assert counts["saved"] == 3, (name, counts)
+        assert counts["unsaved"] == 4, (name, counts)

@@ -984,30 +984,31 @@ def _flash_attention_bhsd(q, k, v, seg, block_q, block_k, interpret, window=0,
 # --- chunk attention for ring/sequence parallelism ---------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
-def _chunk_attention_bhsd(
-    q, k, v, seg_q, seg_k, causal, block_q, block_k, interpret, stream, window,
-    q_offset
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11, 12, 13))
+def _chunk_finalize(
+    q, k, v, seg_q, seg_k, out, lse, causal, block_q, block_k, interpret,
+    stream, window, q_offset
 ):
-    return _flash_fwd(
-        q, k, v, seg_q, seg_k, block_q=block_q, block_k=block_k,
-        interpret=interpret, causal=causal, stream=stream,
-        window=window, q_offset=q_offset,
-    )
+    """Identity on ``(out, lse)``; attaches the chunk backward kernels.
+
+    Same layout as :func:`_flash_finalize`: the forward kernel runs OUTSIDE
+    this custom_vjp (on stop_gradient inputs) so its outputs are ordinary
+    named jaxpr values — a ``save_only_these_names(..., "attn")`` remat
+    policy keeps them and the backward (ring steps, bidirectional encoders)
+    never re-runs the forward kernel.  Unlike _flash_finalize, ``lse`` stays
+    a differentiable output: ring's combine_chunks needs its cotangent.
+    """
+    del q, k, v, seg_q, seg_k
+    return out, lse
 
 
-def _chunk_fwd(q, k, v, seg_q, seg_k, causal, block_q, block_k, interpret,
-               stream, window, q_offset):
-    out, lse = _flash_fwd(
-        q, k, v, seg_q, seg_k, block_q=block_q, block_k=block_k,
-        interpret=interpret, causal=causal, stream=stream,
-        window=window, q_offset=q_offset,
-    )
+def _chunk_finalize_fwd(q, k, v, seg_q, seg_k, out, lse, causal, block_q,
+                        block_k, interpret, stream, window, q_offset):
     return (out, lse), (q, k, v, seg_q, seg_k, out, lse)
 
 
-def _chunk_bwd(causal, block_q, block_k, interpret, stream, window, q_offset,
-               residuals, cotangents):
+def _chunk_finalize_bwd(causal, block_q, block_k, interpret, stream, window,
+                        q_offset, residuals, cotangents):
     q, k, v, seg_q, seg_k, out, lse = residuals
     do, dlse = cotangents
     dq, dk, dv = _flash_bwd(
@@ -1016,10 +1017,34 @@ def _chunk_bwd(causal, block_q, block_k, interpret, stream, window, q_offset,
         causal=causal, dlse=dlse, stream=stream,
         window=window, q_offset=q_offset,
     )
-    return dq, dk, dv, None, None
+    # seg ids carry no gradient; out/lse arrive behind stop_gradient
+    return dq, dk, dv, None, None, jnp.zeros_like(out), jnp.zeros_like(lse)
 
 
-_chunk_attention_bhsd.defvjp(_chunk_fwd, _chunk_bwd)
+_chunk_finalize.defvjp(_chunk_finalize_fwd, _chunk_finalize_bwd)
+
+
+def _chunk_attention_bhsd(
+    q, k, v, seg_q, seg_k, causal, block_q, block_k, interpret, stream, window,
+    q_offset
+):
+    from jax.ad_checkpoint import checkpoint_name
+
+    out, lse = _flash_fwd(
+        lax.stop_gradient(q),
+        lax.stop_gradient(k),
+        lax.stop_gradient(v),
+        seg_q, seg_k,
+        block_q=block_q, block_k=block_k,
+        interpret=interpret, causal=causal, stream=stream,
+        window=window, q_offset=q_offset,
+    )
+    out = checkpoint_name(out, "attn")
+    lse = checkpoint_name(lse, "attn")
+    return _chunk_finalize(
+        q, k, v, seg_q, seg_k, out, lse, causal, block_q, block_k, interpret,
+        stream, window, q_offset
+    )
 
 
 def flash_chunk_attention(
