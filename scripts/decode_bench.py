@@ -1,0 +1,87 @@
+"""Serving throughput: tokens/sec for KV-cache decoding on the available chip.
+
+Prints one JSON line per (batch, new_tokens) point.  Not part of the driver
+contract — perf evidence for the generation path (prefill + lax.scan decode,
+last-position lm_head, int8-cache variant).
+
+Usage: python scripts/decode_bench.py [batch,prompt,new[,kv_cache_dtype]] ...
+Defaults exercise batch 8/32 at prompt 512, 128 new tokens, bf16 + int8 cache.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def run_one(batch, prompt_len, new_tokens, kv_dtype="bf16"):
+    from tpu_parallel.models import GPTLM, gpt2_125m, tiny_test
+    from tpu_parallel.models.generate import generate
+
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = (
+        gpt2_125m(
+            dropout_rate=0.0, remat=False, scan_layers=True,
+            kv_cache_dtype=kv_dtype,
+        )
+        if on_tpu
+        else tiny_test(kv_cache_dtype=kv_dtype)
+    )
+    model = GPTLM(cfg)
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(0), (batch, min(prompt_len, cfg.seq_len - new_tokens)),
+        0, cfg.vocab_size,
+    )
+    params = model.init({"params": jax.random.PRNGKey(1)}, prompt, train=False)[
+        "params"
+    ]
+    # warmup (compile)
+    generate(model, params, prompt, max_new_tokens=new_tokens).block_until_ready()
+    t0 = time.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        out = generate(model, params, prompt, max_new_tokens=new_tokens)
+    out.block_until_ready()
+    dt = (time.perf_counter() - t0) / reps
+    return dict(
+        batch=batch,
+        prompt=int(prompt.shape[1]),
+        new_tokens=new_tokens,
+        kv_cache=kv_dtype,
+        model="gpt2_125m" if on_tpu else "tiny",
+        decode_tokens_per_sec=round(batch * new_tokens / dt, 1),
+        ms_per_step=round(dt / new_tokens * 1000, 2),
+    )
+
+
+def main():
+    combos = []
+    for arg in sys.argv[1:]:
+        parts = arg.split(",")
+        combos.append(
+            (int(parts[0]), int(parts[1]), int(parts[2]),
+             parts[3] if len(parts) > 3 else "bf16")
+        )
+    if not combos:
+        combos = [
+            (8, 512, 128, "bf16"),
+            (32, 512, 128, "bf16"),
+            (32, 512, 128, "int8"),
+        ]
+    for combo in combos:
+        try:
+            print(json.dumps(run_one(*combo)), flush=True)
+        except Exception as e:  # OOM etc — report and continue
+            print(
+                json.dumps(dict(combo=list(combo), error=repr(e)[:200])),
+                flush=True,
+            )
+
+
+if __name__ == "__main__":
+    main()
