@@ -1,0 +1,41 @@
+"""BERT-base masked-LM pretraining (bidirectional encoder family)."""
+
+from ml_collections import ConfigDict
+
+from configs.common import model_overrides
+
+
+def get_config():
+    c = ConfigDict()
+    c.simulate_cpu_devices = 0
+    c.model = "bert_base"
+    # encoders run flash attention in its non-causal chunk form; remat with
+    # the attention residuals saved (the chunk kernels name them "attn")
+    c.model_overrides = model_overrides(
+        attn_impl="flash", remat_policy="proj_attn"
+    )
+    c.objective = "mlm"
+    c.mlm_mask_rate = 0.15
+    c.mesh = ConfigDict(dict(data=-1, model=1, pipe=1, seq=1))
+    c.global_batch_size = 64
+    c.num_minibatches = 1
+    c.steps = 100
+    c.optimizer = "adamw"
+    c.lr_schedule = "cosine"
+    c.ema_decay = 0.0
+    c.learning_rate = 1e-4
+    c.warmup_steps = 20
+    c.weight_decay = 0.01
+    c.grad_clip = 1.0
+    c.seed = 0
+    c.log_every = 10
+    c.donate = True
+    c.checkpoint_dir = ""
+    c.checkpoint_every = 100
+    c.data_path = ""
+    c.data_format = "flat"
+    c.eos_id = 50256
+    c.eval_steps = 0
+    c.eval_every = 0
+    c.keep_best = False
+    return c

@@ -27,6 +27,8 @@ def model_overrides(**kw) -> ConfigDict:
         loss_chunk=0,
         # MoE routing family (only meaningful with moe_experts > 0)
         moe_router="topk",
+        # bidirectional (encoder) attention — pairs with objective="mlm"
+        bidirectional=config_dict.placeholder(bool),
         # model-shape knobs: placeholders (None = keep the model's default;
         # the Trainer drops None-valued overrides) so e.g.
         # --config.model_overrides.n_layers=2 works on any config

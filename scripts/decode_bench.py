@@ -33,29 +33,43 @@ def run_one(batch, prompt_len, new_tokens, kv_dtype="bf16"):
         else tiny_test(kv_cache_dtype=kv_dtype)
     )
     model = GPTLM(cfg)
+    # clamp BOTH knobs to the model's window (the CPU tiny model has
+    # seq_len 32, far below the TPU defaults)
+    new_tokens = min(new_tokens, cfg.seq_len // 2)
+    prompt_len = max(1, min(prompt_len, cfg.seq_len - new_tokens))
     prompt = jax.random.randint(
-        jax.random.PRNGKey(0), (batch, min(prompt_len, cfg.seq_len - new_tokens)),
-        0, cfg.vocab_size,
+        jax.random.PRNGKey(0), (batch, prompt_len), 0, cfg.vocab_size
     )
     params = model.init({"params": jax.random.PRNGKey(1)}, prompt, train=False)[
         "params"
     ]
-    # warmup (compile)
-    generate(model, params, prompt, max_new_tokens=new_tokens).block_until_ready()
-    t0 = time.perf_counter()
-    reps = 3
-    for _ in range(reps):
-        out = generate(model, params, prompt, max_new_tokens=new_tokens)
-    out.block_until_ready()
-    dt = (time.perf_counter() - t0) / reps
+
+    def timed(n_new, reps=3):
+        # warmup (compile), then time; finish with a device->host read —
+        # block_until_ready can lie on some transports (the same pitfall
+        # scripts/attn_microbench.py documents)
+        out = generate(model, params, prompt, max_new_tokens=n_new)
+        jax.device_get(out[0, -1])
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = generate(model, params, prompt, max_new_tokens=n_new)
+        out.block_until_ready()
+        jax.device_get(out[0, -1])
+        return (time.perf_counter() - t0) / reps
+
+    dt_full = timed(new_tokens)
+    dt_prefill = timed(1)  # prefill + a single sample
+    decode_dt = max(dt_full - dt_prefill, 1e-9)  # the scan's share
     return dict(
         batch=batch,
-        prompt=int(prompt.shape[1]),
+        prompt=prompt_len,
         new_tokens=new_tokens,
         kv_cache=kv_dtype,
         model="gpt2_125m" if on_tpu else "tiny",
-        decode_tokens_per_sec=round(batch * new_tokens / dt, 1),
-        ms_per_step=round(dt / new_tokens * 1000, 2),
+        e2e_tokens_per_sec=round(batch * new_tokens / dt_full, 1),
+        decode_tokens_per_sec=round(batch * (new_tokens - 1) / decode_dt, 1),
+        decode_ms_per_step=round(decode_dt / (new_tokens - 1) * 1000, 3),
+        prefill_ms=round(dt_prefill * 1000, 2),
     )
 
 

@@ -9,6 +9,7 @@ from a single ``ConfigDict``-style config.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import time
 from typing import Any, Callable, Dict, Optional
 
@@ -20,8 +21,8 @@ from jax.sharding import PartitionSpec as P
 from tpu_parallel.core import compute as compute_metrics
 from tpu_parallel.core.state import TextBatch, TrainState, get_num_params
 from tpu_parallel.data import lm_batch
-from tpu_parallel.models import GPTLM, GPTConfig, make_gpt_loss
-from tpu_parallel.models import gpt2_125m, gpt2_350m, llama_1b, tiny_test
+from tpu_parallel.models import GPTLM, GPTConfig, make_gpt_loss, make_mlm_loss
+from tpu_parallel.models import bert_base, gpt2_125m, gpt2_350m, llama_1b, tiny_test
 from tpu_parallel.parallel.spmd import TrainFunctions, build_train_functions
 from tpu_parallel.runtime import MeshConfig, make_mesh
 from tpu_parallel.utils.profiling import mfu
@@ -30,6 +31,7 @@ MODEL_REGISTRY: Dict[str, Callable[..., GPTConfig]] = {
     "gpt2_125m": gpt2_125m,
     "gpt2_350m": gpt2_350m,
     "llama_1b": llama_1b,
+    "bert_base": bert_base,
     "tiny": tiny_test,
 }
 
@@ -48,6 +50,10 @@ class TrainerConfig:
     # nn.Partitioned spec-discovery pipeline; supporting it needs T5X-style
     # logical-axis metadata.)
     optimizer: str = "adamw"
+    # training objective: "causal" (next-token LM) | "mlm" (masked-LM for
+    # bidirectional/encoder configs — see models.make_mlm_loss)
+    objective: str = "causal"
+    mlm_mask_rate: float = 0.15
     # "cosine" (decay to 10% of peak) | "linear" (decay to 0) | "constant";
     # all include the linear warmup over warmup_steps
     lr_schedule: str = "cosine"
@@ -161,9 +167,29 @@ class Trainer:
         # the model's pipeline degree is dictated by the mesh
         overrides.setdefault("pipe_size", mesh_sizes.get("pipe", 1))
         self.model_config: GPTConfig = MODEL_REGISTRY[config.model](**overrides)
+        if self.model_config.bidirectional and config.objective == "causal":
+            # next-token CE on a bidirectional model: attention SEES the
+            # target — loss collapses, numbers are meaningless.  (The
+            # inverse, objective="mlm" on a causal model, is a legitimate
+            # denoising objective: the masked position cannot see itself.)
+            raise ValueError(
+                "bidirectional models cannot train with objective='causal' "
+                "(attention sees the next-token target); use objective='mlm'"
+            )
         self.model = GPTLM(self.model_config)
         self.tx = make_optimizer(config)
-        self.loss_fn = make_gpt_loss(self.model_config)
+        if config.objective == "mlm":
+            make_loss = functools.partial(
+                make_mlm_loss, mask_rate=config.mlm_mask_rate
+            )
+        elif config.objective == "causal":
+            make_loss = make_gpt_loss
+        else:
+            raise ValueError(
+                f"objective={config.objective!r} (causal | mlm)"
+            )
+        self._make_loss = make_loss
+        self.loss_fn = make_loss(self.model_config)
 
         if config.global_batch_size % mesh_sizes["data"] != 0:
             raise ValueError(
@@ -215,7 +241,7 @@ class Trainer:
             grad_psum_axes=("pipe",),
             num_minibatches=config.num_minibatches,
             donate=config.donate,
-            eval_loss_fn=make_gpt_loss(self.model_config, train=False),
+            eval_loss_fn=self._make_loss(self.model_config, train=False),
             ema_decay=config.ema_decay,
             # interpret-mode pallas (flash/ulysses off-TPU) trips a JAX
             # vma-inference limitation; the checker stays on everywhere else
