@@ -28,6 +28,9 @@ wedged TPU transport, not by the code):
 Watchdog budget: BENCH_WATCHDOG_SECS (default 1800 — the old 900s default
 equalled the worst measured fresh-compile time for the unrolled config, so a
 legitimate cold run could be killed right at the boundary).
+BENCH_RETRY_PAUSE_SECS (default 60) sets the probe-retry pause (the respawn
+settle pause is min(30, this)); BENCH_LAST_GOOD_PATH relocates the last-good
+record (tests point it at a tmp dir).
 """
 
 import json
@@ -38,7 +41,9 @@ import time
 
 _SELF = os.path.abspath(__file__)
 _REPO = os.path.dirname(_SELF)
-_LAST_GOOD = os.path.join(_REPO, "BENCH_LAST_GOOD.json")
+_LAST_GOOD = os.environ.get(
+    "BENCH_LAST_GOOD_PATH", os.path.join(_REPO, "BENCH_LAST_GOOD.json")
+)
 
 
 # --------------------------------------------------------------------------
@@ -225,11 +230,12 @@ def parent_main():
     # the transport is wedged — killing the probe then leaks no claim a
     # working run would need (the claim is already orphaned).
     probe_timeout = min(300.0, budget / 3)
+    retry_pause = float(os.environ.get("BENCH_RETRY_PAUSE_SECS", "60"))
     rc, out, wedged = _run([py, "-c", _PROBE_SRC], probe_timeout)
     if wedged or rc != 0 or "BENCH-PROBE-OK" not in (out or ""):
         # One retry after a pause: transient relay hiccups (mid-handoff
         # claims) clear in under a minute; a real wedge does not.
-        time.sleep(60)
+        time.sleep(retry_pause)
         rc, out, wedged = _run([py, "-c", _PROBE_SRC], probe_timeout)
         if wedged or rc != 0 or "BENCH-PROBE-OK" not in (out or ""):
             detail = (
@@ -276,7 +282,8 @@ def parent_main():
                 print(line, flush=True)
                 return
         if attempt == 1:
-            time.sleep(30)  # let a killed child's claim settle before respawn
+            # let a killed child's claim settle before respawn
+            time.sleep(min(30.0, retry_pause))
     if wedged:
         detail = "child wedged (watchdog)"
     elif rc == 0:
