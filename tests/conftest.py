@@ -105,6 +105,8 @@ _SLOW_TESTS = {
     "test_bidirectional_flash_matches_xla",
     "test_mlm_training_decreases_loss",
     "test_mlm_tp_training",
+    "test_bidirectional_ring_matches_dense",
+    "test_mlm_training_under_sp",
     "test_pp_packed_loss_equals_unpacked",
     "test_pp_packed_leakage_blocked",
     "test_ring_window_matches_masked_reference",

@@ -93,8 +93,9 @@ class TransformerConfig:
     kv_cache_dtype: str = "bf16"
     # bidirectional (encoder / BERT-style) attention: every position sees
     # every same-segment position.  Composes with the xla and flash paths,
-    # GQA, packing, TP/FSDP/PP; refuses decode (encoders don't
-    # autoregress), window, and ring/ulysses SP (causal ring structure)
+    # GQA, packing, TP/FSDP/PP, and ring/ulysses SP (the non-causal ring
+    # visits every chunk fully visible); refuses decode (encoders don't
+    # autoregress) and sliding window
     bidirectional: bool = False
     # mixture-of-experts: 0 = dense MLP; >0 replaces every block's MLP with
     # routed experts, expert-parallel over the model axis
@@ -221,6 +222,20 @@ def decode_attention(
     return out.reshape(b, nq, h, head_dim)
 
 
+
+def bidirectional_flash_attention(q, k, v, segment_ids=None, *, block_q, block_k):
+    """Full-visibility flash attention: ONE non-causal "chunk" spanning the
+    whole sequence (native GQA + in-kernel segment masking; lse discarded).
+    Shared by the encoder's flash path and its Ulysses inner attention."""
+    from tpu_parallel.ops.flash_attention import flash_chunk_attention
+
+    out, _ = flash_chunk_attention(
+        q, k, v, causal=False, block_q=block_q, block_k=block_k,
+        segment_ids_q=segment_ids, segment_ids_kv=segment_ids,
+    )
+    return out
+
+
 class Attention(nn.Module):
     """Multi-head causal self-attention, heads sharded over the model axis.
 
@@ -269,11 +284,6 @@ class Attention(nn.Module):
             if cfg.attn_window:
                 raise NotImplementedError(
                     "sliding window with bidirectional attention"
-                )
-            if cfg.attn_impl in ("ring", "ulysses"):
-                raise NotImplementedError(
-                    f"bidirectional attention under {cfg.attn_impl} sequence "
-                    "parallelism"
                 )
         if n_kv == cfg.n_heads:
             qkv = TPDense(
@@ -455,21 +465,10 @@ class Attention(nn.Module):
         attn_fn = self.attn_fn
         if attn_fn is None:
             if cfg.attn_impl == "flash" and cfg.bidirectional:
-                from tpu_parallel.ops.flash_attention import (
-                    flash_chunk_attention,
+                attn_fn = functools.partial(
+                    bidirectional_flash_attention,
+                    block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
                 )
-
-                # bidirectional flash = one non-causal "chunk" spanning the
-                # whole sequence (the chunk kernels already do full
-                # visibility + segment masking; the lse is discarded)
-                def attn_fn(q, k, v, segment_ids=None):
-                    out, _ = flash_chunk_attention(
-                        q, k, v, causal=False,
-                        block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
-                        segment_ids_q=segment_ids, segment_ids_kv=segment_ids,
-                    )
-                    return out
-
             elif cfg.attn_impl == "flash":
                 from tpu_parallel.ops.flash_attention import flash_attention
 
@@ -499,6 +498,7 @@ class Attention(nn.Module):
                             block_k=cfg.flash_block_k,
                             window=cfg.attn_window,
                             segment_ids=segment_ids,
+                            causal=not cfg.bidirectional,
                         )
 
                 else:
@@ -508,6 +508,7 @@ class Attention(nn.Module):
                             q, k, v, axis_name=cfg.seq_axis,
                             window=cfg.attn_window,
                             segment_ids=segment_ids,
+                            causal=not cfg.bidirectional,
                         )
 
             elif cfg.attn_impl == "ulysses":
@@ -515,13 +516,21 @@ class Attention(nn.Module):
                 from tpu_parallel.ops.ulysses import ulysses_attention
 
                 # the inner attention sees the full gathered sequence, so the
-                # window band applies directly
-                inner = functools.partial(
-                    flash_attention,
-                    block_q=cfg.flash_block_q,
-                    block_k=cfg.flash_block_k,
-                    window=cfg.attn_window,
-                )
+                # window band (causal) or full visibility (bidirectional)
+                # applies directly
+                if cfg.bidirectional:
+                    inner = functools.partial(
+                        bidirectional_flash_attention,
+                        block_q=cfg.flash_block_q,
+                        block_k=cfg.flash_block_k,
+                    )
+                else:
+                    inner = functools.partial(
+                        flash_attention,
+                        block_q=cfg.flash_block_q,
+                        block_k=cfg.flash_block_k,
+                        window=cfg.attn_window,
+                    )
 
                 def attn_fn(q, k, v, segment_ids=None):
                     if segment_ids is not None:

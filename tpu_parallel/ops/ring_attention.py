@@ -36,8 +36,11 @@ def ring_attention(
     use_checkpoint: bool = True,
     window: int = 0,
     segment_ids: Optional[jax.Array] = None,
+    causal: bool = True,
 ) -> jax.Array:
-    """Causal ring attention on seq-sharded [batch, local_seq, heads, hd].
+    """Ring attention on seq-sharded [batch, local_seq, heads, hd]
+    (causal by default; ``causal=False`` is the bidirectional/encoder form —
+    every position sees every same-segment position).
 
     Must run inside a ``shard_map`` region binding ``axis_name``.  Returns
     the local output chunk.  ``use_checkpoint`` remats the per-step combine
@@ -49,6 +52,10 @@ def ring_attention(
     sequences: the ids rotate around the ring with their K/V chunk, so each
     step can mask cross-document pairs exactly.
     """
+    if window and not causal:
+        raise NotImplementedError(
+            "sliding window with bidirectional ring attention"
+        )
     n_chunks = lax.psum(1, axis_name)
     my_chunk = lax.axis_index(axis_name)
     b, local_s, h, d = q.shape
@@ -72,7 +79,11 @@ def ring_attention(
         )
         q_pos = my_chunk * local_s + lax.broadcasted_iota(jnp.int32, s.shape, 2)
         k_pos = src_chunk * local_s + lax.broadcasted_iota(jnp.int32, s.shape, 3)
-        mask = q_pos >= k_pos
+        mask = (
+            q_pos >= k_pos
+            if causal
+            else jnp.ones(s.shape, bool)  # bidirectional: all visible
+        )
         if window:
             # positions here are global, so the band needs no per-chunk
             # offset bookkeeping — the flash ring path encodes the same
@@ -178,6 +189,7 @@ def ring_flash_attention(
     use_checkpoint: bool = True,
     window: int = 0,
     segment_ids: Optional[jax.Array] = None,
+    causal: bool = True,
 ) -> jax.Array:
     """Ring attention with the per-chunk math on the Pallas flash kernels.
 
@@ -208,6 +220,10 @@ def ring_flash_attention(
     """
     from tpu_parallel.ops.flash_attention import flash_chunk_attention
 
+    if window and not causal:
+        raise NotImplementedError(
+            "sliding window with bidirectional ring attention"
+        )
     n_chunks = lax.psum(1, axis_name)
     my_chunk = lax.axis_index(axis_name)
     b, local_s, h, d = q.shape
@@ -272,7 +288,11 @@ def ring_flash_attention(
                 pvary_missing(empty, vma_of(q)),
             )
 
-        if window:
+        if not causal:
+            # bidirectional: every chunk is fully visible — no diagonal, no
+            # skipping, no window (the model layer refuses window+bidir)
+            o_c, lse_c = full(None)
+        elif window:
             # chunks more than max_back ranks back are fully out of window:
             # chunk j's closest (q, k) pair sits (j-1)*local_s + 1 apart, so
             # it contributes iff (j-1)*local_s + 1 < window
