@@ -107,6 +107,9 @@ _SLOW_TESTS = {
     "test_mlm_tp_training",
     "test_bidirectional_ring_matches_dense",
     "test_mlm_training_under_sp",
+    "test_bidirectional_window_matches_dense",
+    "test_encoder_local_attention_model",
+    "test_bidirectional_window_under_ulysses",
     "test_pp_packed_loss_equals_unpacked",
     "test_pp_packed_leakage_blocked",
     "test_ring_window_matches_masked_reference",

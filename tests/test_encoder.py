@@ -124,8 +124,9 @@ def test_mlm_tp_training(mesh_data4_model2, rng):
 
 
 def test_encoder_refusals(rng):
-    """Decode and sliding window refuse loudly under bidirectional
-    (ring/ulysses SP are supported — see test_mlm_training_under_sp)."""
+    """Decode refuses loudly under bidirectional (encoders don't
+    autoregress); window and ring/ulysses SP are supported — see
+    test_bidirectional_window_matches_dense / test_mlm_training_under_sp."""
     tokens = jnp.zeros((1, 32), jnp.int32)
     cfg = _enc_cfg(seq_len=32)
     model = GPTLM(cfg)
@@ -135,10 +136,21 @@ def test_encoder_refusals(rng):
             {"params": params}, tokens, train=False, decode=True,
             mutable=["cache"],
         )
-    with pytest.raises(NotImplementedError, match="window"):
-        GPTLM(_enc_cfg(seq_len=32, attn_window=8)).init(
-            {"params": rng}, tokens, train=False
-        )
+    # window x bidirectional x RING stays refused (the ring ops raise:
+    # the jnp and flash paths would otherwise disagree on band semantics)
+    from tpu_parallel.ops.ring_attention import ring_attention
+    from tpu_parallel.runtime import MeshConfig, make_mesh
+    from jax.sharding import PartitionSpec as _P
+
+    mesh = make_mesh(MeshConfig(data=2, seq=4))
+    with pytest.raises(NotImplementedError, match="bidirectional ring"):
+        jax.shard_map(
+            lambda q: ring_attention(
+                q, q, q, axis_name="seq", window=8, causal=False
+            ),
+            mesh=mesh, in_specs=_P(None, "seq"), out_specs=_P(None, "seq"),
+            check_vma=False,
+        )(jnp.zeros((1, 32, 1, 8)))
 
 
 def test_encoder_classifier_finetunes(mesh_data8, rng):
@@ -269,3 +281,104 @@ def test_mlm_training_under_sp(impl, rng):
     for _ in range(5):
         state, m = funcs.step_fn(state, None, batch)
     assert compute(m)["loss"] < first
+
+
+def test_bidirectional_window_matches_dense(rng):
+    """Encoder local attention: the symmetric band |q-k| < window agrees
+    between the flash chunk kernel (blocks skipped on both sides) and the
+    dense reference, forward and gradients."""
+    from tpu_parallel.models.layers import (
+        bidirectional_flash_attention,
+        causal_attention,
+    )
+
+    b, s, h, d = 1, 256, 2, 16
+    ks = jax.random.split(rng, 3)
+    q, k, v = (jax.random.normal(kk, (b, s, h, d)) for kk in ks)
+    for window in (16, 48, 100):
+        out = bidirectional_flash_attention(
+            q, k, v, block_q=32, block_k=32, window=window
+        )
+        ref = causal_attention(q, k, v, window=window, causal=False)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3,
+            err_msg=f"window={window}",
+        )
+
+    window = 48
+
+    def loss_flash(q, k, v):
+        return (
+            bidirectional_flash_attention(
+                q, k, v, block_q=32, block_k=32, window=window
+            )
+            ** 2
+        ).sum()
+
+    def loss_ref(q, k, v):
+        return (causal_attention(q, k, v, window=window, causal=False) ** 2).sum()
+
+    g_f = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_r = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(g_f, g_r, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b_), rtol=5e-3, atol=5e-3,
+            err_msg=f"d{name}",
+        )
+
+
+def test_encoder_local_attention_model(rng):
+    """A windowed encoder's forward: tokens outside the band cannot
+    influence a position (flash == xla at the model level)."""
+    cfg_x = _enc_cfg(seq_len=64, attn_impl="xla", attn_window=8,
+                     scan_layers=False, n_layers=1)
+    cfg_f = _enc_cfg(seq_len=64, attn_impl="flash", attn_window=8,
+                     scan_layers=False, n_layers=1,
+                     flash_block_q=16, flash_block_k=16)
+    tokens = jax.random.randint(rng, (1, 64), 0, cfg_x.vocab_size)
+    params = GPTLM(cfg_x).init(
+        {"params": jax.random.PRNGKey(0)}, tokens, train=False
+    )["params"]
+    lx = GPTLM(cfg_x).apply({"params": params}, tokens, train=False)
+    lf = GPTLM(cfg_f).apply({"params": params}, tokens, train=False)
+    np.testing.assert_allclose(
+        np.asarray(lf), np.asarray(lx), rtol=2e-3, atol=2e-3
+    )
+    # a token 20 positions away (> window 8) cannot influence position 0
+    tokens2 = tokens.at[0, 20].set((tokens[0, 20] + 1) % cfg_x.vocab_size)
+    lx2 = GPTLM(cfg_x).apply({"params": params}, tokens2, train=False)
+    np.testing.assert_allclose(
+        np.asarray(lx[:, 0]), np.asarray(lx2[:, 0]), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_bidirectional_window_under_ulysses(rng):
+    """Encoder local attention composes with Ulysses SP: the symmetric band
+    applies on the gathered sequence, matching the dense reference."""
+    from tpu_parallel.models.layers import causal_attention
+    from tpu_parallel.ops.ulysses import ulysses_attention
+    from tpu_parallel.models.layers import bidirectional_flash_attention
+    from tpu_parallel.runtime import MeshConfig, make_mesh
+    import functools
+
+    mesh = make_mesh(MeshConfig(data=2, seq=4))
+    b, s, h, d = 1, 128, 4, 16
+    ks = jax.random.split(rng, 3)
+    q, k, v = (jax.random.normal(kk, (b, s, h, d)) for kk in ks)
+    window = 24
+    inner = functools.partial(
+        bidirectional_flash_attention, block_q=32, block_k=32, window=window
+    )
+    out = jax.jit(
+        jax.shard_map(
+            lambda q, k, v: ulysses_attention(
+                q, k, v, axis_name="seq", attn_fn=inner
+            ),
+            mesh=mesh, in_specs=P(None, "seq"), out_specs=P(None, "seq"),
+            check_vma=False,
+        )
+    )(q, k, v)
+    ref = causal_attention(q, k, v, window=window, causal=False)
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3
+    )

@@ -92,10 +92,12 @@ class TransformerConfig:
     # (position, kv-head); dequantization is a transient per layer per step
     kv_cache_dtype: str = "bf16"
     # bidirectional (encoder / BERT-style) attention: every position sees
-    # every same-segment position.  Composes with the xla and flash paths,
-    # GQA, packing, TP/FSDP/PP, and ring/ulysses SP (the non-causal ring
-    # visits every chunk fully visible); refuses decode (encoders don't
-    # autoregress) and sliding window
+    # every same-segment position — with attn_window > 0, those in the
+    # symmetric band |q - k| < window (encoder local attention).  Composes
+    # with the xla and flash paths, GQA, packing, TP/FSDP/PP, ulysses SP
+    # (band applied on the gathered sequence), and ring SP (full visibility
+    # only — window x ring stays refused in the ring ops); refuses decode
+    # (encoders don't autoregress)
     bidirectional: bool = False
     # mixture-of-experts: 0 = dense MLP; >0 replaces every block's MLP with
     # routed experts, expert-parallel over the model axis
@@ -166,10 +168,9 @@ def causal_attention(
     ``q, k, v``: [batch, seq, heads, head_dim].  O(seq^2) memory — the
     Pallas flash kernel (``ops.flash_attention``) replaces this on TPU for
     long sequences.  ``causal=False`` is the bidirectional (encoder) form:
-    every position attends every (same-segment) position.
+    every position attends every (same-segment) position — with ``window``,
+    those within the symmetric band |q - k| < window.
     """
-    if window and not causal:
-        raise NotImplementedError("sliding window with bidirectional attention")
     head_dim = q.shape[-1]
     scale = 1.0 / jnp.sqrt(head_dim).astype(q.dtype)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k)
@@ -178,8 +179,12 @@ def causal_attention(
     k_pos = lax.broadcasted_iota(jnp.int32, scores.shape, 3)
     mask = q_pos >= k_pos if causal else None
     if window:
-        # sliding window: query t attends keys in (t - window, t] only
-        mask = jnp.logical_and(mask, q_pos - k_pos < window)
+        # causal: query t attends keys in (t - window, t]; bidirectional
+        # (encoder local attention): the symmetric band |q - k| < window
+        near = q_pos - k_pos < window
+        if not causal:
+            near = jnp.logical_and(near, k_pos - q_pos < window)
+        mask = near if mask is None else jnp.logical_and(mask, near)
     if segment_ids is not None:
         same_seg = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
         mask = same_seg if mask is None else jnp.logical_and(mask, same_seg)
@@ -223,14 +228,17 @@ def decode_attention(
 
 
 
-def bidirectional_flash_attention(q, k, v, segment_ids=None, *, block_q, block_k):
+def bidirectional_flash_attention(q, k, v, segment_ids=None, *, block_q,
+                                  block_k, window=0):
     """Full-visibility flash attention: ONE non-causal "chunk" spanning the
     whole sequence (native GQA + in-kernel segment masking; lse discarded).
+    ``window`` restricts to the symmetric band |q - k| < window (encoder
+    local attention) with out-of-band key blocks skipped in-kernel.
     Shared by the encoder's flash path and its Ulysses inner attention."""
     from tpu_parallel.ops.flash_attention import flash_chunk_attention
 
     out, _ = flash_chunk_attention(
-        q, k, v, causal=False, block_q=block_q, block_k=block_k,
+        q, k, v, causal=False, block_q=block_q, block_k=block_k, window=window,
         segment_ids_q=segment_ids, segment_ids_kv=segment_ids,
     )
     return out
@@ -280,10 +288,6 @@ class Attention(nn.Module):
                 raise NotImplementedError(
                     "incremental decoding with bidirectional attention "
                     "(encoders do not autoregress)"
-                )
-            if cfg.attn_window:
-                raise NotImplementedError(
-                    "sliding window with bidirectional attention"
                 )
         if n_kv == cfg.n_heads:
             qkv = TPDense(
@@ -468,6 +472,7 @@ class Attention(nn.Module):
                 attn_fn = functools.partial(
                     bidirectional_flash_attention,
                     block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
+                    window=cfg.attn_window,
                 )
             elif cfg.attn_impl == "flash":
                 from tpu_parallel.ops.flash_attention import flash_attention
@@ -523,6 +528,7 @@ class Attention(nn.Module):
                         bidirectional_flash_attention,
                         block_q=cfg.flash_block_q,
                         block_k=cfg.flash_block_k,
+                        window=cfg.attn_window,
                     )
                 else:
                     inner = functools.partial(

@@ -130,7 +130,11 @@ def _band_mask(qi, ki, shape, block_q: int, block_k: int, causal: bool,
     if causal:
         mask = q_pos >= k_pos
     if window:
+        # causal: one-sided band (keys at most window-1 behind the query);
+        # non-causal (encoder local attention): symmetric |q - k| < window
         near = q_pos - k_pos < window
+        if not causal:
+            near = jnp.logical_and(near, k_pos - q_pos < window)
         mask = near if mask is None else jnp.logical_and(mask, near)
     return mask
 
@@ -141,7 +145,16 @@ def _stream_k_range(qi, block_q, block_k, causal, window, num_ki, q_offset=0):
     (DMA clamp) — they MUST agree, so it is one function.  The range may be
     empty (first > last) for offset chunks whose window misses every key
     block; callers must clamp before using it as an index."""
-    last = ((qi + 1) * block_q - 1) // block_k if causal else num_ki - 1
+    if causal:
+        last = ((qi + 1) * block_q - 1) // block_k
+    elif window:
+        # symmetric band: the largest visible key is q_max + window - 1
+        last = jnp.minimum(
+            num_ki - 1,
+            (q_offset + (qi + 1) * block_q - 1 + window - 1) // block_k,
+        )
+    else:
+        last = num_ki - 1
     first = (
         _window_first_k_block(qi, block_q, block_k, window, q_offset)
         if window
@@ -155,7 +168,14 @@ def _stream_q_range(ki, block_q, block_k, causal, window, num_qi, q_offset=0):
     mirror of :func:`_stream_k_range`, shared by the streamed dkv kernel's
     compute predicate and its index maps for the same must-agree reason.
     May be empty (last < first) — see _stream_k_range."""
-    first = ki * block_k // block_q if causal else 0
+    if causal:
+        first = ki * block_k // block_q
+    elif window:
+        # symmetric band: the smallest query seeing key block ki is
+        # k_min - window + 1 (in q-local coordinates: minus q_offset)
+        first = jnp.maximum(0, ki * block_k - window + 1 - q_offset) // block_q
+    else:
+        first = 0
     if window:
         # queries beyond (k_block_end + window - 1) see none of this block
         # (-(-x // y) is a tracer-safe ceil); q_offset shifts the band
@@ -231,16 +251,13 @@ def _fwd_kernel(
     q = (q_ref[0] * jnp.asarray(scale, q_ref.dtype)).astype(q_ref.dtype)
     if has_segments:
         seg_q = seg_q_ref[0]  # [bq, 1] — block qi via the index map
-    if causal:
-        num_k_blocks = (qi + 1) * block_q // block_k  # only blocks <= qi
-    else:
-        # full (non-causal) mode: ring attention's fully-visible K/V chunks
-        num_k_blocks = k_ref.shape[1] // block_k
-    first_k_block = (
-        _window_first_k_block(qi, block_q, block_k, window, q_offset)
-        if window
-        else 0
+    # band range from the ONE shared helper (causal: blocks <= qi; full
+    # mode: every block, or the symmetric window band for encoders)
+    first_k_block, last_k_block = _stream_k_range(
+        qi, block_q, block_k, causal, window,
+        k_ref.shape[1] // block_k, q_offset,
     )
+    num_k_blocks = last_k_block + 1
 
     def body(ki, carry):
         acc, m_prev, l_prev = carry
@@ -455,15 +472,11 @@ def _bwd_dq_kernel(
     delta = delta_ref[0]  # [bq, 1]
     if has_segments:
         seg_q = seg_q_ref[0]  # [bq, 1] — block qi via the index map
-    if causal:
-        num_k_blocks = (qi + 1) * block_q // block_k
-    else:
-        num_k_blocks = k_ref.shape[1] // block_k
-    first_k_block = (
-        _window_first_k_block(qi, block_q, block_k, window, q_offset)
-        if window
-        else 0
+    first_k_block, last_k_block = _stream_k_range(
+        qi, block_q, block_k, causal, window,
+        k_ref.shape[1] // block_k, q_offset,
     )
+    num_k_blocks = last_k_block + 1
 
     def body(ki, dq):
         k = k_ref[0, pl.ds(ki * block_k, block_k), :]
@@ -1076,12 +1089,17 @@ def flash_chunk_attention(
     attention (q and k index the same positions); ``causal=False`` is a
     fully-visible (strictly-past) chunk.
 
-    ``window``/``q_offset`` (both static) add a sliding-window band:
-    query i (global position ``q_offset + i`` relative to the chunk's keys)
-    sees key j iff ``q_offset + i - j < window``.  Ring attention passes
+    ``window``/``q_offset`` (both static) add a banded mask over global
+    positions (query i sits at ``q_offset + i`` relative to the chunk's
+    keys).  With ``causal=True`` the band is one-sided (key j visible iff
+    ``q_offset + i - j < window``, Mistral semantics); with
+    ``causal=False`` it is SYMMETRIC — ``|q_offset + i - j| < window`` —
+    the encoder local-attention form.  Ring attention passes
     ``q_offset = j_back * local_seq`` for the chunk ``j_back`` ranks behind
-    — rows whose window misses the whole chunk come back as empty partials
-    (out 0, lse NEG_INF), which :func:`combine_chunks` weights to zero.
+    (its keys are all behind the queries, so the symmetric upper side is
+    vacuous there) — rows whose window misses the whole chunk come back as
+    empty partials (out 0, lse NEG_INF), which :func:`combine_chunks`
+    weights to zero.
 
     ``segment_ids_q``/``segment_ids_kv`` ([batch, seq_q] / [batch, seq_kv],
     both or neither) mask packed sequences across chunks: queries attend

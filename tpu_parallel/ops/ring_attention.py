@@ -290,7 +290,8 @@ def ring_flash_attention(
 
         if not causal:
             # bidirectional: every chunk is fully visible — no diagonal, no
-            # skipping, no window (the model layer refuses window+bidir)
+            # skipping, no window (this function raises on window+non-causal
+            # above; the jnp ring does the same)
             o_c, lse_c = full(None)
         elif window:
             # chunks more than max_back ranks back are fully out of window:
