@@ -457,13 +457,19 @@ class Attention(nn.Module):
     def _attend(self, q, k, v, segment_ids):
         cfg = self.config
         group = q.shape[-2] // k.shape[-2]
-        if group != 1 and not (cfg.attn_impl == "flash" and self.attn_fn is None):
+        native_group = (
+            cfg.attn_impl in ("flash", "ring") and self.attn_fn is None
+        )
+        if group != 1 and not native_group:
             # GQA head expansion for the paths without native group routing
-            # (xla einsum, ring, ulysses, injected hooks).  XLA fuses this
-            # broadcast into the einsum contractions; the Pallas flash path
-            # must NOT take it — kernel operands are materialized buffers,
-            # so it routes groups via BlockSpec index maps instead and K/V
-            # stay at kv-head width end to end.
+            # (xla einsum, ulysses, injected hooks).  XLA fuses this
+            # broadcast into the einsum contractions.  The Pallas flash
+            # path must NOT take it — kernel operands are materialized
+            # buffers, so it routes groups via BlockSpec index maps — and
+            # ring keeps K/V at kv-head width because THEY ride the
+            # ppermute ring: grouped queries cut the ring traffic by
+            # `group` (the jnp ring contracts grouped queries natively,
+            # like decode_attention).
             k = jnp.repeat(k, group, axis=2)
             v = jnp.repeat(v, group, axis=2)
         attn_fn = self.attn_fn

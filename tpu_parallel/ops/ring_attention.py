@@ -59,11 +59,20 @@ def ring_attention(
     n_chunks = lax.psum(1, axis_name)
     my_chunk = lax.axis_index(axis_name)
     b, local_s, h, d = q.shape
+    h_kv = k.shape[2]
+    if h % h_kv != 0:
+        raise ValueError(f"q heads {h} not a multiple of k/v heads {h_kv}")
+    group = h // h_kv
     scale = 1.0 / (d**0.5)
     # keep MXU operands in the input dtype (bf16 runs the systolic array at
     # full rate; fp32 operands would halve it) and accumulate fp32 via
-    # preferred_element_type — same recipe as the Pallas flash kernels
-    qf = (q * jnp.asarray(scale, q.dtype)).transpose(0, 2, 1, 3)  # [B,H,ls,D]
+    # preferred_element_type — same recipe as the Pallas flash kernels.
+    # GQA is native: K/V stay at kv-head width — they are what rides the
+    # ring, so grouped queries cut the ppermute traffic by `group` —
+    # and queries reshape to [B, H_kv, G, ls, D] to contract against them.
+    qf = (q * jnp.asarray(scale, q.dtype)).transpose(0, 2, 1, 3).reshape(
+        b, h_kv, group, local_s, d
+    )
     seg_local = (
         None if segment_ids is None else segment_ids.astype(jnp.int32)
     )
@@ -72,13 +81,13 @@ def ring_attention(
         """One ring step: attend local q to the currently-held kv chunk."""
         out, m_prev, l_prev = carry
         k_cur, v_cur, seg_cur, src_chunk = kv_and_src
-        kf = k_cur.transpose(0, 2, 1, 3)
+        kf = k_cur.transpose(0, 2, 1, 3)  # [B, H_kv, ls, D]
         vf = v_cur.transpose(0, 2, 1, 3)
         s = jnp.einsum(
-            "bhqd,bhkd->bhqk", qf, kf, preferred_element_type=jnp.float32
+            "bngqd,bnkd->bngqk", qf, kf, preferred_element_type=jnp.float32
         )
-        q_pos = my_chunk * local_s + lax.broadcasted_iota(jnp.int32, s.shape, 2)
-        k_pos = src_chunk * local_s + lax.broadcasted_iota(jnp.int32, s.shape, 3)
+        q_pos = my_chunk * local_s + lax.broadcasted_iota(jnp.int32, s.shape, 3)
+        k_pos = src_chunk * local_s + lax.broadcasted_iota(jnp.int32, s.shape, 4)
         mask = (
             q_pos >= k_pos
             if causal
@@ -90,7 +99,10 @@ def ring_attention(
             # geometry statically via flash_chunk_attention's q_offset
             mask = jnp.logical_and(mask, q_pos - k_pos < window)
         if seg_cur is not None:
-            same = seg_local[:, None, :, None] == seg_cur[:, None, None, :]
+            same = (
+                seg_local[:, None, None, :, None]
+                == seg_cur[:, None, None, None, :]
+            )
             mask = jnp.logical_and(mask, same)
         s = jnp.where(mask, s, NEG_INF)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -101,7 +113,7 @@ def ring_attention(
         l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
         # probs cast to the K/V dtype for the MXU; fp32 accumulate
         out = out * alpha + jnp.einsum(
-            "bhqk,bhkd->bhqd",
+            "bngqk,bnkd->bngqd",
             p.astype(vf.dtype),
             vf,
             preferred_element_type=jnp.float32,
@@ -126,9 +138,9 @@ def ring_attention(
         src_next = (src_chunk - 1) % n_chunks
         return (new_acc, (k_next, v_next, seg_next, src_next)), None
 
-    out0 = jnp.zeros((b, h, local_s, d), jnp.float32)
-    m0 = jnp.full((b, h, local_s, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, local_s, 1), jnp.float32)
+    out0 = jnp.zeros((b, h_kv, group, local_s, d), jnp.float32)
+    m0 = jnp.full((b, h_kv, group, local_s, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h_kv, group, local_s, 1), jnp.float32)
     # the accumulators come out of `combine` varying over every axis q varies
     # on PLUS the ring axis itself (axis_index makes the body's outputs
     # ring-varying even when the inputs are replicated, e.g. on a size-1
@@ -149,6 +161,7 @@ def ring_attention(
     init = ((out0, m0, l0), (k0, v0, seg0, my_chunk))
     ((out, m, l), _), _ = lax.scan(step, init, None, length=n_chunks)
     out = out / jnp.maximum(l, 1e-20)
+    out = out.reshape(b, h, local_s, d)
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
@@ -223,6 +236,10 @@ def ring_flash_attention(
     if window and not causal:
         raise NotImplementedError(
             "sliding window with bidirectional ring attention"
+        )
+    if q.shape[2] % k.shape[2] != 0:
+        raise ValueError(
+            f"q heads {q.shape[2]} not a multiple of k/v heads {k.shape[2]}"
         )
     n_chunks = lax.psum(1, axis_name)
     my_chunk = lax.axis_index(axis_name)
