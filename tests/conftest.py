@@ -121,6 +121,8 @@ _SLOW_TESTS = {
     "test_gpt_ring_packed_training",
     "test_ring_gqa_matches_expanded_reference",
     "test_gpt_ring_gqa_training",
+    "test_ulysses_gqa_matches_expanded_reference",
+    "test_gpt_ulysses_gqa_training",
     "test_gpt_ulysses_packed_training",
     "test_gqa_model_flash_matches_xla",
     "test_gqa_decode_matches_train_forward",

@@ -458,18 +458,20 @@ class Attention(nn.Module):
         cfg = self.config
         group = q.shape[-2] // k.shape[-2]
         native_group = (
-            cfg.attn_impl in ("flash", "ring") and self.attn_fn is None
+            cfg.attn_impl in ("flash", "ring", "ulysses")
+            and self.attn_fn is None
         )
         if group != 1 and not native_group:
             # GQA head expansion for the paths without native group routing
-            # (xla einsum, ulysses, injected hooks).  XLA fuses this
-            # broadcast into the einsum contractions.  The Pallas flash
-            # path must NOT take it — kernel operands are materialized
-            # buffers, so it routes groups via BlockSpec index maps — and
-            # ring keeps K/V at kv-head width because THEY ride the
-            # ppermute ring: grouped queries cut the ring traffic by
-            # `group` (the jnp ring contracts grouped queries natively,
-            # like decode_attention).
+            # (xla einsum, injected hooks).  XLA fuses this broadcast into
+            # the einsum contractions.  The Pallas flash path must NOT take
+            # it — kernel operands are materialized buffers, so it routes
+            # groups via BlockSpec index maps; ring keeps K/V at kv-head
+            # width because THEY ride the ppermute ring (group x less ring
+            # traffic; the jnp ring contracts grouped queries natively,
+            # like decode_attention); ulysses reshards kv heads at kv width
+            # (group x less all_to_all volume) or expands internally when
+            # h_kv doesn't divide the axis.
             k = jnp.repeat(k, group, axis=2)
             v = jnp.repeat(v, group, axis=2)
         attn_fn = self.attn_fn

@@ -10,7 +10,11 @@ reshard back.  Communication is two all-to-alls of the activations per call
 sequence fits HBM; ring wins when the sequence itself must never
 materialize on one chip.
 
-Requires ``n_heads % axis_size == 0`` (after any GQA head repetition).
+Requires ``n_heads % axis_size == 0``.  GQA K/V pass at kv-head width:
+when ``n_kv_heads % axis_size == 0`` they reshard as-is (group-times less
+all_to_all volume — the q->kv routing is preserved shard-locally);
+otherwise the op expands them to full width internally.  Do NOT
+pre-expand K/V before calling.
 """
 
 from __future__ import annotations
@@ -18,6 +22,7 @@ from __future__ import annotations
 from typing import Callable, Optional
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 
 
@@ -40,8 +45,24 @@ def ulysses_attention(
     """
     n = lax.psum(1, axis_name)
     h = q.shape[2]
+    h_kv = k.shape[2]
     if h % n != 0:
         raise ValueError(f"n_heads={h} not divisible by seq axis size {n}")
+    if h_kv != h:
+        # grouped-query K/V: when the kv heads split evenly over the axis,
+        # reshard them at kv width — the q->kv head routing is preserved
+        # shard-locally (q head i and kv head i//group land on the same
+        # rank, local index i' -> i'//group), and the K/V all_to_all volume
+        # drops by the group factor.  Otherwise expand to full heads first
+        # (correct, full-width traffic).
+        if h % h_kv != 0:
+            raise ValueError(
+                f"q heads {h} not a multiple of k/v heads {h_kv}"
+            )
+        if h_kv % n != 0:
+            group = h // h_kv
+            k = jnp.repeat(k, group, axis=2)
+            v = jnp.repeat(v, group, axis=2)
     if attn_fn is None:
         # flash by default: the inner attention runs over the FULL gathered
         # sequence, so a naive softmax would materialize the [B, H/n, S, S]
