@@ -107,6 +107,7 @@ _SLOW_TESTS = {
     "test_mlm_tp_training",
     "test_bidirectional_ring_matches_dense",
     "test_mlm_training_under_sp",
+    "test_mlm_training_under_pp",
     "test_bidirectional_window_matches_dense",
     "test_encoder_local_attention_model",
     "test_bidirectional_window_under_ulysses",
