@@ -132,7 +132,7 @@ def _generate_core(
     (:func:`_sample_sharded`) and the per-step full-vocab all_gather
     disappears for greedy/temperature/top-k decoding.
     """
-    from tpu_parallel.models.gpt import _make_lm_head
+    from tpu_parallel.models.gpt import _lm_head_params, _make_lm_head
     from tpu_parallel.parallel.tp import axis_size_or_none
 
     cfg = model.config
@@ -142,11 +142,14 @@ def _generate_core(
             f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
             f"exceeds seq_len ({cfg.seq_len})"
         )
-    head = _make_lm_head(cfg, name=None, gather=False)
+    # unwrapped head + one up-front FSDP gather: the wrapped head would
+    # re-all_gather the vocab kernel every decode step inside the scan
+    head = _make_lm_head(cfg, name=None, gather=False, fsdp_wrap=False)
+    lm_params = _lm_head_params(cfg, params)
 
     def next_token(h, rng):
         # h: [b, t, d] hidden states; head only the final position
-        logits = head.apply({"params": params["lm_head"]}, h[:, -1:])[:, 0]
+        logits = head.apply({"params": lm_params}, h[:, -1:])[:, 0]
         if axis_size_or_none(cfg.model_axis) is not None:
             return _sample_sharded(
                 logits, rng, temperature, top_k, top_p, cfg.model_axis

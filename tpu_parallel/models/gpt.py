@@ -66,16 +66,28 @@ class GPTConfig(TransformerConfig):
 
 
 def _make_lm_head(
-    cfg: "GPTConfig", name: Optional[str] = "lm_head", gather: bool = True
-) -> TPDense:
+    cfg: "GPTConfig",
+    name: Optional[str] = "lm_head",
+    gather: bool = True,
+    fsdp_wrap: bool = True,
+):
     """The vocab projection — one definition for the in-model call and the
     standalone apply in :func:`make_gpt_loss` (``name=None``; the loss binds
     it directly to ``params["lm_head"]``).  The loss path passes
     ``gather=False``: logits stay column-sharded over the model axis and CE
     runs vocab-parallel (``core.losses.vocab_parallel_cross_entropy``) —
     the public model surface keeps full-vocab logits for generation/interop.
-    The parameter tree is identical either way."""
-    return TPDense(
+    The parameter tree is identical either way.
+
+    Under ``cfg.fsdp`` the head is FSDP-wrapped like the blocks (the vocab
+    kernel is among the largest single params in the model).  Callers that
+    apply the head repeatedly in a scan (chunked CE, the decode loop) pass
+    ``fsdp_wrap=False`` and pre-gather via :func:`_lm_head_params` ONCE
+    outside the loop — the wrapped module would re-all_gather the kernel
+    every iteration (jax.checkpoint pins the gather inside the scan body, so
+    XLA cannot hoist it)."""
+    cls = fsdp.maybe_shard(TPDense, cfg) if fsdp_wrap else TPDense
+    return cls(
         features=cfg.vocab_size,
         axis_name=cfg.model_axis,
         style="column",
@@ -84,6 +96,23 @@ def _make_lm_head(
         dtype=cfg.dtype,
         name=name,
     )
+
+
+def _lm_head_params(cfg: "GPTConfig", params):
+    """The lm_head param subtree, FSDP-gathered ONCE when sharded.
+
+    Pairs with ``_make_lm_head(..., fsdp_wrap=False)``: the returned tree is
+    the full (per-TP-rank) weight, safe to close over in a chunk/decode scan
+    without re-gathering per iteration.  The gather's custom backward still
+    psum_scatters the accumulated cotangent, so gradients are identical to
+    the per-iteration-gather form.  No-op when the data axis is unbound
+    (plain ``generate`` on exported params) or ``cfg.fsdp`` is off."""
+    from tpu_parallel.parallel.tp import axis_size_or_none
+
+    lm = params["lm_head"]
+    if cfg.fsdp and axis_size_or_none(cfg.data_axis) is not None:
+        lm = fsdp.gather_params(lm, cfg.data_axis)
+    return lm
 
 
 class GPTLM(nn.Module):
@@ -114,12 +143,9 @@ class GPTLM(nn.Module):
                 counter.value + jnp.arange(tokens.shape[1])[None, :], tokens.shape
             )
             counter.value = counter.value + tokens.shape[1]
-        embed_cls = Embedding
-        if cfg.fsdp:
-            embed_cls = fsdp.shard_module_params(
-                Embedding, cfg.data_axis, cfg.fsdp_min_size
-            )
-        x = embed_cls(cfg, name="embed")(tokens, positions=positions)
+        x = fsdp.maybe_shard(Embedding, cfg)(cfg, name="embed")(
+            tokens, positions=positions
+        )
 
         if cfg.pipe_interleave > 1 and cfg.pipe_size <= 1:
             raise ValueError(
@@ -227,13 +253,16 @@ def make_gpt_loss(config: GPTConfig, train: bool = True):
         config.data_axis, config.model_axis, config.pipe_axis, config.seq_axis
     )
     chunk = config.loss_chunk
-    head = _make_lm_head(config, name=None, gather=False)
+    # unwrapped head + one explicit gather (_lm_head_params): under
+    # fsdp+loss_chunk the wrapped head would re-all_gather the vocab kernel
+    # per sequence chunk, forward AND rematerialized backward
+    head = _make_lm_head(config, name=None, gather=False, fsdp_wrap=False)
 
-    def ce_block(params, h, targets, mask):
+    def ce_block(lm_params, h, targets, mask):
         """lm_head + CE + accuracy on one block of hidden states; returns
         (loss_sum, correct_sum).  Vocab-parallel when the model axis is
         bound (mesh path), plain CE on full logits otherwise."""
-        logits = head.apply({"params": params["lm_head"]}, h)
+        logits = head.apply({"params": lm_params}, h)
         if axis_size_or_none(config.model_axis) is not None:
             ce, pred = vocab_parallel_cross_entropy(
                 logits, targets, config.model_axis
@@ -245,7 +274,7 @@ def make_gpt_loss(config: GPTConfig, train: bool = True):
         correct = ((pred == targets) * mask).sum()
         return loss_sum, correct
 
-    def chunked_ce(params, h, targets, mask):
+    def chunked_ce(lm_params, h, targets, mask):
         """scan ce_block over sequence chunks; logits exist only
         [B, loss_chunk, vocab/tp] at a time."""
         b, s = targets.shape
@@ -257,7 +286,7 @@ def make_gpt_loss(config: GPTConfig, train: bool = True):
         ms = mask.reshape(b, n, chunk).transpose(1, 0, 2)
 
         def body(carry, xs):
-            loss_sum, correct = ce_block(params, *xs)
+            loss_sum, correct = ce_block(lm_params, *xs)
             return (carry[0] + loss_sum, carry[1] + correct), None
 
         # promote the zero carry to the body outputs' varying-axes type (the
@@ -319,10 +348,11 @@ def make_gpt_loss(config: GPTConfig, train: bool = True):
         if config.pipe_size > 1:
             mask = mask * pp.last_stage_mask(config.pipe_axis)
         n_tok = mask.sum()
+        lm_params = _lm_head_params(config, params)
         if chunk:
-            loss_sum, correct = chunked_ce(params, hidden, batch.targets, mask)
+            loss_sum, correct = chunked_ce(lm_params, hidden, batch.targets, mask)
         else:
-            loss_sum, correct = ce_block(params, hidden, batch.targets, mask)
+            loss_sum, correct = ce_block(lm_params, hidden, batch.targets, mask)
         metrics: Metrics = {
             "loss": (loss_sum, n_tok),
             "accuracy": (correct.astype(jnp.float32), n_tok),

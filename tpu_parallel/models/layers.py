@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.ad_checkpoint import checkpoint_name
 
+from tpu_parallel.parallel import fsdp
 from tpu_parallel.parallel.tp import TPDense, axis_size_or_none
 
 
@@ -74,6 +75,16 @@ class TransformerConfig:
     # only viable at short sequence or small batch)
     remat_policy: str = "full"
     scan_layers: bool = True
+    # layers per unrolled step of the layer scan (nn.scan's ``unroll``).
+    # Measured verdict (SWEEP_r04.json): at 125M the ~11% scan cost persists
+    # unchanged under plain remat (not a remat-policy interaction) AND
+    # in-scan unrolling makes it WORSE (0.389 MFU at unroll=1 vs
+    # 0.349/0.343/0.334 at 2/4/6) — the cost is the per-tick carry
+    # round-trips, which unrolling the loop body does not remove.  Deep
+    # configs should keep scan_unroll=1 and accept the scan tax, or go
+    # fully unrolled (scan_layers=False) where compile budget allows; the
+    # knob stays for measurement on other shapes/hardware.
+    scan_unroll: int = 1
     fsdp: bool = False  # shard big params over the data axis (ZeRO-3)
     fsdp_min_size: int = 2**18
     attn_impl: str = "xla"  # "xla" | "flash" | "ring" | "ulysses"
@@ -649,16 +660,19 @@ class Block(nn.Module):
 
 class _ScanBlock(nn.Module):
     """nn.scan target: one Block per tick, carrying (x, positions, segment_ids,
-    aux_scale, cache_valid)."""
+    aux_scale, cache_valid).  ``block_cls`` lets BlockStack substitute the
+    FSDP-wrapped Block (static metadata — both classes produce the same
+    variable tree shape, the wrapped one with data-sharded leaves)."""
 
     config: TransformerConfig
     train: bool
     decode: bool = False
+    block_cls: Any = Block
 
     @nn.compact
     def __call__(self, carry, _):
         x, positions, segment_ids, aux_scale, cache_valid = carry
-        x = Block(self.config, name="block")(
+        x = self.block_cls(self.config, name="block")(
             x,
             positions=positions,
             segment_ids=segment_ids,
@@ -712,6 +726,14 @@ class BlockStack(nn.Module):
             remat_kwargs["policy"] = jax.checkpoint_policies.save_only_these_names(
                 "proj", "attn"
             )
+        # ZeRO-3 over the layers themselves: each tick (scan) or layer
+        # (unrolled) gathers ITS params just-in-time and the backward
+        # re-gathers under remat, so peak HBM holds one layer's full weights
+        # — without this wrap `fsdp=True` sharded only the embeddings/lm_head
+        # and the block stack (the bulk of the model) stayed replicated over
+        # the data axis.  The wrap sits INSIDE nn.remat: the all_gather is
+        # recomputed, never saved.
+        base_block: Any = fsdp.maybe_shard(Block, cfg)
         if cfg.scan_layers:
             if seq_parallel_active(cfg):
                 # seq-parallel attention output is seq-varying (axis_index /
@@ -726,14 +748,16 @@ class BlockStack(nn.Module):
             scan_target = _ScanBlock
             if cfg.remat and not decode:
                 scan_target = nn.remat(_ScanBlock, **remat_kwargs)
+            # no divisibility requirement: lax.scan peels a remainder step
             stacked = nn.scan(
                 scan_target,
                 variable_axes={"params": 0, "cache": 0, "losses": 0},
                 variable_broadcast=False,
                 split_rngs={"params": True, "dropout": True},
                 length=self.n_layers,
+                unroll=cfg.scan_unroll,
                 metadata_params={nn.PARTITION_NAME: None},
-            )(cfg, train, decode, name="layers")
+            )(cfg, train, decode, base_block, name="layers")
             (x, _, _, _, _), _ = stacked(
                 (x, positions, segment_ids, aux_scale, cache_valid), None
             )
@@ -743,9 +767,9 @@ class BlockStack(nn.Module):
             # decode=5) — without it nn.remat traces them as jnp bools and
             # every `if train` raises TracerBoolConversionError
             block_cls = (
-                nn.remat(Block, static_argnums=(4, 5), **remat_kwargs)
+                nn.remat(base_block, static_argnums=(4, 5), **remat_kwargs)
                 if cfg.remat and not decode
-                else Block
+                else base_block
             )
             for i in range(self.n_layers):
                 x = block_cls(cfg, name=f"layer_{i}")(

@@ -131,6 +131,15 @@ def gather_params(params: Pytree, axis_name: str) -> Pytree:
     )
 
 
+def maybe_shard(target: Union[nn.Module, Callable], config):
+    """FSDP-wrap ``target`` iff ``config.fsdp`` — the one place the
+    axis/min-size plumbing lives (callers: Block stack, embeddings, lm_head;
+    a site that skips this wrap silently leaves its module replicated)."""
+    if getattr(config, "fsdp", False):
+        return shard_module_params(target, config.data_axis, config.fsdp_min_size)
+    return target
+
+
 def shard_module_params(
     target: Union[nn.Module, Callable],
     axis_name: str,
