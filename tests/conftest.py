@@ -108,6 +108,13 @@ _SLOW_TESTS = {
     "test_bidirectional_ring_matches_dense",
     "test_mlm_training_under_sp",
     "test_mlm_training_under_pp",
+    # round-4 FSDP-coverage additions
+    "test_gpt_fsdp_matches_replicated",
+    # seq2seq family (mesh trainers / double-init > ~4s)
+    "test_scan_matches_unrolled",
+    "test_seq2seq_dp_training",
+    "test_seq2seq_tp_training",
+    "test_seq2seq_fsdp_training",
     "test_bidirectional_window_matches_dense",
     "test_encoder_local_attention_model",
     "test_bidirectional_window_under_ulysses",

@@ -1,5 +1,6 @@
 """KV-cache generation tests: cache decode must equal full re-forwarding."""
 
+import flax.linen as nn
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -123,6 +124,58 @@ def test_export_single_device_params_roundtrip(mesh_data8, rng):
     prompt = jnp.zeros((1, 4), jnp.int32)
     out = generate(model, params, prompt, max_new_tokens=4)
     assert out.shape == (1, 4)
+
+
+def test_export_fsdp_sharded_params_and_generate(mesh_data8, rng):
+    """FSDP (data-axis) shard names are slices of REAL dims — the global
+    array already holds the full weight, so export drops the names (even on
+    a leading dim, e.g. the vocab axis) and plain generate serves the
+    result; the model's fsdp wrap degrades to identity without a mesh."""
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_parallel.data import lm_batch
+    from tpu_parallel.models import make_gpt_loss
+    from tpu_parallel.models.generate import export_single_device_params
+    from tpu_parallel.parallel.spmd import build_train_functions
+
+    cfg = tiny_test(dtype=jnp.float32, fsdp=True, fsdp_min_size=0)
+    batch = lm_batch(jax.random.PRNGKey(0), 8, cfg.seq_len, cfg.vocab_size)
+    model = GPTLM(cfg)
+    tx = optax.adamw(1e-3)
+
+    def model_init(r, b):
+        from tpu_parallel.core.state import TrainState
+
+        v = model.init({"params": r}, b.tokens, positions=b.positions, train=False)
+        return TrainState.create(
+            apply_fn=model.apply, params=v["params"], tx=tx, rng=r
+        )
+
+    funcs = build_train_functions(
+        model_init, make_gpt_loss(cfg), mesh_data8, batch,
+        batch_spec=P("data"), donate=False,
+    )
+    state = funcs.init_fn(rng, batch)
+    params = export_single_device_params(state.params)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    out = generate(model, params, prompt, max_new_tokens=4)
+    assert out.shape == (1, 4)
+    # exported logits equal the mesh's own forward on the same tokens
+    toks = jnp.zeros((8, cfg.seq_len), jnp.int32)
+    single = model.apply({"params": params}, toks[:1], train=False)
+    mesh_fwd = jax.jit(
+        jax.shard_map(
+            lambda p, t: model.apply({"params": p}, t, train=False),
+            mesh=mesh_data8,
+            in_specs=(nn.get_partition_spec(state.params), P("data")),
+            out_specs=P("data"),
+            check_vma=False,
+        )
+    )(state.params, toks)
+    np.testing.assert_allclose(
+        np.asarray(single[0]), np.asarray(mesh_fwd[0]), rtol=2e-5, atol=2e-5
+    )
 
 
 def test_export_refuses_tp_sharded_params(mesh_data4_model2, rng):

@@ -1,0 +1,279 @@
+"""Encoder-decoder (seq2seq) family: semantics, meshes, generation."""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tpu_parallel.core import TrainState, compute
+from tpu_parallel.models.seq2seq import (
+    EncoderDecoder,
+    Seq2SeqBatch,
+    make_seq2seq_loss,
+    seq2seq_generate,
+    tiny_seq2seq,
+)
+from tpu_parallel.parallel.spmd import build_train_functions
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = tiny_seq2seq()
+    model = EncoderDecoder(cfg)
+    src = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 256)
+    dst = jax.random.randint(jax.random.PRNGKey(2), (2, 8), 0, 256)
+    variables = model.init({"params": jax.random.PRNGKey(0)}, src, dst, train=False)
+    return model, variables, src, dst
+
+
+def test_forward_shapes(tiny_model):
+    model, variables, src, dst = tiny_model
+    logits = model.apply(variables, src, dst, train=False)
+    assert logits.shape == (2, 8, 256)
+
+
+def test_decoder_is_causal(tiny_model):
+    """Perturbing a future decoder token leaves earlier logits unchanged."""
+    model, variables, src, dst = tiny_model
+    base = model.apply(variables, src, dst, train=False)
+    dst2 = dst.at[:, 5].set((dst[:, 5] + 1) % 256)
+    pert = model.apply(variables, src, dst2, train=False)
+    np.testing.assert_allclose(base[:, :5], pert[:, :5], atol=1e-5)
+    assert not np.allclose(base[:, 5:], pert[:, 5:])
+
+
+def test_every_position_sees_source(tiny_model):
+    """Cross-attention: a source perturbation reaches every decoder position
+    (bidirectional encoder + full-visibility memory)."""
+    model, variables, src, dst = tiny_model
+    base = model.apply(variables, src, dst, train=False)
+    src2 = src.at[:, 3].set((src[:, 3] + 1) % 256)
+    pert = model.apply(variables, src2, dst, train=False)
+    diff = np.abs(np.asarray(base) - np.asarray(pert)).max(axis=(0, 2))
+    assert (diff > 0).all(), f"some decoder positions blind to source: {diff}"
+
+
+def test_source_padding_masked(tiny_model):
+    """Positions masked by src_mask cannot influence the output — neither
+    through encoder self-attention nor through cross-attention."""
+    model, variables, src, dst = tiny_model
+    mask = jnp.ones((2, 16), bool).at[:, 12:].set(False)
+    a = model.apply(variables, src, dst, src_mask=mask, train=False)
+    b = model.apply(
+        variables, src.at[:, 12:].set(7), dst, src_mask=mask, train=False
+    )
+    np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+def test_generate_matches_teacher_forcing(tiny_model):
+    """KV-cached decode (self cache + cross cache + position counter) emits
+    exactly the greedy path of the full teacher-forced forward."""
+    model, variables, src, _ = tiny_model
+    toks = seq2seq_generate(
+        model, variables["params"], src, max_new_tokens=6, bos_id=1
+    )
+    forced = jnp.concatenate(
+        [jnp.full((2, 1), 1, jnp.int32), toks[:, :-1]], axis=1
+    )
+    ref = jnp.argmax(
+        model.apply(variables, src, forced, train=False).astype(jnp.float32), -1
+    )
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))
+
+
+def test_scan_matches_unrolled():
+    """Scanned and unrolled stacks compute the same function on the SAME
+    per-layer weights (stacked scan params copied into the per-layer
+    scopes, like test_gpt_scan_equals_unrolled)."""
+    src = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 256)
+    dst = jax.random.randint(jax.random.PRNGKey(2), (2, 8), 0, 256)
+    cfg_s = tiny_seq2seq(scan_layers=True, remat=False)
+    cfg_l = tiny_seq2seq(scan_layers=False, remat=False)
+    model_s = EncoderDecoder(cfg_s)
+    model_l = EncoderDecoder(cfg_l)
+    vars_s = model_s.init({"params": jax.random.PRNGKey(0)}, src, dst, train=False)
+    vars_l = model_l.init({"params": jax.random.PRNGKey(0)}, src, dst, train=False)
+
+    rebuilt = jax.tree_util.tree_map(lambda x: x, vars_l["params"])  # copy
+    for stack, n in (("encoder", cfg_l.encoder_layers), ("decoder", cfg_l.n_layers)):
+        stacked = vars_s["params"][stack]["layers"]["block"]
+        for i in range(n):
+            rebuilt[stack][f"layer_{i}"] = jax.tree_util.tree_map(
+                lambda x: x[i], stacked
+            )
+    for shared in ("embed", "enc_norm", "dec_norm", "lm_head"):
+        rebuilt[shared] = vars_s["params"][shared]
+
+    out_s = model_s.apply(vars_s, src, dst, train=False)
+    out_l = model_l.apply({"params": rebuilt}, src, dst, train=False)
+    np.testing.assert_allclose(
+        np.asarray(out_s), np.asarray(out_l), rtol=1e-4, atol=1e-4
+    )
+
+
+def _s2s_batch(key, batch_size, cfg):
+    """Copy-task batch: target reproduces the source."""
+    k1, _ = jax.random.split(key)
+    src = jax.random.randint(k1, (batch_size, 16), 2, cfg.vocab_size)
+    bos = jnp.ones((batch_size, 1), jnp.int32)
+    return Seq2SeqBatch(
+        src_tokens=src,
+        tokens=jnp.concatenate([bos, src[:, :-1]], axis=1)[:, :16],
+        targets=src,
+        src_mask=jnp.ones_like(src, bool),
+    )
+
+
+def _train(mesh, cfg, steps=8, **build_kwargs):
+    batch = _s2s_batch(jax.random.PRNGKey(0), 16, cfg)
+    model = EncoderDecoder(cfg)
+    tx = optax.adamw(3e-3)
+
+    def init(rng, b):
+        variables = model.init(
+            {"params": rng}, b.src_tokens, b.tokens, train=False
+        )
+        return TrainState.create(
+            apply_fn=model.apply, params=variables["params"], tx=tx, rng=rng
+        )
+
+    funcs = build_train_functions(
+        init,
+        make_seq2seq_loss(cfg),
+        mesh,
+        batch,
+        batch_spec=P("data"),
+        donate=False,
+        **build_kwargs,
+    )
+    state = funcs.init_fn(jax.random.PRNGKey(42), batch)
+    state, m0 = funcs.step_fn(state, None, batch)
+    first = compute(m0)["loss"]
+    for _ in range(steps - 1):
+        state, m = funcs.step_fn(state, None, batch)
+    return first, compute(m)["loss"], state
+
+
+def test_seq2seq_dp_training(mesh_data8):
+    cfg = tiny_seq2seq()
+    first, last, _ = _train(mesh_data8, cfg)
+    assert last < first
+
+
+def test_seq2seq_tp_training(mesh_data4_model2):
+    """TP trains (vocab-parallel CE path) and shards attention kernels."""
+    cfg = tiny_seq2seq()
+    first, last, state = _train(
+        mesh_data4_model2, cfg, grad_sync_axes=("data", "model")
+    )
+    assert last < first
+    specs = nn.get_partition_spec(state).params
+    flat = jax.tree_util.tree_leaves_with_path(specs)
+    assert any("model" in str(s) for _, s in flat), "no model-sharded params"
+
+
+def test_seq2seq_fsdp_training(mesh_data8):
+    """FSDP shards encoder, decoder (incl. cross-attention), and lm_head."""
+    cfg = tiny_seq2seq(fsdp=True, fsdp_min_size=0)
+    first, last, state = _train(mesh_data8, cfg)
+    assert last < first
+    specs = nn.get_partition_spec(state).params
+    flat = jax.tree_util.tree_leaves_with_path(specs)
+    for sub in ("encoder", "cross_attn", "lm_head"):
+        hits = [
+            s
+            for p, s in flat
+            if sub in jax.tree_util.keystr(p)
+            and "kernel" in jax.tree_util.keystr(p)
+        ]
+        assert hits and all("data" in str(s) for s in hits), (sub, hits)
+
+
+def test_seq2seq_vocab_parallel_ce_matches_full(mesh_data4_model2):
+    """Under TP, the loss path's vocab-parallel CE (column-sharded logits,
+    psum'd softmax statistics) equals plain CE on the gathered full-vocab
+    logits — same params, same mesh, same tokens."""
+    from jax.sharding import PartitionSpec
+
+    from tpu_parallel.core.losses import token_cross_entropy
+    from tpu_parallel.models.gpt import _lm_head_params, make_ce_fn
+
+    cfg = tiny_seq2seq()
+    model = EncoderDecoder(cfg)
+    batch = _s2s_batch(jax.random.PRNGKey(0), 4, cfg)
+    ce_fn = make_ce_fn(cfg)
+
+    def init_fn(rng, b):
+        return model.init(
+            {"params": rng}, b.src_tokens, b.tokens, train=False
+        )["params"]
+
+    P_ = PartitionSpec
+    probe = jax.shard_map(
+        init_fn, mesh=mesh_data4_model2, in_specs=(P_(), P_()),
+        out_specs=P_(), check_vma=False,
+    )
+    specs = nn.get_partition_spec(
+        jax.eval_shape(probe, jax.random.PRNGKey(0), batch)
+    )
+    params = jax.jit(
+        jax.shard_map(
+            init_fn, mesh=mesh_data4_model2, in_specs=(P_(), P_()),
+            out_specs=specs, check_vma=False,
+        )
+    )(jax.random.PRNGKey(0), batch)
+
+    def both(params, b):
+        mask = jnp.ones(b.targets.shape, jnp.float32)
+        hidden = model.apply(
+            {"params": params}, b.src_tokens, b.tokens,
+            src_mask=b.src_mask, train=False, hidden_only=True,
+        )
+        vp_sum, _ = ce_fn(_lm_head_params(cfg, params), hidden, b.targets, mask)
+        logits = model.apply(
+            {"params": params}, b.src_tokens, b.tokens,
+            src_mask=b.src_mask, train=False,
+        )
+        full_sum = (token_cross_entropy(logits, b.targets) * mask).sum()
+        return vp_sum, full_sum
+
+    vp, full = jax.jit(
+        jax.shard_map(
+            both, mesh=mesh_data4_model2, in_specs=(specs, P_()),
+            out_specs=P_(), check_vma=False,
+        )
+    )(params, batch)
+    np.testing.assert_allclose(np.asarray(vp), np.asarray(full), rtol=1e-5)
+
+
+def test_eval_forward_needs_no_dropout_rng():
+    """train=False must deactivate every dropout (incl. cross-attention's):
+    a bare apply without a 'dropout' rng is the eval contract."""
+    cfg = tiny_seq2seq(dropout_rate=0.1)
+    model = EncoderDecoder(cfg)
+    src = jnp.zeros((1, 8), jnp.int32)
+    dst = jnp.zeros((1, 4), jnp.int32)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        src, dst, train=True,
+    )
+    a = model.apply(variables, src, dst, train=False)
+    b = model.apply(variables, src, dst, train=False)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_refusals_are_loud():
+    src = jnp.zeros((1, 8), jnp.int32)
+    dst = jnp.zeros((1, 8), jnp.int32)
+    for bad in (
+        dict(pipe_size=2),
+        dict(attn_impl="ring"),
+        dict(moe_experts=2),
+    ):
+        with pytest.raises(NotImplementedError):
+            EncoderDecoder(tiny_seq2seq(**bad)).init(
+                {"params": jax.random.PRNGKey(0)}, src, dst, train=False
+            )

@@ -12,6 +12,15 @@ from tpu_parallel.models.gpt import (
 )
 from tpu_parallel.models.layers import TransformerConfig
 from tpu_parallel.models.mlp import MLPClassifier, MLPConfig
+from tpu_parallel.models.seq2seq import (
+    EncoderDecoder,
+    Seq2SeqBatch,
+    Seq2SeqConfig,
+    make_seq2seq_loss,
+    seq2seq_generate,
+    t5_small,
+    tiny_seq2seq,
+)
 from tpu_parallel.models.hf import from_hf_gpt2, from_hf_llama, to_hf_gpt2
 from tpu_parallel.models.quantize import (
     QuantizedTensor,
@@ -41,4 +50,11 @@ __all__ = [
     "TransformerConfig",
     "MLPClassifier",
     "MLPConfig",
+    "EncoderDecoder",
+    "Seq2SeqBatch",
+    "Seq2SeqConfig",
+    "make_seq2seq_loss",
+    "seq2seq_generate",
+    "t5_small",
+    "tiny_seq2seq",
 ]

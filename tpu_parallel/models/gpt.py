@@ -228,40 +228,26 @@ class GPTLM(nn.Module):
         return _make_lm_head(cfg)(x)
 
 
-def make_gpt_loss(config: GPTConfig, train: bool = True):
-    """Next-token CE in the accumulate_gradients loss shape, PP/TP-aware.
+def make_ce_fn(config: GPTConfig):
+    """``(lm_params, hidden, targets, mask) -> (loss_sum, correct_sum)``:
+    the shared CE machinery of every token-prediction objective (causal LM,
+    MLM, seq2seq) — vocab-parallel under TP, sequence-chunked under
+    ``config.loss_chunk``.
 
-    Dropout RNG folds over every parallel axis; under PP the loss and metric
-    counts are masked to the last pipe rank (the only rank with real logits).
-    ``train=False`` builds the evaluation variant (dropout off).
-
-    The lm_head is applied here, not in the model: logits stay column-
-    sharded over the model axis and CE runs vocab-parallel — under TP the
-    full-vocab [B, S, vocab] logits tensor never materializes and the
-    per-microbatch all_gather (the largest TP collective) disappears;
-    the softmax statistics cost three O(B*S) scalar collectives instead.
-
-    With ``config.loss_chunk > 0`` the lm_head + CE additionally run
-    ``loss_chunk`` sequence positions at a time under a rematerialized
-    ``lax.scan`` — even the vocab-*sharded* logits never exist at full
-    sequence length (see ``GPTConfig.loss_chunk``).
-    """
+    ``lm_params`` must be pre-gathered when FSDP-sharded
+    (:func:`_lm_head_params`): the head applied here is unwrapped, so the
+    chunk scan never re-all_gathers the vocab kernel per iteration."""
     from tpu_parallel.core.losses import vocab_parallel_cross_entropy
-    from tpu_parallel.parallel.tp import axis_size_or_none
 
-    fold_axes = (
-        config.data_axis, config.model_axis, config.pipe_axis, config.seq_axis
-    )
     chunk = config.loss_chunk
-    # unwrapped head + one explicit gather (_lm_head_params): under
-    # fsdp+loss_chunk the wrapped head would re-all_gather the vocab kernel
-    # per sequence chunk, forward AND rematerialized backward
     head = _make_lm_head(config, name=None, gather=False, fsdp_wrap=False)
 
     def ce_block(lm_params, h, targets, mask):
         """lm_head + CE + accuracy on one block of hidden states; returns
         (loss_sum, correct_sum).  Vocab-parallel when the model axis is
         bound (mesh path), plain CE on full logits otherwise."""
+        from tpu_parallel.parallel.tp import axis_size_or_none
+
         logits = head.apply({"params": lm_params}, h)
         if axis_size_or_none(config.model_axis) is not None:
             ce, pred = vocab_parallel_cross_entropy(
@@ -304,6 +290,32 @@ def make_gpt_loss(config: GPTConfig, train: bool = True):
         )
         (loss_sum, correct), _ = lax.scan(jax.checkpoint(body), init, (hs, ts, ms))
         return loss_sum, correct
+
+    return chunked_ce if chunk else ce_block
+
+
+def make_gpt_loss(config: GPTConfig, train: bool = True):
+    """Next-token CE in the accumulate_gradients loss shape, PP/TP-aware.
+
+    Dropout RNG folds over every parallel axis; under PP the loss and metric
+    counts are masked to the last pipe rank (the only rank with real logits).
+    ``train=False`` builds the evaluation variant (dropout off).
+
+    The lm_head is applied here, not in the model: logits stay column-
+    sharded over the model axis and CE runs vocab-parallel — under TP the
+    full-vocab [B, S, vocab] logits tensor never materializes and the
+    per-microbatch all_gather (the largest TP collective) disappears;
+    the softmax statistics cost three O(B*S) scalar collectives instead.
+
+    With ``config.loss_chunk > 0`` the lm_head + CE additionally run
+    ``loss_chunk`` sequence positions at a time under a rematerialized
+    ``lax.scan`` — even the vocab-*sharded* logits never exist at full
+    sequence length (see ``GPTConfig.loss_chunk``).
+    """
+    fold_axes = (
+        config.data_axis, config.model_axis, config.pipe_axis, config.seq_axis
+    )
+    ce_fn = make_ce_fn(config)
 
     def loss_fn(params, apply_fn, batch, rng):
         dropout_rng = fold_rng_over_axis(rng, fold_axes)
@@ -348,11 +360,9 @@ def make_gpt_loss(config: GPTConfig, train: bool = True):
         if config.pipe_size > 1:
             mask = mask * pp.last_stage_mask(config.pipe_axis)
         n_tok = mask.sum()
-        lm_params = _lm_head_params(config, params)
-        if chunk:
-            loss_sum, correct = chunked_ce(lm_params, hidden, batch.targets, mask)
-        else:
-            loss_sum, correct = ce_block(lm_params, hidden, batch.targets, mask)
+        loss_sum, correct = ce_fn(
+            _lm_head_params(config, params), hidden, batch.targets, mask
+        )
         metrics: Metrics = {
             "loss": (loss_sum, n_tok),
             "accuracy": (correct.astype(jnp.float32), n_tok),

@@ -684,6 +684,31 @@ class _ScanBlock(nn.Module):
         return (x, positions, segment_ids, aux_scale, cache_valid), None
 
 
+def remat_kwargs_for(config: TransformerConfig) -> dict:
+    """``nn.remat`` kwargs for a layer stack under ``config.remat_policy``.
+
+    prevent_cse=False is safe (and fastest) under scan for plain remat, but
+    with a save-policy XLA can CSE the "recompute" against the forward and
+    hoist per-layer score tensors out of the scan — 9G+ of stacked
+    [layers, B, H, S, S] buffers.  Keep CSE prevention on when a policy
+    narrows the saveable set.
+    """
+    remat_kwargs = dict(prevent_cse=config.remat_policy != "full")
+    if config.remat_policy == "dots":
+        remat_kwargs["policy"] = (
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        )
+    elif config.remat_policy == "proj":
+        remat_kwargs["policy"] = jax.checkpoint_policies.save_only_these_names(
+            "proj"
+        )
+    elif config.remat_policy == "proj_attn":
+        remat_kwargs["policy"] = jax.checkpoint_policies.save_only_these_names(
+            "proj", "attn"
+        )
+    return remat_kwargs
+
+
 class BlockStack(nn.Module):
     """``n_layers`` blocks, optionally remat'd and scanned.
 
@@ -708,24 +733,7 @@ class BlockStack(nn.Module):
         cache_valid: Optional[jax.Array] = None,
     ) -> jax.Array:
         cfg = self.config
-        # prevent_cse=False is safe (and fastest) under scan for plain remat,
-        # but with a save-policy XLA can CSE the "recompute" against the
-        # forward and hoist per-layer score tensors out of the scan — 9G+ of
-        # stacked [layers, B, H, S, S] buffers.  Keep CSE prevention on when
-        # a policy narrows the saveable set.
-        remat_kwargs = dict(prevent_cse=cfg.remat_policy != "full")
-        if cfg.remat_policy == "dots":
-            remat_kwargs["policy"] = (
-                jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-            )
-        elif cfg.remat_policy == "proj":
-            remat_kwargs["policy"] = jax.checkpoint_policies.save_only_these_names(
-                "proj"
-            )
-        elif cfg.remat_policy == "proj_attn":
-            remat_kwargs["policy"] = jax.checkpoint_policies.save_only_these_names(
-                "proj", "attn"
-            )
+        remat_kwargs = remat_kwargs_for(cfg)
         # ZeRO-3 over the layers themselves: each tick (scan) or layer
         # (unrolled) gathers ITS params just-in-time and the backward
         # re-gathers under remat, so peak HBM holds one layer's full weights

@@ -1,0 +1,662 @@
+"""Encoder-decoder (seq2seq) transformer family.
+
+Completes the model-family triad next to the decoder-only LMs
+(:mod:`~tpu_parallel.models.gpt`) and the bidirectional encoders
+(``bidirectional=True`` + :func:`~tpu_parallel.models.gpt.make_mlm_loss`):
+a T5-shaped architecture — bidirectional encoder over the source, causal
+decoder over the target, cross-attention from every decoder layer into the
+encoder's output.  No reference capability exists (the reference trains
+2-layer MLPs only — SURVEY.md §2.4); this is framework surface the
+reference's users would expect.
+
+TPU-first choices, consistent with the rest of the family:
+
+- Encoder and decoder reuse the same :class:`TPDense`-structured blocks
+  (:class:`~tpu_parallel.models.layers.Block` /
+  :class:`~tpu_parallel.models.layers.Attention`), so tensor parallelism is
+  structural and FSDP wraps per-layer via ``fsdp.maybe_shard`` exactly as
+  the LM stack does.
+- Cross-attention is GQA-native (grouped queries contract against kv-width
+  memory directly, like
+  :func:`~tpu_parallel.models.layers.decode_attention`) and carries no
+  positional transform: relative order enters through the self-attention
+  paths on each side, the standard encoder-decoder convention.
+- Decoding caches the projected memory K/V once at prefill (``cache``
+  collection) — per-step cross-attention is two einsums against cached
+  tensors, no re-projection of the source.
+- The loss reuses :func:`~tpu_parallel.models.gpt.make_ce_fn`:
+  vocab-parallel CE under TP, sequence-chunked under ``loss_chunk``,
+  FSDP-gathered lm_head applied once.
+
+Deliberate refusals (loud, not silent): pipeline parallelism (heterogeneous
+enc/dec stages need their own schedule — the pipe axis is a GPTLM
+capability for now) and sequence-parallel attention inside the seq2seq
+stacks (ring/Ulysses shard the self-attention token axis but the
+cross-attention memory would need its own resharding story).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax import lax
+
+from tpu_parallel.core.metrics import Metrics
+from tpu_parallel.core.rng import fold_rng_over_axis
+from tpu_parallel.models.gpt import (
+    GPTConfig,
+    _lm_head_params,
+    _make_lm_head,
+    make_ce_fn,
+)
+from tpu_parallel.models.layers import (
+    MLP,
+    Attention,
+    BlockStack,
+    Embedding,
+    make_norm,
+    remat_kwargs_for,
+)
+from tpu_parallel.parallel import fsdp
+from tpu_parallel.parallel.tp import TPDense, axis_size_or_none
+
+
+@dataclasses.dataclass(frozen=True)
+class Seq2SeqConfig(GPTConfig):
+    """GPTConfig plus the encoder/decoder split.
+
+    ``n_layers`` is the DECODER depth (so LM-tuned knobs like remat policy
+    and FLOPs accounting carry over); ``enc_layers`` sizes the encoder
+    (default: same depth).  ``src_seq_len`` bounds the source length for
+    learned positions and the memory cache (default: ``seq_len``).
+    """
+
+    enc_layers: Optional[int] = None
+    src_seq_len: Optional[int] = None
+
+    @property
+    def encoder_layers(self) -> int:
+        return self.enc_layers if self.enc_layers is not None else self.n_layers
+
+    @property
+    def source_len(self) -> int:
+        return self.src_seq_len if self.src_seq_len is not None else self.seq_len
+
+
+@struct.dataclass
+class Seq2SeqBatch:
+    """Source tokens + teacher-forced decoder tokens/targets.
+
+    ``src_mask`` flags real source positions (False = padding: masked out of
+    every cross-attention); ``loss_mask`` zeroes padding out of the CE.
+    """
+
+    src_tokens: jax.Array  # [B, S_src]
+    tokens: jax.Array  # [B, S_dst] decoder input (BOS-shifted)
+    targets: jax.Array  # [B, S_dst]
+    src_mask: Optional[jax.Array] = None  # [B, S_src] bool/0-1
+    loss_mask: Optional[jax.Array] = None  # [B, S_dst]
+
+    @property
+    def size(self) -> int:
+        return self.src_tokens.shape[0]
+
+
+def cross_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    memory_mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Full-visibility attention of decoder queries over encoder memory.
+
+    ``q``: [B, T, h, dh]; ``k``/``v``: [B, S, h_kv, dh] with
+    ``h % h_kv == 0`` — grouped queries contract against their kv head
+    directly (GQA-native, no K/V expansion).  ``memory_mask`` [B, S] masks
+    source padding.  fp32 softmax, bf16 einsums on the MXU.
+    """
+    b, tq, h, head_dim = q.shape
+    h_kv = k.shape[2]
+    group = h // h_kv
+    scale = 1.0 / jnp.sqrt(head_dim).astype(q.dtype)
+    qg = (q * scale).reshape(b, tq, h_kv, group, head_dim)
+    scores = jnp.einsum("bqngd,bknd->bngqk", qg, k).astype(jnp.float32)
+    if memory_mask is not None:
+        keep = memory_mask.astype(bool)[:, None, None, None, :]
+        scores = jnp.where(keep, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bngqk,bknd->bqngd", probs, v)
+    return out.reshape(b, tq, h, head_dim)
+
+
+class CrossAttention(nn.Module):
+    """Decoder-side cross-attention into the encoder memory, TP-structural.
+
+    Q is column-parallel at query-head width; the memory K/V projection is
+    column-parallel at kv-head width; the output closes the Megatron pair
+    row-parallel.  With ``decode=True`` and ``memory`` given (prefill), the
+    projected K/V are written to a ``cache`` collection; subsequent steps
+    pass ``memory=None`` and read the cache — the source is projected
+    exactly once per generation.
+    """
+
+    config: Seq2SeqConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,
+        memory: Optional[jax.Array],
+        memory_mask: Optional[jax.Array] = None,
+        train: bool = True,
+        decode: bool = False,
+    ) -> jax.Array:
+        cfg = self.config
+        tp_size = axis_size_or_none(cfg.model_axis) or 1
+        n_kv = cfg.n_kv_heads or cfg.n_heads
+        local_heads = cfg.n_heads // tp_size
+        local_kv = n_kv // tp_size
+
+        q = TPDense(
+            features=cfg.n_heads * cfg.head_dim,
+            axis_name=cfg.model_axis,
+            style="column",
+            dtype=cfg.dtype,
+            name="q",
+        )(x)
+        q = q.reshape(*x.shape[:-1], local_heads, cfg.head_dim)
+
+        if memory is not None:
+            kv = TPDense(
+                features=2 * n_kv * cfg.head_dim,
+                axis_name=cfg.model_axis,
+                style="column",
+                dtype=cfg.dtype,
+                name="kv",
+            )(memory)
+            kv = kv.reshape(*memory.shape[:-1], local_kv, 2 * cfg.head_dim)
+            k, v = jnp.split(kv, 2, axis=-1)
+        elif not decode:
+            raise ValueError("cross-attention needs `memory` outside decode")
+        else:
+            k = v = None  # read from cache below
+
+        if decode:
+            b = x.shape[0]
+            s_src = memory.shape[1] if memory is not None else None
+            if k is None and not self.has_variable("cache", "cross_key"):
+                raise ValueError(
+                    "decode step before prefill: run one decode=True apply "
+                    "WITH `memory` first to populate the cross K/V cache"
+                )
+            init_shape = (b, s_src or 1, local_kv, cfg.head_dim)
+            cached_k = self.variable(
+                "cache", "cross_key", jnp.zeros, init_shape, cfg.dtype
+            )
+            cached_v = self.variable(
+                "cache", "cross_value", jnp.zeros, init_shape, cfg.dtype
+            )
+            cached_m = self.variable(
+                "cache",
+                "cross_mask",
+                jnp.ones,
+                (b, s_src or 1),
+                jnp.bool_,
+            )
+            if k is not None:  # prefill: project once, persist
+                cached_k.value = k
+                cached_v.value = v
+                if memory_mask is not None:
+                    cached_m.value = memory_mask.astype(bool)
+            k, v = cached_k.value, cached_v.value
+            memory_mask = cached_m.value
+
+        out = cross_attention(q, k, v, memory_mask)
+        out = out.reshape(*x.shape[:-1], local_heads * cfg.head_dim)
+        out = TPDense(
+            features=cfg.d_model,
+            axis_name=cfg.model_axis,
+            style="row",
+            dtype=cfg.dtype,
+            name="out",
+        )(out)
+        if cfg.dropout_rate > 0.0:
+            out = nn.Dropout(rate=cfg.dropout_rate, deterministic=not train)(out)
+        return out
+
+
+class DecoderBlock(nn.Module):
+    """Pre-norm decoder block: causal self-attn, cross-attn, MLP."""
+
+    config: Seq2SeqConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,
+        memory: Optional[jax.Array],
+        memory_mask: Optional[jax.Array] = None,
+        positions: Optional[jax.Array] = None,
+        train: bool = True,
+        decode: bool = False,
+    ) -> jax.Array:
+        cfg = self.config
+        h = make_norm(cfg, "norm_self")(x).astype(cfg.dtype)
+        x = x + Attention(cfg, name="self_attn")(
+            h, positions=positions, train=train, decode=decode
+        )
+        h = make_norm(cfg, "norm_cross")(x).astype(cfg.dtype)
+        x = x + CrossAttention(cfg, name="cross_attn")(
+            h, memory, memory_mask=memory_mask, train=train, decode=decode
+        )
+        h = make_norm(cfg, "norm_mlp")(x).astype(cfg.dtype)
+        x = x + MLP(cfg, name="mlp")(h, train=train)
+        return x
+
+
+class _ScanDecoderBlock(nn.Module):
+    """nn.scan target for the decoder stack: memory rides the carry."""
+
+    config: Seq2SeqConfig
+    train: bool
+    decode: bool = False
+    block_cls: type = DecoderBlock
+
+    @nn.compact
+    def __call__(self, carry, _):
+        x, memory, memory_mask, positions = carry
+        x = self.block_cls(self.config, name="block")(
+            x,
+            memory,
+            memory_mask=memory_mask,
+            positions=positions,
+            train=self.train,
+            decode=self.decode,
+        )
+        return (x, memory, memory_mask, positions), None
+
+
+class DecoderStack(nn.Module):
+    """``n_layers`` decoder blocks, scanned+remat'd like BlockStack."""
+
+    config: Seq2SeqConfig
+    n_layers: int
+
+    @nn.compact
+    def __call__(
+        self,
+        x: jax.Array,
+        memory: Optional[jax.Array],
+        memory_mask: Optional[jax.Array] = None,
+        positions: Optional[jax.Array] = None,
+        train: bool = True,
+        decode: bool = False,
+    ) -> jax.Array:
+        cfg = self.config
+        remat_kwargs = remat_kwargs_for(cfg)
+        base_block = fsdp.maybe_shard(DecoderBlock, cfg)
+        if cfg.scan_layers:
+            scan_target = _ScanDecoderBlock
+            if cfg.remat and not decode:
+                scan_target = nn.remat(_ScanDecoderBlock, **remat_kwargs)
+            # None slots (decode steps read memory from the per-layer cache)
+            # pass through the carry as empty pytree nodes — structure
+            # stays static across prefill and steps
+            stacked = nn.scan(
+                scan_target,
+                variable_axes={"params": 0, "cache": 0},
+                variable_broadcast=False,
+                split_rngs={"params": True, "dropout": True},
+                length=self.n_layers,
+                unroll=cfg.scan_unroll,
+                metadata_params={nn.PARTITION_NAME: None},
+            )(cfg, train, decode, base_block, name="layers")
+            (x, _, _, _), _ = stacked((x, memory, memory_mask, positions), None)
+        else:
+            block_cls = (
+                nn.remat(base_block, static_argnums=(5, 6), **remat_kwargs)
+                if cfg.remat and not decode
+                else base_block
+            )
+            for i in range(self.n_layers):
+                x = block_cls(cfg, name=f"layer_{i}")(
+                    x, memory, memory_mask, positions, train, decode
+                )
+        return x
+
+
+class _DecodePos(nn.Module):
+    """Model-level decode position counter (compact, so the cache variable
+    can be created lazily on the first mutable decode apply — mirrors
+    GPTLM's in-line counter, which a setup-style method may not create)."""
+
+    @nn.compact
+    def __call__(self, dst: jax.Array) -> jax.Array:
+        counter = self.variable(
+            "cache", "decode_pos", lambda: jnp.zeros((), jnp.int32)
+        )
+        positions = jnp.broadcast_to(
+            counter.value + jnp.arange(dst.shape[1])[None, :], dst.shape
+        )
+        counter.value = counter.value + dst.shape[1]
+        return positions
+
+
+class EncoderDecoder(nn.Module):
+    """``(src [B, S_src], dst [B, S_dst]) -> logits [B, S_dst, vocab]``.
+
+    The token embedding is shared between encoder input, decoder input
+    (T5-style tying); the lm_head stays untied like the LM family.  The
+    encoder runs the existing :class:`BlockStack` with
+    ``bidirectional=True``; the decoder is :class:`DecoderStack`.
+    """
+
+    config: Seq2SeqConfig
+
+    def setup(self):
+        cfg = self.config
+        if cfg.pipe_size > 1:
+            raise NotImplementedError(
+                "pipeline parallelism for encoder-decoder models "
+                "(heterogeneous enc/dec stages need their own schedule)"
+            )
+        if cfg.attn_impl in ("ring", "ulysses"):
+            raise NotImplementedError(
+                "sequence-parallel attention inside the seq2seq stacks "
+                "(cross-attention memory needs its own resharding story)"
+            )
+        if cfg.moe_experts > 0:
+            raise NotImplementedError("MoE blocks in the seq2seq stacks")
+        # encoder sees bidirectional attention; decoder causal.  Positions
+        # are bounded by the LONGER of the two lengths so the shared learned
+        # table covers both sides.
+        table = max(cfg.seq_len, cfg.source_len)
+        self._enc_cfg = dataclasses.replace(
+            cfg, bidirectional=True, seq_len=cfg.source_len
+        )
+        self._dec_cfg = dataclasses.replace(cfg, bidirectional=False)
+        self.embed = fsdp.maybe_shard(Embedding, cfg)(
+            dataclasses.replace(cfg, seq_len=table), name="embed"
+        )
+        self.encoder = BlockStack(
+            self._enc_cfg, cfg.encoder_layers, name="encoder"
+        )
+        self.enc_norm = make_norm(cfg, "enc_norm")
+        self.decoder = DecoderStack(self._dec_cfg, cfg.n_layers, name="decoder")
+        self.dec_norm = make_norm(cfg, "dec_norm")
+        self.lm_head = _make_lm_head(cfg)
+        self.decode_pos = _DecodePos(name="pos_counter")
+
+    def encode(
+        self,
+        src: jax.Array,
+        src_mask: Optional[jax.Array] = None,
+        train: bool = True,
+    ) -> jax.Array:
+        """Source tokens -> memory [B, S_src, d_model].
+
+        Padding is excluded from encoder self-attention via segment_ids
+        (pad positions form their own segment), and from every
+        cross-attention via the mask the caller threads through.
+        """
+        x = self.embed(src)
+        segment_ids = None
+        if src_mask is not None:
+            # real tokens segment 1, padding segment 0 — same-segment
+            # visibility keeps padding out of the real tokens' softmax
+            segment_ids = src_mask.astype(jnp.int32)
+        x = self.encoder(x, segment_ids=segment_ids, train=train)
+        return self.enc_norm(x).astype(self.config.dtype)
+
+    def decode(
+        self,
+        dst: jax.Array,
+        memory: Optional[jax.Array],
+        src_mask: Optional[jax.Array] = None,
+        positions: Optional[jax.Array] = None,
+        train: bool = True,
+        decode: bool = False,
+        hidden_only: bool = False,
+    ) -> jax.Array:
+        cfg = self.config
+        if decode and positions is None:
+            positions = self.decode_pos(dst)
+        x = self.embed(dst, positions=positions)
+        x = self.decoder(
+            x,
+            memory,
+            memory_mask=src_mask,
+            positions=positions,
+            train=train,
+            decode=decode,
+        )
+        x = self.dec_norm(x).astype(cfg.dtype)
+        if hidden_only:
+            return x
+        return self.lm_head(x)
+
+    def __call__(
+        self,
+        src: jax.Array,
+        dst: jax.Array,
+        src_mask: Optional[jax.Array] = None,
+        train: bool = True,
+        decode: bool = False,
+        hidden_only: bool = False,
+    ) -> jax.Array:
+        memory = self.encode(src, src_mask=src_mask, train=train)
+        return self.decode(
+            dst,
+            memory,
+            src_mask=src_mask,
+            train=train,
+            decode=decode,
+            hidden_only=hidden_only,
+        )
+
+
+def make_seq2seq_loss(config: Seq2SeqConfig, train: bool = True):
+    """Teacher-forced CE over decoder positions, TP/FSDP-aware.
+
+    Same contract as :func:`make_gpt_loss` (``accumulate_gradients`` loss
+    shape); the CE machinery is shared (:func:`make_ce_fn` — vocab-parallel
+    under TP, chunked under ``loss_chunk``, pre-gathered lm_head).
+    """
+    fold_axes = (config.data_axis, config.model_axis)
+    ce_fn = make_ce_fn(config)
+
+    def loss_fn(params, apply_fn, batch: Seq2SeqBatch, rng):
+        dropout_rng = fold_rng_over_axis(rng, fold_axes)
+        hidden = apply_fn(
+            {"params": params},
+            batch.src_tokens,
+            batch.tokens,
+            src_mask=batch.src_mask,
+            train=train,
+            hidden_only=True,
+            rngs={"dropout": dropout_rng},
+        )
+        mask = (
+            batch.loss_mask
+            if batch.loss_mask is not None
+            else jnp.ones(batch.targets.shape, jnp.float32)
+        )
+        n_tok = mask.sum()
+        loss_sum, correct = ce_fn(
+            _lm_head_params(config, params), hidden, batch.targets, mask
+        )
+        metrics: Metrics = {
+            "loss": (loss_sum, n_tok),
+            "accuracy": (correct.astype(jnp.float32), n_tok),
+        }
+        return loss_sum / jnp.maximum(n_tok, 1.0), metrics
+
+    return loss_fn
+
+
+def seq2seq_generate(
+    model: EncoderDecoder,
+    params,
+    src: jax.Array,
+    src_mask: Optional[jax.Array] = None,
+    rng: Optional[jax.Array] = None,
+    *,
+    bos_id: int = 0,
+    max_new_tokens: int = 32,
+    temperature: float = 0.0,
+    top_k: int = 0,
+) -> jax.Array:
+    """Encode once, then KV-cached autoregressive decoding.
+
+    Returns [B, max_new_tokens].  Greedy at ``temperature == 0``; the
+    sampling filters are shared with the LM path
+    (:func:`~tpu_parallel.models.generate._sample`).  Single-device params
+    layout (the seq2seq family has no mesh-sharded serving path yet — train
+    on a mesh, then ``export_single_device_params``).
+    """
+    cfg = model.config
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    if max_new_tokens > cfg.seq_len:
+        raise ValueError(
+            f"max_new_tokens ({max_new_tokens}) exceeds decoder seq_len "
+            f"({cfg.seq_len})"
+        )
+    if src.shape[1] > cfg.source_len:
+        # nn.Embed clamps out-of-range position indices under jit, so an
+        # oversized source would silently reuse the last learned position
+        # embedding instead of failing
+        raise ValueError(
+            f"source length ({src.shape[1]}) exceeds the encoder's "
+            f"source_len ({cfg.source_len})"
+        )
+    return _seq2seq_generate_jit(
+        model,
+        params,
+        src,
+        src_mask,
+        rng,
+        bos_id=bos_id,
+        max_new_tokens=max_new_tokens,
+        temperature=temperature,
+        top_k=top_k,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnums=(0,),
+    static_argnames=("bos_id", "max_new_tokens", "temperature", "top_k"),
+)
+def _seq2seq_generate_jit(
+    model: EncoderDecoder,
+    params,
+    src,
+    src_mask,
+    rng,
+    *,
+    bos_id: int,
+    max_new_tokens: int,
+    temperature: float,
+    top_k: int,
+):
+    """Module-level jitted core: a serving loop pays trace + compile once per
+    (model, shapes, knobs), not per call."""
+    from tpu_parallel.models.generate import _sample
+
+    cfg = model.config
+    b = src.shape[0]
+    memory = model.apply(
+        {"params": params}, src, src_mask, False, method=model.encode
+    )
+    head = _make_lm_head(cfg, name=None, gather=False, fsdp_wrap=False)
+    lm_params = _lm_head_params(cfg, params)
+
+    def next_token(h, rng):
+        logits = head.apply({"params": lm_params}, h[:, -1:])[:, 0]
+        return _sample(logits, rng, temperature, top_k)
+
+    # prefill: BOS through the decoder populates self- and cross-caches
+    bos = jnp.full((b, 1), bos_id, jnp.int32)
+    hidden, variables = model.apply(
+        {"params": params},
+        bos,
+        memory,
+        src_mask,
+        None,
+        False,
+        True,
+        True,
+        method=model.decode,
+        mutable=["cache"],
+    )
+    rng, sub = jax.random.split(rng)
+    first = next_token(hidden, sub)
+
+    def step(carry, _):
+        cache, tok, rng = carry
+        hidden, updated = model.apply(
+            {"params": params, "cache": cache},
+            tok[:, None],
+            None,
+            None,
+            None,
+            False,
+            True,
+            True,
+            method=model.decode,
+            mutable=["cache"],
+        )
+        rng, sub = jax.random.split(rng)
+        nxt = next_token(hidden, sub)
+        return (updated["cache"], nxt, rng), tok
+
+    init = (variables["cache"], first, rng)
+    (_, last, _), toks = lax.scan(step, init, None, length=max_new_tokens - 1)
+    return jnp.concatenate([toks.T, last[:, None]], axis=1)
+
+
+def t5_small(**overrides) -> Seq2SeqConfig:
+    """T5-small-shaped encoder-decoder (~60M params, vocab padded to 128)."""
+    return Seq2SeqConfig(
+        **{
+            **dict(
+                vocab_size=32128,
+                d_model=512,
+                n_layers=6,
+                enc_layers=6,
+                n_heads=8,
+                seq_len=512,
+                mlp_ratio=4,
+                norm="rmsnorm",
+                mlp="gelu",
+            ),
+            **overrides,
+        }
+    )
+
+
+def tiny_seq2seq(**overrides) -> Seq2SeqConfig:
+    """Toy config for CPU-mesh tests."""
+    return Seq2SeqConfig(
+        **{
+            **dict(
+                vocab_size=256,
+                d_model=32,
+                n_layers=2,
+                enc_layers=2,
+                n_heads=4,
+                seq_len=32,
+                src_seq_len=32,
+                dtype=jnp.float32,
+                num_microbatches=2,
+            ),
+            **overrides,
+        }
+    )

@@ -49,7 +49,16 @@ def shard_params(
     the local slice is taken with ``dynamic_slice_in_dim`` at this device's
     axis index, and the leaf is wrapped in ``nn.Partitioned`` so partition
     specs can later be read off with ``nn.get_partition_spec``.
+
+    Identity when ``axis_name`` is unbound (no mesh): an FSDP-configured
+    model then runs on plain single-device params — same degrade-gracefully
+    contract as the structural-TP layers (``tp.axis_size_or_none``), so
+    ``export_single_device_params`` output serves directly.
     """
+    from tpu_parallel.parallel.tp import axis_size_or_none
+
+    if axis_size_or_none(axis_name) is None:
+        return params
     axis_idx = lax.axis_index(axis_name)
     axis_size = lax.psum(1, axis_name)
 
@@ -114,7 +123,14 @@ def _gather_with_scattered_grad(x: jax.Array, axis_name: str, axis: int) -> jax.
 
 @jax.named_scope("gather_params")
 def gather_params(params: Pytree, axis_name: str) -> Pytree:
-    """Materialize full weights from their 1/N shards for compute."""
+    """Materialize full weights from their 1/N shards for compute.
+
+    Identity when ``axis_name`` is unbound (see :func:`shard_params`) —
+    exported single-device params are already full."""
+    from tpu_parallel.parallel.tp import axis_size_or_none
+
+    if axis_size_or_none(axis_name) is None:
+        return params
 
     def gather(p):
         if isinstance(p, nn.Partitioned) and axis_name in p.names:

@@ -25,7 +25,7 @@ Design (shard_map idiom — every function here runs per-device inside a
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Optional, Sequence
 
 import flax.linen as nn
 import jax
@@ -240,7 +240,9 @@ class TPDense(nn.Module):
         raise ValueError(f"unknown TPDense style: {self.style!r}")
 
 
-def export_single_device_params(params: Pytree) -> Pytree:
+def export_single_device_params(
+    params: Pytree, fsdp_axes: Sequence[str] = ("data",)
+) -> Pytree:
     """Convert mesh-trained params to the mesh-free module layout.
 
     Bridges the two parameter layouts of the structural-TP design (see
@@ -250,9 +252,15 @@ def export_single_device_params(params: Pytree) -> Pytree:
     no mesh axis bound.  Use it to run single-device inference (e.g.
     ``models.generate``) on a state trained under a DP/FSDP mesh.
 
+    ``fsdp_axes`` names the mesh axes used for FSDP-style slicing of REAL
+    parameter dims (``fsdp.shard_params``): outside shard_map the global
+    array already holds the full weight, so those names are simply dropped
+    — even on a leading dim (the embedding's vocab dim is dim 0).
+
     Raises if a parameter is genuinely split over a >1 mesh axis (tp or
-    pipe degree > 1) — such weights live on multiple devices; run inference
-    under the same mesh instead of exporting.
+    pipe degree > 1, i.e. a stacked ModuleShard device axis) — such weights
+    live divided across module scopes; run inference under the mesh instead
+    of exporting.
     """
 
     def unbox(x):
@@ -261,16 +269,24 @@ def export_single_device_params(params: Pytree) -> Pytree:
             for i in reversed(range(len(names))):
                 if names[i] is None:
                     continue
+                if names[i] in fsdp_axes:
+                    # FSDP shard of a real dim: global value is already the
+                    # full weight — drop the name, keep the dim
+                    continue
                 if value.shape[i] == 1:
                     value = jnp.squeeze(value, i)
                 elif i == 0:  # stacked ModuleShard axis with real tp/pipe degree
                     raise ValueError(
                         f"parameter is split over mesh axis {names[i]!r} "
                         f"(size {value.shape[i]}); export requires tp/pipe "
-                        "degree 1 — run inference under the mesh instead"
+                        "degree 1 — run inference under the mesh instead. "
+                        f"(If {names[i]!r} is a RENAMED data axis used for "
+                        "FSDP, pass fsdp_axes=({!r},) to export it.)".format(
+                            names[i]
+                        )
                     )
-                # non-leading named dims (FSDP shards of a real dim) keep
-                # their global shape after unboxing — nothing to do
+                # non-leading named dims keep their global shape — nothing
+                # to do
             return value
         return x
 
