@@ -110,6 +110,7 @@ _SLOW_TESTS = {
     "test_mlm_training_under_pp",
     # round-4 FSDP-coverage additions
     "test_gpt_fsdp_matches_replicated",
+    "test_postnorm_mlm_training",
     # seq2seq family (mesh trainers / double-init > ~4s)
     "test_scan_matches_unrolled",
     "test_seq2seq_dp_training",

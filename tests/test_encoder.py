@@ -408,3 +408,33 @@ def test_mlm_training_under_pp(mesh_2x2x2, rng):
     for _ in range(5):
         state, m = funcs.step_fn(state, None, batch)
     assert compute(m)["loss"] < first
+
+
+def test_postnorm_mlm_training(mesh_data8, rng):
+    """The BERT-faithful variant (post-norm residuals + embeddings
+    LayerNorm + erf gelu) trains end-to-end: interop architecture knobs
+    are full citizens, not import-only."""
+    cfg = tiny_test(
+        bidirectional=True, seq_len=32, prenorm=False, embed_norm=True,
+        mlp="gelu_exact",
+    )
+    batch = lm_batch(jax.random.PRNGKey(0), 16, cfg.seq_len, cfg.vocab_size)
+    model = GPTLM(cfg)
+    tx = optax.adamw(3e-3)
+
+    def init(rng_, b):
+        p = model.init({"params": rng_}, b.tokens, train=False)["params"]
+        return TrainState.create(apply_fn=model.apply, params=p, tx=tx, rng=rng_)
+
+    funcs = build_train_functions(
+        init, make_mlm_loss(cfg, mask_rate=0.3), mesh_data8, batch,
+        batch_spec=P("data"), donate=False,
+    )
+    state = funcs.init_fn(rng, batch)
+    state, m0 = funcs.step_fn(state, None, batch)
+    first = compute(m0)["loss"]
+    for _ in range(8):
+        state, m = funcs.step_fn(state, None, batch)
+    assert compute(m)["loss"] < first
+    # post-norm trunk has no final norm (parity with the HF layout)
+    assert "norm_final" not in state.params

@@ -272,6 +272,8 @@ def test_refusals_are_loud():
         dict(pipe_size=2),
         dict(attn_impl="ring"),
         dict(moe_experts=2),
+        dict(prenorm=False),
+        dict(embed_norm=True),
     ):
         with pytest.raises(NotImplementedError):
             EncoderDecoder(tiny_seq2seq(**bad)).init(

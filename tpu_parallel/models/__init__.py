@@ -5,6 +5,7 @@ from tpu_parallel.models.gpt import (
     gpt2_350m,
     EncoderClassifier,
     bert_base,
+    bert_base_hf,
     llama_1b,
     make_gpt_loss,
     make_mlm_loss,
@@ -21,7 +22,7 @@ from tpu_parallel.models.seq2seq import (
     t5_small,
     tiny_seq2seq,
 )
-from tpu_parallel.models.hf import from_hf_gpt2, from_hf_llama, to_hf_gpt2
+from tpu_parallel.models.hf import from_hf_bert, from_hf_gpt2, from_hf_llama, to_hf_gpt2
 from tpu_parallel.models.quantize import (
     QuantizedTensor,
     dequantize_params,
@@ -43,6 +44,8 @@ __all__ = [
     "gpt2_350m",
     "EncoderClassifier",
     "bert_base",
+    "bert_base_hf",
+    "from_hf_bert",
     "llama_1b",
     "make_gpt_loss",
     "make_mlm_loss",

@@ -216,7 +216,11 @@ class GPTLM(nn.Module):
                 decode=decode,
             )
 
-        x = make_norm(cfg, "norm_final")(x).astype(cfg.dtype)
+        if cfg.prenorm:
+            # post-norm stacks (BERT interop) leave the trunk already
+            # normalized by the last block's norm_mlp — an extra final norm
+            # has no HF counterpart and would break checkpoint parity
+            x = make_norm(cfg, "norm_final")(x).astype(cfg.dtype)
         if hidden_only:
             # for chunked-loss training (make_gpt_loss applies the lm_head
             # itself, loss_chunk positions at a time)
@@ -551,6 +555,34 @@ def bert_base(**overrides) -> GPTConfig:
                 n_heads=12,
                 seq_len=512,
                 bidirectional=True,
+            ),
+            **overrides,
+        }
+    )
+
+
+def bert_base_hf(**overrides) -> GPTConfig:
+    """BERT-base in its ORIGINAL (HF-checkpoint-faithful) form: post-norm
+    residuals, embeddings.LayerNorm, erf gelu, vocab 30522 unpadded —
+    the config :func:`~tpu_parallel.models.hf.from_hf_bert` imports into.
+    For from-scratch pretraining prefer :func:`bert_base` (pre-norm,
+    MXU-padded vocab)."""
+    return GPTConfig(
+        **{
+            **dict(
+                vocab_size=30522,
+                d_model=768,
+                n_layers=12,
+                n_heads=12,
+                seq_len=512,
+                bidirectional=True,
+                prenorm=False,
+                embed_norm=True,
+                mlp="gelu_exact",
+                scan_layers=False,
+                # BERT's LayerNorm epsilon (GPT-2/Llama use 1e-5; with the
+                # wrong eps all 25 norms silently drift from torch)
+                norm_eps=1e-12,
             ),
             **overrides,
         }

@@ -406,3 +406,175 @@ def from_hf_llama(hf_model_or_dict, config, dtype=jnp.float32) -> Pytree:
             },
         }
     return params
+
+
+def from_hf_bert(hf_model_or_dict, config, dtype=jnp.float32):
+    """HF BERT trunk weights -> ``(params, pooler)`` for the encoder family.
+
+    ``params`` is the GPTLM (unrolled, mesh-free) layout for a
+    post-norm bidirectional config; ``pooler`` is ``{"kernel", "bias"}``
+    for :class:`~tpu_parallel.models.gpt.EncoderClassifier`'s tanh pooler
+    (``None`` when the checkpoint has no pooler — e.g. a full
+    ``BertForMaskedLM`` state dict, whose ``bert.`` prefix is stripped and
+    which carries embeddings + encoder but no pooler).
+
+    ``config`` must be the BERT-faithful variant: ``prenorm=False`` (post-
+    norm residuals), ``embed_norm=True`` (embeddings.LayerNorm),
+    ``mlp="gelu_exact"`` (erf gelu), ``bidirectional=True``, learned
+    positions, no GQA.  Reference checkpoint structure:
+    ``encoder.layer.{i}.attention.self.{query,key,value}`` /
+    ``attention.output.{dense,LayerNorm}`` / ``intermediate.dense`` /
+    ``output.{dense,LayerNorm}``.
+
+    Token-type (segment) embeddings: row 0 is folded into the position
+    table — exact for single-segment inputs (``token_type_ids == 0``,
+    the universal fine-tuning case); two-segment NSP-style inputs are not
+    representable and the fold is documented rather than silent: pass
+    ``token_type_ids`` of zeros on the HF side when comparing.
+
+    The MLM prediction head (dense+gelu+LN+decoder) is NOT imported —
+    ``lm_head`` is initialized to the TIED word embedding (the decoder's
+    weight without its transform), which suits fine-tuning;
+    :class:`EncoderClassifier` ignores it entirely.
+    """
+    if config.prenorm or not config.embed_norm:
+        raise ValueError(
+            "BERT interop needs the post-norm variant: prenorm=False, "
+            "embed_norm=True (see bert_base_hf)"
+        )
+    if (
+        config.positional != "learned"
+        or config.mlp != "gelu_exact"
+        or config.norm != "layernorm"
+        or not config.bidirectional
+    ):
+        raise ValueError(
+            "BERT interop needs positional='learned', mlp='gelu_exact', "
+            "norm='layernorm', bidirectional=True"
+        )
+    if (config.n_kv_heads or config.n_heads) != config.n_heads:
+        raise ValueError("BERT has no GQA: n_kv_heads must be None/n_heads")
+    if config.scan_layers:
+        raise ValueError(
+            "from_hf_bert emits the unrolled layout; build the config with "
+            "scan_layers=False"
+        )
+    sd = {}
+    for k, v in _state_dict(hf_model_or_dict).items():
+        sd[k.removeprefix("bert.")] = v
+    hf_config = getattr(hf_model_or_dict, "config", None)
+    if hf_config is not None and getattr(
+        hf_config, "num_attention_heads", config.n_heads
+    ) != config.n_heads:
+        raise ValueError(
+            f"checkpoint has num_attention_heads="
+            f"{hf_config.num_attention_heads}, config.n_heads={config.n_heads}"
+        )
+    if hf_config is not None:
+        hf_eps = getattr(hf_config, "layer_norm_eps", None)
+        if hf_eps is not None and abs(hf_eps - config.norm_eps) > 1e-15:
+            # not derivable from any tensor — a mismatch (BERT's 1e-12 vs
+            # the family default 1e-5) silently drifts every LayerNorm
+            raise ValueError(
+                f"checkpoint layer_norm_eps={hf_eps}, config.norm_eps="
+                f"{config.norm_eps} (bert_base_hf sets 1e-12)"
+            )
+    ckpt_layers = 1 + max(
+        int(k.split(".")[2]) for k in sd if k.startswith("encoder.layer.")
+    )
+    if ckpt_layers != config.n_layers:
+        raise ValueError(
+            f"checkpoint has {ckpt_layers} layers, config.n_layers="
+            f"{config.n_layers} — refusing to silently truncate/underfill"
+        )
+    wte = sd["embeddings.word_embeddings.weight"]
+    if wte.shape != (config.vocab_size, config.d_model):
+        raise ValueError(
+            f"word_embeddings {wte.shape} != (vocab={config.vocab_size}, "
+            f"d={config.d_model})"
+        )
+    wpe = sd["embeddings.position_embeddings.weight"]
+    if wpe.shape[0] < config.seq_len:
+        raise ValueError(
+            f"checkpoint position table covers {wpe.shape[0]} positions < "
+            f"config.seq_len={config.seq_len}"
+        )
+    cast = lambda x: jnp.asarray(x, dtype)
+    # fold token-type-0 into the position table (see docstring)
+    tt0 = sd["embeddings.token_type_embeddings.weight"][0]
+    params: Dict[str, Any] = {
+        "embed": {
+            "tok": {"embedding": cast(wte)},
+            "pos": {"embedding": cast(wpe[: config.seq_len] + tt0[None, :])},
+            "norm": {
+                "scale": cast(sd["embeddings.LayerNorm.weight"]),
+                "bias": cast(sd["embeddings.LayerNorm.bias"]),
+            },
+        },
+        # tied word embedding as a serviceable lm_head (see docstring)
+        "lm_head": {"shard": {"kernel": cast(wte.T)}},
+        "blocks": {},
+    }
+    h = config.n_heads
+    for i in range(config.n_layers):
+        p = f"encoder.layer.{i}"
+        # torch Linear stores [out, in]; our kernels are [in, out].  Fuse
+        # q|k|v blocks then regroup per-head like the GPT-2 path.
+        qkv_w = np.concatenate(
+            [
+                sd[f"{p}.attention.self.{n}.weight"].T
+                for n in ("query", "key", "value")
+            ],
+            axis=1,
+        )
+        qkv_b = np.concatenate(
+            [
+                sd[f"{p}.attention.self.{n}.bias"]
+                for n in ("query", "key", "value")
+            ]
+        )
+        params["blocks"][f"layer_{i}"] = {
+            # post-norm: norm_attn/norm_mlp normalize the residual SUMS —
+            # HF's attention.output.LayerNorm / output.LayerNorm
+            "norm_attn": {
+                "scale": cast(sd[f"{p}.attention.output.LayerNorm.weight"]),
+                "bias": cast(sd[f"{p}.attention.output.LayerNorm.bias"]),
+            },
+            "norm_mlp": {
+                "scale": cast(sd[f"{p}.output.LayerNorm.weight"]),
+                "bias": cast(sd[f"{p}.output.LayerNorm.bias"]),
+            },
+            "attn": {
+                "qkv": {
+                    "shard": {
+                        "kernel": cast(_qkv_to_ours(qkv_w, h)),
+                        "bias": cast(_qkv_to_ours(qkv_b, h)),
+                    }
+                },
+                "out": {
+                    "shard": {
+                        "kernel": cast(sd[f"{p}.attention.output.dense.weight"].T)
+                    },
+                    "bias": cast(sd[f"{p}.attention.output.dense.bias"]),
+                },
+            },
+            "mlp": {
+                "up": {
+                    "shard": {
+                        "kernel": cast(sd[f"{p}.intermediate.dense.weight"].T),
+                        "bias": cast(sd[f"{p}.intermediate.dense.bias"]),
+                    }
+                },
+                "down": {
+                    "shard": {"kernel": cast(sd[f"{p}.output.dense.weight"].T)},
+                    "bias": cast(sd[f"{p}.output.dense.bias"]),
+                },
+            },
+        }
+    pooler = None
+    if "pooler.dense.weight" in sd:
+        pooler = {
+            "kernel": cast(sd["pooler.dense.weight"].T),
+            "bias": cast(sd["pooler.dense.bias"]),
+        }
+    return params, pooler

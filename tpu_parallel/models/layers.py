@@ -51,10 +51,21 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     # norm: "layernorm" (GPT-2) or "rmsnorm" (Llama)
     norm: str = "layernorm"
+    # norm placement: True = pre-norm (GPT/Llama/T5: x + f(norm(x)), final
+    # norm after the stack); False = post-norm (original BERT:
+    # norm(x + f(x)), embedding-sum norm instead of a final norm — set
+    # embed_norm=True to match).  Post-norm exists for checkpoint interop
+    # (models/hf.py BERT import); pre-norm remains the default for
+    # from-scratch training (stabler at depth).
+    prenorm: bool = True
+    # LayerNorm over the embedding sum (token + positional) before the
+    # stack — the BERT embeddings.LayerNorm
+    embed_norm: bool = False
     # canonical GPT-2/Llama epsilon (flax's default is 1e-6; 1e-5 matches
     # the reference implementations bit-for-bit — models/hf.py interop)
     norm_eps: float = 1e-5
-    # mlp: "gelu" (GPT-2) or "swiglu" (Llama)
+    # mlp: "gelu" (GPT-2's tanh approximation), "gelu_exact" (BERT's erf
+    # form — interop-exact against torch), or "swiglu" (Llama)
     mlp: str = "gelu"
     # parallelism
     model_axis: str = "model"
@@ -603,7 +614,9 @@ class MLP(nn.Module):
                 features=hidden, axis_name=cfg.model_axis, style="column",
                 dtype=cfg.dtype, name="up",
             )(x)
-            h = nn.gelu(checkpoint_name(h, "proj"))
+            h = nn.gelu(
+                checkpoint_name(h, "proj"), approximate=cfg.mlp != "gelu_exact"
+            )
         y = TPDense(
             features=cfg.d_model, axis_name=cfg.model_axis, style="row",
             dtype=cfg.dtype, use_bias=cfg.mlp != "swiglu", name="down",
@@ -639,22 +652,34 @@ class Block(nn.Module):
                 "incremental decoding with expert-choice routing "
                 "(the routing pool collapses to one token per row)"
             )
-        h = make_norm(cfg, "norm_attn")(x).astype(cfg.dtype)
-        x = x + Attention(cfg, name="attn")(
-            h,
+        attn = Attention(cfg, name="attn")
+        mlp_fn = (
+            lambda h: MLP(cfg, name="mlp")(h, train=train)
+        )
+        if cfg.moe_experts > 0:
+            from tpu_parallel.models.moe import MoEMLP
+
+            mlp_fn = lambda h: MoEMLP(cfg, name="moe")(
+                h, train=train, aux_scale=aux_scale
+            )
+        attn_kwargs = dict(
             positions=positions,
             segment_ids=segment_ids,
             train=train,
             decode=decode,
             cache_valid=cache_valid,
         )
-        h = make_norm(cfg, "norm_mlp")(x).astype(cfg.dtype)
-        if cfg.moe_experts > 0:
-            from tpu_parallel.models.moe import MoEMLP
-
-            x = x + MoEMLP(cfg, name="moe")(h, train=train, aux_scale=aux_scale)
+        if cfg.prenorm:
+            h = make_norm(cfg, "norm_attn")(x).astype(cfg.dtype)
+            x = x + attn(h, **attn_kwargs)
+            h = make_norm(cfg, "norm_mlp")(x).astype(cfg.dtype)
+            x = x + mlp_fn(h)
         else:
-            x = x + MLP(cfg, name="mlp")(h, train=train)
+            # post-norm (original BERT): normalize the residual SUM
+            x = make_norm(cfg, "norm_attn")(x + attn(x, **attn_kwargs)).astype(
+                cfg.dtype
+            )
+            x = make_norm(cfg, "norm_mlp")(x + mlp_fn(x)).astype(cfg.dtype)
         return x
 
 
@@ -815,4 +840,7 @@ class Embedding(nn.Module):
                 name="pos",
             )(positions)
             emb = emb + pos_emb
+        if cfg.embed_norm:
+            # BERT's embeddings.LayerNorm over the summed embedding
+            emb = make_norm(cfg, "norm")(emb).astype(cfg.dtype)
         return emb

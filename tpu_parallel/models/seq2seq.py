@@ -373,6 +373,14 @@ class EncoderDecoder(nn.Module):
             )
         if cfg.moe_experts > 0:
             raise NotImplementedError("MoE blocks in the seq2seq stacks")
+        if not cfg.prenorm or cfg.embed_norm:
+            # Block honors prenorm but DecoderBlock and the enc/dec final
+            # norms are pre-norm-shaped — a half-applied knob would build a
+            # chimera silently
+            raise NotImplementedError(
+                "post-norm / embed-norm variants in the seq2seq stacks "
+                "(BERT-interop knobs; the seq2seq family is pre-norm)"
+            )
         # encoder sees bidirectional attention; decoder causal.  Positions
         # are bounded by the LONGER of the two lengths so the shared learned
         # table covers both sides.
