@@ -305,36 +305,52 @@ class _HashableTree:
         )
 
 
-@functools.lru_cache(maxsize=32)
-def _sharded_generate_fn(
-    model, mesh, specs: _HashableTree, batch_spec, max_new_tokens, temperature,
-    top_k, top_p=0.0,
-):
+def build_sharded_serving(model, mesh, param_specs, batch_specs, out_spec, core):
+    """The one shard_map serving harness, shared by every family.
+
+    ``core(model, params, *batch_args, rng)`` is the traceable decode body
+    (:func:`_generate_core`, seq2seq's ``_seq2seq_core``, ...).  The harness
+    contributes the invariants both paths must share: sampling RNG folds
+    over the DATA axis only (TP ranks must draw the same sample), and
+    ``check_vma=False`` — sampled tokens are replicated over the model and
+    pipe axes by construction (every TP rank's decision flows through the
+    vocab-parallel collectives in :func:`_sample_sharded` — or an
+    identical-rng gathered sample on the top_p path; the decode ring
+    psum-broadcasts over pipe), which the checker cannot prove.
+    """
     from jax.sharding import PartitionSpec as P
 
     from tpu_parallel.core.rng import fold_rng_over_axis
 
-    param_specs = specs.tree()
-
-    def body(params, prompt, rng):
+    def body(params, *args):
+        *batch_args, rng = args
         rng = fold_rng_over_axis(rng, (model.config.data_axis,))
-        return _generate_core(
-            model, params, prompt, rng, max_new_tokens, temperature, top_k, top_p
-        )
+        return core(model, params, *batch_args, rng)
 
     return jax.jit(
         jax.shard_map(
             body,
             mesh=mesh,
-            in_specs=(param_specs, batch_spec, P()),
-            out_specs=batch_spec,
-            # sampled tokens are replicated over the model and pipe axes by
-            # construction (every TP rank's sampling decision flows through
-            # the vocab-parallel collectives in _sample_sharded — or an
-            # identical-rng gathered sample on the top_p path; the decode
-            # ring psum-broadcasts over pipe); the checker cannot prove it
+            in_specs=(param_specs, *batch_specs, P()),
+            out_specs=out_spec,
             check_vma=False,
         )
+    )
+
+
+@functools.lru_cache(maxsize=32)
+def _sharded_generate_fn(
+    model, mesh, specs: _HashableTree, batch_spec, max_new_tokens, temperature,
+    top_k, top_p=0.0,
+):
+    def core(model_, params, prompt, rng):
+        return _generate_core(
+            model_, params, prompt, rng, max_new_tokens, temperature, top_k,
+            top_p,
+        )
+
+    return build_sharded_serving(
+        model, mesh, specs.tree(), (batch_spec,), batch_spec, core
     )
 
 

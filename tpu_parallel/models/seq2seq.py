@@ -519,31 +519,18 @@ def seq2seq_generate(
     max_new_tokens: int = 32,
     temperature: float = 0.0,
     top_k: int = 0,
+    top_p: float = 0.0,
 ) -> jax.Array:
     """Encode once, then KV-cached autoregressive decoding.
 
     Returns [B, max_new_tokens].  Greedy at ``temperature == 0``; the
     sampling filters are shared with the LM path
     (:func:`~tpu_parallel.models.generate._sample`).  Single-device params
-    layout (the seq2seq family has no mesh-sharded serving path yet — train
-    on a mesh, then ``export_single_device_params``).
+    layout — for mesh-sharded states use :func:`seq2seq_generate_sharded`
+    (or ``export_single_device_params`` for DP/FSDP-only meshes).
     """
-    cfg = model.config
     if rng is None:
         rng = jax.random.PRNGKey(0)
-    if max_new_tokens > cfg.seq_len:
-        raise ValueError(
-            f"max_new_tokens ({max_new_tokens}) exceeds decoder seq_len "
-            f"({cfg.seq_len})"
-        )
-    if src.shape[1] > cfg.source_len:
-        # nn.Embed clamps out-of-range position indices under jit, so an
-        # oversized source would silently reuse the last learned position
-        # embedding instead of failing
-        raise ValueError(
-            f"source length ({src.shape[1]}) exceeds the encoder's "
-            f"source_len ({cfg.source_len})"
-        )
     return _seq2seq_generate_jit(
         model,
         params,
@@ -554,13 +541,14 @@ def seq2seq_generate(
         max_new_tokens=max_new_tokens,
         temperature=temperature,
         top_k=top_k,
+        top_p=top_p,
     )
 
 
 @functools.partial(
     jax.jit,
     static_argnums=(0,),
-    static_argnames=("bos_id", "max_new_tokens", "temperature", "top_k"),
+    static_argnames=("bos_id", "max_new_tokens", "temperature", "top_k", "top_p"),
 )
 def _seq2seq_generate_jit(
     model: EncoderDecoder,
@@ -573,12 +561,52 @@ def _seq2seq_generate_jit(
     max_new_tokens: int,
     temperature: float,
     top_k: int,
+    top_p: float = 0.0,
 ):
     """Module-level jitted core: a serving loop pays trace + compile once per
     (model, shapes, knobs), not per call."""
-    from tpu_parallel.models.generate import _sample
+    return _seq2seq_core(
+        model, params, src, src_mask, rng,
+        bos_id=bos_id, max_new_tokens=max_new_tokens,
+        temperature=temperature, top_k=top_k, top_p=top_p,
+    )
+
+
+def _seq2seq_core(
+    model: EncoderDecoder,
+    params,
+    src,
+    src_mask,
+    rng,
+    *,
+    bos_id: int,
+    max_new_tokens: int,
+    temperature: float,
+    top_k: int,
+    top_p: float = 0.0,
+):
+    """Traceable encode + prefill + decode scan, shared by the jit path and
+    the shard_map path (:func:`seq2seq_generate_sharded`).  Under a bound
+    model axis the lm_head logits stay vocab-sharded and sampling runs
+    vocab-parallel (every TP rank emits the same token).
+
+    The length guards live HERE (trace time, static shapes) so BOTH entry
+    points enforce them: nn.Embed clamps out-of-range position indices
+    under jit and dynamic_update_slice clamps cache overflow — either
+    would silently corrupt generations instead of failing."""
+    from tpu_parallel.models.generate import _sample, _sample_sharded
 
     cfg = model.config
+    if max_new_tokens > cfg.seq_len:
+        raise ValueError(
+            f"max_new_tokens ({max_new_tokens}) exceeds decoder seq_len "
+            f"({cfg.seq_len})"
+        )
+    if src.shape[1] > cfg.source_len:
+        raise ValueError(
+            f"source length ({src.shape[1]}) exceeds the encoder's "
+            f"source_len ({cfg.source_len})"
+        )
     b = src.shape[0]
     memory = model.apply(
         {"params": params}, src, src_mask, False, method=model.encode
@@ -588,7 +616,11 @@ def _seq2seq_generate_jit(
 
     def next_token(h, rng):
         logits = head.apply({"params": lm_params}, h[:, -1:])[:, 0]
-        return _sample(logits, rng, temperature, top_k)
+        if axis_size_or_none(cfg.model_axis) is not None:
+            return _sample_sharded(
+                logits, rng, temperature, top_k, top_p, cfg.model_axis
+            )
+        return _sample(logits, rng, temperature, top_k, top_p)
 
     # prefill: BOS through the decoder populates self- and cross-caches
     bos = jnp.full((b, 1), bos_id, jnp.int32)
@@ -628,6 +660,75 @@ def _seq2seq_generate_jit(
     init = (variables["cache"], first, rng)
     (_, last, _), toks = lax.scan(step, init, None, length=max_new_tokens - 1)
     return jnp.concatenate([toks.T, last[:, None]], axis=1)
+
+
+def seq2seq_generate_sharded(
+    model: EncoderDecoder,
+    params,
+    src: jax.Array,
+    mesh,
+    src_mask: Optional[jax.Array] = None,
+    rng: Optional[jax.Array] = None,
+    *,
+    bos_id: int = 0,
+    max_new_tokens: int = 32,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 0.0,
+    param_specs=None,
+    batch_spec=None,
+) -> jax.Array:
+    """Serve a mesh-trained seq2seq state under its own mesh.
+
+    Same contract as :func:`~tpu_parallel.models.generate.generate_sharded`:
+    TP-split weights stay split (the KV and cross-memory caches shard over
+    heads exactly as activations), each data shard decodes its rows, and
+    sampling under TP runs vocab-parallel so every model rank emits the
+    same token.  Sampling RNG folds over the data axis only.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_parallel.models.generate import _HashableTree
+
+    if param_specs is None:
+        param_specs = nn.get_partition_spec(params)
+    if batch_spec is None:
+        batch_spec = P(model.config.data_axis)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    if src_mask is None:
+        src_mask = jnp.ones(src.shape, jnp.bool_)
+    fn = _sharded_seq2seq_fn(
+        model,
+        mesh,
+        _HashableTree.of(param_specs),
+        batch_spec,
+        bos_id,
+        max_new_tokens,
+        temperature,
+        top_k,
+        top_p,
+    )
+    return fn(params, src, src_mask, rng)
+
+
+@functools.lru_cache(maxsize=32)
+def _sharded_seq2seq_fn(
+    model, mesh, specs, batch_spec, bos_id, max_new_tokens, temperature, top_k,
+    top_p=0.0,
+):
+    from tpu_parallel.models.generate import build_sharded_serving
+
+    def core(model_, params, src, src_mask, rng):
+        return _seq2seq_core(
+            model_, params, src, src_mask, rng,
+            bos_id=bos_id, max_new_tokens=max_new_tokens,
+            temperature=temperature, top_k=top_k, top_p=top_p,
+        )
+
+    return build_sharded_serving(
+        model, mesh, specs.tree(), (batch_spec, batch_spec), batch_spec, core
+    )
 
 
 def t5_small(**overrides) -> Seq2SeqConfig:
