@@ -319,6 +319,24 @@ def test_sharded_generate_tp_mesh(mesh_data4_model2):
     np.testing.assert_array_equal(np.asarray(toks), np.asarray(ref))
 
 
+def test_loss_runs_without_mesh():
+    """The loss (like the model) degrades gracefully to plain jit: axis
+    folds skip unbound axes instead of dying in axis_index — single-chip
+    training needs no ceremonial 1-device mesh."""
+    cfg = tiny_seq2seq()
+    model = EncoderDecoder(cfg)
+    batch = _s2s_batch(jax.random.PRNGKey(0), 4, cfg)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0)}, batch.src_tokens, batch.tokens,
+        train=False,
+    )
+    loss_fn = make_seq2seq_loss(cfg)
+    loss, metrics = jax.jit(
+        lambda p, b: loss_fn(p, model.apply, b, jax.random.PRNGKey(1))
+    )(variables["params"], batch)
+    assert np.isfinite(float(loss))
+
+
 def test_eval_forward_needs_no_dropout_rng():
     """train=False must deactivate every dropout (incl. cross-attention's):
     a bare apply without a 'dropout' rng is the eval contract."""

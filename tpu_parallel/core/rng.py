@@ -19,11 +19,20 @@ def fold_rng_over_axis(rng: jax.Array, axis_names: Union[str, Sequence[str]]) ->
     Use for anything that must differ per device (dropout on different data
     shards, per-stage init).  Leave the key unfolded for anything that must be
     identical across an axis (replicated init).
+
+    Unbound axes are skipped — the same degrade-gracefully contract as the
+    structural-TP layers: a loss/model built for a mesh runs under plain
+    ``jit`` (single device, no shard_map) with every fold a no-op, instead
+    of dying in ``axis_index``.
     """
     if isinstance(axis_names, str):
         axis_names = (axis_names,)
     for name in axis_names:
-        rng = jax.random.fold_in(rng, lax.axis_index(name))
+        try:
+            idx = lax.axis_index(name)
+        except NameError:
+            continue
+        rng = jax.random.fold_in(rng, idx)
     return rng
 
 
