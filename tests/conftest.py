@@ -153,6 +153,7 @@ _SLOW_TESTS = {
     "test_quantized_model_generates_close",
     "test_from_hf_logits_match",
     "test_from_hf_llama_logits_match",
+    "test_from_hf_t5_logits_match",
     "test_optimizer_families_train",
     "test_window_decode_matches_train_forward",
     "test_roundtrip_exact",

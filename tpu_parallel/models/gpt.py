@@ -37,6 +37,7 @@ from tpu_parallel.models.layers import (
     Block,
     BlockStack,
     Embedding,
+    RelativePositionBias,
     TransformerConfig,
     make_norm,
 )
@@ -147,6 +148,29 @@ class GPTLM(nn.Module):
             tokens, positions=positions
         )
 
+        attn_bias = None
+        if cfg.positional == "relative":
+            # T5-style bucketed score bias, ONE table shared by every layer
+            # (hence computed here, above the stack) — xla attention path
+            # only; PP would need the bias as a pipeline extra and packing
+            # per-row position tables, neither wired yet
+            if cfg.pipe_size > 1:
+                raise NotImplementedError(
+                    "relative position bias under pipeline parallelism"
+                )
+            if cfg.attn_impl != "xla":
+                raise NotImplementedError(
+                    "relative position bias needs attn_impl='xla' (the "
+                    "flash/ring/ulysses kernels take no additive score bias)"
+                )
+            if segment_ids is not None:
+                raise NotImplementedError(
+                    "relative position bias with packed sequences"
+                )
+            attn_bias = RelativePositionBias(
+                cfg, bidirectional=cfg.bidirectional, name="rel_bias"
+            ).for_step(positions, tokens.shape[1], cfg.seq_len, decode)
+
         if cfg.pipe_interleave > 1 and cfg.pipe_size <= 1:
             raise ValueError(
                 "pipe_interleave > 1 requires pipe_size > 1 (a pipe mesh "
@@ -214,6 +238,7 @@ class GPTLM(nn.Module):
                 segment_ids=segment_ids,
                 train=train,
                 decode=decode,
+                attn_bias=attn_bias,
             )
 
         if cfg.prenorm:

@@ -46,9 +46,15 @@ class TransformerConfig:
     mlp_ratio: int = 4
     dropout_rate: float = 0.0
     dtype: Any = jnp.bfloat16
-    # positional encoding: "learned" (GPT-2) or "rope" (Llama)
+    # positional encoding: "learned" (GPT-2), "rope" (Llama), or "relative"
+    # (T5: no embedding-level positions — a bucketed per-head bias added to
+    # the attention scores, shared across the stack's layers; xla attention
+    # path only)
     positional: str = "learned"
     rope_theta: float = 10000.0
+    # T5 relative-bias shape knobs (used when positional="relative")
+    rel_num_buckets: int = 32
+    rel_max_distance: int = 128
     # norm: "layernorm" (GPT-2) or "rmsnorm" (Llama)
     norm: str = "layernorm"
     # norm placement: True = pre-norm (GPT/Llama/T5: x + f(norm(x)), final
@@ -65,8 +71,12 @@ class TransformerConfig:
     # the reference implementations bit-for-bit — models/hf.py interop)
     norm_eps: float = 1e-5
     # mlp: "gelu" (GPT-2's tanh approximation), "gelu_exact" (BERT's erf
-    # form — interop-exact against torch), or "swiglu" (Llama)
+    # form — interop-exact against torch), "relu" (original T5), "swiglu"
+    # (Llama), or "geglu" (T5 v1.1: gelu-gated, two up projections)
     mlp: str = "gelu"
+    # biases on the attention/MLP projections (False for Llama-style and T5
+    # checkpoints, True for GPT-2/BERT)
+    dense_bias: bool = True
     # parallelism
     model_axis: str = "model"
     data_axis: str = "data"
@@ -184,6 +194,7 @@ def causal_attention(
     segment_ids: Optional[jax.Array] = None,
     window: int = 0,
     causal: bool = True,
+    bias: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Reference attention: fp32 softmax, bf16 matmuls on the MXU.
 
@@ -197,6 +208,9 @@ def causal_attention(
     scale = 1.0 / jnp.sqrt(head_dim).astype(q.dtype)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k)
     scores = scores.astype(jnp.float32)
+    if bias is not None:
+        # additive position bias [1|B, h, q, k] (T5 relative bias)
+        scores = scores + bias.astype(jnp.float32)
     q_pos = lax.broadcasted_iota(jnp.int32, scores.shape, 2)
     k_pos = lax.broadcasted_iota(jnp.int32, scores.shape, 3)
     mask = q_pos >= k_pos if causal else None
@@ -218,7 +232,7 @@ def causal_attention(
 
 def decode_attention(
     q: jax.Array, k_all: jax.Array, v_all: jax.Array, positions: jax.Array,
-    window: int = 0,
+    window: int = 0, bias: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Attention of new queries against a full KV cache, GQA-native.
 
@@ -235,6 +249,10 @@ def decode_attention(
     scale = 1.0 / jnp.sqrt(head_dim).astype(q.dtype)
     qg = (q * scale).reshape(b, nq, h_kv, group, head_dim)
     scores = jnp.einsum("bqngd,bknd->bngqk", qg, k_all).astype(jnp.float32)
+    if bias is not None:
+        # [1|B, h, q, k] -> grouped [1|B, h_kv, group, q, k]
+        bb = bias.reshape(bias.shape[0], h_kv, group, *bias.shape[2:])
+        scores = scores + bb.astype(jnp.float32)
     k_pos = jnp.arange(k_all.shape[1])
     mask = k_pos[None, None, None, None, :] <= positions[:, None, None, :, None]
     if window:
@@ -248,6 +266,83 @@ def decode_attention(
     out = jnp.einsum("bngqk,bknd->bqngd", probs, v_all)
     return out.reshape(b, nq, h, head_dim)
 
+
+
+def t5_relative_bucket(
+    relative_position: jax.Array,
+    bidirectional: bool,
+    num_buckets: int,
+    max_distance: int,
+) -> jax.Array:
+    """T5's relative-position bucketing (log-spaced beyond ``max_exact``).
+
+    ``relative_position`` is ``k_pos - q_pos``.  Bidirectional stacks split
+    the buckets between past and future; causal stacks bucket only the past
+    (future positions land in bucket 0 and are masked out by the causal
+    mask anyway).  Mirrors ``_relative_position_bucket`` in the canonical
+    implementation so imported tables index identically.
+    """
+    rp = relative_position
+    bucket = jnp.zeros_like(rp)
+    if bidirectional:
+        num_buckets = num_buckets // 2
+        bucket = bucket + (rp > 0).astype(jnp.int32) * num_buckets
+        rp = jnp.abs(rp)
+    else:
+        rp = -jnp.minimum(rp, 0)
+    max_exact = num_buckets // 2
+    is_small = rp < max_exact
+    scaled = max_exact + (
+        jnp.log(jnp.maximum(rp, 1).astype(jnp.float32) / max_exact)
+        / jnp.log(max_distance / max_exact)
+        * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    scaled = jnp.minimum(scaled, num_buckets - 1)
+    return bucket + jnp.where(is_small, rp, scaled)
+
+
+class RelativePositionBias(nn.Module):
+    """T5-style bucketed per-head position bias, shared across a stack.
+
+    ``(q_positions [Q], k_positions [K]) -> bias [1, n_heads, Q, K]``
+    (fp32).  The bucket table is a tiny replicated param
+    ``[num_buckets, n_heads]``; under TP the caller's Attention slices its
+    local heads off the full-width bias.
+    """
+
+    config: TransformerConfig
+    bidirectional: bool
+
+    @nn.compact
+    def __call__(self, q_positions: jax.Array, k_positions: jax.Array):
+        cfg = self.config
+        rel = k_positions[None, :] - q_positions[:, None]  # [Q, K]
+        bucket = t5_relative_bucket(
+            rel, self.bidirectional, cfg.rel_num_buckets, cfg.rel_max_distance
+        )
+        table = self.param(
+            "rel_embedding",
+            nn.initializers.normal(stddev=1.0),
+            (cfg.rel_num_buckets, cfg.n_heads),
+        )
+        bias = jnp.asarray(table, jnp.float32)[bucket]  # [Q, K, H]
+        return bias.transpose(2, 0, 1)[None]  # [1, H, Q, K]
+
+    def for_step(
+        self,
+        positions: Optional[jax.Array],
+        q_len: int,
+        cache_len: int,
+        decode: bool,
+    ) -> jax.Array:
+        """The positions-to-bias recipe shared by GPTLM and the seq2seq
+        decoder: queries at ``positions`` (row 0 — every current caller
+        broadcasts uniform positions; packed/ragged rows are refused
+        upstream) against themselves (training) or every cache slot
+        (``decode``)."""
+        q_pos = positions[0] if positions is not None else jnp.arange(q_len)
+        k_pos = jnp.arange(cache_len) if decode else q_pos
+        return self(q_pos, k_pos)
 
 
 def bidirectional_flash_attention(q, k, v, segment_ids=None, *, block_q,
@@ -292,9 +387,16 @@ class Attention(nn.Module):
         train: bool = True,
         decode: bool = False,
         cache_valid: Optional[jax.Array] = None,
+        attn_bias: Optional[jax.Array] = None,
     ) -> jax.Array:
         cfg = self.config
         tp_size = axis_size_or_none(cfg.model_axis) or 1
+        if attn_bias is not None and tp_size > 1:
+            # the model-level bias covers all heads; keep this rank's slice
+            lh = attn_bias.shape[1] // tp_size
+            attn_bias = lax.dynamic_slice_in_dim(
+                attn_bias, lax.axis_index(cfg.model_axis) * lh, lh, axis=1
+            )
         n_kv = cfg.n_kv_heads or cfg.n_heads
         if cfg.n_heads % tp_size != 0:
             raise ValueError(f"n_heads={cfg.n_heads} not divisible by tp={tp_size}")
@@ -316,6 +418,7 @@ class Attention(nn.Module):
                 features=3 * cfg.d_model,
                 axis_name=cfg.model_axis,
                 style="column",
+                use_bias=cfg.dense_bias,
                 dtype=cfg.dtype,
                 name="qkv",
             )(x)
@@ -328,6 +431,7 @@ class Attention(nn.Module):
                 features=cfg.n_heads * cfg.head_dim,
                 axis_name=cfg.model_axis,
                 style="column",
+                use_bias=cfg.dense_bias,
                 dtype=cfg.dtype,
                 name="q",
             )(x)
@@ -338,6 +442,7 @@ class Attention(nn.Module):
                 features=2 * n_kv * cfg.head_dim,
                 axis_name=cfg.model_axis,
                 style="column",
+                use_bias=cfg.dense_bias,
                 dtype=cfg.dtype,
                 name="kv",
             )(x)
@@ -453,9 +558,12 @@ class Attention(nn.Module):
             cache_index.value = keep(idx + x.shape[1], idx)
             # decode_attention contracts grouped queries against the
             # kv-width cache directly — no K/V expansion
-            out = decode_attention(q, k_all, v_all, positions, window=cfg.attn_window)
+            out = decode_attention(
+                q, k_all, v_all, positions, window=cfg.attn_window,
+                bias=attn_bias,
+            )
         else:
-            out = self._attend(q, k, v, segment_ids)
+            out = self._attend(q, k, v, segment_ids, attn_bias)
         if cfg.attn_impl != "flash":
             # let the "proj_attn" remat policy keep the attention context so
             # the backward never recomputes it — an O(seq) residual.  The
@@ -468,6 +576,7 @@ class Attention(nn.Module):
             features=cfg.d_model,
             axis_name=cfg.model_axis,
             style="row",
+            use_bias=cfg.dense_bias,
             dtype=cfg.dtype,
             name="out",
         )(out)
@@ -476,8 +585,15 @@ class Attention(nn.Module):
             out = nn.Dropout(rate=cfg.dropout_rate, deterministic=not train)(out)
         return out
 
-    def _attend(self, q, k, v, segment_ids):
+    def _attend(self, q, k, v, segment_ids, attn_bias=None):
         cfg = self.config
+        if attn_bias is not None and cfg.attn_impl != "xla":
+            # the Pallas/ring/ulysses kernels take no additive score bias;
+            # T5-style models must run the xla attention path
+            raise NotImplementedError(
+                f"attention score bias (positional='relative') under "
+                f"attn_impl={cfg.attn_impl!r} — use attn_impl='xla'"
+            )
         group = q.shape[-2] // k.shape[-2]
         native_group = (
             cfg.attn_impl in ("flash", "ring", "ulysses")
@@ -584,13 +700,20 @@ class Attention(nn.Module):
             else:
                 attn_fn = functools.partial(
                     causal_attention, window=cfg.attn_window,
-                    causal=not cfg.bidirectional,
+                    causal=not cfg.bidirectional, bias=attn_bias,
                 )
         return attn_fn(q, k, v, segment_ids=segment_ids)
 
 
 class MLP(nn.Module):
-    """Transformer MLP: column-up / row-down (Megatron pair); gelu or SwiGLU."""
+    """Transformer MLP: column-up / row-down (Megatron pair).
+
+    Activations: gelu (GPT-2 tanh form), gelu_exact (BERT erf form), relu
+    (original T5), swiglu (Llama, silu-gated), geglu (T5 v1.1 — gated by
+    the TANH-approximate gelu, what HF's "gated-gelu" resolves to).  Gated
+    variants use two column projections (gate/up), bias-free (no gated
+    checkpoint family carries them).
+    """
 
     config: TransformerConfig
 
@@ -598,8 +721,8 @@ class MLP(nn.Module):
     def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
         cfg = self.config
         hidden = cfg.mlp_ratio * cfg.d_model
-        if cfg.mlp == "swiglu":
-            # Llama-style: two column projections, silu-gated, row back down.
+        gated = cfg.mlp in ("swiglu", "geglu")
+        if gated:
             gate = TPDense(
                 features=hidden, axis_name=cfg.model_axis, style="column",
                 dtype=cfg.dtype, use_bias=False, name="gate",
@@ -608,18 +731,27 @@ class MLP(nn.Module):
                 features=hidden, axis_name=cfg.model_axis, style="column",
                 dtype=cfg.dtype, use_bias=False, name="up",
             )(x)
-            h = nn.silu(checkpoint_name(gate, "proj")) * checkpoint_name(up, "proj")
+            # geglu's gate is gelu_new (the tanh approximation) — what T5
+            # v1.1's "gated-gelu" resolves to in the canonical implementation
+            act = (
+                nn.silu
+                if cfg.mlp == "swiglu"
+                else functools.partial(nn.gelu, approximate=True)
+            )
+            h = act(checkpoint_name(gate, "proj")) * checkpoint_name(up, "proj")
         else:
             h = TPDense(
                 features=hidden, axis_name=cfg.model_axis, style="column",
-                dtype=cfg.dtype, name="up",
+                use_bias=cfg.dense_bias, dtype=cfg.dtype, name="up",
             )(x)
-            h = nn.gelu(
-                checkpoint_name(h, "proj"), approximate=cfg.mlp != "gelu_exact"
-            )
+            h = checkpoint_name(h, "proj")
+            if cfg.mlp == "relu":
+                h = nn.relu(h)
+            else:
+                h = nn.gelu(h, approximate=cfg.mlp != "gelu_exact")
         y = TPDense(
             features=cfg.d_model, axis_name=cfg.model_axis, style="row",
-            dtype=cfg.dtype, use_bias=cfg.mlp != "swiglu", name="down",
+            dtype=cfg.dtype, use_bias=not gated and cfg.dense_bias, name="down",
         )(h)
         y = checkpoint_name(y, "proj")
         if cfg.dropout_rate > 0.0:
@@ -642,6 +774,7 @@ class Block(nn.Module):
         decode: bool = False,
         aux_scale: Optional[jax.Array] = None,
         cache_valid: Optional[jax.Array] = None,
+        attn_bias: Optional[jax.Array] = None,
     ) -> jax.Array:
         cfg = self.config
         if decode and cfg.moe_experts > 0 and cfg.moe_router == "expert_choice":
@@ -668,6 +801,7 @@ class Block(nn.Module):
             train=train,
             decode=decode,
             cache_valid=cache_valid,
+            attn_bias=attn_bias,
         )
         if cfg.prenorm:
             h = make_norm(cfg, "norm_attn")(x).astype(cfg.dtype)
@@ -696,7 +830,7 @@ class _ScanBlock(nn.Module):
 
     @nn.compact
     def __call__(self, carry, _):
-        x, positions, segment_ids, aux_scale, cache_valid = carry
+        x, positions, segment_ids, aux_scale, cache_valid, attn_bias = carry
         x = self.block_cls(self.config, name="block")(
             x,
             positions=positions,
@@ -705,8 +839,12 @@ class _ScanBlock(nn.Module):
             decode=self.decode,
             aux_scale=aux_scale,
             cache_valid=cache_valid,
+            attn_bias=attn_bias,
         )
-        return (x, positions, segment_ids, aux_scale, cache_valid), None
+        return (
+            (x, positions, segment_ids, aux_scale, cache_valid, attn_bias),
+            None,
+        )
 
 
 def remat_kwargs_for(config: TransformerConfig) -> dict:
@@ -756,6 +894,7 @@ class BlockStack(nn.Module):
         decode: bool = False,
         aux_scale: Optional[jax.Array] = None,
         cache_valid: Optional[jax.Array] = None,
+        attn_bias: Optional[jax.Array] = None,
     ) -> jax.Array:
         cfg = self.config
         remat_kwargs = remat_kwargs_for(cfg)
@@ -791,8 +930,9 @@ class BlockStack(nn.Module):
                 unroll=cfg.scan_unroll,
                 metadata_params={nn.PARTITION_NAME: None},
             )(cfg, train, decode, base_block, name="layers")
-            (x, _, _, _, _), _ = stacked(
-                (x, positions, segment_ids, aux_scale, cache_valid), None
+            (x, _, _, _, _, _), _ = stacked(
+                (x, positions, segment_ids, aux_scale, cache_valid, attn_bias),
+                None,
             )
         else:
             # static_argnums: train/decode are Python bools branching the
@@ -807,7 +947,7 @@ class BlockStack(nn.Module):
             for i in range(self.n_layers):
                 x = block_cls(cfg, name=f"layer_{i}")(
                     x, positions, segment_ids, train, decode, aux_scale,
-                    cache_valid,
+                    cache_valid, attn_bias,
                 )
         return x
 

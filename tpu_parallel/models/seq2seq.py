@@ -60,6 +60,7 @@ from tpu_parallel.models.layers import (
     Attention,
     BlockStack,
     Embedding,
+    RelativePositionBias,
     make_norm,
     remat_kwargs_for,
 )
@@ -167,6 +168,7 @@ class CrossAttention(nn.Module):
             features=cfg.n_heads * cfg.head_dim,
             axis_name=cfg.model_axis,
             style="column",
+            use_bias=cfg.dense_bias,
             dtype=cfg.dtype,
             name="q",
         )(x)
@@ -177,6 +179,7 @@ class CrossAttention(nn.Module):
                 features=2 * n_kv * cfg.head_dim,
                 axis_name=cfg.model_axis,
                 style="column",
+                use_bias=cfg.dense_bias,
                 dtype=cfg.dtype,
                 name="kv",
             )(memory)
@@ -223,6 +226,7 @@ class CrossAttention(nn.Module):
             features=cfg.d_model,
             axis_name=cfg.model_axis,
             style="row",
+            use_bias=cfg.dense_bias,
             dtype=cfg.dtype,
             name="out",
         )(out)
@@ -245,11 +249,13 @@ class DecoderBlock(nn.Module):
         positions: Optional[jax.Array] = None,
         train: bool = True,
         decode: bool = False,
+        attn_bias: Optional[jax.Array] = None,
     ) -> jax.Array:
         cfg = self.config
         h = make_norm(cfg, "norm_self")(x).astype(cfg.dtype)
         x = x + Attention(cfg, name="self_attn")(
-            h, positions=positions, train=train, decode=decode
+            h, positions=positions, train=train, decode=decode,
+            attn_bias=attn_bias,
         )
         h = make_norm(cfg, "norm_cross")(x).astype(cfg.dtype)
         x = x + CrossAttention(cfg, name="cross_attn")(
@@ -270,7 +276,7 @@ class _ScanDecoderBlock(nn.Module):
 
     @nn.compact
     def __call__(self, carry, _):
-        x, memory, memory_mask, positions = carry
+        x, memory, memory_mask, positions, attn_bias = carry
         x = self.block_cls(self.config, name="block")(
             x,
             memory,
@@ -278,8 +284,9 @@ class _ScanDecoderBlock(nn.Module):
             positions=positions,
             train=self.train,
             decode=self.decode,
+            attn_bias=attn_bias,
         )
-        return (x, memory, memory_mask, positions), None
+        return (x, memory, memory_mask, positions, attn_bias), None
 
 
 class DecoderStack(nn.Module):
@@ -297,6 +304,7 @@ class DecoderStack(nn.Module):
         positions: Optional[jax.Array] = None,
         train: bool = True,
         decode: bool = False,
+        attn_bias: Optional[jax.Array] = None,
     ) -> jax.Array:
         cfg = self.config
         remat_kwargs = remat_kwargs_for(cfg)
@@ -317,7 +325,9 @@ class DecoderStack(nn.Module):
                 unroll=cfg.scan_unroll,
                 metadata_params={nn.PARTITION_NAME: None},
             )(cfg, train, decode, base_block, name="layers")
-            (x, _, _, _), _ = stacked((x, memory, memory_mask, positions), None)
+            (x, _, _, _, _), _ = stacked(
+                (x, memory, memory_mask, positions, attn_bias), None
+            )
         else:
             block_cls = (
                 nn.remat(base_block, static_argnums=(5, 6), **remat_kwargs)
@@ -326,7 +336,7 @@ class DecoderStack(nn.Module):
             )
             for i in range(self.n_layers):
                 x = block_cls(cfg, name=f"layer_{i}")(
-                    x, memory, memory_mask, positions, train, decode
+                    x, memory, memory_mask, positions, train, decode, attn_bias
                 )
         return x
 
@@ -400,6 +410,21 @@ class EncoderDecoder(nn.Module):
         self.dec_norm = make_norm(cfg, "dec_norm")
         self.lm_head = _make_lm_head(cfg)
         self.decode_pos = _DecodePos(name="pos_counter")
+        self.enc_rel_bias = self.dec_rel_bias = None
+        if cfg.positional == "relative":
+            # T5: each stack shares ONE bucketed bias table across its
+            # layers (bidirectional buckets for the encoder, causal for the
+            # decoder); cross-attention carries no bias
+            if cfg.attn_impl != "xla":
+                raise NotImplementedError(
+                    "relative position bias needs attn_impl='xla'"
+                )
+            self.enc_rel_bias = RelativePositionBias(
+                self._enc_cfg, bidirectional=True, name="enc_rel_bias"
+            )
+            self.dec_rel_bias = RelativePositionBias(
+                self._dec_cfg, bidirectional=False, name="dec_rel_bias"
+            )
 
     def encode(
         self,
@@ -419,7 +444,13 @@ class EncoderDecoder(nn.Module):
             # real tokens segment 1, padding segment 0 — same-segment
             # visibility keeps padding out of the real tokens' softmax
             segment_ids = src_mask.astype(jnp.int32)
-        x = self.encoder(x, segment_ids=segment_ids, train=train)
+        attn_bias = None
+        if self.enc_rel_bias is not None:
+            pos = jnp.arange(src.shape[1])
+            attn_bias = self.enc_rel_bias(pos, pos)
+        x = self.encoder(
+            x, segment_ids=segment_ids, train=train, attn_bias=attn_bias
+        )
         return self.enc_norm(x).astype(self.config.dtype)
 
     def decode(
@@ -436,6 +467,11 @@ class EncoderDecoder(nn.Module):
         if decode and positions is None:
             positions = self.decode_pos(dst)
         x = self.embed(dst, positions=positions)
+        attn_bias = None
+        if self.dec_rel_bias is not None:
+            attn_bias = self.dec_rel_bias.for_step(
+                positions, dst.shape[1], cfg.seq_len, decode
+            )
         x = self.decoder(
             x,
             memory,
@@ -443,6 +479,7 @@ class EncoderDecoder(nn.Module):
             positions=positions,
             train=train,
             decode=decode,
+            attn_bias=attn_bias,
         )
         x = self.dec_norm(x).astype(cfg.dtype)
         if hidden_only:
@@ -745,6 +782,38 @@ def t5_small(**overrides) -> Seq2SeqConfig:
                 mlp_ratio=4,
                 norm="rmsnorm",
                 mlp="gelu",
+            ),
+            **overrides,
+        }
+    )
+
+
+def t5_small_hf(**overrides) -> Seq2SeqConfig:
+    """T5-small in its checkpoint-faithful form, for
+    :func:`~tpu_parallel.models.hf.from_hf_t5`: relative position bias
+    (32 buckets / max distance 128, one table per stack), T5LayerNorm
+    (= RMSNorm, eps 1e-6), bias-free denses, ReLU MLP (pass
+    ``mlp="geglu"`` for v1.1 checkpoints), unscaled attention folded into
+    the imported q kernels.  xla attention path (the bias refuses the
+    flash kernels); for from-scratch TPU training prefer :func:`t5_small`.
+    """
+    return Seq2SeqConfig(
+        **{
+            **dict(
+                vocab_size=32128,
+                d_model=512,
+                n_layers=6,
+                enc_layers=6,
+                n_heads=8,
+                seq_len=512,
+                mlp_ratio=4,
+                positional="relative",
+                norm="rmsnorm",
+                norm_eps=1e-6,
+                mlp="relu",
+                dense_bias=False,
+                attn_impl="xla",
+                scan_layers=False,
             ),
             **overrides,
         }
