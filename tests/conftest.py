@@ -118,6 +118,8 @@ _SLOW_TESTS = {
     "test_seq2seq_fsdp_training",
     "test_sharded_generate_matches_exported",
     "test_sharded_generate_tp_mesh",
+    "test_seq2seq_sp_training",
+    "test_seq2seq_sp_matches_dense",
     "test_bidirectional_window_matches_dense",
     "test_encoder_local_attention_model",
     "test_bidirectional_window_under_ulysses",

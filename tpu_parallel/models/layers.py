@@ -970,9 +970,13 @@ class Embedding(nn.Module):
         )(tokens)
         if cfg.positional == "learned":
             if positions is None:
-                positions = jnp.broadcast_to(
-                    jnp.arange(tokens.shape[1]), tokens.shape
-                )
+                local = jnp.arange(tokens.shape[1])
+                if seq_parallel_active(cfg):
+                    # seq-sharded tokens: offset local positions to global
+                    # ones so each shard embeds ITS rows of the table (the
+                    # rope analog lives inside Attention)
+                    local = local + lax.axis_index(cfg.seq_axis) * tokens.shape[1]
+                positions = jnp.broadcast_to(local, tokens.shape)
             pos_emb = nn.Embed(
                 num_embeddings=cfg.seq_len,
                 features=cfg.d_model,

@@ -28,11 +28,13 @@ TPU-first choices, consistent with the rest of the family:
   vocab-parallel CE under TP, sequence-chunked under ``loss_chunk``,
   FSDP-gathered lm_head applied once.
 
-Deliberate refusals (loud, not silent): pipeline parallelism (heterogeneous
-enc/dec stages need their own schedule — the pipe axis is a GPTLM
-capability for now) and sequence-parallel attention inside the seq2seq
-stacks (ring/Ulysses shard the self-attention token axis but the
-cross-attention memory would need its own resharding story).
+Sequence parallelism composes: both stacks shard their token axis with
+ring/Ulysses self-attention, and cross-attention gathers the projected
+source K/V (kv-head width — group-fold cheaper than gathering the memory)
+so sharded decoder queries see the whole source.  Deliberate refusals
+(loud, not silent): pipeline parallelism (heterogeneous enc/dec stages
+need their own schedule — the pipe axis is a GPTLM capability for now),
+the post-norm/BERT knobs, and decoding under a seq axis.
 """
 
 from __future__ import annotations
@@ -63,6 +65,7 @@ from tpu_parallel.models.layers import (
     RelativePositionBias,
     make_norm,
     remat_kwargs_for,
+    seq_parallel_active,
 )
 from tpu_parallel.parallel import fsdp
 from tpu_parallel.parallel.tp import TPDense, axis_size_or_none
@@ -310,6 +313,12 @@ class DecoderStack(nn.Module):
         remat_kwargs = remat_kwargs_for(cfg)
         base_block = fsdp.maybe_shard(DecoderBlock, cfg)
         if cfg.scan_layers:
+            if seq_parallel_active(cfg):
+                # seq-parallel attention output is seq-varying; the scan
+                # carry must enter seq-varying too (see BlockStack)
+                from tpu_parallel.core.metrics import pvary_missing, vma_of
+
+                x = pvary_missing(x, vma_of(lax.axis_index(cfg.seq_axis)))
             scan_target = _ScanDecoderBlock
             if cfg.remat and not decode:
                 scan_target = nn.remat(_ScanDecoderBlock, **remat_kwargs)
@@ -376,11 +385,6 @@ class EncoderDecoder(nn.Module):
                 "pipeline parallelism for encoder-decoder models "
                 "(heterogeneous enc/dec stages need their own schedule)"
             )
-        if cfg.attn_impl in ("ring", "ulysses"):
-            raise NotImplementedError(
-                "sequence-parallel attention inside the seq2seq stacks "
-                "(cross-attention memory needs its own resharding story)"
-            )
         if cfg.moe_experts > 0:
             raise NotImplementedError("MoE blocks in the seq2seq stacks")
         if not cfg.prenorm or cfg.embed_norm:
@@ -438,7 +442,7 @@ class EncoderDecoder(nn.Module):
         (pad positions form their own segment), and from every
         cross-attention via the mask the caller threads through.
         """
-        x = self.embed(src)
+        x = self.embed(src)  # Embedding offsets positions under SP itself
         segment_ids = None
         if src_mask is not None:
             # real tokens segment 1, padding segment 0 — same-segment
@@ -464,8 +468,26 @@ class EncoderDecoder(nn.Module):
         hidden_only: bool = False,
     ) -> jax.Array:
         cfg = self.config
+        if decode and seq_parallel_active(cfg):
+            # generation shards nothing over seq (the batch arrives
+            # replicated on that axis); running the SP offsets/gathers on a
+            # bound seq axis would silently corrupt positions and memory
+            raise NotImplementedError(
+                "incremental decoding under sequence parallelism "
+                "(serve seq2seq on a data/model mesh)"
+            )
         if decode and positions is None:
             positions = self.decode_pos(dst)
+        if memory is not None and seq_parallel_active(cfg):
+            # the memory arrives seq-SHARDED (the encoder ran under SP);
+            # every decoder layer's cross-attention needs the whole source.
+            # ONE d_model-wide gather here — outside the remat'd stack, so
+            # it is neither repeated per layer nor replayed in the backward
+            memory = lax.all_gather(memory, cfg.seq_axis, axis=1, tiled=True)
+            if src_mask is not None:
+                src_mask = lax.all_gather(
+                    src_mask, cfg.seq_axis, axis=1, tiled=True
+                )
         x = self.embed(dst, positions=positions)
         attn_bias = None
         if self.dec_rel_bias is not None:
