@@ -418,6 +418,25 @@ def test_seq2seq_sp_matches_dense():
     np.testing.assert_allclose(float(sp), float(dense), rtol=1e-4)
 
 
+def test_seq2seq_pp_training(mesh_pipe4_data2):
+    """Encoder-decoder pipeline: each pipe rank owns enc AND dec chunks,
+    two sequential GPipe passes, memory broadcast between them, loss
+    masked to the last rank.  Loss decreases end-to-end."""
+    cfg = tiny_seq2seq(pipe_size=4, enc_layers=4, n_layers=4, num_microbatches=4)
+    first, last, state = _train(
+        mesh_pipe4_data2,
+        cfg,
+        grad_sync_axes=("data",),
+        grad_psum_axes=("pipe",),
+        metric_axes=("data", "pipe"),
+    )
+    assert last < first
+    # stage params are per-rank: pipe must appear in the sharding
+    specs = nn.get_partition_spec(state).params
+    flat = jax.tree_util.tree_leaves_with_path(specs)
+    assert any("pipe" in str(spec) for _, spec in flat), "no pipe-sharded params"
+
+
 def test_loss_runs_without_mesh():
     """The loss (like the model) degrades gracefully to plain jit: axis
     folds skip unbound axes instead of dying in axis_index — single-chip
@@ -455,15 +474,101 @@ def test_eval_forward_needs_no_dropout_rng():
 def test_refusals_are_loud():
     src = jnp.zeros((1, 8), jnp.int32)
     dst = jnp.zeros((1, 8), jnp.int32)
-    # (attn_impl="ring"/"ulysses" no longer refuse: SP composes — see
-    # test_seq2seq_sp_training / test_seq2seq_sp_matches_dense)
+    # (ring/ulysses and pipe_size>1 no longer refuse: SP and PP compose —
+    # see test_seq2seq_sp_training / test_seq2seq_pp_training)
     for bad in (
-        dict(pipe_size=2),
         dict(moe_experts=2),
         dict(prenorm=False),
         dict(embed_norm=True),
+        dict(pipe_size=2, pipe_interleave=2),
     ):
         with pytest.raises(NotImplementedError):
             EncoderDecoder(tiny_seq2seq(**bad)).init(
                 {"params": jax.random.PRNGKey(0)}, src, dst, train=False
             )
+    # interleave without a pipe degree: silently-ignored knob refused
+    with pytest.raises(ValueError, match="pipe_interleave"):
+        EncoderDecoder(tiny_seq2seq(pipe_interleave=2)).init(
+            {"params": jax.random.PRNGKey(0)}, src, dst, train=False
+        )
+
+
+def test_mesh_bound_refusals_are_loud(mesh_pipe4_data2):
+    """The refusals that only fire under a bound mesh axis: relative bias
+    under PP (init-time) and incremental decoding under a pipe mesh
+    (apply-time) raise instead of silently corrupting."""
+    from jax.sharding import PartitionSpec
+
+    P_ = PartitionSpec
+    src = jnp.zeros((8, 8), jnp.int32)
+    dst = jnp.zeros((8, 8), jnp.int32)
+
+    # relative bias + PP: setup refuses during the mesh init trace
+    cfg_rel = tiny_seq2seq(
+        pipe_size=4, enc_layers=4, n_layers=4, positional="relative",
+        norm="rmsnorm",
+    )
+    model_rel = EncoderDecoder(cfg_rel)
+    with pytest.raises(NotImplementedError, match="relative"):
+        jax.eval_shape(
+            jax.shard_map(
+                lambda s, d: model_rel.init(
+                    {"params": jax.random.PRNGKey(0)}, s, d, train=False
+                ),
+                mesh=mesh_pipe4_data2,
+                in_specs=(P_("data"), P_("data")),
+                out_specs=P_(),
+                check_vma=False,
+            ),
+            src, dst,
+        )
+
+    # decoding on a pipe mesh: decode() refuses at trace time
+    cfg_pp = tiny_seq2seq(pipe_size=4, enc_layers=4, n_layers=4)
+    model_pp = EncoderDecoder(cfg_pp)
+
+    def try_decode(s, d):
+        v = model_pp.init({"params": jax.random.PRNGKey(0)}, s, d, train=False)
+        return model_pp.apply(
+            v, s, d, train=False, decode=True, mutable=["cache"]
+        )
+
+    with pytest.raises(NotImplementedError, match="decoding"):
+        jax.eval_shape(
+            jax.shard_map(
+                try_decode, mesh=mesh_pipe4_data2,
+                in_specs=(P_("data"), P_("data")), out_specs=P_(),
+                check_vma=False,
+            ),
+            src, dst,
+        )
+
+
+def test_sp_decode_refusal():
+    """Decoding with a bound seq axis refuses (the serving batch is
+    seq-replicated; SP offsets would silently corrupt it)."""
+    from jax.sharding import PartitionSpec
+    from tpu_parallel.runtime import MeshConfig, make_mesh
+
+    mesh = make_mesh(MeshConfig(data=2, seq=4))
+    cfg = tiny_seq2seq(attn_impl="ring", seq_len=64, src_seq_len=64)
+    model = EncoderDecoder(cfg)
+    P_ = PartitionSpec
+    src = jnp.zeros((8, 64), jnp.int32)
+    dst = jnp.zeros((8, 64), jnp.int32)
+
+    def try_decode(s, d):
+        v = model.init({"params": jax.random.PRNGKey(0)}, s, d, train=False)
+        return model.apply(
+            v, s, d, train=False, decode=True, mutable=["cache"]
+        )
+
+    with pytest.raises(NotImplementedError, match="sequence parallelism"):
+        jax.eval_shape(
+            jax.shard_map(
+                try_decode, mesh=mesh,
+                in_specs=(P_("data", "seq"), P_("data", "seq")),
+                out_specs=P_(), check_vma=False,
+            ),
+            src, dst,
+        )

@@ -28,13 +28,16 @@ TPU-first choices, consistent with the rest of the family:
   vocab-parallel CE under TP, sequence-chunked under ``loss_chunk``,
   FSDP-gathered lm_head applied once.
 
-Sequence parallelism composes: both stacks shard their token axis with
-ring/Ulysses self-attention, and cross-attention gathers the projected
-source K/V (kv-head width — group-fold cheaper than gathering the memory)
-so sharded decoder queries see the whole source.  Deliberate refusals
-(loud, not silent): pipeline parallelism (heterogeneous enc/dec stages
-need their own schedule — the pipe axis is a GPTLM capability for now),
-the post-norm/BERT knobs, and decoding under a seq axis.
+Every mesh strategy composes.  Sequence parallelism: both stacks shard
+their token axis with ring/Ulysses self-attention, and the seq-sharded
+encoder memory is gathered once per decoder pass (outside the remat'd
+stack) so sharded decoder queries see the whole source.  Pipeline
+parallelism: each pipe rank owns enc_layers/pipe encoder blocks AND
+n_layers/pipe decoder blocks as two sequential GPipe passes — the encoder
+pipeline broadcasts its output, the decoder pipeline feeds it to every
+stage's cross-attention as a per-microbatch extra.  Deliberate refusals
+(loud, not silent): MoE blocks, the post-norm/BERT knobs, relative bias
+under PP, and decoding under a bound seq axis or pipe mesh.
 """
 
 from __future__ import annotations
@@ -380,10 +383,10 @@ class EncoderDecoder(nn.Module):
 
     def setup(self):
         cfg = self.config
-        if cfg.pipe_size > 1:
-            raise NotImplementedError(
-                "pipeline parallelism for encoder-decoder models "
-                "(heterogeneous enc/dec stages need their own schedule)"
+        if cfg.pipe_interleave > 1 and cfg.pipe_size <= 1:
+            raise ValueError(
+                "pipe_interleave > 1 requires pipe_size > 1 (a pipe mesh "
+                "axis); on a pipe=1 mesh the knob would be silently ignored"
             )
         if cfg.moe_experts > 0:
             raise NotImplementedError("MoE blocks in the seq2seq stacks")
@@ -406,11 +409,61 @@ class EncoderDecoder(nn.Module):
         self.embed = fsdp.maybe_shard(Embedding, cfg)(
             dataclasses.replace(cfg, seq_len=table), name="embed"
         )
-        self.encoder = BlockStack(
-            self._enc_cfg, cfg.encoder_layers, name="encoder"
-        )
+        if cfg.pipe_size > 1:
+            # Heterogeneous stages, homogeneous ranks: each pipe rank owns
+            # encoder_layers/pipe encoder blocks AND n_layers/pipe decoder
+            # blocks, run as two sequential GPipe passes.  The encoder
+            # pipeline broadcasts its output (one d_model all-reduce) so
+            # every rank holds the memory; the decoder pipeline then feeds
+            # it to every stage's cross-attention as a per-microbatch extra
+            # — model input already replicated per rank, zero ring traffic.
+            import functools
+
+            from tpu_parallel.parallel import pp
+
+            if cfg.positional == "relative":
+                raise NotImplementedError(
+                    "relative position bias under pipeline parallelism"
+                )
+            if cfg.pipe_interleave > 1:
+                raise NotImplementedError(
+                    "the interleaved schedule for encoder-decoder models"
+                )
+            for n, what in (
+                (cfg.encoder_layers, "enc_layers"),
+                (cfg.n_layers, "n_layers"),
+            ):
+                if n % cfg.pipe_size != 0:
+                    raise ValueError(
+                        f"{what}={n} not divisible by pipe_size={cfg.pipe_size}"
+                    )
+            self.encoder = pp.PipelineModule(
+                stage_fn=functools.partial(
+                    BlockStack,
+                    self._enc_cfg,
+                    cfg.encoder_layers // cfg.pipe_size,
+                ),
+                num_microbatches=cfg.num_microbatches,
+                axis_name=cfg.pipe_axis,
+                broadcast_outputs=True,
+                name="encoder",
+            )
+            self.decoder = pp.PipelineModule(
+                stage_fn=functools.partial(
+                    DecoderStack, self._dec_cfg, cfg.n_layers // cfg.pipe_size
+                ),
+                num_microbatches=cfg.num_microbatches,
+                axis_name=cfg.pipe_axis,
+                name="decoder",
+            )
+        else:
+            self.encoder = BlockStack(
+                self._enc_cfg, cfg.encoder_layers, name="encoder"
+            )
+            self.decoder = DecoderStack(
+                self._dec_cfg, cfg.n_layers, name="decoder"
+            )
         self.enc_norm = make_norm(cfg, "enc_norm")
-        self.decoder = DecoderStack(self._dec_cfg, cfg.n_layers, name="decoder")
         self.dec_norm = make_norm(cfg, "dec_norm")
         self.lm_head = _make_lm_head(cfg)
         self.decode_pos = _DecodePos(name="pos_counter")
@@ -452,9 +505,15 @@ class EncoderDecoder(nn.Module):
         if self.enc_rel_bias is not None:
             pos = jnp.arange(src.shape[1])
             attn_bias = self.enc_rel_bias(pos, pos)
-        x = self.encoder(
-            x, segment_ids=segment_ids, train=train, attn_bias=attn_bias
-        )
+        if self.config.pipe_size > 1:
+            extras = (
+                {"segment_ids": segment_ids} if segment_ids is not None else None
+            )
+            x = self.encoder(x, train=train, extras=extras)
+        else:
+            x = self.encoder(
+                x, segment_ids=segment_ids, train=train, attn_bias=attn_bias
+            )
         return self.enc_norm(x).astype(self.config.dtype)
 
     def decode(
@@ -494,15 +553,31 @@ class EncoderDecoder(nn.Module):
             attn_bias = self.dec_rel_bias.for_step(
                 positions, dst.shape[1], cfg.seq_len, decode
             )
-        x = self.decoder(
-            x,
-            memory,
-            memory_mask=src_mask,
-            positions=positions,
-            train=train,
-            decode=decode,
-            attn_bias=attn_bias,
-        )
+        if cfg.pipe_size > 1:
+            if decode:
+                raise NotImplementedError(
+                    "incremental decoding for pipelined encoder-decoder "
+                    "models (the cross-attention caches would need their "
+                    "own ring plumbing)"
+                )
+            # memory/mask are model inputs every rank holds (the encoder
+            # pipeline broadcast its output): ride as per-microbatch extras
+            extras = {"memory": memory}
+            if src_mask is not None:
+                extras["memory_mask"] = src_mask
+            if positions is not None:
+                extras["positions"] = positions
+            x = self.decoder(x, train=train, extras=extras)
+        else:
+            x = self.decoder(
+                x,
+                memory,
+                memory_mask=src_mask,
+                positions=positions,
+                train=train,
+                decode=decode,
+                attn_bias=attn_bias,
+            )
         x = self.dec_norm(x).astype(cfg.dtype)
         if hidden_only:
             return x
@@ -535,7 +610,9 @@ def make_seq2seq_loss(config: Seq2SeqConfig, train: bool = True):
     shape); the CE machinery is shared (:func:`make_ce_fn` — vocab-parallel
     under TP, chunked under ``loss_chunk``, pre-gathered lm_head).
     """
-    fold_axes = (config.data_axis, config.model_axis)
+    fold_axes = (
+        config.data_axis, config.model_axis, config.pipe_axis, config.seq_axis
+    )
     ce_fn = make_ce_fn(config)
 
     def loss_fn(params, apply_fn, batch: Seq2SeqBatch, rng):
@@ -554,6 +631,11 @@ def make_seq2seq_loss(config: Seq2SeqConfig, train: bool = True):
             if batch.loss_mask is not None
             else jnp.ones(batch.targets.shape, jnp.float32)
         )
+        if config.pipe_size > 1:
+            # real logits live on the last pipe rank only
+            from tpu_parallel.parallel import pp
+
+            mask = mask * pp.last_stage_mask(config.pipe_axis)
         n_tok = mask.sum()
         loss_sum, correct = ce_fn(
             _lm_head_params(config, params), hidden, batch.targets, mask
