@@ -120,6 +120,7 @@ _SLOW_TESTS = {
     "test_sharded_generate_tp_mesh",
     "test_seq2seq_sp_training",
     "test_seq2seq_pp_training",
+    "test_seq2seq_moe_training",
     "test_seq2seq_sp_matches_dense",
     "test_bidirectional_window_matches_dense",
     "test_encoder_local_attention_model",

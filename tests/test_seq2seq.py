@@ -418,6 +418,35 @@ def test_seq2seq_sp_matches_dense():
     np.testing.assert_allclose(float(sp), float(dense), rtol=1e-4)
 
 
+def test_seq2seq_moe_training(mesh_data4_model2):
+    """Switch-style MoE encoder-decoder: routed experts replace the MLP in
+    BOTH stacks, expert-parallel over the model axis, balance aux loss
+    collected across encoder+decoder blocks.  (The original Switch
+    Transformer is exactly a T5-shaped MoE.)"""
+    cfg = tiny_seq2seq(moe_experts=4, moe_top_k=1)
+    batch = _s2s_batch(jax.random.PRNGKey(0), 16, cfg)
+    model = EncoderDecoder(cfg)
+    tx = optax.adamw(3e-3)
+
+    def init(rng, b):
+        v = model.init({"params": rng}, b.src_tokens, b.tokens, train=False)
+        return TrainState.create(
+            apply_fn=model.apply, params=v["params"], tx=tx, rng=rng
+        )
+
+    funcs = build_train_functions(
+        init, make_seq2seq_loss(cfg), mesh_data4_model2, batch,
+        batch_spec=P("data"), grad_sync_axes=("data", "model"), donate=False,
+    )
+    state = funcs.init_fn(jax.random.PRNGKey(42), batch)
+    state, m0 = funcs.step_fn(state, None, batch)
+    first = compute(m0)
+    assert "moe_balance" in first and first["moe_balance"] > 0
+    for _ in range(7):
+        state, m = funcs.step_fn(state, None, batch)
+    assert compute(m)["loss"] < first["loss"]
+
+
 def test_seq2seq_pp_training(mesh_pipe4_data2):
     """Encoder-decoder pipeline: each pipe rank owns enc AND dec chunks,
     two sequential GPipe passes, memory broadcast between them, loss
@@ -476,8 +505,10 @@ def test_refusals_are_loud():
     dst = jnp.zeros((1, 8), jnp.int32)
     # (ring/ulysses and pipe_size>1 no longer refuse: SP and PP compose —
     # see test_seq2seq_sp_training / test_seq2seq_pp_training)
+    # (moe alone no longer refuses: Switch-style MoE composes — see
+    # test_seq2seq_moe_training; the PP combo still does)
     for bad in (
-        dict(moe_experts=2),
+        dict(moe_experts=2, pipe_size=2),
         dict(prenorm=False),
         dict(embed_norm=True),
         dict(pipe_size=2, pipe_interleave=2),

@@ -35,9 +35,13 @@ stack) so sharded decoder queries see the whole source.  Pipeline
 parallelism: each pipe rank owns enc_layers/pipe encoder blocks AND
 n_layers/pipe decoder blocks as two sequential GPipe passes — the encoder
 pipeline broadcasts its output, the decoder pipeline feeds it to every
-stage's cross-attention as a per-microbatch extra.  Deliberate refusals
-(loud, not silent): MoE blocks, the post-norm/BERT knobs, relative bias
-under PP, and decoding under a bound seq axis or pipe mesh.
+stage's cross-attention as a per-microbatch extra.  MoE composes too:
+routed experts replace the MLP in BOTH stacks (the original Switch
+Transformer is exactly a T5-shaped MoE), expert-parallel over the model
+axis, balance aux collected across encoder+decoder blocks.  Deliberate
+refusals (loud, not silent): MoE under the pipelined schedule, the
+post-norm/BERT knobs, relative bias under PP, and decoding under a bound
+seq axis or pipe mesh.
 """
 
 from __future__ import annotations
@@ -268,7 +272,19 @@ class DecoderBlock(nn.Module):
             h, memory, memory_mask=memory_mask, train=train, decode=decode
         )
         h = make_norm(cfg, "norm_mlp")(x).astype(cfg.dtype)
-        x = x + MLP(cfg, name="mlp")(h, train=train)
+        if cfg.moe_experts > 0:
+            if decode and cfg.moe_router == "expert_choice":
+                # Block's guard, mirrored: a single-token decode step
+                # collapses the EC routing pool to one token per row
+                raise NotImplementedError(
+                    "incremental decoding with expert-choice routing "
+                    "(the routing pool collapses to one token per row)"
+                )
+            from tpu_parallel.models.moe import MoEMLP
+
+            x = x + MoEMLP(cfg, name="moe")(h, train=train)
+        else:
+            x = x + MLP(cfg, name="mlp")(h, train=train)
         return x
 
 
@@ -330,7 +346,7 @@ class DecoderStack(nn.Module):
             # stays static across prefill and steps
             stacked = nn.scan(
                 scan_target,
-                variable_axes={"params": 0, "cache": 0},
+                variable_axes={"params": 0, "cache": 0, "losses": 0},
                 variable_broadcast=False,
                 split_rngs={"params": True, "dropout": True},
                 length=self.n_layers,
@@ -388,8 +404,11 @@ class EncoderDecoder(nn.Module):
                 "pipe_interleave > 1 requires pipe_size > 1 (a pipe mesh "
                 "axis); on a pipe=1 mesh the knob would be silently ignored"
             )
-        if cfg.moe_experts > 0:
-            raise NotImplementedError("MoE blocks in the seq2seq stacks")
+        if cfg.moe_experts > 0 and cfg.pipe_size > 1:
+            raise NotImplementedError(
+                "MoE under the pipelined encoder-decoder (bubble-tick sow "
+                "masking is wired for the GPTLM pipeline only)"
+            )
         if not cfg.prenorm or cfg.embed_norm:
             # Block honors prenorm but DecoderBlock and the enc/dec final
             # norms are pre-norm-shaped — a half-applied knob would build a
@@ -617,15 +636,34 @@ def make_seq2seq_loss(config: Seq2SeqConfig, train: bool = True):
 
     def loss_fn(params, apply_fn, batch: Seq2SeqBatch, rng):
         dropout_rng = fold_rng_over_axis(rng, fold_axes)
-        hidden = apply_fn(
-            {"params": params},
-            batch.src_tokens,
-            batch.tokens,
+        apply_kwargs = dict(
             src_mask=batch.src_mask,
             train=train,
             hidden_only=True,
             rngs={"dropout": dropout_rng},
         )
+        aux_loss = 0.0
+        if config.moe_experts > 0:
+            hidden, mods = apply_fn(
+                {"params": params},
+                batch.src_tokens,
+                batch.tokens,
+                mutable=["losses"],
+                **apply_kwargs,
+            )
+            sown = jax.tree_util.tree_leaves(mods.get("losses", {}))
+            if sown:
+                # every encoder AND decoder block sows once per apply (PP
+                # is refused with MoE, so no microbatch factor)
+                denom = config.encoder_layers + config.n_layers
+                aux_loss = sum(jnp.sum(leaf) for leaf in sown) / denom
+        else:
+            hidden = apply_fn(
+                {"params": params},
+                batch.src_tokens,
+                batch.tokens,
+                **apply_kwargs,
+            )
         mask = (
             batch.loss_mask
             if batch.loss_mask is not None
@@ -644,7 +682,11 @@ def make_seq2seq_loss(config: Seq2SeqConfig, train: bool = True):
             "loss": (loss_sum, n_tok),
             "accuracy": (correct.astype(jnp.float32), n_tok),
         }
-        return loss_sum / jnp.maximum(n_tok, 1.0), metrics
+        total = loss_sum / jnp.maximum(n_tok, 1.0)
+        if config.moe_experts > 0:
+            metrics["moe_balance"] = (aux_loss * n_tok, n_tok)
+            total = total + config.moe_balance_weight * aux_loss
+        return total, metrics
 
     return loss_fn
 
