@@ -122,6 +122,7 @@ def _generate_core(
     temperature: float,
     top_k: int,
     top_p: float = 0.0,
+    prompt_mask: Optional[jax.Array] = None,
 ) -> jax.Array:
     """The traceable prefill + decode-scan body shared by :func:`generate`
     (jit, one device) and :func:`generate_sharded` (shard_map, any mesh).
@@ -131,6 +132,12 @@ def _generate_core(
     column-sharded under TP: sampling then runs vocab-parallel
     (:func:`_sample_sharded`) and the per-step full-vocab all_gather
     disappears for greedy/temperature/top-k decoding.
+
+    ``prompt_mask`` [b, P] enables RAGGED batches: rows LEFT-padded (False
+    at the left, so the last slot is each row's final real token — the one
+    the head reads).  Pad slots write position -1 into the per-slot cache
+    position table and are never attended; each row continues from its own
+    length.  None = all rows full length (the aligned fast path).
     """
     from tpu_parallel.models.gpt import _lm_head_params, _make_lm_head
     from tpu_parallel.parallel.tp import axis_size_or_none
@@ -141,6 +148,11 @@ def _generate_core(
         raise ValueError(
             f"prompt ({prompt_len}) + max_new_tokens ({max_new_tokens}) "
             f"exceeds seq_len ({cfg.seq_len})"
+        )
+    if prompt_mask is not None and cfg.positional == "relative":
+        raise NotImplementedError(
+            "ragged prompts with relative position bias (the shared bias "
+            "table assumes row-uniform query positions)"
         )
     # unwrapped head + one up-front FSDP gather: the wrapped head would
     # re-all_gather the vocab kernel every decode step inside the scan
@@ -158,7 +170,20 @@ def _generate_core(
 
     # Prefill: one batched forward over the prompt creates and fills the
     # cache ('cache' is created on the fly because it is marked mutable).
-    positions = jnp.broadcast_to(jnp.arange(prompt_len), (b, prompt_len))
+    if prompt_mask is None:
+        positions = jnp.broadcast_to(jnp.arange(prompt_len), (b, prompt_len))
+        lengths = jnp.full((b,), prompt_len, jnp.int32)
+    else:
+        m = prompt_mask.astype(jnp.int32)
+        if m.shape != prompt.shape:
+            raise ValueError(
+                f"prompt_mask shape {m.shape} != prompt shape {prompt.shape}"
+            )
+        # real tokens get 0..len-1; pads get -1 (never attended; their
+        # nn.Embed lookup clamps harmlessly — the outputs are unread)
+        positions = jnp.cumsum(m, axis=1) - 1
+        positions = jnp.where(m > 0, positions, -1)
+        lengths = m.sum(axis=1).astype(jnp.int32)
     hidden, variables = model.apply(
         {"params": params},
         prompt,
@@ -176,7 +201,7 @@ def _generate_core(
         hidden, updated = model.apply(
             {"params": params, "cache": cache},
             tok[:, None],
-            positions=jnp.full((b, 1), pos, jnp.int32),
+            positions=pos[:, None],
             train=False,
             decode=True,
             hidden_only=True,
@@ -186,7 +211,7 @@ def _generate_core(
         nxt = next_token(hidden, sub)
         return (updated["cache"], nxt, pos + 1, rng), tok
 
-    init = (variables["cache"], first, jnp.int32(prompt_len), rng)
+    init = (variables["cache"], first, lengths, rng)
     (_, last, _, _), toks = lax.scan(step, init, None, length=max_new_tokens - 1)
     # scan emits the *input* token of each step; append the final sample
     return jnp.concatenate([toks.T, last[:, None]], axis=1)
@@ -206,20 +231,25 @@ def generate(
     temperature: float = 0.0,
     top_k: int = 0,
     top_p: float = 0.0,
+    prompt_mask: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt`` [batch, P].
 
     Returns [batch, max_new_tokens] of sampled tokens (greedy when
     ``temperature == 0``).  The prompt must fit the model's ``seq_len``
     together with the new tokens (the cache is allocated at ``seq_len``).
-    Single-device params layout — for mesh-sharded states use
-    :func:`generate_sharded` (or ``export_single_device_params`` when the
-    weights aren't split over tp/pipe).
+    ``prompt_mask`` serves RAGGED batches — rows LEFT-padded to a common
+    length, each continuing from its own last real token (see
+    :func:`_generate_core`).  Single-device params layout — for
+    mesh-sharded states use :func:`generate_sharded` (or
+    ``export_single_device_params`` when the weights aren't split over
+    tp/pipe).
     """
     if rng is None:
         rng = jax.random.PRNGKey(0)
     return _generate_core(
-        model, params, prompt, rng, max_new_tokens, temperature, top_k, top_p
+        model, params, prompt, rng, max_new_tokens, temperature, top_k, top_p,
+        prompt_mask=prompt_mask,
     )
 
 
@@ -234,10 +264,13 @@ def generate_sharded(
     temperature: float = 0.0,
     top_k: int = 0,
     top_p: float = 0.0,
+    prompt_mask: Optional[jax.Array] = None,
     param_specs=None,
     batch_spec=None,
 ) -> jax.Array:
     """Generate under a mesh: TP-split weights stay split, batch shards DP.
+    ``prompt_mask`` serves ragged (left-padded) batches, sharded like the
+    prompt rows.
 
     The serving path for states whose weights live on multiple devices
     (``export_single_device_params`` refuses tp/pipe degree > 1 by design).
@@ -262,6 +295,13 @@ def generate_sharded(
         batch_spec = P(model.config.data_axis)
     if rng is None:
         rng = jax.random.PRNGKey(0)
+    # the shard_map arity is fixed, so a placeholder all-ones mask always
+    # rides along; has_mask keeps the no-mask call IDENTICAL to the aligned
+    # path inside the core (an all-ones mask is semantically aligned, but
+    # must not trip the ragged-vs-relative refusal)
+    has_mask = prompt_mask is not None
+    if prompt_mask is None:
+        prompt_mask = jnp.ones(prompt.shape, jnp.bool_)
     fn = _sharded_generate_fn(
         model,
         mesh,
@@ -271,8 +311,9 @@ def generate_sharded(
         temperature,
         top_k,
         top_p,
+        has_mask,
     )
-    return fn(params, prompt, rng)
+    return fn(params, prompt, prompt_mask, rng)
 
 
 class _HashableTree:
@@ -341,16 +382,16 @@ def build_sharded_serving(model, mesh, param_specs, batch_specs, out_spec, core)
 @functools.lru_cache(maxsize=32)
 def _sharded_generate_fn(
     model, mesh, specs: _HashableTree, batch_spec, max_new_tokens, temperature,
-    top_k, top_p=0.0,
+    top_k, top_p=0.0, has_mask=False,
 ):
-    def core(model_, params, prompt, rng):
+    def core(model_, params, prompt, prompt_mask, rng):
         return _generate_core(
             model_, params, prompt, rng, max_new_tokens, temperature, top_k,
-            top_p,
+            top_p, prompt_mask=prompt_mask if has_mask else None,
         )
 
     return build_sharded_serving(
-        model, mesh, specs.tree(), (batch_spec,), batch_spec, core
+        model, mesh, specs.tree(), (batch_spec, batch_spec), batch_spec, core
     )
 
 
@@ -410,6 +451,9 @@ def generate_beam(
         name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
         if name.startswith(("cached_key", "cached_value")):
             return jnp.repeat(x, k, axis=x.ndim - 4)
+        if name.startswith("cached_pos"):
+            # per-slot position table: [..., rows, S] with batch at ndim-2
+            return jnp.repeat(x, k, axis=x.ndim - 2)
         return x
 
     cache0 = jax.tree_util.tree_map_with_path(expand, variables["cache"])
@@ -444,6 +488,8 @@ def generate_beam(
             name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
             if name.startswith(("cached_key", "cached_value")):
                 return jnp.take(x, row_idx, axis=x.ndim - 4)
+            if name.startswith("cached_pos"):
+                return jnp.take(x, row_idx, axis=x.ndim - 2)
             return x
 
         cache = jax.tree_util.tree_map_with_path(reorder, updated["cache"])

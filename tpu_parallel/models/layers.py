@@ -233,15 +233,21 @@ def causal_attention(
 def decode_attention(
     q: jax.Array, k_all: jax.Array, v_all: jax.Array, positions: jax.Array,
     window: int = 0, bias: Optional[jax.Array] = None,
+    k_positions: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Attention of new queries against a full KV cache, GQA-native.
 
     ``q``: [batch, new_len, heads, head_dim] at global ``positions``
     [batch, new_len]; ``k_all``/``v_all``: [batch, cache_len, kv_heads,
     head_dim] where ``heads % kv_heads == 0`` (grouped queries contract
-    against their group's K/V directly — no repeated-K/V materialization)
-    and entries beyond the write index are zeros and masked out by the
-    position comparison (cache slot j holds global position j).
+    against their group's K/V directly — no repeated-K/V materialization).
+
+    ``k_positions``: the global position each cache slot holds.  Default
+    (None) is the aligned layout — slot j holds position j, entries beyond
+    the write index masked out by the position comparison.  Ragged batches
+    (left-padded prompts) pass the per-row table ``[batch, cache_len]``
+    where pad slots hold -1: negative slots never attend, and the causal
+    comparison keys off the STORED positions, not slot indices.
     """
     b, nq, h, head_dim = q.shape
     h_kv = k_all.shape[2]
@@ -253,14 +259,15 @@ def decode_attention(
         # [1|B, h, q, k] -> grouped [1|B, h_kv, group, q, k]
         bb = bias.reshape(bias.shape[0], h_kv, group, *bias.shape[2:])
         scores = scores + bb.astype(jnp.float32)
-    k_pos = jnp.arange(k_all.shape[1])
-    mask = k_pos[None, None, None, None, :] <= positions[:, None, None, :, None]
+    if k_positions is None:
+        k_pos = jnp.broadcast_to(jnp.arange(k_all.shape[1]), (b, k_all.shape[1]))
+    else:
+        k_pos = k_positions
+    kp = k_pos[:, None, None, None, :]
+    qp = positions[:, None, None, :, None]
+    mask = jnp.logical_and(kp >= 0, kp <= qp)
     if window:
-        mask = jnp.logical_and(
-            mask,
-            positions[:, None, None, :, None] - k_pos[None, None, None, None, :]
-            < window,
-        )
+        mask = jnp.logical_and(mask, qp - kp < window)
     scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
     out = jnp.einsum("bngqk,bknd->bqngd", probs, v_all)
@@ -500,6 +507,15 @@ class Attention(nn.Module):
                     (b, cfg.seq_len, local_kv, 1),
                     jnp.float32,
                 )
+            # per-slot global positions (int32 [b, seq_len]) — the decode
+            # mask keys off STORED positions, so ragged (left-padded)
+            # batches work: pad slots hold -1 and never attend.  Aligned
+            # batches write j at slot j, reproducing the classic layout.
+            cached_p = self.variable(
+                "cache",
+                "cached_pos",
+                lambda: jnp.full((b, cfg.seq_len), -1, jnp.int32),
+            )
             cache_index = self.variable(
                 "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
             )
@@ -555,12 +571,16 @@ class Attention(nn.Module):
                 )
                 cached_k.value = keep(k_all, cached_k.value)
                 cached_v.value = keep(v_all, cached_v.value)
+            new_p = lax.dynamic_update_slice_in_dim(
+                cached_p.value, positions.astype(jnp.int32), idx, axis=1
+            )
+            cached_p.value = keep(new_p, cached_p.value)
             cache_index.value = keep(idx + x.shape[1], idx)
             # decode_attention contracts grouped queries against the
             # kv-width cache directly — no K/V expansion
             out = decode_attention(
                 q, k_all, v_all, positions, window=cfg.attn_window,
-                bias=attn_bias,
+                bias=attn_bias, k_positions=new_p,
             )
         else:
             out = self._attend(q, k, v, segment_ids, attn_bias)
