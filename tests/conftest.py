@@ -130,6 +130,10 @@ _SLOW_TESTS = {
     "test_ring_window_matches_masked_reference",
     "test_ring_flash_window_matches_masked_reference",
     "test_ring_flash_window_gradients_match",
+    "test_ring_bidirectional_window_matches_dense",
+    "test_ring_flash_bidirectional_window_matches_dense",
+    "test_ring_flash_bidirectional_window_gradients",
+    "test_encoder_local_attention_under_ring",
     "test_gpt_ring_window_training",
     "test_gpt_ulysses_window_training",
     "test_ring_packed_matches_reference",

@@ -136,21 +136,10 @@ def test_encoder_refusals(rng):
             {"params": params}, tokens, train=False, decode=True,
             mutable=["cache"],
         )
-    # window x bidirectional x RING stays refused (the ring ops raise:
-    # the jnp and flash paths would otherwise disagree on band semantics)
-    from tpu_parallel.ops.ring_attention import ring_attention
-    from tpu_parallel.runtime import MeshConfig, make_mesh
-    from jax.sharding import PartitionSpec as _P
-
-    mesh = make_mesh(MeshConfig(data=2, seq=4))
-    with pytest.raises(NotImplementedError, match="bidirectional ring"):
-        jax.shard_map(
-            lambda q: ring_attention(
-                q, q, q, axis_name="seq", window=8, causal=False
-            ),
-            mesh=mesh, in_specs=_P(None, "seq"), out_specs=_P(None, "seq"),
-            check_vma=False,
-        )(jnp.zeros((1, 32, 1, 8)))
+    # (window x bidirectional x ring no longer refuses — the symmetric
+    # band spans chunks via signed static offsets: see
+    # test_ring_bidirectional_window_matches_dense and
+    # test_encoder_local_attention_under_ring)
 
 
 def test_encoder_classifier_finetunes(mesh_data8, rng):
@@ -438,3 +427,36 @@ def test_postnorm_mlm_training(mesh_data8, rng):
     assert compute(m)["loss"] < first
     # post-norm trunk has no final norm (parity with the HF layout)
     assert "norm_final" not in state.params
+
+
+def test_encoder_local_attention_under_ring(rng):
+    """Long-document encoder recipe: local attention (symmetric band) +
+    ring sequence parallelism — MLM trains end-to-end on a (data, seq)
+    mesh."""
+    from tpu_parallel.runtime import MeshConfig, make_mesh
+
+    mesh = make_mesh(MeshConfig(data=2, seq=4))
+    cfg = tiny_test(
+        bidirectional=True, attn_impl="ring", attn_window=24, seq_len=64
+    )
+    batch = lm_batch(jax.random.PRNGKey(0), 8, cfg.seq_len, cfg.vocab_size)
+    model = GPTLM(cfg)
+    tx = optax.adamw(3e-3)
+
+    def init(rng_, b):
+        p = model.init({"params": rng_}, b.tokens, train=False)["params"]
+        return TrainState.create(apply_fn=model.apply, params=p, tx=tx, rng=rng_)
+
+    funcs = build_train_functions(
+        init, make_mlm_loss(cfg, mask_rate=0.3), mesh, batch,
+        batch_spec=P("data", "seq"),
+        grad_sync_axes=("data", "seq"), metric_axes=("data", "seq"),
+        donate=False,
+        check_vma=False,
+    )
+    state = funcs.init_fn(rng, batch)
+    state, m0 = funcs.step_fn(state, None, batch)
+    first = compute(m0)["loss"]
+    for _ in range(5):
+        state, m = funcs.step_fn(state, None, batch)
+    assert compute(m)["loss"] < first

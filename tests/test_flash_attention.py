@@ -527,12 +527,15 @@ def test_stream_long_seq_backward_runs(rng):
         assert np.abs(arr).max() > 0, f"{name} is all zero"
 
 
-@pytest.mark.parametrize("q_offset", [32, 100, 140])
+@pytest.mark.parametrize("q_offset", [32, 100, 140, -32, -100, -140])
 def test_stream_offset_chunk_matches_resident(rng, q_offset):
     """Streamed kernels with a window q_offset (ring partial chunks) agree
     with the resident kernels — including empty rows (at q_offset=140 with
     window=40, rows past local index 26 see no keys at all: their partials
-    must come back (0, NEG_INF) with exactly-zero gradients)."""
+    must come back (0, NEG_INF) with exactly-zero gradients).  NEGATIVE
+    offsets are the bidirectional ring's ahead chunks: the in-bounds
+    clamps in the streamed index maps must hold there too (early q blocks
+    see no keys; late k blocks see no queries)."""
     from tpu_parallel.ops.flash_attention import flash_chunk_attention
 
     b, s, h, d = 1, 128, 2, 32

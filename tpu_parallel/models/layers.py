@@ -127,9 +127,9 @@ class TransformerConfig:
     # every same-segment position — with attn_window > 0, those in the
     # symmetric band |q - k| < window (encoder local attention).  Composes
     # with the xla and flash paths, GQA, packing, TP/FSDP/PP, ulysses SP
-    # (band applied on the gathered sequence), and ring SP (full visibility
-    # only — window x ring stays refused in the ring ops); refuses decode
-    # (encoders don't autoregress)
+    # (band applied on the gathered sequence), and ring SP (the band spans
+    # chunks via signed static offsets; out-of-band chunks skip their
+    # kernels); refuses decode (encoders don't autoregress)
     bidirectional: bool = False
     # mixture-of-experts: 0 = dense MLP; >0 replaces every block's MLP with
     # routed experts, expert-parallel over the model axis

@@ -203,6 +203,10 @@ def _stream_kv_map(kv_row, block_q, block_k, causal, window, num_ki, q_offset):
         first, last = _stream_k_range(
             qi, block_q, block_k, causal, window, num_ki, q_offset
         )
+        # negative q_offset (ahead ring chunks) can drive `last` below 0 for
+        # early q blocks; the index map must stay in bounds — compute is
+        # predicated off for those steps anyway
+        last = jnp.clip(last, 0, num_ki - 1)
         return (kv_row(bh_), jnp.clip(ki, jnp.minimum(first, last), last), 0)
 
     return kv_map
@@ -823,6 +827,10 @@ def _flash_bwd(
             first_q, last_q = _stream_q_range(
                 ki, block_q, block_k, causal, window, num_qi, q_offset
             )
+            # negative q_offset (ahead ring chunks) can push first_q past
+            # the last block for late k blocks; keep the index in bounds —
+            # those grid steps are compute-predicated off
+            first_q = jnp.clip(first_q, 0, num_qi - 1)
             return jnp.clip(qi, first_q, jnp.maximum(last_q, first_q))
 
         def q_map(bkv_, ki, g, qi):
@@ -1094,12 +1102,12 @@ def flash_chunk_attention(
     keys).  With ``causal=True`` the band is one-sided (key j visible iff
     ``q_offset + i - j < window``, Mistral semantics); with
     ``causal=False`` it is SYMMETRIC — ``|q_offset + i - j| < window`` —
-    the encoder local-attention form.  Ring attention passes
-    ``q_offset = j_back * local_seq`` for the chunk ``j_back`` ranks behind
-    (its keys are all behind the queries, so the symmetric upper side is
-    vacuous there) — rows whose window misses the whole chunk come back as
-    empty partials (out 0, lse NEG_INF), which :func:`combine_chunks`
-    weights to zero.
+    the encoder local-attention form.  Ring attention passes SIGNED
+    ``q_offset = j * local_seq``: positive for chunks behind the queries
+    (the symmetric upper side is vacuous there), NEGATIVE for chunks ahead
+    (bidirectional rings — the upper side binds).  Rows whose window misses
+    the whole chunk come back as empty partials (out 0, lse NEG_INF),
+    which :func:`combine_chunks` weights to zero.
 
     ``segment_ids_q``/``segment_ids_kv`` ([batch, seq_q] / [batch, seq_kv],
     both or neither) mask packed sequences across chunks: queries attend

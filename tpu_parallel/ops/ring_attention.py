@@ -46,16 +46,14 @@ def ring_attention(
     the local output chunk.  ``use_checkpoint`` remats the per-step combine
     so the backward pass replays the ring instead of storing every rotated
     K/V chunk (keeps the O(seq/n) memory promise under autodiff).
-    ``window > 0`` adds Mistral-style sliding-window masking on the global
-    positions (query t sees keys in (t - window, t] only).
+    ``window > 0`` adds sliding-window masking on the global positions:
+    causal = Mistral-style (query t sees keys in (t - window, t]);
+    bidirectional = encoder local attention (the symmetric band
+    |q - k| < window).
     ``segment_ids`` (the LOCAL chunk's [batch, local_seq] ids) masks packed
     sequences: the ids rotate around the ring with their K/V chunk, so each
     step can mask cross-document pairs exactly.
     """
-    if window and not causal:
-        raise NotImplementedError(
-            "sliding window with bidirectional ring attention"
-        )
     n_chunks = lax.psum(1, axis_name)
     my_chunk = lax.axis_index(axis_name)
     b, local_s, h, d = q.shape
@@ -96,8 +94,13 @@ def ring_attention(
         if window:
             # positions here are global, so the band needs no per-chunk
             # offset bookkeeping — the flash ring path encodes the same
-            # geometry statically via flash_chunk_attention's q_offset
-            mask = jnp.logical_and(mask, q_pos - k_pos < window)
+            # geometry statically via flash_chunk_attention's q_offset.
+            # causal: one-sided (keys at most window-1 behind); encoder
+            # local attention (non-causal): the symmetric band |q-k|<window
+            near = q_pos - k_pos < window
+            if not causal:
+                near = jnp.logical_and(near, k_pos - q_pos < window)
+            mask = jnp.logical_and(mask, near)
         if seg_cur is not None:
             same = (
                 seg_local[:, None, None, :, None]
@@ -233,10 +236,6 @@ def ring_flash_attention(
     """
     from tpu_parallel.ops.flash_attention import flash_chunk_attention
 
-    if window and not causal:
-        raise NotImplementedError(
-            "sliding window with bidirectional ring attention"
-        )
     if q.shape[2] % k.shape[2] != 0:
         raise ValueError(
             f"q heads {q.shape[2]} not a multiple of k/v heads {k.shape[2]}"
@@ -266,12 +265,13 @@ def ring_flash_attention(
             return o.astype(jnp.float32), s
 
         def back(j):
-            # chunk j ranks back: its keys start j*local_s before our
-            # queries.  Fully inside the window -> plain full kernel;
-            # straddling the band edge -> windowed kernel with the static
-            # offset
+            # chunk j ranks back (j > 0) or ahead (j < 0, bidirectional
+            # only): its keys start j*local_s before our queries — a
+            # SIGNED static offset the kernel band handles either way.
+            # Fully inside the window -> plain full kernel; straddling the
+            # band edge -> windowed kernel with the static offset
             offset = j * local_s
-            fully_visible = offset + local_s - 1 < window
+            fully_visible = abs(offset) + local_s - 1 < window
 
             def run(_):
                 o, s = flash_chunk_attention(
@@ -305,10 +305,26 @@ def ring_flash_attention(
                 pvary_missing(empty, vma_of(q)),
             )
 
-        if not causal:
-            # bidirectional: every chunk is fully visible — no diagonal, no
-            # skipping, no window (this function raises on window+non-causal
-            # above; the jnp ring does the same)
+        if not causal and window:
+            # encoder local attention: the symmetric band |q - k| < window.
+            # Chunks more than max_back ranks away IN EITHER direction miss
+            # the band entirely (their kernels are skipped); the diagonal
+            # runs the symmetric windowed kernel, offset chunks the banded
+            # kernel with a SIGNED static offset.
+            max_back = min(n_chunks - 1, -(-(window - 1) // local_s))
+            # back(0) IS the symmetric diagonal (offset 0: the banded
+            # kernel, or the plain full kernel when the whole chunk sits
+            # inside the band); out-of-band distances clip onto the shared
+            # leading skip entry
+            branches = [skip] + [
+                back(j) for j in range(-max_back, max_back + 1)
+            ]
+            j_signed = my_chunk - src_chunk
+            in_band = jnp.abs(j_signed) <= max_back
+            idx = jnp.where(in_band, j_signed + max_back + 1, 0)
+            o_c, lse_c = lax.switch(idx, branches, None)
+        elif not causal:
+            # bidirectional, no window: every chunk fully visible
             o_c, lse_c = full(None)
         elif window:
             # chunks more than max_back ranks back are fully out of window:
