@@ -1,0 +1,50 @@
+"""T5-small encoder-decoder through the Trainer CLI.
+
+The seq2seq family dispatches automatically in the Trainer (the registry
+factory returns a Seq2SeqConfig): EncoderDecoder model, teacher-forced CE
+(vocab-parallel under TP), synthetic copy-task batches by default.  The
+flash-attention + proj_attn remat defaults are the measured single-chip
+recipe (docs/08_seq2seq.md: 17.8 ms/step at batch 16 x 512/512 on v5e-1).
+"""
+
+from ml_collections import ConfigDict, config_dict
+
+from configs.common import model_overrides
+
+
+def get_config():
+    c = ConfigDict()
+    c.simulate_cpu_devices = 0
+    c.model = "t5_small"
+    c.model_overrides = model_overrides(
+        attn_impl="flash",
+        remat_policy="proj_attn",
+        scan_layers=False,
+        enc_layers=config_dict.placeholder(int),
+        src_seq_len=config_dict.placeholder(int),
+    )
+    c.mesh = ConfigDict(dict(data=-1, model=1, pipe=1, seq=1))
+    c.global_batch_size = 16
+    c.num_minibatches = 1
+    c.steps = 50
+    c.optimizer = "adamw"
+    c.objective = "seq2seq"
+    c.mlm_mask_rate = 0.15
+    c.lr_schedule = "cosine"
+    c.ema_decay = 0.0
+    c.learning_rate = 3e-4
+    c.warmup_steps = 10
+    c.weight_decay = 0.1
+    c.grad_clip = 1.0
+    c.seed = 0
+    c.log_every = 10
+    c.donate = True
+    c.checkpoint_dir = ""
+    c.checkpoint_every = 100
+    c.data_path = ""
+    c.data_format = "flat"
+    c.eos_id = 0
+    c.eval_steps = 0
+    c.eval_every = 0
+    c.keep_best = False
+    return c

@@ -92,7 +92,19 @@ def test_config_one_step(path):
     chunks = mesh.pipe * overrides.get("pipe_interleave", 1)
     if chunks > 4:
         overrides["n_layers"] = chunks
-    d["model"] = "tiny"
+    # swap in the matching tiny FAMILY (a seq2seq config must smoke the
+    # encoder-decoder dispatch, not a causal tiny)
+    from tpu_parallel.models import Seq2SeqConfig
+    from tpu_parallel.train_lib import MODEL_REGISTRY
+
+    is_s2s = isinstance(MODEL_REGISTRY[d["model"]](), Seq2SeqConfig)
+    d["model"] = "tiny_seq2seq" if is_s2s else "tiny"
+    if is_s2s:
+        # seq2seq-only shape knobs the tiny factory already sets
+        overrides.pop("enc_layers", None)
+        overrides.pop("src_seq_len", None)
+        overrides.pop("seq_len", None)
+        overrides.pop("loss_chunk", None)
     d["steps"] = 1
     d["log_every"] = 1
     d["donate"] = False

@@ -163,3 +163,39 @@ def test_ema_params_track_and_eval():
     trainer.state = state
     result = trainer.evaluate(steps=1)
     assert "loss" in result
+
+
+def test_trainer_seq2seq_family():
+    """The registry dispatches Seq2SeqConfig factories to the
+    encoder-decoder wiring: EncoderDecoder model, teacher-forced CE,
+    synthetic seq2seq batches — same Trainer surface (train + evaluate)."""
+    from tpu_parallel.runtime import MeshConfig
+
+    tr = Trainer(
+        TrainerConfig(
+            model="tiny_seq2seq",
+            mesh=MeshConfig(data=4, model=2),
+            global_batch_size=16,
+            steps=6,
+            log_every=100,
+            objective="seq2seq",
+        )
+    )
+    tr.init()
+    first = tr.evaluate(steps=1)["loss"]
+    m = tr.train()
+    assert m["loss"] < first
+    assert "tokens_per_sec" in m
+    ev = tr.evaluate(steps=2)
+    assert ev["loss"] < first
+
+
+def test_trainer_seq2seq_rejects_single_stack_objective():
+    from tpu_parallel.runtime import MeshConfig
+
+    with pytest.raises(ValueError, match="single-stack"):
+        Trainer(
+            TrainerConfig(
+                model="tiny_seq2seq", mesh=MeshConfig(data=8), objective="mlm"
+            )
+        )

@@ -1,6 +1,6 @@
 from tpu_parallel.data.loader import DataLoader, TokenDataset, make_global_batch
 from tpu_parallel.data.packed import PackedDataset
-from tpu_parallel.data.synthetic import classification_batch, lm_batch
+from tpu_parallel.data.synthetic import classification_batch, lm_batch, seq2seq_batch
 
 __all__ = [
     "DataLoader",
@@ -9,4 +9,5 @@ __all__ = [
     "make_global_batch",
     "classification_batch",
     "lm_batch",
+    "seq2seq_batch",
 ]

@@ -38,3 +38,30 @@ def lm_batch(
         loss_mask=jnp.ones((batch_size, seq_len), jnp.float32),
         positions=jnp.broadcast_to(jnp.arange(seq_len), (batch_size, seq_len)),
     )
+
+
+def seq2seq_batch(
+    rng: jax.Array,
+    batch_size: int,
+    src_len: int,
+    dst_len: int,
+    vocab_size: int,
+    bos_id: int = 1,
+):
+    """Teacher-forced seq2seq batch: random source, target = the source
+    cycled to the target length (a learnable copy task, like
+    :func:`lm_batch`'s random stream — and shape-exact for EVERY
+    ``dst_len``: a bare ``src[:, :dst_len]`` would silently clamp when
+    ``dst_len > src_len``)."""
+    from tpu_parallel.models.seq2seq import Seq2SeqBatch
+
+    src = jax.random.randint(rng, (batch_size, src_len), 2, vocab_size)
+    reps = -(-dst_len // src_len)  # ceil
+    tgt = jnp.tile(src, (1, reps))[:, :dst_len]
+    bos = jnp.full((batch_size, 1), bos_id, jnp.int32)
+    return Seq2SeqBatch(
+        src_tokens=src,
+        tokens=jnp.concatenate([bos, tgt[:, :-1]], axis=1),
+        targets=tgt,
+        src_mask=jnp.ones_like(src, bool),
+    )

@@ -20,9 +20,20 @@ from jax.sharding import PartitionSpec as P
 
 from tpu_parallel.core import compute as compute_metrics
 from tpu_parallel.core.state import TextBatch, TrainState, get_num_params
-from tpu_parallel.data import lm_batch
+from tpu_parallel.data import lm_batch, seq2seq_batch
 from tpu_parallel.models import GPTLM, GPTConfig, make_gpt_loss, make_mlm_loss
-from tpu_parallel.models import bert_base, gpt2_125m, gpt2_350m, llama_1b, tiny_test
+from tpu_parallel.models import (
+    EncoderDecoder,
+    Seq2SeqConfig,
+    bert_base,
+    gpt2_125m,
+    gpt2_350m,
+    llama_1b,
+    make_seq2seq_loss,
+    t5_small,
+    tiny_seq2seq,
+    tiny_test,
+)
 from tpu_parallel.parallel.spmd import TrainFunctions, build_train_functions
 from tpu_parallel.runtime import MeshConfig, make_mesh
 from tpu_parallel.utils.profiling import mfu
@@ -33,6 +44,10 @@ MODEL_REGISTRY: Dict[str, Callable[..., GPTConfig]] = {
     "llama_1b": llama_1b,
     "bert_base": bert_base,
     "tiny": tiny_test,
+    # encoder-decoder family: Seq2SeqConfig factories dispatch the Trainer
+    # to EncoderDecoder + make_seq2seq_loss + teacher-forced batches
+    "t5_small": t5_small,
+    "tiny_seq2seq": tiny_seq2seq,
 }
 
 
@@ -167,6 +182,10 @@ class Trainer:
         # the model's pipeline degree is dictated by the mesh
         overrides.setdefault("pipe_size", mesh_sizes.get("pipe", 1))
         self.model_config: GPTConfig = MODEL_REGISTRY[config.model](**overrides)
+        self.is_seq2seq = isinstance(self.model_config, Seq2SeqConfig)
+        if self.is_seq2seq:
+            self._init_seq2seq(config)
+            return
         if self.model_config.bidirectional and config.objective == "causal":
             # next-token CE on a bidirectional model: attention SEES the
             # target — loss collapses, numbers are meaningless.  (The
@@ -191,11 +210,6 @@ class Trainer:
         self._make_loss = make_loss
         self.loss_fn = make_loss(self.model_config)
 
-        if config.global_batch_size % mesh_sizes["data"] != 0:
-            raise ValueError(
-                f"global batch {config.global_batch_size} not divisible by "
-                f"data axis {mesh_sizes['data']}"
-            )
         self.example_batch = lm_batch(
             jax.random.PRNGKey(0),
             config.global_batch_size,
@@ -217,6 +231,19 @@ class Trainer:
                 rng=rng,
             )
 
+        self._finish_init(config, model_init)
+
+    def _finish_init(self, config: TrainerConfig, model_init) -> None:
+        """The family-independent tail of __init__: batch divisibility,
+        seq-parallel wiring, and the compiled train/eval functions.  ONE
+        copy — the GPT and seq2seq paths must not drift on the
+        build_train_functions kwargs (grad axes, check_vma, EMA...)."""
+        mesh_sizes = dict(self.mesh.shape)
+        if config.global_batch_size % mesh_sizes["data"] != 0:
+            raise ValueError(
+                f"global batch {config.global_batch_size} not divisible by "
+                f"data axis {mesh_sizes['data']}"
+            )
         # Sequence/context parallelism: a >1 ``seq`` axis shards the token
         # dimension of the batch (ring/Ulysses attention then communicates
         # K/V over it); gradients pick up a partial contribution per seq
@@ -253,6 +280,46 @@ class Trainer:
         )
         self.state: Optional[TrainState] = None
 
+    def _init_seq2seq(self, config: TrainerConfig) -> None:
+        """Encoder-decoder family wiring: same Trainer surface, different
+        model class / loss / batch shape.  Objectives other than the
+        teacher-forced seq2seq CE are refused (MLM/causal are single-stack
+        objectives)."""
+        mesh_sizes = dict(self.mesh.shape)
+        if config.objective not in ("causal", "seq2seq"):
+            # "causal" is the TrainerConfig default — treat it as "the
+            # family's native objective" rather than demanding every config
+            # spell out objective="seq2seq"
+            raise ValueError(
+                f"objective={config.objective!r} is a single-stack "
+                "objective; encoder-decoder models train teacher-forced"
+            )
+        self.model = EncoderDecoder(self.model_config)
+        self.tx = make_optimizer(config)
+        self._make_loss = make_seq2seq_loss
+        self.loss_fn = make_seq2seq_loss(self.model_config)
+        cfgm = self.model_config
+        self.example_batch = seq2seq_batch(
+            jax.random.PRNGKey(0),
+            config.global_batch_size,
+            cfgm.source_len,
+            cfgm.seq_len,
+            cfgm.vocab_size,
+        )
+
+        def model_init(rng, batch) -> TrainState:
+            variables = self.model.init(
+                {"params": rng}, batch.src_tokens, batch.tokens, train=False
+            )
+            return TrainState.create(
+                apply_fn=self.model.apply,
+                params=variables["params"],
+                tx=self.tx,
+                rng=rng,
+            )
+
+        self._finish_init(config, model_init)
+
     def init(self) -> TrainState:
         rng = jax.random.PRNGKey(self.config.seed)
         self.state = self.funcs.init_fn(rng, self.example_batch)
@@ -281,6 +348,15 @@ class Trainer:
         timed_from = 0  # throughput covers steps AFTER this one
         for step in range(1, steps + 1):
             batch = next(batch_iter) if batch_iter is not None else self.example_batch
+            if step == 1 and self.is_seq2seq and not hasattr(batch, "src_tokens"):
+                # the token-stream DataLoader yields TextBatch — refusing
+                # here beats an AttributeError deep inside the jitted step
+                raise ValueError(
+                    "seq2seq models need Seq2SeqBatch batches (src_tokens + "
+                    "teacher-forced tokens/targets); the token-stream "
+                    f"DataLoader yields {type(batch).__name__} — provide a "
+                    "paired-data iterator"
+                )
             state, metrics = self.funcs.step_fn(state, metrics, batch)
             if step == 1:
                 # steady-state timing: the first step carries compilation —
@@ -302,9 +378,15 @@ class Trainer:
                     last["tokens_per_sec"] = tokens_per_step * step / max(
                         time.perf_counter() - t_start, 1e-9
                     )
-                util = mfu(
-                    last["tokens_per_sec"] / jax.device_count(),
-                    self.model_config,
+                # mfu's FLOPs model is the decoder-only transformer; an
+                # encoder-decoder number from it would be fiction
+                util = (
+                    None
+                    if self.is_seq2seq
+                    else mfu(
+                        last["tokens_per_sec"] / jax.device_count(),
+                        self.model_config,
+                    )
                 )
                 if util is not None:  # None off-TPU (no known peak FLOPs)
                     last["mfu"] = util
@@ -532,12 +614,15 @@ class Trainer:
         cfg1 = dataclasses.replace(
             self.model_config, pipe_size=1, attn_impl="xla"
         )
-        model1 = GPTLM(cfg1)
-        shapes = jax.eval_shape(
-            lambda r: model1.init(
-                {"params": r}, jnp.zeros((1, 8), jnp.int32), train=False
-            ),
-            jax.random.PRNGKey(0),
-        )
+        toks = jnp.zeros((1, 8), jnp.int32)
+        if self.is_seq2seq:
+            # a GPTLM built from the Seq2SeqConfig would count a decoder-only
+            # twin — half the model (no encoder, no cross-attention)
+            model1 = EncoderDecoder(cfg1)
+            init1 = lambda r: model1.init({"params": r}, toks, toks, train=False)
+        else:
+            model1 = GPTLM(cfg1)
+            init1 = lambda r: model1.init({"params": r}, toks, train=False)
+        shapes = jax.eval_shape(init1, jax.random.PRNGKey(0))
         leaves = jax.tree_util.tree_leaves(shapes["params"])
         return int(sum(np.prod(l.shape) for l in leaves))
