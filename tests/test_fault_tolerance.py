@@ -173,3 +173,34 @@ def test_fit_keeps_best_checkpoint(tmp_path, devices):
     restored = best.restore(target)
     assert int(restored.step) == best.latest_step
     best.close()
+
+
+def test_fit_seq2seq_family(tmp_path):
+    """The fault-tolerant loop is family-agnostic: an encoder-decoder state
+    checkpoints and resumes through the same fit surface."""
+    from tpu_parallel.runtime import MeshConfig
+
+    def s2s_config(steps):
+        return TrainerConfig(
+            model="tiny_seq2seq",
+            mesh=MeshConfig(data=8),
+            global_batch_size=16,
+            steps=steps,
+            log_every=100,
+            objective="seq2seq",
+            donate=False,
+        )
+
+    ckpt_dir = str(tmp_path / "s2s")
+    t1 = Trainer(s2s_config(4))
+    t1.fit(ckpt_dir, checkpoint_every=2)
+    assert int(t1.state.step) == 4
+
+    t2 = Trainer(s2s_config(6))
+    t2.fit(ckpt_dir, checkpoint_every=2)
+    assert int(t2.state.step) == 6
+    p_resumed = jax.tree_util.tree_leaves(t2.state.params)[0]
+    fresh = Trainer(s2s_config(6))
+    fresh.init()
+    p_fresh = jax.tree_util.tree_leaves(fresh.state.params)[0]
+    assert not np.allclose(np.asarray(p_resumed), np.asarray(p_fresh))
