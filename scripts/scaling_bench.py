@@ -1,4 +1,7 @@
-"""Scaling-efficiency harness: DP / TP / PP / SP step time vs device count.
+"""Scaling-efficiency harness: DP / TP / PP / SP / EP step time vs devices.
+
+Usage: ``python scripts/scaling_bench.py [strategy ...]`` — no args runs
+every strategy (dp tp pp sp ep).
 
 BASELINE.json's metric is "tokens/sec/chip AND DP/TP/PP scaling efficiency"
 — this harness produces the scaling half.  For each strategy it runs the
@@ -21,6 +24,10 @@ Scaling regimes (efficiency definitions):
   attention rotates K/V around the seq axis.  Same efficiency definition
   as TP; the communication is the ring rotation, not projection
   all-reduces.
+- **EP — strong scaling of routed-expert FLOPs**: 8 fixed experts sharded
+  over the model axis (TP rides along structurally); efficiency as TP —
+  read the ep lines against tp as the incremental cost of routed dispatch
+  at equal mesh shape.
 
 Without 8 local accelerators the harness simulates 8 CPU devices — the
 numbers then measure *structural* overhead (collective count, schedule
@@ -87,6 +94,15 @@ def main():
             # rotation instead of the projection all-reduces
             mesh_cfg, batch = MeshConfig(data=1, seq=n), per_chip_batch
             overrides["attn_impl"] = "ring"
+        elif strategy == "ep":
+            # expert parallelism: FIXED 8 routed experts sharded over the
+            # model axis (each rank runs 8/n experts' FLOPs + one psum
+            # combine) — strong scaling of the expert MLP work.  The model
+            # axis also splits attention (structural TP rides along); the
+            # ep lines therefore read against tp as the incremental cost
+            # of routed dispatch at equal mesh shape.
+            mesh_cfg, batch = MeshConfig(data=1, model=n), per_chip_batch
+            overrides["moe_experts"] = 8
         else:
             raise ValueError(strategy)
         config = TrainerConfig(
@@ -124,7 +140,12 @@ def main():
         )
 
     results = []
-    for strategy in ("dp", "tp", "pp", "sp"):
+    valid = ("dp", "tp", "pp", "sp", "ep")
+    wanted = sys.argv[1:] or list(valid)
+    unknown = [w for w in wanted if w not in valid]
+    if unknown:
+        raise SystemExit(f"unknown strategies {unknown}; valid: {valid}")
+    for strategy in wanted:
         t1 = None
         for n in (1, 2, 4, 8):
             r = run(strategy, n)
