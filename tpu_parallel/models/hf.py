@@ -408,6 +408,96 @@ def from_hf_llama(hf_model_or_dict, config, dtype=jnp.float32) -> Pytree:
     return params
 
 
+def to_hf_llama(params: Pytree, config) -> Dict[str, np.ndarray]:
+    """This framework's (unrolled, mesh-free) Llama params -> an HF Llama
+    state dict (``model.``-prefixed keys plus ``lm_head.weight``) loadable
+    with ``LlamaForCausalLM.load_state_dict`` — the inverse of
+    :func:`from_hf_llama` (interleaved -> rotate_half RoPE permutation,
+    per-head de-fusion, kernel transposes).
+
+    Llama has NO attention biases; this model's projections carry them
+    (imported as zeros) unless ``dense_bias=False``.  If fine-tuning moved
+    them materially off zero the export would silently change the function
+    — refuse instead; absent biases (dense_bias=False) export cleanly.
+    """
+    if (
+        config.positional != "rope"
+        or config.mlp != "swiglu"
+        or config.norm != "rmsnorm"
+    ):
+        # a learned-positional/layernorm model would export silently wrong
+        # (position table dropped, norm biases dropped) — same guard as
+        # from_hf_llama
+        raise ValueError(
+            "Llama interop needs positional='rope', mlp='swiglu', "
+            "norm='rmsnorm'"
+        )
+    d = config.d_model
+    h = config.n_heads
+    kv = config.n_kv_heads or config.n_heads
+    dh = config.head_dim
+    perm = _rope_perm(dh)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(dh)
+    g = lambda *path: np.asarray(_dig(params, path), np.float32)
+
+    def check_zero_bias(tree, path, name):
+        try:
+            b = np.asarray(_dig(tree, path))
+        except (KeyError, TypeError):
+            return  # dense_bias=False: no bias param — nothing to drop
+        if np.abs(b).max() > 1e-6:
+            raise ValueError(
+                f"{name} bias is nonzero (max |b| = {np.abs(b).max():.2e}); "
+                "Llama has no bias slots — exporting would drop it. Zero "
+                "the biases (or retrain without them: dense_bias=False)"
+            )
+
+    sd: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": g("embed", "tok", "embedding"),
+        "model.norm.weight": g("norm_final", "scale"),
+        "lm_head.weight": g("lm_head", "shard", "kernel").T,
+    }
+    for i in range(config.n_layers):
+        ours = params["blocks"][f"layer_{i}"]
+        gl = lambda *path: np.asarray(_dig(ours, path), np.float32)
+        p = f"model.layers.{i}"
+        sd[f"{p}.input_layernorm.weight"] = gl("norm_attn", "scale")
+        sd[f"{p}.post_attention_layernorm.weight"] = gl("norm_mlp", "scale")
+        attn = ours["attn"]
+        if kv == h:
+            check_zero_bias(attn, ("qkv", "shard", "bias"), f"layer {i} qkv")
+            qkv = gl("attn", "qkv", "shard", "kernel").reshape(d, h, 3 * dh)
+            q, k, v = qkv[..., :dh], qkv[..., dh : 2 * dh], qkv[..., 2 * dh :]
+        else:
+            check_zero_bias(attn, ("q", "shard", "bias"), f"layer {i} q")
+            check_zero_bias(attn, ("kv", "shard", "bias"), f"layer {i} kv")
+            q = gl("attn", "q", "shard", "kernel").reshape(d, h, dh)
+            kvw = gl("attn", "kv", "shard", "kernel").reshape(d, kv, 2 * dh)
+            k, v = kvw[..., :dh], kvw[..., dh:]
+        check_zero_bias(attn, ("out", "bias"), f"layer {i} out")
+        # undo the interleaved RoPE permutation for q and k (v untouched)
+        sd[f"{p}.self_attn.q_proj.weight"] = (
+            q[:, :, inv].reshape(d, h * dh).T
+        )
+        sd[f"{p}.self_attn.k_proj.weight"] = (
+            k[:, :, inv].reshape(d, kv * dh).T
+        )
+        sd[f"{p}.self_attn.v_proj.weight"] = v.reshape(d, kv * dh).T
+        sd[f"{p}.self_attn.o_proj.weight"] = gl(
+            "attn", "out", "shard", "kernel"
+        ).T
+        for hf_name, ours_name in (
+            ("gate_proj", "gate"),
+            ("up_proj", "up"),
+            ("down_proj", "down"),
+        ):
+            sd[f"{p}.mlp.{hf_name}.weight"] = gl(
+                "mlp", ours_name, "shard", "kernel"
+            ).T
+    return sd
+
+
 def from_hf_bert(hf_model_or_dict, config, dtype=jnp.float32):
     """HF BERT trunk weights -> ``(params, pooler)`` for the encoder family.
 
