@@ -670,6 +670,100 @@ def from_hf_bert(hf_model_or_dict, config, dtype=jnp.float32):
     return params, pooler
 
 
+def to_hf_bert(
+    params: Pytree,
+    config,
+    pooler: Optional[Dict[str, Any]] = None,
+    n_positions: Optional[int] = None,
+    type_vocab_size: int = 2,
+) -> Dict[str, np.ndarray]:
+    """This framework's post-norm BERT params -> an HF ``BertModel`` state
+    dict — the inverse of :func:`from_hf_bert`.
+
+    The import folded token-type row 0 into the position table (exact for
+    single-segment inputs); the fold cannot be split back, so the export
+    writes the COMPOSITE table as ``position_embeddings`` and ZEROS for
+    ``token_type_embeddings`` — the exported model computes the identical
+    function for ``token_type_ids == 0``, which is the only regime the
+    import supported in the first place.  ``pooler`` (the dict
+    :func:`from_hf_bert` returned, or EncoderClassifier's pooler params)
+    exports ``pooler.dense``; omit it for a pooler-free dict.
+    """
+    if config.prenorm or not config.embed_norm:
+        raise ValueError(
+            "BERT interop needs the post-norm variant: prenorm=False, "
+            "embed_norm=True (see bert_base_hf)"
+        )
+    if (
+        config.positional != "learned"
+        or config.mlp != "gelu_exact"
+        or config.norm != "layernorm"
+        or not config.bidirectional
+        or (config.n_kv_heads or config.n_heads) != config.n_heads
+    ):
+        # same guard as from_hf_bert: a tanh-gelu / causal / GQA model
+        # would export silently wrong (drifted or dropped weights)
+        raise ValueError(
+            "BERT interop needs positional='learned', mlp='gelu_exact', "
+            "norm='layernorm', bidirectional=True, no GQA"
+        )
+    h = config.n_heads
+    g = lambda *path: np.asarray(_dig(params, path), np.float32)
+    pos = g("embed", "pos", "embedding")
+    if n_positions is not None:
+        if n_positions < pos.shape[0]:
+            raise ValueError(
+                f"n_positions={n_positions} < trained position table "
+                f"{pos.shape[0]} — refusing to silently truncate"
+            )
+        if n_positions > pos.shape[0]:
+            # the import sliced a longer table (seq_len < n_positions):
+            # zero-pad back out so torch accepts the dict (the discarded
+            # rows are gone; they export as zeros, like to_hf_gpt2)
+            pos = np.concatenate(
+                [pos, np.zeros((n_positions - pos.shape[0], pos.shape[1]),
+                               np.float32)]
+            )
+    sd: Dict[str, np.ndarray] = {
+        "embeddings.word_embeddings.weight": g("embed", "tok", "embedding"),
+        "embeddings.position_embeddings.weight": pos,
+        "embeddings.token_type_embeddings.weight": np.zeros(
+            (type_vocab_size, config.d_model), np.float32
+        ),
+        "embeddings.LayerNorm.weight": g("embed", "norm", "scale"),
+        "embeddings.LayerNorm.bias": g("embed", "norm", "bias"),
+    }
+    for i in range(config.n_layers):
+        b = ("blocks", f"layer_{i}")
+        p = f"encoder.layer.{i}"
+        qkv_w = _qkv_to_hf(g(*b, "attn", "qkv", "shard", "kernel"), h)
+        qkv_b = _qkv_to_hf(g(*b, "attn", "qkv", "shard", "bias"), h)
+        d = config.d_model
+        for j, name in enumerate(("query", "key", "value")):
+            sd[f"{p}.attention.self.{name}.weight"] = qkv_w[
+                :, j * d : (j + 1) * d
+            ].T
+            sd[f"{p}.attention.self.{name}.bias"] = qkv_b[j * d : (j + 1) * d]
+        sd[f"{p}.attention.output.dense.weight"] = g(
+            *b, "attn", "out", "shard", "kernel"
+        ).T
+        sd[f"{p}.attention.output.dense.bias"] = g(*b, "attn", "out", "bias")
+        sd[f"{p}.attention.output.LayerNorm.weight"] = g(*b, "norm_attn", "scale")
+        sd[f"{p}.attention.output.LayerNorm.bias"] = g(*b, "norm_attn", "bias")
+        sd[f"{p}.intermediate.dense.weight"] = g(
+            *b, "mlp", "up", "shard", "kernel"
+        ).T
+        sd[f"{p}.intermediate.dense.bias"] = g(*b, "mlp", "up", "shard", "bias")
+        sd[f"{p}.output.dense.weight"] = g(*b, "mlp", "down", "shard", "kernel").T
+        sd[f"{p}.output.dense.bias"] = g(*b, "mlp", "down", "bias")
+        sd[f"{p}.output.LayerNorm.weight"] = g(*b, "norm_mlp", "scale")
+        sd[f"{p}.output.LayerNorm.bias"] = g(*b, "norm_mlp", "bias")
+    if pooler is not None:
+        sd["pooler.dense.weight"] = np.asarray(pooler["kernel"], np.float32).T
+        sd["pooler.dense.bias"] = np.asarray(pooler["bias"], np.float32)
+    return sd
+
+
 def from_hf_t5(hf_model_or_dict, config, dtype=jnp.float32) -> Pytree:
     """HF T5 weights -> :class:`~tpu_parallel.models.seq2seq.EncoderDecoder`
     params (unrolled, mesh-free layout).
