@@ -935,3 +935,122 @@ def from_hf_t5(hf_model_or_dict, config, dtype=jnp.float32) -> Pytree:
             "mlp": mlp(f"{p}.layer.2.DenseReluDense"),
         }
     return params
+
+
+def to_hf_t5(params: Pytree, config) -> Dict[str, np.ndarray]:
+    """This framework's T5-faithful seq2seq params -> an HF T5 state dict —
+    the inverse of :func:`from_hf_t5`.
+
+    Undoes the two forward-pass folds: the ``sqrt(head_dim)`` scale comes
+    OFF the q kernels (T5 attention is unscaled), and the lm_head exports
+    UNTIED (``lm_head.weight`` present, no ``d**-0.5`` rescale to strip —
+    dividing it back out reconstructs T5's tied forward exactly, and
+    untied checkpoints load it verbatim; pass the result to a model with
+    ``tie_word_embeddings=False``, or compare against a tied model with
+    the shared embedding).  Emits the mapping HF's
+    ``T5ForConditionalGeneration`` loads: ``shared`` + per-stack
+    ``relative_attention_bias`` on block 0 + self/cross attention blocks.
+    """
+    if config.positional != "relative" or config.norm != "rmsnorm":
+        raise ValueError(
+            "T5 interop needs positional='relative', norm='rmsnorm' "
+            "(see t5_small_hf)"
+        )
+    if config.dense_bias or config.mlp not in ("relu", "geglu"):
+        raise ValueError(
+            "T5 interop needs dense_bias=False and mlp='relu' or 'geglu'"
+        )
+    if (config.n_kv_heads or config.n_heads) != config.n_heads:
+        raise ValueError("T5 has no GQA: n_kv_heads must be None/n_heads")
+    if config.scan_layers:
+        raise ValueError(
+            "to_hf_t5 reads the unrolled layout; build the config with "
+            "scan_layers=False"
+        )
+    d = config.d_model
+    h = config.n_heads
+    dh = config.head_dim
+    qscale = np.float32(1.0 / np.sqrt(dh))
+    g = lambda *path: np.asarray(_dig(params, path), np.float32)
+
+    shared = g("embed", "tok", "embedding")
+    sd: Dict[str, np.ndarray] = {
+        "shared.weight": shared,
+        # T5 stores the per-stack embed_tokens as (tied) aliases of shared
+        "encoder.embed_tokens.weight": shared,
+        "decoder.embed_tokens.weight": shared,
+        "encoder.block.0.layer.0.SelfAttention.relative_attention_bias"
+        ".weight": g("enc_rel_bias", "rel_embedding"),
+        "decoder.block.0.layer.0.SelfAttention.relative_attention_bias"
+        ".weight": g("dec_rel_bias", "rel_embedding"),
+        "encoder.final_layer_norm.weight": g("enc_norm", "scale"),
+        "decoder.final_layer_norm.weight": g("dec_norm", "scale"),
+        # exported untied: T5's tied forward rescales by d**-0.5 at run
+        # time, which the import folded INTO this kernel — dividing it out
+        # here would only be correct for tied checkpoints, so emit the
+        # kernel as-is and load with tie_word_embeddings=False
+        "lm_head.weight": g("lm_head", "shard", "kernel").T,
+    }
+
+    def sub(tree):
+        return lambda *path: np.asarray(_dig(tree, path), np.float32)
+
+    def split_self(attn):
+        qkv = _qkv_to_hf(sub(attn)("qkv", "shard", "kernel"), h)
+        q, k, v = (qkv[:, j * d : (j + 1) * d] for j in range(3))
+        return (q * qscale).T, k.T, v.T
+
+    def split_cross(attn):
+        q = sub(attn)("q", "shard", "kernel")
+        kvw = sub(attn)("kv", "shard", "kernel").reshape(d, h, 2 * dh)
+        k = kvw[..., :dh].reshape(d, h * dh)
+        v = kvw[..., dh:].reshape(d, h * dh)
+        return (q * qscale).T, k.T, v.T
+
+    def mlp_keys(ours, p):
+        gm = sub(ours)
+        out = {}
+        if config.mlp == "geglu":
+            out[f"{p}.wi_0.weight"] = gm("gate", "shard", "kernel").T
+            out[f"{p}.wi_1.weight"] = gm("up", "shard", "kernel").T
+        else:
+            out[f"{p}.wi.weight"] = gm("up", "shard", "kernel").T
+        out[f"{p}.wo.weight"] = gm("down", "shard", "kernel").T
+        return out
+
+    for i in range(config.encoder_layers):
+        ours = params["encoder"][f"layer_{i}"]
+        p = f"encoder.block.{i}"
+        q, k, v = split_self(ours["attn"])
+        sd[f"{p}.layer.0.SelfAttention.q.weight"] = q
+        sd[f"{p}.layer.0.SelfAttention.k.weight"] = k
+        sd[f"{p}.layer.0.SelfAttention.v.weight"] = v
+        go = sub(ours)
+        sd[f"{p}.layer.0.SelfAttention.o.weight"] = go(
+            "attn", "out", "shard", "kernel"
+        ).T
+        sd[f"{p}.layer.0.layer_norm.weight"] = go("norm_attn", "scale")
+        sd[f"{p}.layer.1.layer_norm.weight"] = go("norm_mlp", "scale")
+        sd.update(mlp_keys(ours["mlp"], f"{p}.layer.1.DenseReluDense"))
+    for i in range(config.n_layers):
+        ours = params["decoder"][f"layer_{i}"]
+        p = f"decoder.block.{i}"
+        q, k, v = split_self(ours["self_attn"])
+        sd[f"{p}.layer.0.SelfAttention.q.weight"] = q
+        sd[f"{p}.layer.0.SelfAttention.k.weight"] = k
+        sd[f"{p}.layer.0.SelfAttention.v.weight"] = v
+        go = sub(ours)
+        sd[f"{p}.layer.0.SelfAttention.o.weight"] = go(
+            "self_attn", "out", "shard", "kernel"
+        ).T
+        cq, ck, cv = split_cross(ours["cross_attn"])
+        sd[f"{p}.layer.1.EncDecAttention.q.weight"] = cq
+        sd[f"{p}.layer.1.EncDecAttention.k.weight"] = ck
+        sd[f"{p}.layer.1.EncDecAttention.v.weight"] = cv
+        sd[f"{p}.layer.1.EncDecAttention.o.weight"] = go(
+            "cross_attn", "out", "shard", "kernel"
+        ).T
+        for j, name in ((0, "norm_self"), (1, "norm_cross"), (2, "norm_mlp")):
+            sd[f"{p}.layer.{j}.layer_norm.weight"] = go(name, "scale")
+        sd.update(mlp_keys(ours["mlp"], f"{p}.layer.2.DenseReluDense"))
+    return sd
