@@ -603,3 +603,44 @@ def test_sp_decode_refusal():
             ),
             src, dst,
         )
+
+
+def test_beam_search_beats_or_matches_greedy(tiny_model):
+    """Beam=1 equals greedy exactly; beam=4's sequence log-probability is
+    scored exactly (the returned score equals the teacher-forced
+    log-probability of the returned tokens — pinning the per-step cache
+    reorder that routes each beam to its own self K/V rows)."""
+    from tpu_parallel.models.seq2seq import seq2seq_generate_beam
+
+    model, variables, src, _ = tiny_model
+    params = variables["params"]
+    greedy = seq2seq_generate(
+        model, params, src, max_new_tokens=6, bos_id=1
+    )
+    beam1, s1 = seq2seq_generate_beam(
+        model, params, src, bos_id=1, max_new_tokens=6, num_beams=1
+    )
+    np.testing.assert_array_equal(np.asarray(beam1), np.asarray(greedy))
+
+    def seq_logp(tokens):
+        forced = jnp.concatenate(
+            [jnp.full((tokens.shape[0], 1), 1, jnp.int32), tokens[:, :-1]], 1
+        )
+        logits = model.apply(variables, src, forced, train=False).astype(
+            jnp.float32
+        )
+        lp = jax.nn.log_softmax(logits)
+        return jnp.take_along_axis(lp, tokens[..., None], -1)[..., 0].sum(-1)
+
+    beam4, s4 = seq2seq_generate_beam(
+        model, params, src, bos_id=1, max_new_tokens=6, num_beams=4
+    )
+    np.testing.assert_allclose(
+        np.asarray(s1), np.asarray(seq_logp(beam1)), rtol=1e-4, atol=1e-4
+    )
+    # the returned score must be the TRUE teacher-forced log-probability of
+    # the returned beam-4 tokens — this pins the non-trivial cache reorder
+    # (a row routed to the wrong beam's K/V would break the equality)
+    np.testing.assert_allclose(
+        np.asarray(s4), np.asarray(seq_logp(beam4)), rtol=1e-4, atol=1e-4
+    )

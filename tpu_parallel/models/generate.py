@@ -398,6 +398,64 @@ def _sharded_generate_fn(
 # --- beam search --------------------------------------------------------------
 
 
+def beam_cache_batch_axis(path, x):
+    """Batch axis of a KV-cache leaf, by name — ONE registry for every
+    family's beam search (a new cache leaf added here reorders correctly
+    in both).  K/V payloads (self and cross) carry batch at ndim-4; the
+    per-slot position table and the cross padding mask at ndim-2; scalar
+    counters return None (pass through)."""
+    name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+    if name.startswith(
+        ("cached_key", "cached_value", "cross_key", "cross_value")
+    ):
+        return x.ndim - 4
+    if name.startswith(("cached_pos", "cross_mask")):
+        return x.ndim - 2
+    return None
+
+
+def beam_expand_cache(cache, k):
+    """Replicate every batch row ``k`` ways (beam j of row i = row i*k+j)."""
+
+    def expand(path, x):
+        ax = beam_cache_batch_axis(path, x)
+        return x if ax is None else jnp.repeat(x, k, axis=ax)
+
+    return jax.tree_util.tree_map_with_path(expand, cache)
+
+
+def beam_reorder_cache(cache, row_idx, skip_prefixes=()):
+    """Gather cache rows to follow their winning beams.  ``skip_prefixes``
+    names beam-INVARIANT leaves (e.g. the cross-attention memory caches,
+    identical across a row's beams by construction) whose per-step gather
+    would be a provable no-op — skipping saves the HBM traffic."""
+
+    def reorder(path, x):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name.startswith(tuple(skip_prefixes)):
+            return x
+        ax = beam_cache_batch_axis(path, x)
+        return x if ax is None else jnp.take(x, row_idx, axis=ax)
+
+    return jax.tree_util.tree_map_with_path(reorder, cache)
+
+
+def beam_backtrack(first, toks, src_beams, scores):
+    """Follow each row's best final beam back through the per-step
+    (token, source-beam) records; returns [batch, T] token ids."""
+    def backtrack(carry, xs):
+        beam = carry
+        step_toks, step_src = xs
+        tok_here = jnp.take_along_axis(step_toks, beam[:, None], axis=1)[:, 0]
+        beam = jnp.take_along_axis(step_src, beam[:, None], axis=1)[:, 0]
+        return beam, tok_here
+
+    best = jnp.argmax(scores, axis=-1)
+    beam0, rev_toks = lax.scan(backtrack, best, (toks[::-1], src_beams[::-1]))
+    first_tok = jnp.take_along_axis(first, beam0[:, None], axis=1)[:, 0]
+    return jnp.concatenate([first_tok[:, None], rev_toks[::-1].T], axis=1)
+
+
 @functools.partial(
     jax.jit, static_argnums=(0,),
     static_argnames=("max_new_tokens", "num_beams", "length_penalty"),
@@ -447,16 +505,7 @@ def generate_beam(
         mutable=["cache"],
     )
 
-    def expand(path, x):
-        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
-        if name.startswith(("cached_key", "cached_value")):
-            return jnp.repeat(x, k, axis=x.ndim - 4)
-        if name.startswith("cached_pos"):
-            # per-slot position table: [..., rows, S] with batch at ndim-2
-            return jnp.repeat(x, k, axis=x.ndim - 2)
-        return x
-
-    cache0 = jax.tree_util.tree_map_with_path(expand, variables["cache"])
+    cache0 = beam_expand_cache(variables["cache"], k)
     first_logp = jax.nn.log_softmax(logits[:, -1].astype(jnp.float32))  # [b, V]
     scores, first = jax.lax.top_k(first_logp, k)  # [b, k] each
     tok = first.reshape(b * k).astype(jnp.int32)
@@ -477,22 +526,10 @@ def generate_beam(
         new_scores, flat_idx = jax.lax.top_k(joint.reshape(b, k * vocab), k)
         src_beam = flat_idx // vocab  # [b, k] originating beam per winner
         next_tok = (flat_idx % vocab).astype(jnp.int32)
-        # reorder cache rows + emit bookkeeping to follow winning beams.
-        # K/V payloads (and their int8 scales) are [..., rows, S, kv, dh]-
-        # shaped with the batch axis at ndim-4 — a leading layer axis when
-        # the model scans its layers; the cache_index counter carries no
-        # batch dim and passes through.
+        # reorder cache rows to follow winning beams (shared helper: K/V
+        # payloads + the position table; scalar counters pass through)
         row_idx = (src_beam + jnp.arange(b)[:, None] * k).reshape(b * k)
-
-        def reorder(path, x):
-            name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
-            if name.startswith(("cached_key", "cached_value")):
-                return jnp.take(x, row_idx, axis=x.ndim - 4)
-            if name.startswith("cached_pos"):
-                return jnp.take(x, row_idx, axis=x.ndim - 2)
-            return x
-
-        cache = jax.tree_util.tree_map_with_path(reorder, updated["cache"])
+        cache = beam_reorder_cache(updated["cache"], row_idx)
         return (
             (cache, next_tok.reshape(b * k), new_scores, pos + 1),
             (next_tok, src_beam),
@@ -503,21 +540,9 @@ def generate_beam(
         step, init, None, length=max_new_tokens - 1
     )
 
-    # backtrack: follow each final beam to its token at every step.
-    # toks/src_beams: [T-1, b, k]; the first token table is `first` [b, k].
-    def backtrack(carry, xs):
-        beam = carry  # [b] current beam index per row
-        step_toks, step_src = xs  # [b, k] each
-        tok_here = jnp.take_along_axis(step_toks, beam[:, None], axis=1)[:, 0]
-        beam = jnp.take_along_axis(step_src, beam[:, None], axis=1)[:, 0]
-        return beam, tok_here
-
-    best = jnp.argmax(scores, axis=-1)  # [b] winning beam at the end
-    beam0, rev_toks = lax.scan(
-        backtrack, best, (toks[::-1], src_beams[::-1])
-    )
-    first_tok = jnp.take_along_axis(first, beam0[:, None], axis=1)[:, 0]
-    out = jnp.concatenate([first_tok[:, None], rev_toks[::-1].T], axis=1)
+    # backtrack: follow each final beam to its token at every step
+    # (toks/src_beams: [T-1, b, k]; the first token table is `first` [b, k])
+    out = beam_backtrack(first, toks, src_beams, scores)
     best_scores = jnp.max(scores, axis=-1)
     if length_penalty:
         total_len = jnp.float32(max_new_tokens)

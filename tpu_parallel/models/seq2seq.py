@@ -984,3 +984,107 @@ def tiny_seq2seq(**overrides) -> Seq2SeqConfig:
             **overrides,
         }
     )
+
+
+@functools.partial(
+    jax.jit, static_argnums=(0,),
+    static_argnames=("bos_id", "max_new_tokens", "num_beams", "length_penalty"),
+)
+def seq2seq_generate_beam(
+    model: EncoderDecoder,
+    params,
+    src: jax.Array,
+    src_mask: Optional[jax.Array] = None,
+    *,
+    bos_id: int = 0,
+    max_new_tokens: int = 32,
+    num_beams: int = 4,
+    length_penalty: float = 0.0,
+):
+    """Beam-search decoding for the encoder-decoder family.
+
+    Returns ``(tokens [batch, max_new_tokens], scores [batch])`` — the
+    highest-scoring continuation per source row, scores = total
+    log-probability / ``len**length_penalty``.  Same mechanics as the LM
+    :func:`~tpu_parallel.models.generate.generate_beam`: encode + prefill
+    ONCE per source row, replicate the caches ``num_beams`` ways (beams
+    are identical until the first expansion), then per step take the top
+    beams of the joint continuations and reorder every cache row — self
+    K/V, the per-slot position table, AND the cross-attention memory
+    cache — to follow its winning beam.  Fixed-length decoding (no EOS
+    early exit), single-device params layout.
+    """
+    cfg = model.config
+    b = src.shape[0]
+    if max_new_tokens > cfg.seq_len:
+        raise ValueError(
+            f"max_new_tokens ({max_new_tokens}) exceeds decoder seq_len "
+            f"({cfg.seq_len})"
+        )
+    if src.shape[1] > cfg.source_len:
+        raise ValueError(
+            f"source length ({src.shape[1]}) exceeds the encoder's "
+            f"source_len ({cfg.source_len})"
+        )
+    k = num_beams
+    vocab = cfg.vocab_size
+    memory = model.apply(
+        {"params": params}, src, src_mask, False, method=model.encode
+    )
+    head = _make_lm_head(cfg, name=None, gather=False, fsdp_wrap=False)
+    lm_params = _lm_head_params(cfg, params)
+    logp_of = lambda h: jax.nn.log_softmax(
+        head.apply({"params": lm_params}, h[:, -1:])[:, 0].astype(jnp.float32)
+    )
+
+    from tpu_parallel.models.generate import (
+        beam_backtrack,
+        beam_expand_cache,
+        beam_reorder_cache,
+    )
+
+    bos = jnp.full((b, 1), bos_id, jnp.int32)
+    hidden, variables = model.apply(
+        {"params": params}, bos, memory, src_mask, None, False, True, True,
+        method=model.decode, mutable=["cache"],
+    )
+    cache0 = beam_expand_cache(variables["cache"], k)
+    scores, first = jax.lax.top_k(logp_of(hidden), k)  # [b, k] each
+    tok = first.reshape(b * k).astype(jnp.int32)
+
+    def step(carry, _):
+        cache, tok, scores = carry
+        hidden, updated = model.apply(
+            {"params": params, "cache": cache},
+            tok[:, None], None, None, None, False, True, True,
+            method=model.decode, mutable=["cache"],
+        )
+        joint = scores[:, :, None] + logp_of(hidden).reshape(b, k, vocab)
+        new_scores, flat_idx = jax.lax.top_k(joint.reshape(b, k * vocab), k)
+        src_beam = flat_idx // vocab
+        next_tok = (flat_idx % vocab).astype(jnp.int32)
+        row_idx = (src_beam + jnp.arange(b)[:, None] * k).reshape(b * k)
+        # cross caches are beam-INVARIANT (written once at prefill; every
+        # beam of a row holds identical copies) — skip their per-step
+        # gather, it would move n_layers full source caches for a no-op
+        cache = beam_reorder_cache(
+            updated["cache"], row_idx,
+            skip_prefixes=("cross_key", "cross_value", "cross_mask"),
+        )
+        return (
+            (cache, next_tok.reshape(b * k), new_scores),
+            (next_tok, src_beam),
+        )
+
+    init = (cache0, tok, scores)
+    (_, _, scores), (toks, src_beams) = lax.scan(
+        step, init, None, length=max_new_tokens - 1
+    )
+
+    out = beam_backtrack(first, toks, src_beams, scores)
+    best_scores = jnp.max(scores, axis=-1)
+    if length_penalty:
+        best_scores = best_scores / (
+            jnp.float32(max_new_tokens) ** length_penalty
+        )
+    return out.astype(jnp.int32), best_scores
