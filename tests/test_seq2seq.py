@@ -356,8 +356,9 @@ def test_seq2seq_sp_training(impl):
     assert compute(m)["loss"] < first
 
 
-def test_seq2seq_sp_matches_dense():
-    """The SP forward computes the SAME function: on one mesh, the ring
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_seq2seq_sp_matches_dense(impl):
+    """The SP forward computes the SAME function: on one mesh, the SP
     model's global-mean loss over the seq-SHARDED batch equals the xla
     model's over the seq-REPLICATED batch — identical params (the mesh
     layout is shared; only the attention impl and batch sharding differ)."""
@@ -366,7 +367,7 @@ def test_seq2seq_sp_matches_dense():
     from tpu_parallel.runtime import MeshConfig, make_mesh
 
     mesh = make_mesh(MeshConfig(data=2, seq=4))
-    cfg_r = tiny_seq2seq(attn_impl="ring", seq_len=64, src_seq_len=64)
+    cfg_r = tiny_seq2seq(attn_impl=impl, seq_len=64, src_seq_len=64)
     cfg_d = tiny_seq2seq(attn_impl="xla", seq_len=64, src_seq_len=64)
     batch = _s2s_batch(jax.random.PRNGKey(0), 2, cfg_r, length=64)
     model_r = EncoderDecoder(cfg_r)
