@@ -162,6 +162,9 @@ _SLOW_TESTS = {
     "test_from_hf_logits_match",
     "test_from_hf_llama_logits_match",
     "test_from_hf_t5_logits_match",
+    "test_from_hf_rejects_structural_mismatch",
+    "test_to_hf_t5_roundtrip_loads_into_torch",
+    "test_gpt_fsdp_chunked_loss_matches_unchunked",
     "test_optimizer_families_train",
     "test_window_decode_matches_train_forward",
     "test_roundtrip_exact",
