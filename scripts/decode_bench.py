@@ -6,6 +6,10 @@ last-position lm_head, int8-cache variant).
 
 Usage: python scripts/decode_bench.py [batch,prompt,new[,kv_cache_dtype]] ...
 Defaults exercise batch 8/32 at prompt 512, 128 new tokens, bf16 + int8 cache.
+
+Beam mode: python scripts/decode_bench.py beam [batch prompt new num_beams]
+— times lazy vs eager beam search against the aligned-greedy floor at the
+same effective rows (defaults 2 x 512 + 128, 4 beams).
 """
 
 import json
@@ -73,7 +77,63 @@ def run_one(batch, prompt_len, new_tokens, kv_dtype="bf16"):
     )
 
 
+def run_beam(batch=2, prompt_len=512, new_tokens=128, num_beams=4):
+    """Lazy vs eager beam search vs the aligned-greedy floor at the same
+    effective rows (batch * num_beams) — one JSON line per variant."""
+    from tpu_parallel.models import GPTLM, gpt2_125m, tiny_test
+    from tpu_parallel.models.generate import generate, generate_beam
+
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = (
+        gpt2_125m(dropout_rate=0.0, remat=False, scan_layers=True)
+        if on_tpu
+        else tiny_test()
+    )
+    model = GPTLM(cfg)
+    new_tokens = min(new_tokens, cfg.seq_len // 2)
+    prompt_len = max(1, min(prompt_len, cfg.seq_len - new_tokens))
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(0), (batch, prompt_len), 0, cfg.vocab_size
+    )
+    params = model.init({"params": jax.random.PRNGKey(1)}, prompt, train=False)[
+        "params"
+    ]
+    rows = batch * num_beams
+    flat_prompt = jnp.repeat(prompt, num_beams, axis=0)
+
+    def timed(fn, reps=3):
+        out = fn()
+        jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[-1])
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn()
+        jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[-1])
+        return (time.perf_counter() - t0) / reps
+
+    dt_greedy = timed(
+        lambda: generate(model, params, flat_prompt, max_new_tokens=new_tokens)
+    )
+    results = dict(greedy_rows_ms=round(dt_greedy * 1000, 1))
+    for name, lazy in (("lazy", True), ("eager", False)):
+        dt = timed(
+            lambda lazy=lazy: generate_beam(
+                model, params, prompt, max_new_tokens=new_tokens,
+                num_beams=num_beams, lazy=lazy,
+            )
+        )
+        results[f"beam_{name}_ms"] = round(dt * 1000, 1)
+        results[f"beam_{name}_vs_greedy_per_row"] = round(dt / dt_greedy, 3)
+    results.update(
+        batch=batch, num_beams=num_beams, rows=rows, prompt=prompt_len,
+        new_tokens=new_tokens, model="gpt2_125m" if on_tpu else "tiny",
+    )
+    print(json.dumps(results), flush=True)
+
+
 def main():
+    if len(sys.argv) > 1 and sys.argv[1] == "beam":
+        run_beam(*(int(a) for a in sys.argv[2:]))
+        return
     combos = []
     for arg in sys.argv[1:]:
         parts = arg.split(",")

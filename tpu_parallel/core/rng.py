@@ -23,7 +23,10 @@ def fold_rng_over_axis(rng: jax.Array, axis_names: Union[str, Sequence[str]]) ->
     Unbound axes are skipped — the same degrade-gracefully contract as the
     structural-TP layers: a loss/model built for a mesh runs under plain
     ``jit`` (single device, no shard_map) with every fold a no-op, instead
-    of dying in ``axis_index``.
+    of dying in ``axis_index``.  The skip is deliberately permissive (ANY
+    unbound name, so renamed config axes keep working mesh-free); typo'd
+    axis names are caught where config meets mesh instead — the Trainer
+    validates every config axis against the mesh's axis names at init.
     """
     if isinstance(axis_names, str):
         axis_names = (axis_names,)

@@ -424,6 +424,50 @@ def beam_expand_cache(cache, k):
     return jax.tree_util.tree_map_with_path(expand, cache)
 
 
+def beam_seed_src(cache, num_beams):
+    """Insert an identity ``beam_src`` table beside every self-attention
+    cache (lazy beam search): ``beam_src[row, slot]`` names the row whose
+    cache physically holds that slot of this row's beam history.  Identity
+    is correct post-prefill — every beam of a prompt holds identical
+    replicated prefill slots.  Seeding happens HERE (not lazily inside the
+    layer) so the decode scan's carry structure is fixed from step one."""
+
+    def walk(d):
+        if not isinstance(d, dict):
+            return d
+        out = {key: walk(val) for key, val in d.items()}
+        if "cached_key" in d:
+            ck = d["cached_key"]
+            batch_ax = ck.ndim - 4  # stacked layer dims (nn.scan) lead
+            rows, cache_len = ck.shape[batch_ax], ck.shape[batch_ax + 1]
+            ident = jnp.arange(rows, dtype=jnp.int32)[:, None] + jnp.zeros(
+                (rows, cache_len), jnp.int32
+            )
+            out["beam_src"] = jnp.broadcast_to(
+                ident, (*ck.shape[:batch_ax], rows, cache_len)
+            ) + jnp.zeros((), jnp.int32)
+        return out
+
+    return walk(cache)
+
+
+def beam_advance_src(cache, row_idx):
+    """Lazy-beam step update: row-gather every ``beam_src`` table by the
+    winning beams' parent rows (``new[r'] = old[parent(r')]``).  The K/V
+    payloads are NOT touched — that is the point: the eager alternative
+    (:func:`beam_reorder_cache`) moves every layer's full cache every step.
+    The slot written this step already maps to the writing row (the layer
+    maintains that invariant), so the gather alone keeps the table exact."""
+
+    def advance(path, x):
+        name = path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+        if name == "beam_src":
+            return jnp.take(x, row_idx, axis=x.ndim - 2)
+        return x
+
+    return jax.tree_util.tree_map_with_path(advance, cache)
+
+
 def beam_reorder_cache(cache, row_idx, skip_prefixes=()):
     """Gather cache rows to follow their winning beams.  ``skip_prefixes``
     names beam-INVARIANT leaves (e.g. the cross-attention memory caches,
@@ -458,7 +502,7 @@ def beam_backtrack(first, toks, src_beams, scores):
 
 @functools.partial(
     jax.jit, static_argnums=(0,),
-    static_argnames=("max_new_tokens", "num_beams", "length_penalty"),
+    static_argnames=("max_new_tokens", "num_beams", "length_penalty", "lazy"),
 )
 def generate_beam(
     model: GPTLM,
@@ -468,6 +512,7 @@ def generate_beam(
     max_new_tokens: int = 32,
     num_beams: int = 4,
     length_penalty: float = 0.0,
+    lazy: bool = True,
 ) -> Tuple[jax.Array, jax.Array]:
     """Beam-search decoding: the highest-scoring continuation per prompt row.
 
@@ -477,11 +522,18 @@ def generate_beam(
 
     Beams ride as extra batch rows through the same prefill + decode scan
     as :func:`generate`; each step takes the top ``num_beams`` of the
-    ``num_beams * vocab`` joint continuations per prompt and reorders the
-    KV cache rows to follow their originating beams (a batched gather over
-    the cache pytree).  No early-termination/EOS handling — fixed-length
-    decoding, the same contract as :func:`generate`.
+    ``num_beams * vocab`` joint continuations per prompt.  ``lazy=True``
+    (default) follows beam ancestry through per-slot source-row tables and
+    the cross-beam decode attention
+    (:func:`~tpu_parallel.models.layers.beam_decode_attention`) — the KV
+    cache is never re-gathered; ``lazy=False`` is the eager form that
+    physically reorders every layer's cache rows each step (same tokens,
+    ~2x the per-step HBM traffic — kept as the reference implementation).
+    No early-termination/EOS handling — fixed-length decoding, the same
+    contract as :func:`generate`.
     """
+    import dataclasses
+
     cfg = model.config
     b, prompt_len = prompt.shape
     if prompt_len + max_new_tokens > cfg.seq_len:
@@ -494,9 +546,16 @@ def generate_beam(
 
     # prefill ONCE per prompt row, then replicate the cache k ways (beam j
     # of prompt i is row i*k + j) — beams are identical until the first
-    # expansion, so prefilling b*k rows would waste (k-1)/k of the FLOPs
+    # expansion, so prefilling b*k rows would waste (k-1)/k of the FLOPs.
+    # Prefill always runs the plain (beam_width=0) model: rows are still
+    # un-expanded prompt rows.
+    plain = (
+        model
+        if cfg.beam_width == 0
+        else type(model)(dataclasses.replace(cfg, beam_width=0))
+    )
     positions = jnp.broadcast_to(jnp.arange(prompt_len), (b, prompt_len))
-    logits, variables = model.apply(
+    logits, variables = plain.apply(
         {"params": params},
         prompt,
         positions=positions,
@@ -510,9 +569,15 @@ def generate_beam(
     scores, first = jax.lax.top_k(first_logp, k)  # [b, k] each
     tok = first.reshape(b * k).astype(jnp.int32)
 
+    if lazy:
+        stepper = type(model)(dataclasses.replace(cfg, beam_width=k))
+        cache0 = beam_seed_src(cache0, k)
+    else:
+        stepper = plain
+
     def step(carry, _):
         cache, tok, scores, pos = carry
-        logits, updated = model.apply(
+        logits, updated = stepper.apply(
             {"params": params, "cache": cache},
             tok[:, None],
             positions=jnp.full((b * k, 1), pos, jnp.int32),
@@ -526,10 +591,14 @@ def generate_beam(
         new_scores, flat_idx = jax.lax.top_k(joint.reshape(b, k * vocab), k)
         src_beam = flat_idx // vocab  # [b, k] originating beam per winner
         next_tok = (flat_idx % vocab).astype(jnp.int32)
-        # reorder cache rows to follow winning beams (shared helper: K/V
-        # payloads + the position table; scalar counters pass through)
         row_idx = (src_beam + jnp.arange(b)[:, None] * k).reshape(b * k)
-        cache = beam_reorder_cache(updated["cache"], row_idx)
+        if lazy:
+            # follow ancestry in the tiny int32 tables only
+            cache = beam_advance_src(updated["cache"], row_idx)
+        else:
+            # reorder cache rows to follow winning beams (shared helper: K/V
+            # payloads + the position table; scalar counters pass through)
+            cache = beam_reorder_cache(updated["cache"], row_idx)
         return (
             (cache, next_tok.reshape(b * k), new_scores, pos + 1),
             (next_tok, src_beam),

@@ -117,7 +117,15 @@ def _lm_head_params(cfg: "GPTConfig", params):
 
 
 class GPTLM(nn.Module):
-    """tokens [B, S] -> logits [B, S, vocab]."""
+    """tokens [B, S] -> logits [B, S, vocab].
+
+    ``positions`` contract under ``positional="relative"``: every row must
+    hold the SAME position vector (the bias table is computed once from row
+    0 — ragged/packed per-row positions are refused by the framework entry
+    points, and a direct ``apply`` with genuinely per-row positions would
+    silently get row-0 bias for all rows).  Learned/rope positional modes
+    accept per-row positions.
+    """
 
     config: GPTConfig
 

@@ -799,6 +799,8 @@ def from_hf_t5(hf_model_or_dict, config, dtype=jnp.float32) -> Pytree:
             "T5 interop needs dense_bias=False and mlp='relu' (original) "
             "or 'geglu' (v1.1)"
         )
+    if (config.n_kv_heads or config.n_heads) != config.n_heads:
+        raise ValueError("T5 has no GQA: n_kv_heads must be None/n_heads")
     if config.scan_layers:
         raise ValueError(
             "from_hf_t5 emits the unrolled layout; build the config with "

@@ -123,6 +123,13 @@ class TransformerConfig:
     # the cache HBM (the decode-memory hog) with one fp32 scale per
     # (position, kv-head); dequantization is a transient per layer per step
     kv_cache_dtype: str = "bf16"
+    # lazy beam-search decode: >1 switches the decode attention to the
+    # cross-beam form (beam j of prompt i = row i*k+j) that follows beam
+    # ancestry through a per-slot source-row table instead of physically
+    # re-gathering every layer's KV cache every step.  Set ONLY by the beam
+    # loops (models/generate.py builds a beam_width=k model for the decode
+    # scan); 0 everywhere else.
+    beam_width: int = 0
     # bidirectional (encoder / BERT-style) attention: every position sees
     # every same-segment position — with attn_window > 0, those in the
     # symmetric band |q - k| < window (encoder local attention).  Composes
@@ -273,6 +280,68 @@ def decode_attention(
     out = jnp.einsum("bngqk,bknd->bqngd", probs, v_all)
     return out.reshape(b, nq, h, head_dim)
 
+
+def beam_decode_attention(
+    q: jax.Array, k_all: jax.Array, v_all: jax.Array, positions: jax.Array,
+    beam_src: jax.Array, num_beams: int, window: int = 0,
+    bias: Optional[jax.Array] = None,
+    k_positions: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Decode attention against an UN-reordered beam-search KV cache.
+
+    Rows are beam-major: beam j of prompt i is row ``i*num_beams + j``.
+    ``beam_src`` [rows, cache_len] names, per row and cache slot, the row
+    (within the same prompt's beam group) whose cache physically holds that
+    slot of this beam's history — the beam loop maintains it (each written
+    slot maps to the writing row; a row-gather by winner parents follows
+    every top-k).  Mathematically identical to physically gathering cache
+    rows by beam ancestry, but the cache is read once and never rewritten:
+    scores/values are computed all-pairs over the ``num_beams`` group rows
+    (k x the attention FLOPs — noise in bandwidth-bound decode, where the
+    eager reorder's full cache read+write per layer per step dominates)
+    and the right pair is selected per slot from the table.
+    """
+    rows, nq, h, head_dim = q.shape
+    kb = num_beams
+    b = rows // kb
+    if b * kb != rows:
+        raise ValueError(f"rows={rows} not divisible by num_beams={kb}")
+    cache_len = k_all.shape[1]
+    h_kv = k_all.shape[2]
+    group = h // h_kv
+    scale = 1.0 / jnp.sqrt(head_dim).astype(q.dtype)
+    qg = (q * scale).reshape(b, kb, nq, h_kv, group, head_dim)
+    kg = k_all.reshape(b, kb, cache_len, h_kv, head_dim)
+    # all-pairs scores over the beam group: [b, j, j', h_kv, group, q, slot]
+    scores = jnp.einsum("bjqngd,bpsnd->bjpngqs", qg, kg).astype(jnp.float32)
+    # per (row, slot) select the source beam's score
+    src_local = (beam_src.reshape(b, kb, cache_len) % kb).astype(jnp.int32)
+    idx = src_local[:, :, None, None, None, None, :]  # [b, j, 1, 1, 1, 1, s]
+    sel = jnp.take_along_axis(scores, idx, axis=2)[:, :, 0]  # [b,j,n,g,q,s]
+    sel = sel.reshape(rows, h_kv, group, nq, cache_len)
+    if bias is not None:
+        bb = bias.reshape(bias.shape[0], h_kv, group, *bias.shape[2:])
+        sel = sel + bb.astype(jnp.float32)
+    if k_positions is None:
+        k_pos = jnp.broadcast_to(jnp.arange(cache_len), (rows, cache_len))
+    else:
+        k_pos = k_positions
+    kp = k_pos[:, None, None, None, :]
+    qp = positions[:, None, None, :, None]
+    mask = jnp.logical_and(kp >= 0, kp <= qp)
+    if window:
+        mask = jnp.logical_and(mask, qp - kp < window)
+    sel = jnp.where(mask, sel, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(sel, axis=-1).astype(q.dtype)
+    # value side: bucket each row's probs by source beam (one-hot over j')
+    # and contract all-pairs — V is read once, never gathered
+    pg = probs.reshape(b, kb, h_kv, group, nq, cache_len)
+    onehot = jax.nn.one_hot(src_local, kb, axis=2, dtype=q.dtype)
+    # onehot: [b, j, j', s]; pm: [b, j, j', n, g, q, s]
+    pm = pg[:, :, None] * onehot[:, :, :, None, None, None, :]
+    vg = v_all.reshape(b, kb, cache_len, h_kv, head_dim)
+    out = jnp.einsum("bjpngqs,bpsnd->bjqngd", pm, vg)
+    return out.reshape(rows, nq, h, head_dim)
 
 
 def t5_relative_bucket(
@@ -576,12 +645,36 @@ class Attention(nn.Module):
             )
             cached_p.value = keep(new_p, cached_p.value)
             cache_index.value = keep(idx + x.shape[1], idx)
-            # decode_attention contracts grouped queries against the
-            # kv-width cache directly — no K/V expansion
-            out = decode_attention(
-                q, k_all, v_all, positions, window=cfg.attn_window,
-                bias=attn_bias, k_positions=new_p,
-            )
+            if cfg.beam_width > 1:
+                # lazy beam search: the cache rows are never re-gathered;
+                # a per-slot source-row table follows beam ancestry instead.
+                # This layer's contract: every slot IT writes maps to the
+                # writing row (the beam loop row-gathers the table by winner
+                # parents after each top-k).
+                own_row = jnp.arange(b, dtype=jnp.int32)[:, None]
+                beam_src = self.variable(
+                    "cache",
+                    "beam_src",
+                    lambda: own_row + jnp.zeros((b, cfg.seq_len), jnp.int32),
+                )
+                new_src = lax.dynamic_update_slice_in_dim(
+                    beam_src.value,
+                    own_row + jnp.zeros((b, x.shape[1]), jnp.int32),
+                    idx,
+                    axis=1,
+                )
+                beam_src.value = keep(new_src, beam_src.value)
+                out = beam_decode_attention(
+                    q, k_all, v_all, positions, new_src, cfg.beam_width,
+                    window=cfg.attn_window, bias=attn_bias, k_positions=new_p,
+                )
+            else:
+                # decode_attention contracts grouped queries against the
+                # kv-width cache directly — no K/V expansion
+                out = decode_attention(
+                    q, k_all, v_all, positions, window=cfg.attn_window,
+                    bias=attn_bias, k_positions=new_p,
+                )
         else:
             out = self._attend(q, k, v, segment_ids, attn_bias)
         if cfg.attn_impl != "flash":

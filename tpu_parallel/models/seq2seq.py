@@ -393,6 +393,12 @@ class EncoderDecoder(nn.Module):
     (T5-style tying); the lm_head stays untied like the LM family.  The
     encoder runs the existing :class:`BlockStack` with
     ``bidirectional=True``; the decoder is :class:`DecoderStack`.
+
+    ``positions`` contract under ``positional="relative"``: every row must
+    hold the SAME position vector (the per-stack bias tables are computed
+    once from row 0; ragged/packed per-row positions are refused by the
+    framework entry points — a direct ``apply`` with per-row positions would
+    silently get row-0 bias for all rows).
     """
 
     config: Seq2SeqConfig
@@ -879,6 +885,11 @@ def seq2seq_generate_sharded(
         batch_spec = P(model.config.data_axis)
     if rng is None:
         rng = jax.random.PRNGKey(0)
+    # the shard_map arity is fixed, so a placeholder all-ones mask always
+    # rides along; has_mask keeps the no-mask call on the unmasked fast path
+    # inside the core (an all-ones mask is semantically identical but pays
+    # the segment-ids masking compute in encode on every call)
+    has_mask = src_mask is not None
     if src_mask is None:
         src_mask = jnp.ones(src.shape, jnp.bool_)
     fn = _sharded_seq2seq_fn(
@@ -891,6 +902,7 @@ def seq2seq_generate_sharded(
         temperature,
         top_k,
         top_p,
+        has_mask,
     )
     return fn(params, src, src_mask, rng)
 
@@ -898,13 +910,13 @@ def seq2seq_generate_sharded(
 @functools.lru_cache(maxsize=32)
 def _sharded_seq2seq_fn(
     model, mesh, specs, batch_spec, bos_id, max_new_tokens, temperature, top_k,
-    top_p=0.0,
+    top_p=0.0, has_mask=True,
 ):
     from tpu_parallel.models.generate import build_sharded_serving
 
     def core(model_, params, src, src_mask, rng):
         return _seq2seq_core(
-            model_, params, src, src_mask, rng,
+            model_, params, src, src_mask if has_mask else None, rng,
             bos_id=bos_id, max_new_tokens=max_new_tokens,
             temperature=temperature, top_k=top_k, top_p=top_p,
         )
@@ -988,7 +1000,9 @@ def tiny_seq2seq(**overrides) -> Seq2SeqConfig:
 
 @functools.partial(
     jax.jit, static_argnums=(0,),
-    static_argnames=("bos_id", "max_new_tokens", "num_beams", "length_penalty"),
+    static_argnames=(
+        "bos_id", "max_new_tokens", "num_beams", "length_penalty", "lazy",
+    ),
 )
 def seq2seq_generate_beam(
     model: EncoderDecoder,
@@ -1000,6 +1014,7 @@ def seq2seq_generate_beam(
     max_new_tokens: int = 32,
     num_beams: int = 4,
     length_penalty: float = 0.0,
+    lazy: bool = True,
 ):
     """Beam-search decoding for the encoder-decoder family.
 
@@ -1009,11 +1024,15 @@ def seq2seq_generate_beam(
     :func:`~tpu_parallel.models.generate.generate_beam`: encode + prefill
     ONCE per source row, replicate the caches ``num_beams`` ways (beams
     are identical until the first expansion), then per step take the top
-    beams of the joint continuations and reorder every cache row — self
-    K/V, the per-slot position table, AND the cross-attention memory
-    cache — to follow its winning beam.  Fixed-length decoding (no EOS
-    early exit), single-device params layout.
+    beams of the joint continuations.  ``lazy=True`` (default) follows
+    beam ancestry through per-slot source-row tables (self-attention
+    caches are never re-gathered; cross caches are beam-invariant either
+    way); ``lazy=False`` physically reorders the self K/V and position
+    rows every step.  Fixed-length decoding (no EOS early exit),
+    single-device params layout.
     """
+    import dataclasses
+
     cfg = model.config
     b = src.shape[0]
     if max_new_tokens > cfg.seq_len:
@@ -1038,39 +1057,60 @@ def seq2seq_generate_beam(
     )
 
     from tpu_parallel.models.generate import (
+        beam_advance_src,
         beam_backtrack,
         beam_expand_cache,
         beam_reorder_cache,
+        beam_seed_src,
     )
 
+    # prefill always runs the plain (beam_width=0) model: rows are still
+    # un-expanded source rows (same guard as the LM generate_beam)
+    plain = (
+        model
+        if cfg.beam_width == 0
+        else type(model)(dataclasses.replace(cfg, beam_width=0))
+    )
     bos = jnp.full((b, 1), bos_id, jnp.int32)
-    hidden, variables = model.apply(
+    hidden, variables = plain.apply(
         {"params": params}, bos, memory, src_mask, None, False, True, True,
-        method=model.decode, mutable=["cache"],
+        method=plain.decode, mutable=["cache"],
     )
     cache0 = beam_expand_cache(variables["cache"], k)
     scores, first = jax.lax.top_k(logp_of(hidden), k)  # [b, k] each
     tok = first.reshape(b * k).astype(jnp.int32)
 
+    if lazy:
+        stepper = type(model)(dataclasses.replace(cfg, beam_width=k))
+        cache0 = beam_seed_src(cache0, k)
+    else:
+        stepper = plain
+
     def step(carry, _):
         cache, tok, scores = carry
-        hidden, updated = model.apply(
+        hidden, updated = stepper.apply(
             {"params": params, "cache": cache},
             tok[:, None], None, None, None, False, True, True,
-            method=model.decode, mutable=["cache"],
+            method=stepper.decode, mutable=["cache"],
         )
         joint = scores[:, :, None] + logp_of(hidden).reshape(b, k, vocab)
         new_scores, flat_idx = jax.lax.top_k(joint.reshape(b, k * vocab), k)
         src_beam = flat_idx // vocab
         next_tok = (flat_idx % vocab).astype(jnp.int32)
         row_idx = (src_beam + jnp.arange(b)[:, None] * k).reshape(b * k)
-        # cross caches are beam-INVARIANT (written once at prefill; every
-        # beam of a row holds identical copies) — skip their per-step
-        # gather, it would move n_layers full source caches for a no-op
-        cache = beam_reorder_cache(
-            updated["cache"], row_idx,
-            skip_prefixes=("cross_key", "cross_value", "cross_mask"),
-        )
+        if lazy:
+            # self-attention ancestry rides the tiny int32 tables; cross
+            # caches are beam-invariant and untouched either way
+            cache = beam_advance_src(updated["cache"], row_idx)
+        else:
+            # cross caches are beam-INVARIANT (written once at prefill;
+            # every beam of a row holds identical copies) — skip their
+            # per-step gather, it would move n_layers full source caches
+            # for a no-op
+            cache = beam_reorder_cache(
+                updated["cache"], row_idx,
+                skip_prefixes=("cross_key", "cross_value", "cross_mask"),
+            )
         return (
             (cache, next_tok.reshape(b * k), new_scores),
             (next_tok, src_beam),

@@ -239,6 +239,17 @@ class Trainer:
         copy — the GPT and seq2seq paths must not drift on the
         build_train_functions kwargs (grad axes, check_vma, EMA...)."""
         mesh_sizes = dict(self.mesh.shape)
+        # Typo'd axis names in a config would otherwise degrade silently:
+        # fold_rng_over_axis (deliberately) skips ANY unbound axis name, so
+        # a 'modle' axis would quietly change dropout folding instead of
+        # failing.  Validate where config meets mesh, once.
+        for field in ("data_axis", "model_axis", "pipe_axis", "seq_axis"):
+            ax = getattr(self.model_config, field, None)
+            if ax is not None and ax not in self.mesh.axis_names:
+                raise ValueError(
+                    f"model config {field}={ax!r} is not a mesh axis "
+                    f"(mesh has {tuple(self.mesh.axis_names)})"
+                )
         if config.global_batch_size % mesh_sizes["data"] != 0:
             raise ValueError(
                 f"global batch {config.global_batch_size} not divisible by "
