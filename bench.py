@@ -75,16 +75,20 @@ def child_main():
     )
 
     if on_tpu:
-        # Defaults from the round-3 sweep (SWEEP_r03.json, scripts/
-        # sweep_bench.py): 0.4344 MFU on v5e-1 vs 0.2852 for the previous
-        # batch-16/proj/XLA-attn/scan config.  The three levers, measured by
-        # substitution (scripts/bisect_step.py, scripts/attn_wrap_bisect.py):
-        # the Pallas flash kernel at 512x512 tiles (XLA attention costs ~2x
-        # more inside shard_map than standalone; flash is immune), the
-        # "proj_attn" remat policy (saves flash's out+lse so the backward
-        # never re-runs the forward kernel), and unrolled layers (the layer
-        # scan cost ~25ms/step at this depth).
-        model, batch, steps, minib = "gpt2_125m", 16 * n_chips, 20, 1
+        # Defaults from the round-5 sweep (SWEEP_r05.json, scripts/
+        # sweep_bench.py): 0.4689 MFU on v5e-1 at batch 128 with 8
+        # accumulation minibatches (per-pass batch 16), up from round 4's
+        # 0.4468 at batch 16/minib 1.  The earlier levers stand (flash
+        # 512x512 tiles, "proj_attn" remat, unrolled layers — see
+        # SWEEP_r03/r04); round 5 added the batch ladder: throughput climbs
+        # with accumulated batch while the per-pass shape stays at the
+        # compile-friendly 16.  The scan-layers alternative was bisected
+        # (fwd +6.6%, bwd +15.7% — the lax.scan transpose) and tuned
+        # (scan_group / _split_transpose / in-scan unroll / batch ladder):
+        # best 0.4278 at the same 128/8 shape, an ~9% structural tax the
+        # sweeps could not close — the bench stays unrolled, deep configs
+        # (350M/1B) keep scan for compile budget (docs/05).
+        model, batch, steps, minib = "gpt2_125m", 128 * n_chips, 20, 8
         overrides = dict(
             dropout_rate=0.0,
             remat=True,

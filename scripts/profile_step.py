@@ -6,9 +6,9 @@ prints the top ops by self time — the evidence needed to close the MFU gap
 (BASELINE.md north star) instead of guessing at configs.
 
 Usage:
-    python scripts/profile_step.py [batch] [remat] [attn] [chunk]
+    python scripts/profile_step.py [batch] [remat] [attn] [chunk] [scan] [k=v...]
 e.g.
-    python scripts/profile_step.py 16 proj xla 0
+    python scripts/profile_step.py 16 proj xla 0 1 scan_group=2
 """
 
 import glob
@@ -18,7 +18,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def run_and_trace(batch, remat, attn, chunk, logdir):
+def run_and_trace(batch, remat, attn, chunk, logdir, scan=None, extra=None):
     import jax
 
     from tpu_parallel.runtime import MeshConfig
@@ -26,8 +26,10 @@ def run_and_trace(batch, remat, attn, chunk, logdir):
     from tpu_parallel.utils.profiling import sync, trace
 
     overrides = dict(
-        dropout_rate=0.0, attn_impl=attn, loss_chunk=chunk,
+        dropout_rate=0.0, attn_impl=attn, loss_chunk=chunk, **(extra or {}),
     )
+    if scan is not None:
+        overrides["scan_layers"] = scan
     if remat in ("dots", "proj", "proj_attn"):
         overrides.update(remat=True, remat_policy=remat)
     else:
@@ -123,8 +125,17 @@ def main():
     remat = args[1] if len(args) > 1 else "proj"
     attn = args[2] if len(args) > 2 else "xla"
     chunk = int(args[3]) if len(args) > 3 else 0
+    scan = (args[4] != "0") if len(args) > 4 else None
+    extra = {}
+    for kv in args[5:]:
+        key, val = kv.split("=", 1)
+        try:
+            val = int(val)
+        except ValueError:
+            pass
+        extra[key] = val
     logdir = os.environ.get("PROFILE_DIR", "/tmp/tpu_parallel_profile")
-    run_and_trace(batch, remat, attn, chunk, logdir)
+    run_and_trace(batch, remat, attn, chunk, logdir, scan=scan, extra=extra)
     summarize(logdir)
 
 

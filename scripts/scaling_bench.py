@@ -1,7 +1,7 @@
 """Scaling-efficiency harness: DP / TP / PP / SP / EP step time vs devices.
 
 Usage: ``python scripts/scaling_bench.py [strategy ...]`` — no args runs
-every strategy (dp tp pp sp ep).
+every strategy (dp tp pp pp_1f1b sp ep).
 
 BASELINE.json's metric is "tokens/sec/chip AND DP/TP/PP scaling efficiency"
 — this harness produces the scaling half.  For each strategy it runs the
@@ -88,6 +88,14 @@ def main():
         elif strategy == "pp":
             mesh_cfg, batch = MeshConfig(data=1, pipe=n), per_chip_batch
             overrides["num_microbatches"] = per_chip_batch
+        elif strategy == "pp_1f1b":
+            # the memory-bounded schedule: same mesh/microbatching as pp,
+            # gradients computed inside the interleaved fwd/bwd scan
+            # (parallel/pp.py pipeline_1f1b_grads) — reads against pp as
+            # the structural cost of the 1F1B buffer walk + second ring
+            mesh_cfg, batch = MeshConfig(data=1, pipe=n), per_chip_batch
+            overrides["num_microbatches"] = per_chip_batch
+            overrides["pipe_schedule"] = "1f1b"
         elif strategy == "sp":
             # sequence parallelism: fixed batch x seq, tokens sharded over
             # the ring — strong scaling like TP, communication is the K/V
@@ -140,7 +148,7 @@ def main():
         )
 
     results = []
-    valid = ("dp", "tp", "pp", "sp", "ep")
+    valid = ("dp", "tp", "pp", "pp_1f1b", "sp", "ep")
     wanted = sys.argv[1:] or list(valid)
     unknown = [w for w in wanted if w not in valid]
     if unknown:
@@ -158,6 +166,9 @@ def main():
             if strategy == "pp":
                 m = per_chip_batch  # microbatches
                 r["ideal_fraction"] = round(m / (m + n - 1), 4)
+            elif strategy == "pp_1f1b":
+                m = per_chip_batch
+                r["ideal_fraction"] = round(m / (m + 2 * n - 2), 4)
             results.append(r)
             print(json.dumps(r), flush=True)
     return results

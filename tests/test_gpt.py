@@ -414,3 +414,42 @@ def test_gpt_remat_proj_attn_matches_no_remat(mesh_data8, rng):
     for first, last in losses[1:]:
         np.testing.assert_allclose(first, losses[0][0], rtol=1e-5)
         np.testing.assert_allclose(last, losses[0][1], rtol=1e-4)
+
+
+def test_scan_group_matches_ungrouped(rng):
+    """scan_group=2 (two blocks per scanned body) computes exactly the
+    same function as the g=1 layout when the g=1 stacked params [L, ...]
+    are resliced into the grouped layout: tick i applies block0 = layer
+    2i then block1 = layer 2i+1, so block0 holds layers 0::2 and block1
+    layers 1::2.  Pins the grouped param naming, the application order,
+    and the divisibility refusal."""
+    from tpu_parallel.models import GPTLM, tiny_test
+
+    cfg1 = tiny_test(dtype=jnp.float32, remat=False)
+    cfg2 = tiny_test(dtype=jnp.float32, remat=False, scan_group=2)
+    m1, m2 = GPTLM(cfg1), GPTLM(cfg2)
+    toks = jax.random.randint(rng, (2, 8), 0, cfg1.vocab_size)
+    p1 = m1.init({"params": jax.random.PRNGKey(1)}, toks, train=False)["params"]
+    remap = dict(p1)
+    remap["blocks"] = {
+        "layers": {
+            "block0": jax.tree_util.tree_map(
+                lambda a: a[0::2], p1["blocks"]["layers"]["block"]
+            ),
+            "block1": jax.tree_util.tree_map(
+                lambda a: a[1::2], p1["blocks"]["layers"]["block"]
+            ),
+        }
+    }
+    l1 = m1.apply({"params": p1}, toks, train=False)
+    l2 = m2.apply({"params": remap}, toks, train=False)
+    np.testing.assert_allclose(
+        np.asarray(l1), np.asarray(l2), rtol=1e-6, atol=1e-6
+    )
+    # grouped init produces the grouped tree shape
+    p2 = m2.init({"params": jax.random.PRNGKey(1)}, toks, train=False)["params"]
+    assert set(p2["blocks"]["layers"]) == {"block0", "block1"}
+    # non-divisible group refused loudly
+    bad = GPTLM(tiny_test(dtype=jnp.float32, remat=False, scan_group=3))
+    with pytest.raises(ValueError, match="scan_group"):
+        bad.init({"params": jax.random.PRNGKey(1)}, toks, train=False)

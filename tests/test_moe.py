@@ -680,3 +680,115 @@ def test_expert_choice_ep_matches_single_device(mesh_data4_model2, rng):
     np.testing.assert_allclose(
         np.asarray(y_local), np.asarray(y_ep), rtol=2e-4, atol=2e-4
     )
+
+
+# --- all_to_all dispatch -------------------------------------------------------
+
+
+@pytest.mark.parametrize("top_k", [1, 2])
+def test_alltoall_matches_dense(mesh_data4_model2, rng, top_k):
+    """The all_to_all token-sharded dispatch produces the same outputs,
+    gradients (after the model-axis sync), and balance loss as the dense
+    replicated-token dispatch, given ample capacity (no drops in either
+    layout).  Pins the full wire protocol: token slice -> local masks ->
+    dispatch a2a -> experts -> combine a2a -> all_gather, plus the
+    pmean'd global balance statistics."""
+    import flax.linen as nn
+    from tpu_parallel.models.moe import MoEMLP
+    from tpu_parallel.parallel import fsdp
+
+    cfg_dense = tiny_test(
+        moe_experts=4, moe_top_k=top_k, dtype=jnp.float32,
+        moe_capacity_factor=4.0,
+    )
+    cfg_a2a = tiny_test(
+        moe_experts=4, moe_top_k=top_k, dtype=jnp.float32,
+        moe_capacity_factor=4.0, moe_dispatch="alltoall",
+    )
+    x = jax.random.normal(rng, (2, 8, cfg_dense.d_model), jnp.float32)
+    w_out = jax.random.normal(jax.random.PRNGKey(3), x.shape, jnp.float32)
+
+    moe_dense = MoEMLP(cfg_dense)
+    moe_a2a = MoEMLP(cfg_a2a)
+    variables = moe_dense.init({"params": jax.random.PRNGKey(7)}, x, train=False)
+    p = variables["params"]
+    ep_params = {
+        "router": p["router"],
+        "experts": {
+            "sharded": jax.tree_util.tree_map(
+                lambda w: nn.Partitioned(
+                    w.reshape(2, 2, *w.shape[1:]), names=("model",) + (None,) * w.ndim
+                ),
+                p["experts"],
+            )
+        },
+    }
+    specs = nn.get_partition_spec(ep_params)
+
+    def run(moe):
+        def fwd_and_grads(params, x, w):
+            def loss(params):
+                y, mods = moe.apply(
+                    {"params": params}, x, train=False, mutable=["losses"]
+                )
+                bal = sum(
+                    jnp.sum(leaf)
+                    for leaf in jax.tree_util.tree_leaves(mods["losses"])
+                )
+                return jnp.sum(y * w), (y, bal)
+
+            g, (y, bal) = jax.grad(loss, has_aux=True)(params)
+            g = fsdp.sync_gradients(g, ("model",))
+            return y, bal, g
+
+        return jax.jit(
+            jax.shard_map(
+                fwd_and_grads,
+                mesh=mesh_data4_model2,
+                in_specs=(specs, P(), P()),
+                out_specs=(P(), P(), specs),
+                check_vma=False,
+            )
+        )(ep_params, x, w_out)
+
+    y_d, bal_d, g_d = run(moe_dense)
+    y_a, bal_a, g_a = run(moe_a2a)
+    np.testing.assert_allclose(
+        np.asarray(y_d), np.asarray(y_a), rtol=2e-4, atol=2e-4
+    )
+    np.testing.assert_allclose(
+        float(bal_d), float(bal_a), rtol=1e-5, atol=1e-6
+    )
+    for (path, leaf_d), leaf_a in zip(
+        jax.tree_util.tree_leaves_with_path(g_d), jax.tree_util.tree_leaves(g_a)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(leaf_d), np.asarray(leaf_a), rtol=2e-4, atol=1e-5,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+def test_alltoall_ep_training_decreases_loss(rng):
+    """End-to-end: a GPTLM with alltoall MoE dispatch trains through the
+    standard builder on a data x model mesh."""
+    from tpu_parallel.runtime import MeshConfig
+    from tpu_parallel.train_lib import Trainer, TrainerConfig
+
+    config = TrainerConfig(
+        model="tiny",
+        model_overrides=dict(
+            moe_experts=4, moe_top_k=2, moe_dispatch="alltoall",
+            dtype=jnp.float32, remat=False, dropout_rate=0.0,
+        ),
+        mesh=MeshConfig(data=4, model=2),
+        global_batch_size=8,
+        steps=6,
+        log_every=1000,
+        donate=False,
+        seed=0,
+    )
+    trainer = Trainer(config)
+    trainer.init()
+    first = trainer.train(steps=3)["loss"]
+    last = trainer.train(steps=3)["loss"]
+    assert last < first, (first, last)

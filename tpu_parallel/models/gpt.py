@@ -56,6 +56,15 @@ class GPTConfig(TransformerConfig):
     # yet composable with MoE (nn.switch requires identical variable
     # writes across branches; each chunk sows its own balance loss).
     pipe_interleave: int = 1
+    # pipeline TRAINING schedule: "gpipe" differentiates through the full
+    # microbatch schedule (activation memory grows with num_microbatches);
+    # "1f1b" computes gradients inside a one-forward-one-backward schedule
+    # that bounds in-flight microbatches at pipe_size per rank (see
+    # parallel/pp.py pipeline_1f1b_grads) at the cost of ~pipe_size extra
+    # bubble ticks.  Same math (grad-parity pinned in tests/test_pp.py);
+    # forward/eval/serving always run the GPipe/ring paths.  Not yet
+    # composable with pipe_interleave > 1 or MoE.
+    pipe_schedule: str = "gpipe"
     # chunked lm_head + CE: compute logits ``loss_chunk`` sequence positions
     # at a time inside the loss (rematerialized in the backward), so the full
     # [B, S, vocab] logits tensor never exists in HBM.  0 = off.  The
@@ -197,6 +206,16 @@ class GPTLM(nn.Module):
                     "branches would sow mismatched loss collections)"
                 )
             layers_per_chunk = cfg.n_layers // chunks
+            if cfg.moe_experts > 0 and cfg.moe_dispatch == "alltoall":
+                from tpu_parallel.core.metrics import pvary_missing
+                from tpu_parallel.parallel.tp import axis_size_or_none
+
+                if axis_size_or_none(cfg.model_axis) is not None:
+                    # the a2a MoE's closing all_gather makes stage outputs
+                    # model-VARYING; the pipeline scan's activation carry
+                    # must enter that way or the carry types disagree
+                    # (same rule as BlockStack's inner scan)
+                    x = pvary_missing(x, (cfg.model_axis,))
             pipeline = pp.PipelineModule(
                 stage_fn=functools.partial(BlockStack, cfg, layers_per_chunk),
                 num_microbatches=cfg.num_microbatches,
@@ -329,6 +348,115 @@ def make_ce_fn(config: GPTConfig):
         return loss_sum, correct
 
     return chunked_ce if chunk else ce_block
+
+
+def make_gpt_1f1b_grad_fn(config: GPTConfig, train: bool = True):
+    """``(params, batch, rng) -> (grads, metrics)`` via the memory-bounded
+    1F1B pipeline schedule (:func:`tpu_parallel.parallel.pp.pipeline_1f1b_grads`).
+
+    Replaces the ``jax.grad``-through-GPipe path inside the train step when
+    ``config.pipe_schedule == "1f1b"``: in-flight microbatch activations are
+    bounded at ``pipe_size`` per rank instead of ``num_microbatches``.  The
+    forward/eval/serving paths (``GPTLM.__call__``) are untouched — the
+    schedule only changes HOW gradients are computed, not the math: grads
+    and loss match the GPipe step (tests/test_pp.py pins parity).
+
+    The per-rank composite mirrors ``GPTLM``'s pipe path module-by-module
+    and BY NAME (embed / pipeline.stage / norm_final / lm_head), so the
+    params tree initialized through the standard path serves unchanged.
+    """
+    # pipe_size == 1 is the legitimate degenerate: every tick forwards and
+    # immediately backwards one microbatch — per-microbatch vjp
+    # accumulation, the n=1 baseline of the scaling harness
+    if config.pipe_interleave > 1:
+        raise NotImplementedError(
+            "1F1B with interleaved virtual stages (the circular schedule's "
+            "chunk walk and the 1F1B buffer discipline do not compose yet)"
+        )
+    if config.moe_experts > 0:
+        raise NotImplementedError(
+            "MoE under 1F1B (sown balance losses need per-tick replay "
+            "bookkeeping the schedule does not carry)"
+        )
+    if config.positional == "relative":
+        raise NotImplementedError("relative position bias under pipelines")
+    layers_per_stage = config.n_layers // config.pipe_size
+    if config.n_layers % config.pipe_size:
+        raise ValueError(
+            f"n_layers={config.n_layers} not divisible by "
+            f"pipe_size={config.pipe_size}"
+        )
+
+    from tpu_parallel.parallel.tp import ModuleShard
+
+    ce_fn = make_ce_fn(config)
+    embed_mod = fsdp.maybe_shard(Embedding, config)(config)
+    if config.pipe_size > 1:
+        stage_mod = ModuleShard(
+            module_fn=functools.partial(BlockStack, config, layers_per_stage),
+            axis_name=config.pipe_axis,
+        )
+        stage_params = lambda p: p["pipeline"]["stage"]  # noqa: E731
+    else:
+        # degenerate single-stage: GPTLM builds a plain BlockStack named
+        # "blocks" at pipe_size=1 — mirror that tree
+        stage_mod = BlockStack(config, config.n_layers)
+        stage_params = lambda p: p["blocks"]  # noqa: E731
+    norm_mod = make_norm(config, None) if config.prenorm else None
+    fold_axes = (
+        config.data_axis, config.model_axis, config.pipe_axis, config.seq_axis
+    )
+
+    def fwd_fn(params, x_in, mb, rng_mb):
+        dropout_rng = fold_rng_over_axis(rng_mb, fold_axes)
+        x0 = embed_mod.apply(
+            {"params": params["embed"]}, mb.tokens, positions=mb.positions
+        )
+        stage_idx = lax.axis_index(config.pipe_axis)
+        x = jnp.where(stage_idx == 0, x0, x_in)
+        y = stage_mod.apply(
+            {"params": stage_params(params)},
+            x,
+            positions=mb.positions,
+            segment_ids=mb.segment_ids,
+            train=train,
+            rngs={"dropout": dropout_rng},
+        )
+        h = y
+        if norm_mod is not None:
+            h = norm_mod.apply({"params": params["norm_final"]}, y).astype(
+                config.dtype
+            )
+        mask = (
+            mb.loss_mask
+            if mb.loss_mask is not None
+            else jnp.ones(mb.targets.shape, jnp.float32)
+        )
+        mask = mask * pp.last_stage_mask(config.pipe_axis)
+        n_tok = mask.sum()
+        loss_sum, correct = ce_fn(
+            _lm_head_params(config, params), h, mb.targets, mask
+        )
+        metrics: Metrics = {
+            "loss": (loss_sum, n_tok),
+            "accuracy": (correct.astype(jnp.float32), n_tok),
+        }
+        return y, loss_sum, metrics
+
+    def grad_fn(params, batch, rng):
+        mb_rows = batch.tokens.shape[0] // config.num_microbatches
+        return pp.pipeline_1f1b_grads(
+            fwd_fn,
+            params,
+            batch,
+            rng,
+            num_microbatches=config.num_microbatches,
+            axis_name=config.pipe_axis,
+            act_shape=(mb_rows, batch.tokens.shape[1], config.d_model),
+            act_dtype=config.dtype,
+        )
+
+    return grad_fn
 
 
 def make_gpt_loss(config: GPTConfig, train: bool = True):

@@ -106,6 +106,24 @@ class TransformerConfig:
     # fully unrolled (scan_layers=False) where compile budget allows; the
     # knob stays for measurement on other shapes/hardware.
     scan_unroll: int = 1
+    # blocks per scanned BODY (scan length becomes n_layers / scan_group):
+    # the residual-stream carry is materialized at tick boundaries only, so
+    # grouping divides the scan's per-tick HBM round-trips by the group size
+    # — unlike scan_unroll, which unrolls the loop but keeps one carry
+    # round-trip per block.  Param layout changes to [n_layers/g] stacks of
+    # g named blocks ("block0".."block{g-1}"); g=1 keeps the historical
+    # layout.  Must divide n_layers.  Measured round 5 (SWEEP_r05.json):
+    # FLAT at 125M (0.3876/0.3865/0.3859/0.3867/0.384 MFU at g=1/2/3/4/6)
+    # — which falsified the carry-round-trip theory of the scan tax; the
+    # bisect then located it in the backward (fwd +6.6%, bwd +15.7% vs
+    # unrolled).  The knob stays for other depths/hardware.
+    scan_group: int = 1
+    # lax.scan's _split_transpose: lowers the layer scan's BACKWARD as two
+    # loops (residual regeneration + gradient accumulation) that XLA can
+    # overlap.  The measured scan tax lives in the backward (fwd +6.6%,
+    # bwd +15.7% vs unrolled at 125M/batch16 — round-5 bisect), which is
+    # exactly the pass this targets.
+    scan_split_transpose: bool = False
     fsdp: bool = False  # shard big params over the data axis (ZeRO-3)
     fsdp_min_size: int = 2**18
     attn_impl: str = "xla"  # "xla" | "flash" | "ring" | "ulysses"
@@ -152,6 +170,19 @@ class TransformerConfig:
     moe_top_k: int = 1
     moe_capacity_factor: float = 1.25
     moe_balance_weight: float = 0.01
+    # EP dispatch mechanics: "dense" replicates the token set over the EP
+    # ranks and builds [T, E, C] one-hot dispatch/combine masks (zero
+    # communication on dispatch, one psum on combine — fine on small
+    # meshes, but per-rank mask memory and dispatch-einsum cost grow with
+    # the FULL token count).  "alltoall" shards the token set over the EP
+    # axis: each rank routes its T/ep tokens locally ([T/ep, E, C/ep]
+    # masks — ep^2 smaller), exchanges expert payloads with one
+    # all_to_all each way, and closes with an all_gather of the combined
+    # tokens.  Capacity becomes a per-(sender, expert) quota of C/ep
+    # (GShard's formulation): identical results while nothing overflows,
+    # different drop choices under pressure.  topk router only
+    # (expert_choice needs global top-capacity; it stays dense).
+    moe_dispatch: str = "dense"
 
     @property
     def head_dim(self) -> int:
@@ -931,29 +962,41 @@ class Block(nn.Module):
 
 
 class _ScanBlock(nn.Module):
-    """nn.scan target: one Block per tick, carrying (x, positions, segment_ids,
-    aux_scale, cache_valid).  ``block_cls`` lets BlockStack substitute the
-    FSDP-wrapped Block (static metadata — both classes produce the same
-    variable tree shape, the wrapped one with data-sharded leaves)."""
+    """nn.scan target: ``group`` Block(s) per tick, carrying (x, positions,
+    segment_ids, aux_scale, cache_valid).  ``block_cls`` lets BlockStack
+    substitute the FSDP-wrapped Block (static metadata — both classes produce
+    the same variable tree shape, the wrapped one with data-sharded leaves).
+
+    ``group > 1`` (``config.scan_group``) applies that many consecutive
+    blocks per scan tick: the carry (the [B, S, d] residual stream) is
+    materialized at tick boundaries only, so grouping divides the per-tick
+    HBM round-trips by ``group`` while keeping compile size at
+    ``n_layers / group`` of the unrolled cost.  Distinct from
+    ``scan_unroll`` (which unrolls the LOOP but keeps one block per carry
+    round-trip — measured slower, see TransformerConfig.scan_unroll).
+    Group 1 keeps the historical single-block param naming ("block")."""
 
     config: TransformerConfig
     train: bool
     decode: bool = False
     block_cls: Any = Block
+    group: int = 1
 
     @nn.compact
     def __call__(self, carry, _):
         x, positions, segment_ids, aux_scale, cache_valid, attn_bias = carry
-        x = self.block_cls(self.config, name="block")(
-            x,
-            positions=positions,
-            segment_ids=segment_ids,
-            train=self.train,
-            decode=self.decode,
-            aux_scale=aux_scale,
-            cache_valid=cache_valid,
-            attn_bias=attn_bias,
-        )
+        for j in range(self.group):
+            name = "block" if self.group == 1 else f"block{j}"
+            x = self.block_cls(self.config, name=name)(
+                x,
+                positions=positions,
+                segment_ids=segment_ids,
+                train=self.train,
+                decode=self.decode,
+                aux_scale=aux_scale,
+                cache_valid=cache_valid,
+                attn_bias=attn_bias,
+            )
         return (
             (x, positions, segment_ids, aux_scale, cache_valid, attn_bias),
             None,
@@ -1030,6 +1073,23 @@ class BlockStack(nn.Module):
                 x = pvary_missing(
                     x, vma_of(jax.lax.axis_index(cfg.seq_axis))
                 )
+            if (
+                cfg.moe_experts > 0
+                and cfg.moe_dispatch == "alltoall"
+                and axis_size_or_none(cfg.model_axis) is not None
+            ):
+                # same carry-typing rule for the a2a MoE: its closing
+                # all_gather leaves the block output model-VARYING (the
+                # values are identical across ranks, but the checker can't
+                # prove it), so the carry must enter model-varying too
+                from tpu_parallel.core.metrics import pvary_missing
+
+                x = pvary_missing(x, (cfg.model_axis,))
+            group = max(1, cfg.scan_group)
+            if self.n_layers % group != 0:
+                raise ValueError(
+                    f"scan_group={group} must divide n_layers={self.n_layers}"
+                )
             scan_target = _ScanBlock
             if cfg.remat and not decode:
                 scan_target = nn.remat(_ScanBlock, **remat_kwargs)
@@ -1039,10 +1099,11 @@ class BlockStack(nn.Module):
                 variable_axes={"params": 0, "cache": 0, "losses": 0},
                 variable_broadcast=False,
                 split_rngs={"params": True, "dropout": True},
-                length=self.n_layers,
+                length=self.n_layers // group,
                 unroll=cfg.scan_unroll,
+                _split_transpose=cfg.scan_split_transpose,
                 metadata_params={nn.PARTITION_NAME: None},
-            )(cfg, train, decode, base_block, name="layers")
+            )(cfg, train, decode, base_block, group, name="layers")
             (x, _, _, _, _, _), _ = stacked(
                 (x, positions, segment_ids, aux_scale, cache_valid, attn_bias),
                 None,

@@ -71,6 +71,48 @@ class ExpertFFN(nn.Module):
         )
 
 
+def _dispatch_masks(onehots, gates, n_experts: int, capacity: int):
+    """[T, E, C] dispatch/combine one-hots from per-choice expert one-hots.
+
+    Choices claim capacity slots choice-major (every token's first choice
+    before any second choice), tracked by a running per-expert count so the
+    slot index stays unique across choices.  Shared by the dense
+    (full-token-set) and all_to_all (per-sender-slice) dispatch paths —
+    only the token set and the capacity quota differ."""
+    tokens = onehots[0].shape[0]
+    count = jnp.zeros((n_experts,), jnp.float32)
+    dispatch = jnp.zeros((tokens, n_experts, capacity), jnp.float32)
+    combine = jnp.zeros((tokens, n_experts, capacity), jnp.float32)
+    for j, onehot in enumerate(onehots):
+        position = (jnp.cumsum(onehot, axis=0) - 1.0 + count[None, :]) * onehot
+        in_capacity = (position < capacity).astype(jnp.float32) * onehot
+        pos_idx = jnp.sum(position, axis=-1).astype(jnp.int32)  # [T]
+        pos_onehot = jax.nn.one_hot(pos_idx, capacity, dtype=jnp.float32)
+        # [T, E, C]: 1 where token t's choice j landed in slot c of expert e
+        dispatch_j = in_capacity[:, :, None] * pos_onehot[:, None, :]
+        dispatch = dispatch + dispatch_j
+        combine = combine + dispatch_j * gates[:, j, None, None]
+        count = count + jnp.sum(onehot, axis=0)
+    return dispatch, combine
+
+
+def _topk_gates(probs, top_k: int):
+    """(gates [T, k], one-hots list) for top-k routing: Switch keeps the raw
+    router probability at k=1; GShard renormalizes over the chosen experts
+    so the combined output is a convex mixture."""
+    n_experts = probs.shape[-1]
+    gate_vals, expert_idx = lax.top_k(probs, top_k)  # [T, k] each
+    if top_k == 1:
+        gates = gate_vals
+    else:
+        gates = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
+    onehots = [
+        jax.nn.one_hot(expert_idx[:, j], n_experts, dtype=jnp.float32)
+        for j in range(top_k)
+    ]
+    return gates, onehots
+
+
 class MoEMLP(nn.Module):
     """Drop-in MLP replacement: top-k routed experts, EP over ``model``."""
 
@@ -100,9 +142,28 @@ class MoEMLP(nn.Module):
             raise ValueError(
                 f"moe_top_k={top_k} must be in [1, moe_experts={n_experts}]"
             )
-        logits = nn.Dense(
+        router = nn.Dense(
             n_experts, use_bias=False, dtype=jnp.float32, name="router"
-        )(xf.astype(jnp.float32))
+        )
+        if cfg.moe_dispatch not in ("dense", "alltoall"):
+            raise ValueError(
+                f"moe_dispatch={cfg.moe_dispatch!r} (dense | alltoall)"
+            )
+        if cfg.moe_dispatch == "alltoall" and cfg.moe_router == "expert_choice":
+            raise NotImplementedError(
+                "expert_choice routing needs the dense dispatch (each "
+                "expert takes its global top-capacity tokens; a sharded "
+                "token set cannot rank them locally)"
+            )
+        if (
+            cfg.moe_dispatch == "alltoall"
+            and cfg.moe_router == "topk"
+            and ep_size > 1
+        ):
+            # ep == 1 falls through to the dense path: with one rank there
+            # is no axis to exchange over, and the masks are already local
+            return self._topk_alltoall(x, router, aux_scale, ep_size, train)
+        logits = router(xf.astype(jnp.float32))
         probs = jax.nn.softmax(logits, axis=-1)  # [T, E]
 
         if cfg.moe_router == "expert_choice":
@@ -113,18 +174,7 @@ class MoEMLP(nn.Module):
             raise ValueError(
                 f"moe_router={cfg.moe_router!r} (topk | expert_choice)"
             )
-        gate_vals, expert_idx = lax.top_k(probs, top_k)  # [T, k] each
-        if top_k == 1:
-            gates = gate_vals  # Switch: the raw router probability
-        else:
-            # GShard: renormalize over the chosen experts so the combined
-            # output is a convex mixture regardless of how much mass the
-            # un-chosen experts held
-            gates = gate_vals / jnp.sum(gate_vals, axis=-1, keepdims=True)
-        onehots = [
-            jax.nn.one_hot(expert_idx[:, j], n_experts, dtype=jnp.float32)
-            for j in range(top_k)
-        ]
+        gates, onehots = _topk_gates(probs, top_k)
 
         # Load-balance loss: E * sum_i fraction_i * router_prob_i, with
         # fraction_i the share of (token, choice) assignments to expert i
@@ -147,27 +197,113 @@ class MoEMLP(nn.Module):
         capacity = max(
             1, int(cfg.moe_capacity_factor * top_k * tokens / n_experts + 0.999)
         )
-        # choices claim capacity slots choice-major (every token's first
-        # choice before any second choice), tracked by a running per-expert
-        # count so the slot index stays unique across choices
-        count = jnp.zeros((n_experts,), jnp.float32)
-        dispatch = jnp.zeros((tokens, n_experts, capacity), jnp.float32)
-        combine = jnp.zeros((tokens, n_experts, capacity), jnp.float32)
-        for j, onehot in enumerate(onehots):
-            position = (jnp.cumsum(onehot, axis=0) - 1.0 + count[None, :]) * onehot
-            in_capacity = (position < capacity).astype(jnp.float32) * onehot
-            pos_idx = jnp.sum(position, axis=-1).astype(jnp.int32)  # [T]
-            pos_onehot = jax.nn.one_hot(pos_idx, capacity, dtype=jnp.float32)
-            # [T, E, C]: 1 where token t's choice j landed in slot c of expert e
-            dispatch_j = in_capacity[:, :, None] * pos_onehot[:, None, :]
-            dispatch = dispatch + dispatch_j
-            combine = combine + dispatch_j * gates[:, j, None, None]
-            count = count + jnp.sum(onehot, axis=0)
+        dispatch, combine = _dispatch_masks(onehots, gates, n_experts, capacity)
 
         # --- expert parallelism: slice my experts, partial-combine, psum ----
         return self._apply_experts(
             x, xf, dispatch, combine, ep_size, local_experts, train
         )
+
+    def _topk_alltoall(self, x, router, aux_scale, ep_size, train):
+        """Sharded-token dispatch: each EP rank routes its ``T/ep`` token
+        slice locally and exchanges expert payloads with one ``all_to_all``
+        each way.
+
+        Per-rank mask memory and dispatch-einsum cost drop from
+        ``[T, E, C]`` to ``[T/ep, E, C/ep]`` (``ep^2`` smaller); expert
+        FLOPs are unchanged.  Capacity becomes a per-(sender, expert)
+        quota of ``C/ep`` slots — identical results to the dense path
+        while nothing overflows (pinned by
+        ``tests/test_moe.py::test_alltoall_matches_dense``), different
+        drop CHOICES under pressure (GShard's formulation: a hot sender
+        can drop while another sender's quota sits idle).
+
+        Wire protocol (``E = ep * E_local``, ``C_s`` = per-sender quota):
+        ``x_send [E, C_s, d]`` --a2a(split 0, concat 1)--> ``[E_local,
+        ep*C_s, d]`` (slot blocks in sender-rank order) -> experts ->
+        ``y_exp [E_local, ep*C_s, d]`` --a2a(split 1, concat 0)-->
+        ``[E, C_s, d]`` back at the sender -> combine -> ``[T/ep, d]``
+        --all_gather--> the replicated ``[T, d]`` the trunk expects."""
+        cfg = self.config
+        n_experts = cfg.moe_experts
+        top_k = cfg.moe_top_k
+        b, s, d = x.shape
+        tokens = b * s
+        if tokens % ep_size:
+            raise ValueError(
+                f"tokens={tokens} not divisible by EP axis size {ep_size} "
+                "(alltoall dispatch shards the token set)"
+            )
+        t_local = tokens // ep_size
+        rank = lax.axis_index(cfg.model_axis)
+        xs = lax.dynamic_slice_in_dim(
+            x.reshape(tokens, d), rank * t_local, t_local, axis=0
+        )
+
+        logits = router(xs.astype(jnp.float32))  # [T/ep, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        gates, onehots = _topk_gates(probs, top_k)
+
+        # balance loss on GLOBAL statistics: local means pmean'd over the
+        # EP axis reproduce the dense path's full-batch fractions exactly
+        assign_frac = sum(oh.mean(axis=0) for oh in onehots) / top_k
+        assign_frac = lax.pmean(assign_frac, cfg.model_axis)
+        mean_probs = lax.pmean(probs.mean(axis=0), cfg.model_axis)
+        balance = n_experts * jnp.sum(assign_frac * mean_probs)
+        if aux_scale is not None:
+            balance = balance * jnp.asarray(aux_scale, jnp.float32)
+        self.sow(
+            "losses",
+            "moe_balance",
+            balance,
+            reduce_fn=lambda a, b_: a + b_,
+            init_fn=lambda: jnp.float32(0.0),
+        )
+
+        cap_send = max(
+            1, int(cfg.moe_capacity_factor * top_k * t_local / n_experts + 0.999)
+        )
+        dispatch, combine = _dispatch_masks(onehots, gates, n_experts, cap_send)
+
+        # dispatch my tokens into per-expert slots, exchange payloads
+        x_send = jnp.einsum(
+            "td,tec->ecd", xs.astype(jnp.float32), dispatch
+        ).astype(cfg.dtype)  # [E, C_s, d]
+        with jax.named_scope("moe_dispatch_a2a"):
+            x_recv = lax.all_to_all(
+                x_send, cfg.model_axis, split_axis=0, concat_axis=1, tiled=True
+            )  # [E_local, ep*C_s, d]
+
+        import functools
+
+        expert_stack = nn.vmap(
+            ExpertFFN,
+            in_axes=0,
+            out_axes=0,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+        )
+        y_exp = ModuleShard(
+            functools.partial(expert_stack, cfg),
+            axis_name=cfg.model_axis,
+            name="experts",
+        )(x_recv)  # [E_local, ep*C_s, d]
+
+        with jax.named_scope("moe_combine_a2a"):
+            y_back = lax.all_to_all(
+                y_exp, cfg.model_axis, split_axis=1, concat_axis=0, tiled=True
+            )  # [E, C_s, d] — my tokens' outputs, expert-major
+        ys = jnp.einsum(
+            "ecd,tec->td", y_back.astype(jnp.float32), combine
+        )  # [T/ep, d]
+        with jax.named_scope("moe_token_all_gather"):
+            y = lax.all_gather(
+                ys, cfg.model_axis, axis=0, tiled=True
+            )  # [T, d] replicated over EP, as the trunk expects
+        y = y.astype(cfg.dtype).reshape(b, s, d)
+        if cfg.dropout_rate > 0.0:
+            y = nn.Dropout(rate=cfg.dropout_rate, deterministic=not train)(y)
+        return y
 
     def _expert_choice(
         self, x, xf, probs, aux_scale, ep_size, local_experts, train

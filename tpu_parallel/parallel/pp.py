@@ -494,6 +494,224 @@ def execute_pipeline_decode(
         return lax.psum(final, axis_name)
 
 
+@jax.named_scope("execute_pipeline_1f1b")
+def pipeline_1f1b_grads(
+    fwd_fn: Callable,
+    params,
+    batch,
+    rng: jax.Array,
+    *,
+    num_microbatches: int,
+    axis_name: str,
+    act_shape: Tuple[int, ...],
+    act_dtype,
+    loss_key: str = "loss",
+):
+    """Memory-bounded 1F1B schedule: loss AND gradients inside ONE scan.
+
+    GPipe (:func:`execute_pipeline`) differentiates through the whole
+    forward schedule, so reverse-mode AD keeps every microbatch's stage
+    boundary live until the backward runs — activation memory grows with
+    ``num_microbatches``.  This schedule interleaves each microbatch's
+    backward as soon as its loss cotangent can reach the rank, bounding
+    in-flight microbatches at ``2 * num_stages - 1`` per rank independent
+    of ``num_microbatches``: the scan's only O(microbatch) state is a
+    ``[2n - 1, mb, ...]`` ring buffer of saved stage INPUTS.
+
+    SPMD lockstep: every tick each rank runs one masked forward (a fresh
+    microbatch arriving on the +1 activation ring) AND one masked backward
+    (a cotangent arriving on the -1 ring, replayed remat-style with
+    ``jax.vjp`` from the saved stage input).  The static schedule, with
+    ``n`` stages and ``m`` microbatches:
+
+    - microbatch ``i`` is injected at tick ``i``; rank ``r`` forwards it
+      at ``i + r`` and backwards it at ``i + 2n - 2 - r`` (the cotangent
+      chain from the last rank, which backwards its microbatch the same
+      tick it forwards it);
+    - total ``m + 2n - 2`` ticks, each costing one forward + one
+      recompute-forward + one backward of a stage on a microbatch.
+
+    Why ``2n - 1`` and not the asynchronous-1F1B ``n``: a microbatch's
+    forward-to-backward lag on rank ``r`` is structurally ``2(n - 1 - r)``
+    ticks (activation travels ``n - 1`` ranks down, cotangent ``n - 1``
+    back, one rank per tick), so at one injection per tick the first rank
+    holds ``2n - 2`` live inputs; the extra slot makes the ring-buffer
+    overwrite strictly later than the backward's read on every rank.
+    Megatron's ``n`` bound comes from throttling steady-state injection to
+    one microbatch per TWO work slots — in lockstep SPMD (where every tick
+    already runs one F and one B per rank) that would halve throughput,
+    not memory.  ``2n - 1`` keeps full throughput and stays m-independent,
+    which is the practical point at large microbatch counts.
+
+    Per-microbatch compute cost equals GPipe-with-remat exactly (one
+    forward, one recompute-forward, one backward); the schedule adds one
+    extra ppermute per tick (two rings instead of one).
+
+    ``fwd_fn(params, x_in, microbatch, rng) -> (y, loss_sum, metrics)``
+    is the per-rank composite: select ``embed(microbatch)`` over ``x_in``
+    on the first rank, apply this rank's stage, compute the
+    last-rank-masked loss (sum over tokens) + (sum, count) metrics.  It
+    must be deterministic given ``rng`` (the backward replays it).
+
+    Returns ``(grads, metrics)``: grads are d(mean loss)/d(params) for
+    THIS data shard (already divided by the shard's token count, psum'd
+    over the pipe axis), ready for the standard partition-aware
+    ``sync_gradients``; metrics follow the (sum, count) convention with
+    per-rank masking, ready for ``sync_metrics``.
+    """
+    from tpu_parallel.core.metrics import pvary_missing, vma_of
+
+    n = lax.psum(1, axis_name)  # static under shard_map
+    stage = lax.axis_index(axis_name)
+    m = num_microbatches
+    n_slots = 2 * n - 1  # see docstring: strict bound on saved-input lag
+
+    def to_mb(a):
+        if a.shape[0] % m:
+            raise ValueError(
+                f"per-device batch {a.shape[0]} not divisible by "
+                f"num_microbatches={m}"
+            )
+        return a.reshape(m, a.shape[0] // m, *a.shape[1:])
+
+    batch_mb = jax.tree_util.tree_map(to_mb, batch)
+
+    def mb_at(idx):
+        safe = jnp.clip(idx, 0, m - 1)
+        return jax.tree_util.tree_map(
+            lambda a: lax.dynamic_index_in_dim(a, safe, axis=0, keepdims=False),
+            batch_mb,
+        )
+
+    def mb_injected_at(u):
+        # microbatch on rank-0's injection clock at tick u, else -1
+        valid = jnp.logical_and(u >= 0, u < m)
+        return jnp.where(valid, u, -1)
+
+    total_ticks = m + 2 * n - 2
+
+    # vma discipline — and it is CORRECTNESS, not just typing: jax.vjp's
+    # cotangent for an input replicated over an axis is automatically
+    # psum'd over that axis (AD transposes the implicit broadcast).  The
+    # activation ring must therefore carry exactly the vma the stage
+    # output has — overclaiming (e.g. model-varying for a TP-replicated
+    # residual stream) would suppress the model-axis reduction of the
+    # input cotangent and corrupt upstream gradients.  The stable vma is
+    # found by one fixed-point pass of eval_shape.
+    tok_leaf = jax.tree_util.tree_leaves(batch)[0]
+    base_vma = tuple(sorted(set(vma_of(tok_leaf)) | {axis_name}))
+    x_seed = pvary_missing(jnp.zeros(act_shape, act_dtype), base_vma)
+    out_abs = jax.eval_shape(fwd_fn, params, x_seed, mb_at(jnp.int32(0)), rng)
+    y_abs, loss_abs, mets_abs = out_abs
+    act_vma = tuple(sorted(set(vma_of(y_abs)) | {axis_name}))
+    if set(act_vma) != set(vma_of(x_seed)):
+        x_seed = pvary_missing(x_seed, act_vma)
+        out_abs = jax.eval_shape(
+            fwd_fn, params, x_seed, mb_at(jnp.int32(0)), rng
+        )
+        y_abs, loss_abs, mets_abs = out_abs
+        if set(vma_of(y_abs)) - set(act_vma):
+            raise ValueError(
+                f"1F1B activation vma did not reach a fixed point: input "
+                f"{act_vma} -> output {vma_of(y_abs)}"
+            )
+
+    def acc_zero(s):
+        # accumulators pick up the pipe-varying schedule masks on top of
+        # the per-tick value's own vma
+        return pvary_missing(
+            jnp.zeros(s.shape, s.dtype),
+            tuple(sorted(set(vma_of(s)) | {axis_name})),
+        )
+
+    fwd_ring0 = x_seed
+    # cotangent dtype follows the primal (bf16 activations -> bf16 cots)
+    bwd_ring0 = pvary_missing(jnp.zeros(act_shape, act_dtype), act_vma)
+    saved0 = pvary_missing(jnp.zeros((n_slots, *act_shape), act_dtype), act_vma)
+    grads0 = jax.tree_util.tree_map(
+        lambda p: pvary_missing(
+            jnp.zeros(p.shape, p.dtype),
+            tuple(sorted(set(vma_of(p)) | {axis_name})),
+        ),
+        params,
+    )
+    metrics0 = jax.tree_util.tree_map(acc_zero, mets_abs)
+
+    perm_fwd = [(i, (i + 1) % n) for i in range(n)]
+    perm_bwd = [(i, (i - 1) % n) for i in range(n)]
+
+    def tick_body(carry, t):
+        fwd_ring, bwd_ring, saved, grads, mets_acc = carry
+
+        # ---- forward half-tick -------------------------------------------
+        i_f = mb_injected_at(t - stage)
+        f_valid = i_f >= 0
+        f_scale = f_valid.astype(jnp.float32)
+        mb_f = mb_at(i_f)
+        rng_f = jax.random.fold_in(rng, jnp.clip(i_f, 0, m - 1))
+        y, _, mets_f = fwd_fn(params, fwd_ring, mb_f, rng_f)
+        mets_acc = jax.tree_util.tree_map(
+            lambda acc, v: acc + v * f_scale, mets_acc, mets_f
+        )
+        slot_f = jnp.clip(i_f, 0, m - 1) % n_slots
+        cur = lax.dynamic_index_in_dim(saved, slot_f, axis=0, keepdims=False)
+        saved = lax.dynamic_update_index_in_dim(
+            saved, jnp.where(f_valid, fwd_ring, cur), slot_f, axis=0
+        )
+
+        # ---- backward half-tick ------------------------------------------
+        i_b = mb_injected_at(t - (2 * n - 2 - stage))
+        b_valid = i_b >= 0
+        b_scale = b_valid.astype(jnp.float32)
+        mb_b = mb_at(i_b)
+        rng_b = jax.random.fold_in(rng, jnp.clip(i_b, 0, m - 1))
+        slot_b = jnp.clip(i_b, 0, m - 1) % n_slots
+        x_saved = lax.dynamic_index_in_dim(saved, slot_b, axis=0, keepdims=False)
+        # the last rank's output cotangent comes from ITS OWN loss (the 1.0
+        # seed below); the ring item it received on the wrap edge is garbage
+        g_y = jnp.where(
+            stage == n - 1, jnp.zeros_like(bwd_ring), bwd_ring
+        ).astype(act_dtype)
+        _, f_vjp = jax.vjp(
+            lambda p, xi: fwd_fn(p, xi, mb_b, rng_b), params, x_saved
+        )
+        # cotangent types must equal the primal output types exactly —
+        # including vma (the eval_shape abstracts carry it)
+        zero_mets = jax.tree_util.tree_map(
+            lambda s: pvary_missing(jnp.zeros(s.shape, s.dtype), vma_of(s)),
+            mets_abs,
+        )
+        loss_ct = pvary_missing(
+            jnp.ones((), loss_abs.dtype), vma_of(loss_abs)
+        )
+        g_params, g_x = f_vjp((g_y, loss_ct, zero_mets))
+        grads = jax.tree_util.tree_map(
+            lambda acc, g: acc + g * b_scale, grads, g_params
+        )
+
+        # ---- rotate both rings -------------------------------------------
+        fwd_ring = lax.ppermute(
+            jnp.where(f_valid, y, jnp.zeros_like(y)), axis_name, perm=perm_fwd
+        )
+        bwd_ring = lax.ppermute(
+            jnp.where(b_valid, g_x, jnp.zeros_like(g_x)),
+            axis_name,
+            perm=perm_bwd,
+        )
+        return (fwd_ring, bwd_ring, saved, grads, mets_acc), None
+
+    carry0 = (fwd_ring0, bwd_ring0, saved0, grads0, metrics0)
+    ticks = jnp.arange(total_ticks, dtype=jnp.int32)
+    (_, _, _, grads, metrics), _ = lax.scan(tick_body, carry0, ticks)
+
+    # normalize to the mean-loss gradient this data shard contributes: the
+    # token count lives on the last rank only — share it around the pipe
+    n_tok = lax.psum(metrics[loss_key][1], axis_name)
+    inv = 1.0 / jnp.maximum(n_tok, 1.0)
+    grads = jax.tree_util.tree_map(lambda g: g * inv.astype(g.dtype), grads)
+    return grads, metrics
+
+
 def last_stage_mask(axis_name: str = "pipe") -> jax.Array:
     """1.0 on the final pipe rank, 0.0 elsewhere.
 

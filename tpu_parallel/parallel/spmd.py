@@ -89,6 +89,7 @@ def build_train_functions(
     eval_loss_fn: Optional[LossFn] = None,
     ema_decay: float = 0.0,
     check_vma: bool = True,
+    grad_fn: Optional[Callable] = None,
 ) -> TrainFunctions:
     """Build matched (init, train_step) functions for ``mesh``.
 
@@ -122,6 +123,12 @@ def build_train_functions(
     ``dynamic_slice`` ("Please open an issue ... as a temporary workaround
     pass check_vma=False").  Real-TPU pallas does not hit that path.
     """
+    if grad_fn is not None and num_minibatches > 1:
+        raise ValueError(
+            "num_minibatches > 1 does not compose with a schedule-owned "
+            "grad_fn (the 1F1B pipeline consumes the whole batch; split it "
+            "with num_microbatches instead)"
+        )
     if isinstance(grad_sync_axes, str):
         grad_sync_axes = (grad_sync_axes,)
     if isinstance(replicated_loss_axes, str):
@@ -181,9 +188,15 @@ def build_train_functions(
 
     def step(state: TrainState, metrics: Optional[Metrics], batch):
         rng, step_rng = jax.random.split(state.rng)
-        grads, step_metrics = accumulate_gradients(
-            state, batch, step_rng, num_minibatches, loss_fn, use_scan=use_scan
-        )
+        if grad_fn is not None:
+            # schedule-owned gradients (the 1F1B pipeline computes loss AND
+            # grads inside one scan — jax.grad through a forward schedule
+            # would rebuild GPipe's m-proportional activation memory)
+            grads, step_metrics = grad_fn(state.params, batch, step_rng)
+        else:
+            grads, step_metrics = accumulate_gradients(
+                state, batch, step_rng, num_minibatches, loss_fn, use_scan=use_scan
+            )
         with jax.named_scope("sync_gradients"):
             grads = fsdp.sync_gradients(
                 grads,

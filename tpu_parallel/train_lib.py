@@ -269,6 +269,24 @@ class Trainer:
         # exposed so data loaders can place batches in the step's layout
         # directly (no per-step reshard): train.py passes it to DataLoader
         self.batch_spec = P("data", "seq") if seq_parallel else P("data")
+        grad_fn = None
+        schedule = getattr(self.model_config, "pipe_schedule", "gpipe")
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"pipe_schedule={schedule!r} (gpipe | 1f1b) — an unknown "
+                "value would silently train with GPipe's m-proportional "
+                "activation memory"
+            )
+        if schedule == "1f1b":
+            from tpu_parallel.models.gpt import make_gpt_1f1b_grad_fn
+            from tpu_parallel.models.seq2seq import Seq2SeqConfig
+
+            if isinstance(self.model_config, Seq2SeqConfig):
+                raise NotImplementedError(
+                    "pipe_schedule='1f1b' for the encoder-decoder family "
+                    "(two sequential pipelines need their own buffer walk)"
+                )
+            grad_fn = make_gpt_1f1b_grad_fn(self.model_config)
         self.funcs: TrainFunctions = build_train_functions(
             model_init,
             self.loss_fn,
@@ -288,6 +306,7 @@ class Trainer:
                 self.model_config.attn_impl in ("flash", "ulysses")
                 and jax.default_backend() != "tpu"
             ),
+            grad_fn=grad_fn,
         )
         self.state: Optional[TrainState] = None
 
