@@ -1,0 +1,59 @@
+"""GPT-2 125M on a real tokenized corpus with a held-out eval split.
+
+The round-5 end-to-end data-path demonstration (BASELINE.md): real text
+(system documentation + the Python standard library sources, ~21M tokens)
+tokenized byte-level by ``scripts/prepare_data.py --tokenizer bytes`` (the
+image carries no cached BPE assets and no network), trained through
+``Trainer.fit`` with periodic held-out evaluation and best-checkpoint
+keeping, resumed once from a mid-run checkpoint in a fresh process.
+
+The model is the unmodified ``gpt2_125m`` architecture (vocab 50304 —
+byte ids occupy the first 256 rows; the rest stay untrained), so every
+bench/serving artifact applies to the resulting checkpoints unchanged.
+Batch shape follows the round-5 ladder (per-pass 16 rows, unrolled).
+
+    python scripts/prepare_data.py corpus.txt corpus.bin --tokenizer bytes
+    python train.py --config=configs/gpt2_125m_corpus.py \
+        --config.data_path=corpus.bin --config.checkpoint_dir=/tmp/ckpt
+"""
+
+from ml_collections import ConfigDict
+
+from configs.common import model_overrides
+
+
+def get_config():
+    c = ConfigDict()
+    c.simulate_cpu_devices = 0
+    c.model = "gpt2_125m"
+    c.model_overrides = model_overrides(
+        dropout_rate=0.0,
+        attn_impl="flash",
+        remat_policy="proj_attn",
+        scan_layers=False,
+    )
+    c.mesh = ConfigDict(dict(data=-1, model=1, pipe=1, seq=1))
+    c.global_batch_size = 32
+    c.num_minibatches = 2
+    c.steps = 2000
+    c.optimizer = "adamw"
+    c.lr_schedule = "cosine"
+    c.ema_decay = 0.0
+    c.learning_rate = 3e-4
+    c.warmup_steps = 100
+    c.weight_decay = 0.1
+    c.grad_clip = 1.0
+    c.seed = 0
+    c.log_every = 100
+    c.donate = True
+    c.checkpoint_dir = ""
+    c.checkpoint_every = 400
+    c.data_path = ""
+    c.data_format = "flat"
+    c.eos_id = 50256
+    # held-out evaluation: last 10% of windows never trained on
+    c.eval_steps = 20
+    c.eval_every = 200
+    c.eval_fraction = 0.1
+    c.keep_best = True
+    return c

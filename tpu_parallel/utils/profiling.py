@@ -44,10 +44,26 @@ def transformer_flops_per_token(cfg) -> float:
     ``12 * L * d * T`` for the T-length causal attention (QK^T, softmax*V,
     fwd+bwd).  Embedding lookups are excluded (gather, not matmul); the
     untied lm_head matmul is included.
+
+    MoE configs use ACTIVE-param accounting (the standard MoE MFU
+    convention): each token runs ``moe_top_k`` experts' FFN matmuls (one
+    under expert-choice, whose per-token average is one expert at
+    capacity_factor 1) plus the router projection — FLOPs scale with k,
+    not with the total expert count, so a Switch model's MFU reads
+    against the same roofline as its dense-equivalent.
     """
+    mlp_term = 2 * cfg.mlp_ratio * cfg.d_model**2
+    moe_experts = getattr(cfg, "moe_experts", 0)
+    if moe_experts:
+        k = (
+            cfg.moe_top_k
+            if getattr(cfg, "moe_router", "topk") == "topk"
+            else 1
+        )
+        mlp_term = k * mlp_term + cfg.d_model * moe_experts  # + router
     matmul_params = (
         cfg.vocab_size * cfg.d_model  # lm_head projection
-        + cfg.n_layers * (4 * cfg.d_model**2 + 2 * cfg.mlp_ratio * cfg.d_model**2)
+        + cfg.n_layers * (4 * cfg.d_model**2 + mlp_term)
     )
     attn = 12 * cfg.n_layers * cfg.d_model * cfg.seq_len
     return 6 * matmul_params + attn
