@@ -76,9 +76,10 @@ def child_main():
 
     if on_tpu:
         # Defaults from the round-5 sweep (SWEEP_r05.json, scripts/
-        # sweep_bench.py): 0.4689 MFU on v5e-1 at batch 128 with 8
+        # sweep_bench.py): 0.4735 MFU on v5e-1 at batch 256 with 16
         # accumulation minibatches (per-pass batch 16), up from round 4's
-        # 0.4468 at batch 16/minib 1.  The earlier levers stand (flash
+        # 0.4468 at batch 16/minib 1 (ladder: 0.4689 at 128/8, 0.4719 at
+        # 192/12 — gains taper but stay monotone).  The earlier levers stand (flash
         # 512x512 tiles, "proj_attn" remat, unrolled layers — see
         # SWEEP_r03/r04); round 5 added the batch ladder: throughput climbs
         # with accumulated batch while the per-pass shape stays at the
@@ -88,7 +89,7 @@ def child_main():
         # best 0.4278 at the same 128/8 shape, an ~9% structural tax the
         # sweeps could not close — the bench stays unrolled, deep configs
         # (350M/1B) keep scan for compile budget (docs/05).
-        model, batch, steps, minib = "gpt2_125m", 128 * n_chips, 20, 8
+        model, batch, steps, minib = "gpt2_125m", 256 * n_chips, 12, 16
         overrides = dict(
             dropout_rate=0.0,
             remat=True,
