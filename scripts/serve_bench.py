@@ -1,0 +1,165 @@
+"""Serving-engine throughput vs. request arrival rate.
+
+Drives the continuous-batching engine (``tpu_parallel.serving``) with a
+Poisson arrival stream of random-length prompts and emits ONE JSON record
+per (rate, slots) point — throughput, TTFT p50/p95, inter-token latency,
+slot occupancy, queue depth — in the same style as the ``DECODE_r*.json``
+static-decode records, so rounds can track serving perf side by side with
+static decode.  Not part of the driver contract.
+
+Usage:
+  python scripts/serve_bench.py [--requests N] [--rate R[,R2,...]]
+      [--slots S] [--new T] [--prompt-min P] [--prompt-max P]
+      [--seed K] [--out FILE]
+
+Defaults exercise 32 requests at rates 8 and 0 (0 = all-at-once) on the
+CPU tiny model (gpt2_125m on TPU).
+Records append to ``--out`` (default serve_bench.jsonl next to this
+script's cwd) via the shared MetricLogger JSONL sink.
+"""
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+
+def run_point(model, params, cfg, *, n_requests, rate, n_slots, new_tokens,
+              prompt_min, prompt_max, seed):
+    from tpu_parallel.serving import (
+        Request,
+        SchedulerConfig,
+        ServingEngine,
+    )
+
+    rnd = random.Random(seed)
+    lengths = [rnd.randint(prompt_min, prompt_max) for _ in range(n_requests)]
+    prompts = [
+        [rnd.randrange(1, cfg.vocab_size) for _ in range(length)]
+        for length in lengths
+    ]
+    # Poisson process: exponential inter-arrival gaps at `rate` req/s
+    # (rate <= 0 or huge => everything arrives at t=0)
+    arrivals, t = [], 0.0
+    for _ in range(n_requests):
+        arrivals.append(t)
+        if rate > 0:
+            t += rnd.expovariate(rate)
+
+    eng = ServingEngine(
+        model, params, n_slots=n_slots,
+        scheduler=SchedulerConfig(max_prefills_per_tick=2),
+        rng=jax.random.PRNGKey(seed),
+    )
+    # warm the compiles outside the measured window: one prefill per
+    # DISTINCT prompt length (jit recompiles per shape) + the one
+    # decode-step program; then start metrics from a clean slate
+    for length in sorted(set(lengths)):
+        eng.add_request(
+            Request(prompt=prompts[lengths.index(length)][:length],
+                    max_new_tokens=2)
+        )
+        eng.run()
+    from tpu_parallel.serving import ServingMetrics
+
+    eng.metrics = ServingMetrics()
+
+    t0 = time.perf_counter()
+    outs, submitted = [], 0
+    while submitted < n_requests or eng.has_work():
+        now = time.perf_counter() - t0
+        while submitted < n_requests and arrivals[submitted] <= now:
+            outs.append(
+                eng.add_request(
+                    Request(
+                        prompt=prompts[submitted],
+                        max_new_tokens=new_tokens,
+                    )
+                )
+            )
+            submitted += 1
+        if eng.has_work():
+            eng.step()
+        else:
+            # idle until the next arrival
+            time.sleep(
+                max(0.0, arrivals[submitted] - (time.perf_counter() - t0))
+            )
+    wall = time.perf_counter() - t0
+    assert all(out.status == "finished" for out in outs)
+
+    summary = eng.metrics.summary()
+    return {
+        "bench": "serve",
+        "model": getattr(cfg, "_name", None) or (
+            "gpt2_125m" if jax.default_backend() == "tpu" else "tiny"
+        ),
+        "backend": jax.default_backend(),
+        "n_requests": n_requests,
+        "arrival_rate_per_sec": rate if rate > 0 else "all_at_once",
+        "n_slots": n_slots,
+        "prompt_len": [prompt_min, prompt_max],
+        "new_tokens": new_tokens,
+        "kv_cache": cfg.kv_cache_dtype,
+        "wall_s": round(wall, 3),
+        "request_tokens_per_sec": round(
+            n_requests * new_tokens / wall, 1
+        ),
+        **summary,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--rate", type=str, default="8,0")
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--new", type=int, default=0,
+                    help="tokens per request (0 = model-dependent default)")
+    ap.add_argument("--prompt-min", type=int, default=0)
+    ap.add_argument("--prompt-max", type=int, default=0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", type=str, default="serve_bench")
+    args = ap.parse_args()
+
+    from tpu_parallel.models import GPTLM, gpt2_125m, tiny_test
+    from tpu_parallel.utils.logging_utils import MetricLogger
+
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = (
+        gpt2_125m(dropout_rate=0.0, remat=False)
+        if on_tpu
+        else tiny_test(remat=False)
+    )
+    new_tokens = args.new or (64 if on_tpu else 8)
+    prompt_min = args.prompt_min or (128 if on_tpu else 3)
+    prompt_max = args.prompt_max or (
+        min(512, cfg.seq_len - new_tokens) if on_tpu
+        else cfg.seq_len - new_tokens - 2
+    )
+    model = GPTLM(cfg)
+    probe = jax.numpy.zeros((1, prompt_max), jax.numpy.int32)
+    params = model.init(
+        {"params": jax.random.PRNGKey(1)}, probe, train=False
+    )["params"]
+
+    logger = MetricLogger(logdir=".", name=args.out)
+    for rate in (float(r) for r in args.rate.split(",")):
+        record = run_point(
+            model, params, cfg,
+            n_requests=args.requests, rate=rate, n_slots=args.slots,
+            new_tokens=new_tokens, prompt_min=prompt_min,
+            prompt_max=prompt_max, seed=args.seed,
+        )
+        logger.log_record(record)
+    logger.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -60,6 +60,7 @@ _SLOW_FILES = {
     "test_multihost.py",
     "test_train_lib.py",
     "test_generate.py",
+    "test_serving.py",
 }
 _SLOW_TESTS = {
     "test_pp_aux_gradient_invariance",

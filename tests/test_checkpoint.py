@@ -67,6 +67,25 @@ def test_profiling_helpers(devices):
     cfg = tiny_test()
     flops = transformer_flops_per_token(cfg)
     assert flops > 0
+    # expert_choice active-param accounting: every expert fills its
+    # capacity, so per-token FLOPs scale with moe_capacity_factor — a
+    # capacity factor of 1.25 must read ~25% more FFN work than 1.0
+    # (ADVICE.md round-5: the old k=1 accounting overstated MFU)
+    ec1 = transformer_flops_per_token(
+        tiny_test(
+            moe_experts=4, moe_router="expert_choice", moe_capacity_factor=1.0
+        )
+    )
+    ec125 = transformer_flops_per_token(
+        tiny_test(
+            moe_experts=4, moe_router="expert_choice", moe_capacity_factor=1.25
+        )
+    )
+    assert ec125 > ec1
+    topk1 = transformer_flops_per_token(
+        tiny_test(moe_experts=4, moe_router="topk", moe_top_k=1)
+    )
+    assert ec1 == topk1  # capacity 1.0 == one expert per token
     f = jax.jit(lambda x: x * 2)
     dt = timeit(f, jnp.ones(16), iters=3, warmup=1)
     assert dt > 0

@@ -113,6 +113,36 @@ def _sample_sharded(
     return vocab_parallel_argmax(lf + g, axis_name)
 
 
+def decode_step(
+    model: GPTLM,
+    params,
+    cache,
+    tok: jax.Array,
+    positions: jax.Array,
+    write_index: Optional[jax.Array] = None,
+):
+    """One single-token decode tick — THE reusable core of every decode loop.
+
+    ``tok``/``positions``: [batch] current tokens and their global positions.
+    Returns ``(hidden [batch, 1, d_model], new_cache)``.  Shared by the
+    :func:`_generate_core` scan body (aligned batches, ``write_index=None``)
+    and the continuous-batching engine (``tpu_parallel.serving.engine``,
+    which passes per-row ``write_index`` so each slot's K/V lands at its own
+    cache depth).
+    """
+    hidden, updated = model.apply(
+        {"params": params, "cache": cache},
+        tok[:, None],
+        positions=positions[:, None],
+        train=False,
+        decode=True,
+        hidden_only=True,
+        mutable=["cache"],
+        write_index=write_index,
+    )
+    return hidden, updated["cache"]
+
+
 def _generate_core(
     model: GPTLM,
     params,
@@ -198,18 +228,10 @@ def _generate_core(
 
     def step(carry, _):
         cache, tok, pos, rng = carry
-        hidden, updated = model.apply(
-            {"params": params, "cache": cache},
-            tok[:, None],
-            positions=pos[:, None],
-            train=False,
-            decode=True,
-            hidden_only=True,
-            mutable=["cache"],
-        )
+        hidden, cache = decode_step(model, params, cache, tok, pos)
         rng, sub = jax.random.split(rng)
         nxt = next_token(hidden, sub)
-        return (updated["cache"], nxt, pos + 1, rng), tok
+        return (cache, nxt, pos + 1, rng), tok
 
     init = (variables["cache"], first, lengths, rng)
     (_, last, _, _), toks = lax.scan(step, init, None, length=max_new_tokens - 1)
@@ -346,7 +368,9 @@ class _HashableTree:
         )
 
 
-def build_sharded_serving(model, mesh, param_specs, batch_specs, out_spec, core):
+def build_sharded_serving(
+    model, mesh, param_specs, batch_specs, out_spec, core, fold_axes=None,
+):
     """The one shard_map serving harness, shared by every family.
 
     ``core(model, params, *batch_args, rng)`` is the traceable decode body
@@ -358,14 +382,24 @@ def build_sharded_serving(model, mesh, param_specs, batch_specs, out_spec, core)
     vocab-parallel collectives in :func:`_sample_sharded` — or an
     identical-rng gathered sample on the top_p path; the decode ring
     psum-broadcasts over pipe), which the checker cannot prove.
+
+    ``fold_axes`` overrides the RNG fold: the default ``None`` folds over
+    the data axis (batch rows are data-sharded, shards must draw
+    independent noise); the serving engine passes ``()`` — its slot arrays
+    ride REPLICATED over the data axis, so every rank must draw the SAME
+    noise or the replicated outputs silently diverge across ranks.
     """
     from jax.sharding import PartitionSpec as P
 
     from tpu_parallel.core.rng import fold_rng_over_axis
 
+    if fold_axes is None:
+        fold_axes = (model.config.data_axis,)
+
     def body(params, *args):
         *batch_args, rng = args
-        rng = fold_rng_over_axis(rng, (model.config.data_axis,))
+        if fold_axes:
+            rng = fold_rng_over_axis(rng, tuple(fold_axes))
         return core(model, params, *batch_args, rng)
 
     return jax.jit(

@@ -147,8 +147,13 @@ class GPTLM(nn.Module):
         train: bool = True,
         decode: bool = False,
         hidden_only: bool = False,
+        write_index: Optional[jax.Array] = None,
     ) -> jax.Array:
         cfg = self.config
+        if write_index is not None and not decode:
+            raise ValueError(
+                "write_index (slot-indexed cache writes) requires decode=True"
+            )
         if decode and positions is None:
             # default decode positions from a model-level step counter, so
             # learned positional embeddings see global positions (Attention
@@ -194,6 +199,13 @@ class GPTLM(nn.Module):
                 "axis); on a pipe=1 mesh the knob would be silently ignored"
             )
         if cfg.pipe_size > 1:
+            if write_index is not None:
+                raise NotImplementedError(
+                    "slot-indexed cache writes under pipeline parallelism "
+                    "(the decode ring's per-stage caches would need the "
+                    "write-slot table as a ring extra — serve pipe meshes "
+                    "through generate_sharded, not the serving engine)"
+                )
             chunks = cfg.pipe_size * cfg.pipe_interleave
             if cfg.n_layers % chunks != 0:
                 raise ValueError(
@@ -266,6 +278,7 @@ class GPTLM(nn.Module):
                 train=train,
                 decode=decode,
                 attn_bias=attn_bias,
+                write_index=write_index,
             )
 
         if cfg.prenorm:

@@ -479,6 +479,14 @@ class Attention(nn.Module):
     ``cache`` collection of length ``seq_len`` (created on first mutable
     apply), and queries attend to the full cache prefix.  The same path
     serves prefill (multi-token write at index 0) and per-token decode.
+
+    ``write_index`` [batch] enables SLOT-INDEXED cache writes for the
+    continuous-batching engine (``tpu_parallel.serving``): each row's
+    single-token K/V lands at its OWN cache slot instead of the shared
+    scalar ``cache_index`` — rows in the same step may sit at different
+    depths of their generations.  The attention read is unchanged (it
+    already keys off the stored per-slot position table, not slot
+    indices), so aligned and slot-indexed layouts read identically.
     """
 
     config: TransformerConfig
@@ -495,6 +503,7 @@ class Attention(nn.Module):
         decode: bool = False,
         cache_valid: Optional[jax.Array] = None,
         attn_bias: Optional[jax.Array] = None,
+        write_index: Optional[jax.Array] = None,
     ) -> jax.Array:
         cfg = self.config
         tp_size = axis_size_or_none(cfg.model_axis) or 1
@@ -643,14 +652,39 @@ class Attention(nn.Module):
                 keep = lambda new, old: new
             else:
                 keep = lambda new, old: jnp.where(cache_valid, new, old)
+            if write_index is not None:
+                # per-row slot writes (continuous batching): the update is a
+                # batched scatter at each row's own index, not one contiguous
+                # dynamic-slice.  Single-token steps only — a multi-token
+                # write would need per-row slice semantics nothing asks for.
+                if x.shape[1] != 1:
+                    raise NotImplementedError(
+                        "write_index (slot-indexed cache writes) requires "
+                        f"single-token decode steps, got {x.shape[1]} tokens"
+                    )
+                if cfg.beam_width > 1:
+                    raise NotImplementedError(
+                        "write_index under lazy beam search (beam_src slot "
+                        "bookkeeping assumes the shared scalar cache_index)"
+                    )
+                rows = jnp.arange(b)
+                wi = write_index.astype(jnp.int32)
+                # out-of-range rows (e.g. a pool's free slots) fall under
+                # JAX's default scatter semantics: the update is DROPPED,
+                # leaving the cache intact — deliberately not clamped,
+                # which would overwrite a valid boundary entry instead
+                upd = lambda buf, new: buf.at[rows, wi].set(
+                    new[:, 0].astype(buf.dtype)
+                )
+            else:
+                upd = lambda buf, new: lax.dynamic_update_slice_in_dim(
+                    buf, new, idx, axis=1
+                )
             if quant_cache:
                 from tpu_parallel.models.quantize import absmax_int8
 
                 kq, ks = absmax_int8(k, axis=-1)
                 vq, vs = absmax_int8(v, axis=-1)
-                upd = lambda buf, new: lax.dynamic_update_slice_in_dim(
-                    buf, new, idx, axis=1
-                )
                 new_k = upd(cached_k.value, kq)
                 new_v = upd(cached_v.value, vq)
                 new_ks = upd(cached_k_scale.value, ks)
@@ -663,17 +697,11 @@ class Attention(nn.Module):
                 k_all = (new_k.astype(jnp.float32) * new_ks).astype(cfg.dtype)
                 v_all = (new_v.astype(jnp.float32) * new_vs).astype(cfg.dtype)
             else:
-                k_all = lax.dynamic_update_slice_in_dim(
-                    cached_k.value, k, idx, axis=1
-                )
-                v_all = lax.dynamic_update_slice_in_dim(
-                    cached_v.value, v, idx, axis=1
-                )
+                k_all = upd(cached_k.value, k)
+                v_all = upd(cached_v.value, v)
                 cached_k.value = keep(k_all, cached_k.value)
                 cached_v.value = keep(v_all, cached_v.value)
-            new_p = lax.dynamic_update_slice_in_dim(
-                cached_p.value, positions.astype(jnp.int32), idx, axis=1
-            )
+            new_p = upd(cached_p.value, positions.astype(jnp.int32))
             cached_p.value = keep(new_p, cached_p.value)
             cache_index.value = keep(idx + x.shape[1], idx)
             if cfg.beam_width > 1:
@@ -919,6 +947,7 @@ class Block(nn.Module):
         aux_scale: Optional[jax.Array] = None,
         cache_valid: Optional[jax.Array] = None,
         attn_bias: Optional[jax.Array] = None,
+        write_index: Optional[jax.Array] = None,
     ) -> jax.Array:
         cfg = self.config
         if decode and cfg.moe_experts > 0 and cfg.moe_router == "expert_choice":
@@ -946,6 +975,7 @@ class Block(nn.Module):
             decode=decode,
             cache_valid=cache_valid,
             attn_bias=attn_bias,
+            write_index=write_index,
         )
         if cfg.prenorm:
             h = make_norm(cfg, "norm_attn")(x).astype(cfg.dtype)
@@ -984,7 +1014,10 @@ class _ScanBlock(nn.Module):
 
     @nn.compact
     def __call__(self, carry, _):
-        x, positions, segment_ids, aux_scale, cache_valid, attn_bias = carry
+        (
+            x, positions, segment_ids, aux_scale, cache_valid, attn_bias,
+            write_index,
+        ) = carry
         for j in range(self.group):
             name = "block" if self.group == 1 else f"block{j}"
             x = self.block_cls(self.config, name=name)(
@@ -996,9 +1029,13 @@ class _ScanBlock(nn.Module):
                 aux_scale=aux_scale,
                 cache_valid=cache_valid,
                 attn_bias=attn_bias,
+                write_index=write_index,
             )
         return (
-            (x, positions, segment_ids, aux_scale, cache_valid, attn_bias),
+            (
+                x, positions, segment_ids, aux_scale, cache_valid, attn_bias,
+                write_index,
+            ),
             None,
         )
 
@@ -1051,6 +1088,7 @@ class BlockStack(nn.Module):
         aux_scale: Optional[jax.Array] = None,
         cache_valid: Optional[jax.Array] = None,
         attn_bias: Optional[jax.Array] = None,
+        write_index: Optional[jax.Array] = None,
     ) -> jax.Array:
         cfg = self.config
         remat_kwargs = remat_kwargs_for(cfg)
@@ -1104,8 +1142,11 @@ class BlockStack(nn.Module):
                 _split_transpose=cfg.scan_split_transpose,
                 metadata_params={nn.PARTITION_NAME: None},
             )(cfg, train, decode, base_block, group, name="layers")
-            (x, _, _, _, _, _), _ = stacked(
-                (x, positions, segment_ids, aux_scale, cache_valid, attn_bias),
+            (x, _, _, _, _, _, _), _ = stacked(
+                (
+                    x, positions, segment_ids, aux_scale, cache_valid,
+                    attn_bias, write_index,
+                ),
                 None,
             )
         else:
@@ -1121,7 +1162,7 @@ class BlockStack(nn.Module):
             for i in range(self.n_layers):
                 x = block_cls(cfg, name=f"layer_{i}")(
                     x, positions, segment_ids, train, decode, aux_scale,
-                    cache_valid, attn_bias,
+                    cache_valid, attn_bias, write_index,
                 )
         return x
 

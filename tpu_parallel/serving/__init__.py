@@ -1,0 +1,38 @@
+"""Continuous-batching serving: iteration-level scheduling over a slot
+pool of KV caches (docs/10_serving_engine.md)."""
+
+from tpu_parallel.serving.cache_pool import CachePool, insert_rows
+from tpu_parallel.serving.engine import ServingEngine, sample_tokens
+from tpu_parallel.serving.metrics import ServingMetrics, percentile
+from tpu_parallel.serving.request import (
+    EXPIRED,
+    FINISHED,
+    QUEUED,
+    REJECTED,
+    RUNNING,
+    Request,
+    RequestOutput,
+    SamplingParams,
+    StreamEvent,
+)
+from tpu_parallel.serving.scheduler import FIFOScheduler, SchedulerConfig
+
+__all__ = [
+    "CachePool",
+    "insert_rows",
+    "ServingEngine",
+    "sample_tokens",
+    "ServingMetrics",
+    "percentile",
+    "Request",
+    "RequestOutput",
+    "SamplingParams",
+    "StreamEvent",
+    "QUEUED",
+    "RUNNING",
+    "FINISHED",
+    "REJECTED",
+    "EXPIRED",
+    "FIFOScheduler",
+    "SchedulerConfig",
+]

@@ -1,0 +1,193 @@
+"""Slot-based KV-cache pool for continuous batching.
+
+The pool is ONE cache pytree in the exact per-layer layout the model's
+:class:`~tpu_parallel.models.layers.Attention` creates (stacked
+``[n_layers, n_slots, seq_len, kv_heads, head_dim]`` payloads under
+``nn.scan``, per-slot position tables, int8 scales under
+``kv_cache_dtype="int8"``) — the batch axis IS the slot axis.  Requests
+own slots for their lifetime: admission prefills the request alone
+(batch 1) and row-inserts the fresh cache into the freed slot; retirement
+just returns the slot index to the free list (the row is dead weight until
+the next insert overwrites all of it, including the position table whose
+``-1`` entries keep unwritten slots out of every attention read).
+
+Memory model: pool bytes are fixed at construction —
+``n_slots x seq_len`` K/V entries per layer regardless of how many
+requests are in flight.  There is no paging/fragmentation (slots are
+whole-sequence rows, the simplest correct layout); ``kv_cache_dtype="int8"``
+halves the payload exactly as on the static path.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_parallel.models.generate import beam_cache_batch_axis
+
+
+def _leaf_name(path) -> str:
+    return path[-1].key if hasattr(path[-1], "key") else str(path[-1])
+
+
+def insert_rows(pool_cache, fresh_cache, slot):
+    """Write a batch-1 prefill cache into row ``slot`` of the pool.
+
+    Pure tree op (traceable; the engine jits it with ``slot`` traced so one
+    compile serves every slot).  Batch axes are located by the shared
+    name registry (:func:`~tpu_parallel.models.generate.beam_cache_batch_axis`
+    — K/V payloads and int8 scales at ndim-4, position tables at ndim-2);
+    scalar counters keep the POOL's value: the engine drives decode with
+    explicit per-slot positions and ``write_index``, so the shared scalar
+    ``cache_index`` is never read on this path.
+    """
+
+    def ins(path, pool_leaf, fresh_leaf):
+        ax = beam_cache_batch_axis(path, pool_leaf)
+        if ax is None:
+            return pool_leaf
+        return lax.dynamic_update_slice_in_dim(
+            pool_leaf, fresh_leaf.astype(pool_leaf.dtype), slot, axis=ax
+        )
+
+    return jax.tree_util.tree_map_with_path(ins, pool_cache, fresh_cache)
+
+
+def _pool_cache_shapes(model, params, n_slots: int):
+    """abstract shapes of the model's decode cache at batch ``n_slots``,
+    via ``jax.eval_shape`` — no forward pass runs.  The ONE shape probe
+    behind both :func:`empty_pool` and :func:`cache_partition_specs`, so
+    the allocated pool tree and its partition specs cannot drift."""
+
+    def probe():
+        tok = jnp.zeros((n_slots, 1), jnp.int32)
+        pos = jnp.zeros((n_slots, 1), jnp.int32)
+        _, variables = model.apply(
+            {"params": params},
+            tok,
+            positions=pos,
+            train=False,
+            decode=True,
+            hidden_only=True,
+            mutable=["cache"],
+        )
+        return variables["cache"]
+
+    return jax.eval_shape(probe)
+
+
+def empty_pool(model, params, n_slots: int, shardings=None):
+    """Allocate the pool cache: the model's own decode-cache structure at
+    batch ``n_slots``, zero-filled, with every position-table entry at -1
+    (no slot attends until a request's prefill row is inserted).
+
+    Only the cache STRUCTURE comes from the model, so any config (GQA
+    widths, int8 scales, unrolled vs scanned stacks) produces its
+    matching pool.  ``shardings`` (a matching tree of ``jax.sharding``
+    objects) places each leaf sharded at BIRTH — allocating host-side and
+    ``device_put``-ing per leaf, so a TP-sharded pool never transits one
+    device whole (a pool sized to the per-device share would otherwise
+    OOM device 0 at construction).
+    """
+    import numpy as np
+
+    shapes = _pool_cache_shapes(model, params, n_slots)
+    if shardings is None:
+        def alloc(path, leaf):
+            if _leaf_name(path).startswith("cached_pos"):
+                return jnp.full(leaf.shape, -1, leaf.dtype)
+            return jnp.zeros(leaf.shape, leaf.dtype)
+
+        return jax.tree_util.tree_map_with_path(alloc, shapes)
+
+    def alloc_sharded(path, leaf, sharding):
+        fill = -1 if _leaf_name(path).startswith("cached_pos") else 0
+        host = np.full(leaf.shape, fill, leaf.dtype)
+        return jax.device_put(host, sharding)
+
+    return jax.tree_util.tree_map_with_path(alloc_sharded, shapes, shardings)
+
+
+def cache_partition_specs(model, params, n_slots: int, mesh):
+    """PartitionSpecs for every pool-cache leaf under ``mesh`` — the
+    out/in specs the sharded engine threads through
+    :func:`~tpu_parallel.models.generate.build_sharded_serving`.
+
+    K/V payloads and their int8 scales shard over the model (TP) axis at
+    the kv-head dim (ndim-2) exactly as activations do; position tables and
+    scalar counters are replicated.  Slots are NOT sharded over the data
+    axis — admission is a per-slot host decision, so every data rank holds
+    every slot (documented engine caveat: data ranks duplicate decode
+    work).  When the mesh has no model axis the payloads are replicated
+    too.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    model_axis = model.config.model_axis
+    if model_axis not in mesh.axis_names:
+        model_axis = None
+    shapes = _pool_cache_shapes(model, params, n_slots)
+
+    def spec(path, leaf):
+        name = _leaf_name(path)
+        if model_axis is not None and name.startswith(
+            ("cached_key", "cached_value", "cross_key", "cross_value")
+        ):
+            parts = [None] * leaf.ndim
+            parts[leaf.ndim - 2] = model_axis  # the kv-head dim
+            return P(*parts)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec, shapes)
+
+
+class CachePool:
+    """Host-side slot bookkeeping + the device cache pytree.
+
+    ``acquire()``/``release()`` manage the free list; ``insert()`` commits
+    a prefilled request into its slot.  The device tree lives at
+    ``self.cache`` and is REPLACED (functionally) by every insert and by
+    every engine decode tick.
+    """
+
+    def __init__(self, model, params, n_slots: int, insert_fn=None,
+                 shardings=None):
+        if n_slots < 1:
+            raise ValueError(f"n_slots={n_slots} < 1")
+        self.n_slots = n_slots
+        self.cache = empty_pool(model, params, n_slots, shardings=shardings)
+        self._free: List[int] = list(range(n_slots))
+        # donate the pool operand: the old tree is dead after every insert,
+        # and without donation XLA keeps a full second pool copy alive
+        self._insert = (
+            insert_fn
+            if insert_fn is not None
+            else jax.jit(insert_rows, donate_argnums=0)
+        )
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def occupancy(self) -> float:
+        return 1.0 - len(self._free) / self.n_slots
+
+    def acquire(self) -> Optional[int]:
+        """Claim a free slot index (lowest-first, deterministic), or None."""
+        if not self._free:
+            return None
+        return self._free.pop(0)
+
+    def release(self, slot: int) -> None:
+        if slot in self._free or not (0 <= slot < self.n_slots):
+            raise ValueError(f"bad release of slot {slot}")
+        self._free.append(slot)
+        self._free.sort()
+
+    def insert(self, fresh_cache, slot: int) -> None:
+        """Row-insert a batch-1 prefill cache into ``slot``."""
+        self.cache = self._insert(self.cache, fresh_cache, jnp.int32(slot))

@@ -1,0 +1,468 @@
+"""Continuous-batching inference engine: iteration-level scheduling over a
+fixed pool of KV-cache slots.
+
+The static path (``models/generate.py``) decodes a batch run-to-completion:
+every request starts together and the whole batch waits for the longest
+generation.  This engine decodes the SLOT POOL instead — one jitted
+single-token step over all ``n_slots`` rows per tick, compiled once — and
+lets requests join (prefill into a freed slot) and leave (EOS / length
+retirement) between ticks:
+
+- tick = [admissions] + [one decode step] + [retirements]
+- admission prefills the request ALONE (batch 1, its exact prompt length)
+  and row-inserts the fresh cache into a free slot
+  (:mod:`~tpu_parallel.serving.cache_pool`); the prefill's last hidden
+  state samples the request's first token, so TTFT is one prefill, not a
+  queue-drain.
+- the decode step threads per-slot positions and per-slot cache write
+  indices (``write_index`` — the slot-indexed write path in
+  ``models/layers.py``) because rows sit at different depths of their
+  generations; the attention mask already keys off stored per-slot
+  positions, so mixed-depth rows read correctly.
+- sampling knobs are per-REQUEST traced arrays (temperature / top_k /
+  top_p per slot, :func:`sample_tokens`): two requests with different
+  knobs share a tick without recompiling.
+- inactive (free) slots still run through the step — their sampled tokens
+  are ignored and their writes land harmlessly in dead rows; masking work
+  out of a fixed-shape jitted step is the standard slot-pool trade.
+
+Greedy equivalence: for requests submitted together, per-request outputs
+are token-identical to static ``generate()`` on the same prompts (pinned
+in ``tests/test_serving.py``) — row-parallel ops make batch composition
+invisible to each row, and both paths share
+:func:`~tpu_parallel.models.generate.decode_step`.
+
+TP serving: pass ``mesh`` (and mesh-sharded ``params``) and the engine
+wraps its prefill/decode cores in the same
+:func:`~tpu_parallel.models.generate.build_sharded_serving` harness as
+``generate_sharded`` — weights stay split, the cache pool shards over
+heads, sampling runs on gathered ``[n_slots, vocab]`` logits (small), with
+``fold_axes=()`` so every rank draws identical noise (slot arrays ride
+replicated over the data axis; data ranks duplicate decode work).  Pipe
+meshes are refused — serve those through ``generate_sharded``.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Callable, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from tpu_parallel.models.generate import (
+    _HashableTree,
+    build_sharded_serving,
+    decode_step,
+)
+from tpu_parallel.serving.cache_pool import (
+    CachePool,
+    cache_partition_specs,
+    insert_rows,
+)
+from tpu_parallel.serving.metrics import ServingMetrics
+from tpu_parallel.serving.request import (
+    FINISHED,
+    REJECTED,
+    RUNNING,
+    Request,
+    RequestOutput,
+    StreamEvent,
+)
+from tpu_parallel.serving.scheduler import FIFOScheduler, SchedulerConfig
+
+
+def sample_tokens(
+    logits: jax.Array,
+    rng: jax.Array,
+    temperature: jax.Array,
+    top_k: jax.Array,
+    top_p: jax.Array,
+) -> jax.Array:
+    """Per-ROW sampling from [batch, vocab] logits with per-row knobs.
+
+    The vectorized counterpart of ``models.generate._sample``: the knobs
+    are traced [batch] arrays, so one compiled program serves every knob
+    combination in the pool.  Same semantics per row — ``temperature == 0``
+    is exact argmax; ``top_k``/``top_p`` compose by intersection after the
+    temperature scale; ``top_k <= 0`` / ``top_p`` outside (0, 1) disable
+    that filter; the argmax token always survives the nucleus cut.
+    """
+    lf = logits.astype(jnp.float32)
+    greedy = jnp.argmax(lf, axis=-1).astype(jnp.int32)
+    # guard the temperature divide: greedy rows take the argmax branch of
+    # the final where, so their scaled logits are never read
+    t = jnp.where(temperature > 0.0, temperature, 1.0)[:, None]
+    x = lf / t
+    vocab = x.shape[-1]
+    # per-row top-k with traced k: the kth-largest value via one sort
+    k = jnp.clip(top_k.astype(jnp.int32), 0, vocab)
+    asc = jnp.sort(x, axis=-1)
+    kth = jnp.take_along_axis(
+        asc, jnp.clip(vocab - k, 0, vocab - 1)[:, None], axis=-1
+    )
+    x = jnp.where((k > 0)[:, None] & (x < kth), -jnp.inf, x)
+    # per-row nucleus on the (already top-k-filtered) distribution
+    desc = jnp.sort(x, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = cum - probs < top_p[:, None]  # mass BEFORE the token < p
+    cutoff = jnp.min(jnp.where(keep, desc, jnp.inf), axis=-1, keepdims=True)
+    use_p = ((top_p > 0.0) & (top_p < 1.0))[:, None]
+    x = jnp.where(use_p & (x < cutoff), -jnp.inf, x)
+    sampled = jax.random.categorical(rng, x, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature > 0.0, sampled, greedy)
+
+
+def _full_last_logits(cfg, params, hidden):
+    """lm_head over the last position only, FULL vocab width on every rank
+    (one tiny [batch, vocab] all_gather under TP — the per-row knob sampler
+    needs the whole row; batch is n_slots, not tokens)."""
+    from tpu_parallel.models.gpt import _lm_head_params, _make_lm_head
+    from tpu_parallel.parallel.tp import axis_size_or_none
+
+    head = _make_lm_head(cfg, name=None, gather=False, fsdp_wrap=False)
+    logits = head.apply(
+        {"params": _lm_head_params(cfg, params)}, hidden[:, -1:]
+    )[:, 0]
+    if axis_size_or_none(cfg.model_axis) is not None:
+        logits = lax.all_gather(logits, cfg.model_axis, axis=-1, tiled=True)
+    return logits
+
+
+def _prefill_core(model, params, prompt, rng):
+    """Batch-1 (or batch-N) prefill: fills a fresh cache, returns the last
+    position's full-vocab logits + the cache.  ``rng`` unused (sampling
+    happens outside so the prefill compiles per prompt LENGTH only, not
+    per knob set)."""
+    del rng
+    b, prompt_len = prompt.shape
+    positions = jnp.broadcast_to(jnp.arange(prompt_len), (b, prompt_len))
+    hidden, variables = model.apply(
+        {"params": params},
+        prompt,
+        positions=positions,
+        train=False,
+        decode=True,
+        hidden_only=True,
+        mutable=["cache"],
+    )
+    return _full_last_logits(model.config, params, hidden), variables["cache"]
+
+
+def _decode_core(
+    model, params, tok, pos, widx, temperature, top_k, top_p, cache, rng
+):
+    """One engine tick over the slot pool: slot-indexed cache writes,
+    per-slot sampling.  Returns (next_tokens [n_slots], new cache)."""
+    hidden, cache = decode_step(
+        model, params, cache, tok, pos, write_index=widx
+    )
+    logits = _full_last_logits(model.config, params, hidden)
+    nxt = sample_tokens(logits, rng, temperature, top_k, top_p)
+    return nxt, cache
+
+
+@functools.lru_cache(maxsize=8)
+def _engine_fns(model):
+    """Jitted engine step functions for the single-host path, cached per
+    model so every engine instance (tests build many) shares traces.
+
+    The cache-pool operand is DONATED in the decode step and the insert:
+    the old pool tree is dead the moment the call returns, and without
+    donation XLA holds a second full pool (the engine's dominant HBM) at
+    every tick."""
+    prefill = jax.jit(
+        lambda params, prompt, rng: _prefill_core(model, params, prompt, rng)
+    )
+    decode = jax.jit(
+        lambda params, tok, pos, widx, temp, tk, tp, cache, rng: _decode_core(
+            model, params, tok, pos, widx, temp, tk, tp, cache, rng
+        ),
+        donate_argnums=7,
+    )
+    sample = jax.jit(sample_tokens)
+    insert = jax.jit(insert_rows, donate_argnums=0)
+    return prefill, decode, sample, insert
+
+
+@functools.lru_cache(maxsize=8)
+def _sharded_engine_fns(model, mesh, specs: _HashableTree,
+                        cache_specs: _HashableTree):
+    """shard_map-wrapped engine step functions (TP serving), through the
+    same ``build_sharded_serving`` harness as ``generate_sharded`` —
+    ``fold_axes=()`` keeps sampling noise identical on every rank (the
+    slot arrays are replicated, so outputs must be too)."""
+    from jax.sharding import PartitionSpec as P
+
+    param_specs = specs.tree()
+    cspecs = cache_specs.tree()
+    prefill = build_sharded_serving(
+        model, mesh, param_specs, (P(),), (P(), cspecs), _prefill_core,
+        fold_axes=(),
+    )
+    decode = build_sharded_serving(
+        model, mesh, param_specs,
+        (P(), P(), P(), P(), P(), P(), cspecs), (P(), cspecs), _decode_core,
+        fold_axes=(),
+    )
+    sample = jax.jit(sample_tokens)
+    # the shard_map-wrapped decode cannot donate (build_sharded_serving
+    # does not expose donation), so the TP tick holds a transient second
+    # pool; the insert at least recycles its operand
+    insert = jax.jit(insert_rows, donate_argnums=0)
+    return prefill, decode, sample, insert
+
+
+class ServingEngine:
+    """In-process continuous-batching engine over one model + params.
+
+    ``step()`` runs one scheduling + decode tick and returns the tick's
+    :class:`StreamEvent`s (incremental delivery); ``run()`` loops until
+    idle.  ``add_request`` is non-blocking: the returned
+    :class:`RequestOutput` fills in as ticks run.
+
+    ``n_slots`` fixes the pool (HBM = ``n_slots x seq_len`` K/V per layer
+    — ``kv_cache_dtype="int8"`` halves it); ``scheduler`` takes a
+    :class:`SchedulerConfig` (or a ready scheduler) for admission policy;
+    ``clock`` is injectable for deterministic timeout tests.
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        n_slots: int = 8,
+        scheduler: Union[SchedulerConfig, FIFOScheduler, None] = None,
+        mesh=None,
+        param_specs=None,
+        rng: Optional[jax.Array] = None,
+        metrics: Optional[ServingMetrics] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        cfg = model.config
+        if getattr(cfg, "pipe_size", 1) > 1:
+            raise NotImplementedError(
+                "the serving engine does not run pipeline meshes — serve "
+                "pipe-split models through generate_sharded"
+            )
+        self.model = model
+        self.params = params
+        self.clock = clock
+        self.metrics = metrics if metrics is not None else ServingMetrics()
+        if isinstance(scheduler, FIFOScheduler):
+            self.scheduler = scheduler
+        else:
+            self.scheduler = FIFOScheduler(scheduler)
+        self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+        pool_shardings = None
+        if mesh is not None:
+            import flax.linen as nn
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            if param_specs is None:
+                param_specs = nn.get_partition_spec(params)
+            cspecs = cache_partition_specs(model, params, n_slots, mesh)
+            # allocate the pool sharded at birth: a TP-split pool must
+            # never transit one device whole
+            pool_shardings = jax.tree_util.tree_map(
+                lambda spec: NamedSharding(mesh, spec), cspecs,
+                is_leaf=lambda x: isinstance(x, P),
+            )
+            fns = _sharded_engine_fns(
+                model, mesh, _HashableTree.of(param_specs),
+                _HashableTree.of(cspecs),
+            )
+        else:
+            fns = _engine_fns(model)
+        self._prefill_fn, self._decode_fn, self._sample_fn, insert = fns
+        self.pool = CachePool(
+            model, params, n_slots, insert_fn=insert,
+            shardings=pool_shardings,
+        )
+
+        n = n_slots
+        self._tok = np.zeros(n, np.int32)
+        self._pos = np.zeros(n, np.int32)
+        self._widx = np.zeros(n, np.int32)
+        self._temp = np.zeros(n, np.float32)
+        self._topk = np.zeros(n, np.int32)
+        self._topp = np.zeros(n, np.float32)
+        self._active = np.zeros(n, bool)
+        self._slot_out: List[Optional[RequestOutput]] = [None] * n
+
+    # -- submission --------------------------------------------------------
+
+    def add_request(self, request: Request) -> RequestOutput:
+        """Submit; returns the live output record (status REJECTED when the
+        prompt cannot fit or admission control refuses)."""
+        out = RequestOutput(request, arrival_time=self.clock())
+        total = len(request.prompt) + request.max_new_tokens
+        if total > self.model.config.seq_len:
+            out.status = REJECTED
+            out.finish_reason = (
+                f"prompt ({len(request.prompt)}) + max_new_tokens "
+                f"({request.max_new_tokens}) exceeds seq_len "
+                f"({self.model.config.seq_len})"
+            )
+            self.metrics.record_rejected()
+            return out
+        if not self.scheduler.submit(out):
+            out.status = REJECTED
+            out.finish_reason = "queue full"
+            self.metrics.record_rejected()
+            return out
+        return out
+
+    # -- the tick ----------------------------------------------------------
+
+    def step(self) -> List[StreamEvent]:
+        """One engine tick: expire stale queue entries, admit into free
+        slots (bounded by the scheduler's prefill budget), one decode step
+        over the pool, retire finished slots.  Returns this tick's events."""
+        now = self.clock()
+        events: List[StreamEvent] = []
+        for out in self.scheduler.expire(now):
+            # terminal notification with no token (token/index = -1):
+            # expiry is asynchronous — unlike REJECTED, which the caller
+            # sees synchronously on add_request — so stream consumers need
+            # the event or they wait forever
+            out.finish_reason = "max_wait"
+            out.finish_time = now
+            event = StreamEvent(
+                request_id=out.request.request_id,
+                token=-1,
+                index=-1,
+                finished=True,
+                finish_reason="max_wait",
+            )
+            if out.request.on_token is not None:
+                out.request.on_token(event)
+            events.append(event)
+            self.metrics.record_expired()
+        admitted = self.scheduler.schedule(self.pool.n_free, now)
+        for out in admitted:
+            events.extend(self._admit(out))
+        decoded = False
+        if self._active.any():
+            events.extend(self._decode_tick())
+            decoded = True
+        self.metrics.record_tick(
+            now=self.clock(),
+            queue_depth=self.scheduler.depth,
+            occupancy=self.pool.occupancy,
+            # expiry notifications carry token=-1 — not generated tokens
+            new_tokens=sum(1 for ev in events if ev.token >= 0),
+            prefills=len(admitted),
+            decoded=decoded,
+        )
+        return events
+
+    def has_work(self) -> bool:
+        return self.scheduler.depth > 0 or bool(self._active.any())
+
+    def run(self, max_ticks: Optional[int] = None) -> List[StreamEvent]:
+        """Tick until idle (or ``max_ticks``); returns all events."""
+        events: List[StreamEvent] = []
+        ticks = 0
+        while self.has_work() and (max_ticks is None or ticks < max_ticks):
+            events.extend(self.step())
+            ticks += 1
+        return events
+
+    # -- internals ---------------------------------------------------------
+
+    def _next_rng(self) -> jax.Array:
+        self._rng, sub = jax.random.split(self._rng)
+        return sub
+
+    def _admit(self, out: RequestOutput) -> List[StreamEvent]:
+        req = out.request
+        slot = self.pool.acquire()
+        assert slot is not None, "scheduler admitted beyond free slots"
+        prompt = jnp.asarray(req.prompt, jnp.int32)[None, :]
+        logits, fresh = self._prefill_fn(
+            self.params, prompt, self._next_rng()
+        )
+        self.pool.insert(fresh, slot)
+        sp = req.sampling
+        first = self._sample_fn(
+            logits,
+            self._next_rng(),
+            jnp.asarray([sp.temperature], jnp.float32),
+            jnp.asarray([sp.top_k], jnp.int32),
+            jnp.asarray([sp.top_p], jnp.float32),
+        )
+        tok0 = int(np.asarray(first)[0])
+        prompt_len = len(req.prompt)
+        self._tok[slot] = tok0
+        self._pos[slot] = prompt_len
+        self._widx[slot] = prompt_len
+        self._temp[slot] = sp.temperature
+        self._topk[slot] = sp.top_k
+        self._topp[slot] = sp.top_p
+        self._active[slot] = True
+        self._slot_out[slot] = out
+        out.status = RUNNING
+        out.first_token_time = self.clock()
+        return [self._deliver(slot, tok0)]
+
+    def _decode_tick(self) -> List[StreamEvent]:
+        nxt, self.pool.cache = self._decode_fn(
+            self.params,
+            jnp.asarray(self._tok),
+            jnp.asarray(self._pos),
+            jnp.asarray(self._widx),
+            jnp.asarray(self._temp),
+            jnp.asarray(self._topk),
+            jnp.asarray(self._topp),
+            self.pool.cache,
+            self._next_rng(),
+        )
+        nxt = np.asarray(nxt)
+        events = []
+        # every slot's current token was just written into the cache;
+        # advance even the slots that retire on this token's delivery
+        for slot in np.nonzero(self._active)[0]:
+            self._pos[slot] += 1
+            self._widx[slot] += 1
+            self._tok[slot] = int(nxt[slot])
+            events.append(self._deliver(int(slot), int(nxt[slot])))
+        return events
+
+    def _deliver(self, slot: int, token: int) -> StreamEvent:
+        """Record one generated token for the request in ``slot``; retire
+        the slot when the token finishes the request (EOS or length)."""
+        out = self._slot_out[slot]
+        req = out.request
+        now = self.clock()
+        out.tokens.append(token)
+        out.token_times.append(now)
+        finish_reason = None
+        if req.eos_token_id is not None and token == req.eos_token_id:
+            finish_reason = "eos"
+        elif len(out.tokens) >= req.max_new_tokens:
+            finish_reason = "length"
+        event = StreamEvent(
+            request_id=req.request_id,
+            token=token,
+            index=len(out.tokens) - 1,
+            finished=finish_reason is not None,
+            finish_reason=finish_reason,
+        )
+        if finish_reason is not None:
+            out.status = FINISHED
+            out.finish_reason = finish_reason
+            out.finish_time = now
+            self._active[slot] = False
+            self._slot_out[slot] = None
+            self.pool.release(slot)
+            self.metrics.record_finished(out)
+        if req.on_token is not None:
+            req.on_token(event)
+        return event

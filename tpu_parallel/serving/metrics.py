@@ -1,0 +1,156 @@
+"""Serving observability: queue depth, TTFT, inter-token latency, slot
+occupancy, throughput.
+
+Two consumers: (1) live per-tick export through
+:class:`~tpu_parallel.utils.logging_utils.MetricLogger` (stdout +
+machine-readable JSONL, process-0-only on multi-host — the same sink the
+trainer uses), and (2) an end-of-run :meth:`ServingMetrics.summary` dict
+(the record ``scripts/serve_bench.py`` emits next to the ``DECODE_r*``
+decode-bench lines).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, Optional, Sequence
+
+from tpu_parallel.utils.logging_utils import MetricLogger
+
+
+def percentile(values: Sequence[float], p: float) -> Optional[float]:
+    """Linear-interpolated percentile (``p`` in [0, 100]); None on empty —
+    the empty-safe wrapper every summary stat here needs."""
+    if not values:
+        return None
+    import numpy as np
+
+    return float(np.percentile(list(values), p))
+
+
+class ServingMetrics:
+    """Accumulates per-tick and per-request serving statistics.
+
+    The engine calls :meth:`record_tick` once per ``step()`` and
+    :meth:`record_finished` as requests retire; everything else derives.
+    ``logger``/``log_every`` stream tick metrics through the shared
+    :class:`MetricLogger` (queue depth, occupancy, cumulative tokens/sec).
+
+    Sample collections are BOUNDED (``max_samples`` most-recent entries,
+    sliding window) so a long-lived engine's memory stays flat — counters
+    and throughput remain exact over the whole lifetime; percentiles and
+    means in :meth:`summary` cover the window.
+    """
+
+    def __init__(
+        self,
+        logger: Optional[MetricLogger] = None,
+        log_every: int = 0,
+        max_samples: int = 100_000,
+    ):
+        self.logger = logger
+        self.log_every = log_every
+        self.ticks = 0
+        self.decode_ticks = 0
+        self.tokens_out = 0
+        self.prefills = 0
+        self.queue_depths: deque = deque(maxlen=max_samples)
+        self.occupancies: deque = deque(maxlen=max_samples)
+        self.ttfts: deque = deque(maxlen=max_samples)
+        self.inter_token: deque = deque(maxlen=max_samples)
+        self.finished = 0
+        self.rejected = 0
+        self.expired = 0
+        self._t_start: Optional[float] = None
+        self._t_last: Optional[float] = None
+
+    def record_tick(
+        self,
+        now: float,
+        queue_depth: int,
+        occupancy: float,
+        new_tokens: int,
+        prefills: int,
+        decoded: bool,
+    ) -> None:
+        if self._t_start is None:
+            self._t_start = now
+        self._t_last = now
+        self.ticks += 1
+        self.decode_ticks += int(decoded)
+        self.tokens_out += new_tokens
+        self.prefills += prefills
+        self.queue_depths.append(queue_depth)
+        self.occupancies.append(occupancy)
+        if (
+            self.logger is not None
+            and self.log_every > 0
+            and self.ticks % self.log_every == 0
+        ):
+            self.logger.log(
+                self.ticks,
+                {
+                    "queue_depth": float(queue_depth),
+                    "slot_occupancy": float(occupancy),
+                    "tokens_out": float(self.tokens_out),
+                    "tokens_per_sec": float(self.throughput() or 0.0),
+                },
+            )
+
+    def record_finished(self, out) -> None:
+        """Fold one retired RequestOutput's latencies in."""
+        self.finished += 1
+        if out.ttft is not None:
+            self.ttfts.append(out.ttft)
+        self.inter_token.extend(out.inter_token_latencies())
+
+    def record_rejected(self) -> None:
+        self.rejected += 1
+
+    def record_expired(self) -> None:
+        self.expired += 1
+
+    def throughput(self) -> Optional[float]:
+        """Generated tokens per wall-second over the ticks observed."""
+        if self._t_start is None or self._t_last is None:
+            return None
+        dt = self._t_last - self._t_start
+        if dt <= 0:
+            return None
+        return self.tokens_out / dt
+
+    def summary(self) -> Dict[str, float]:
+        def ms(x):
+            return None if x is None else round(x * 1000.0, 3)
+
+        mean = lambda xs: (sum(xs) / len(xs)) if xs else None
+        return {
+            "ticks": self.ticks,
+            "decode_ticks": self.decode_ticks,
+            "prefills": self.prefills,
+            "finished": self.finished,
+            "rejected": self.rejected,
+            "expired": self.expired,
+            "tokens_out": self.tokens_out,
+            "tokens_per_sec": (
+                round(self.throughput(), 1)
+                if self.throughput() is not None
+                else None
+            ),
+            "ttft_ms_p50": ms(percentile(self.ttfts, 50)),
+            "ttft_ms_p95": ms(percentile(self.ttfts, 95)),
+            "itl_ms_p50": ms(percentile(self.inter_token, 50)),
+            "itl_ms_p95": ms(percentile(self.inter_token, 95)),
+            "slot_occupancy_mean": (
+                round(mean(self.occupancies), 4)
+                if self.occupancies
+                else None
+            ),
+            "queue_depth_mean": (
+                round(mean(self.queue_depths), 2)
+                if self.queue_depths
+                else None
+            ),
+            "queue_depth_max": (
+                max(self.queue_depths) if self.queue_depths else None
+            ),
+        }

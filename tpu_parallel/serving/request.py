@@ -1,0 +1,122 @@
+"""Request/result types for the continuous-batching serving engine.
+
+A :class:`Request` is one user generation call: a prompt, per-request
+sampling knobs, a stopping contract (``max_new_tokens`` and an optional
+EOS id), and an optional streaming callback.  The engine wraps every
+submitted request in a :class:`RequestOutput` — the mutable record that
+accumulates tokens and timing as the request moves through QUEUED ->
+RUNNING -> FINISHED (or is REJECTED / EXPIRED by the scheduler).
+
+Incremental delivery: every engine tick yields :class:`StreamEvent`s, one
+per token produced that tick; ``Request.on_token`` (when set) receives the
+same events synchronously as they are produced.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Callable, List, Optional, Sequence
+
+_request_counter = itertools.count()
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request sampling knobs — the same contract as
+    :func:`tpu_parallel.models.generate.generate`: ``temperature == 0`` is
+    greedy; ``top_k``/``top_p`` compose by intersection after the
+    temperature scale, and the argmax token always survives the nucleus
+    cut.  Unlike the static path these are per-REQUEST: two requests with
+    different knobs decode in the same engine tick (the sampler is
+    vectorized over traced per-slot knob arrays, so no recompile per
+    combination)."""
+
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 0.0
+
+
+# request lifecycle states
+QUEUED = "queued"  # accepted, waiting for a free slot
+RUNNING = "running"  # occupies a cache slot, decoding
+FINISHED = "finished"  # completed (see finish_reason)
+REJECTED = "rejected"  # refused at submission (queue full / capacity)
+EXPIRED = "expired"  # timed out in the queue (scheduler max_wait)
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request.
+
+    ``prompt`` is a token-id sequence (list/tuple/1-D array).  ``prompt``
+    plus ``max_new_tokens`` must fit the model's ``seq_len`` — the same
+    capacity contract as the static ``generate()`` path, because each cache
+    slot is one ``seq_len``-long row of the pool.
+    """
+
+    prompt: Sequence[int]
+    max_new_tokens: int = 32
+    sampling: SamplingParams = dataclasses.field(default_factory=SamplingParams)
+    eos_token_id: Optional[int] = None
+    request_id: Optional[str] = None
+    # called synchronously with each StreamEvent for this request
+    on_token: Optional[Callable[["StreamEvent"], None]] = None
+
+    def __post_init__(self):
+        if self.request_id is None:
+            self.request_id = f"req-{next(_request_counter)}"
+        if len(self.prompt) < 1:
+            raise ValueError("empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens={self.max_new_tokens} < 1")
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamEvent:
+    """One incrementally-delivered token — or a terminal notification.
+
+    Queue expiry delivers a tokenless terminal event (``token == -1``,
+    ``index == -1``, ``finish_reason == "max_wait"``) so stream consumers
+    learn the request died; every other event carries a real token.
+    """
+
+    request_id: str
+    token: int
+    index: int  # 0-based position among the request's generated tokens
+    finished: bool = False
+    # "eos" | "length" | "max_wait" when finished
+    finish_reason: Optional[str] = None
+
+
+@dataclasses.dataclass
+class RequestOutput:
+    """The engine's mutable per-request record (returned by
+    ``ServingEngine.add_request``; also the scheduler's queue entry)."""
+
+    request: Request
+    status: str = QUEUED
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    finish_reason: Optional[str] = None
+    # timing (engine clock; None until the event happens)
+    arrival_time: Optional[float] = None
+    first_token_time: Optional[float] = None
+    finish_time: Optional[float] = None
+    token_times: List[float] = dataclasses.field(default_factory=list)
+
+    @property
+    def done(self) -> bool:
+        return self.status in (FINISHED, REJECTED, EXPIRED)
+
+    @property
+    def ttft(self) -> Optional[float]:
+        """Time-to-first-token (seconds), None until the first token."""
+        if self.first_token_time is None or self.arrival_time is None:
+            return None
+        return self.first_token_time - self.arrival_time
+
+    def inter_token_latencies(self) -> List[float]:
+        """Gaps between consecutive token deliveries (seconds)."""
+        return [
+            b - a for a, b in zip(self.token_times, self.token_times[1:])
+        ]

@@ -1,0 +1,92 @@
+"""Iteration-level admission scheduling for the serving engine.
+
+Orca-style continuous batching separates two decisions the static path
+fuses: WHEN a request joins the batch (admission — here) and WHEN it
+leaves (retirement — per-slot EOS/length checks in the engine).  The
+scheduler owns the first: a FIFO queue with three policy knobs —
+
+- ``max_queue``: admission control.  A full queue REJECTS new requests at
+  submission instead of growing without bound (the backpressure signal a
+  front-end needs).
+- ``max_prefills_per_tick``: prefill/decode interleaving.  Each prefill
+  runs a full prompt forward between decode ticks, stalling every running
+  request's next token; capping admissions per tick bounds that
+  head-of-line latency hit (1 = smoothest inter-token latency, higher =
+  faster queue drain).
+- ``max_wait``: queue timeout.  Requests that cannot reach a slot within
+  ``max_wait`` seconds EXPIRE (dropped with status ``expired``) rather
+  than serving a reply the client already abandoned.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import List, Optional
+
+from tpu_parallel.serving.request import EXPIRED, QUEUED, RequestOutput
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedulerConfig:
+    max_queue: Optional[int] = None  # None = unbounded queue
+    max_prefills_per_tick: int = 1
+    max_wait: Optional[float] = None  # seconds; None = wait forever
+
+
+class FIFOScheduler:
+    """First-come-first-served admission with the policy knobs above.
+
+    The engine calls ``submit`` at ``add_request`` time, then once per
+    tick: ``expire(now)`` to drop timed-out entries, and
+    ``schedule(n_free, now)`` to pop the tick's admissions.
+    """
+
+    def __init__(self, config: Optional[SchedulerConfig] = None):
+        self.config = config or SchedulerConfig()
+        if self.config.max_prefills_per_tick < 1:
+            raise ValueError(
+                f"max_prefills_per_tick="
+                f"{self.config.max_prefills_per_tick} < 1"
+            )
+        self._queue: deque = deque()
+
+    @property
+    def depth(self) -> int:
+        return len(self._queue)
+
+    def submit(self, out: RequestOutput) -> bool:
+        """Enqueue; False when admission control refuses (queue full)."""
+        cfg = self.config
+        if cfg.max_queue is not None and len(self._queue) >= cfg.max_queue:
+            return False
+        out.status = QUEUED
+        self._queue.append(out)
+        return True
+
+    def expire(self, now: float) -> List[RequestOutput]:
+        """Drop queued entries older than ``max_wait``; returns them."""
+        if self.config.max_wait is None:
+            return []
+        expired = []
+        kept = deque()
+        for out in self._queue:
+            arrival = out.arrival_time if out.arrival_time is not None else now
+            waited = now - arrival
+            if waited > self.config.max_wait:
+                out.status = EXPIRED
+                expired.append(out)
+            else:
+                kept.append(out)
+        self._queue = kept
+        return expired
+
+    def schedule(self, n_free: int, now: float) -> List[RequestOutput]:
+        """Pop up to ``min(n_free, max_prefills_per_tick)`` admissions."""
+        del now  # FIFO ignores it; priority policies would not
+        n = min(n_free, self.config.max_prefills_per_tick)
+        admitted = []
+        while n > 0 and self._queue:
+            admitted.append(self._queue.popleft())
+            n -= 1
+        return admitted

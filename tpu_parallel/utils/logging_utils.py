@@ -48,15 +48,29 @@ class MetricLogger:
             self.file = open(self.path, "a")
         self._t0 = time.time()
 
+    def _emit(self, record: Dict, text: str) -> None:
+        """The one sink write path: ``text`` to stdout, ``record`` as a
+        JSONL line (both process-0-gated by the callers)."""
+        print(text, flush=True)
+        if self.file is not None:
+            self.file.write(json.dumps(record) + "\n")
+            self.file.flush()
+
     def log(self, step: int, metrics: Dict[str, float]) -> None:
         if not self.is_main:
             return
         record = {"step": step, "time": round(time.time() - self._t0, 3), **metrics}
         parts = " ".join(f"{k}={v:.5g}" for k, v in sorted(metrics.items()))
-        print(f"[step {step}] {parts}", flush=True)
-        if self.file is not None:
-            self.file.write(json.dumps(record) + "\n")
-            self.file.flush()
+        self._emit(record, f"[step {step}] {parts}")
+
+    def log_record(self, record: Dict) -> None:
+        """Append one arbitrary JSON record to the sink (process 0 only) —
+        the one-shot form of :meth:`log` for end-of-run summaries
+        (serve_bench perf records, eval reports): no step counter, no
+        float formatting, values pass through as-is."""
+        if not self.is_main:
+            return
+        self._emit(record, json.dumps(record))
 
     def close(self) -> None:
         if self.file is not None:

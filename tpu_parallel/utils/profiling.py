@@ -46,11 +46,14 @@ def transformer_flops_per_token(cfg) -> float:
     untied lm_head matmul is included.
 
     MoE configs use ACTIVE-param accounting (the standard MoE MFU
-    convention): each token runs ``moe_top_k`` experts' FFN matmuls (one
-    under expert-choice, whose per-token average is one expert at
-    capacity_factor 1) plus the router projection — FLOPs scale with k,
-    not with the total expert count, so a Switch model's MFU reads
-    against the same roofline as its dense-equivalent.
+    convention): each token runs ``moe_top_k`` experts' FFN matmuls plus
+    the router projection — FLOPs scale with k, not with the total expert
+    count, so a Switch model's MFU reads against the same roofline as its
+    dense-equivalent.  Under expert-choice routing every expert fills its
+    capacity by construction, so the per-token average is
+    ``moe_capacity_factor`` experts (1.25 by default), not 1 — the FFN
+    term scales by the capacity factor or expert-choice MFU reads ~25%
+    high (ADVICE.md round-5 finding).
     """
     mlp_term = 2 * cfg.mlp_ratio * cfg.d_model**2
     moe_experts = getattr(cfg, "moe_experts", 0)
@@ -58,7 +61,7 @@ def transformer_flops_per_token(cfg) -> float:
         k = (
             cfg.moe_top_k
             if getattr(cfg, "moe_router", "topk") == "topk"
-            else 1
+            else getattr(cfg, "moe_capacity_factor", 1.0)
         )
         mlp_term = k * mlp_term + cfg.d_model * moe_experts  # + router
     matmul_params = (
