@@ -2,24 +2,40 @@
 
 Drives the continuous-batching engine (``tpu_parallel.serving``) with a
 Poisson arrival stream of random-length prompts and emits ONE JSON record
-per (rate, slots) point — throughput, TTFT p50/p95, inter-token latency,
-slot occupancy, queue depth — in the same style as the ``DECODE_r*.json``
-static-decode records, so rounds can track serving perf side by side with
-static decode.  Not part of the driver contract.
+per (rate, config) point — throughput, TTFT p50/p95, inter-token latency,
+slot occupancy, queue depth, prefill compile/call counts, prefix hit rate
+— in the same style as the ``DECODE_r*.json`` static-decode records, so
+rounds can track serving perf side by side with static decode.  Not part
+of the driver contract.
 
 Usage:
   python scripts/serve_bench.py [--requests N] [--rate R[,R2,...]]
       [--slots S] [--new T] [--prompt-min P] [--prompt-max P]
+      [--prompt-dist] [--prefix-len P] [--buckets auto|off|B1,B2,...]
+      [--chunk C] [--prefix-cache N] [--compare] [--smoke]
       [--seed K] [--out FILE]
 
 Defaults exercise 32 requests at rates 8 and 0 (0 = all-at-once) on the
 CPU tiny model (gpt2_125m on TPU).
+
+``--prompt-dist`` switches to the prefix-shared workload: every prompt
+starts with the same ``--prefix-len`` system header followed by a random
+suffix in [prompt-min, prompt-max] — the shape the prefill fast path
+(bucketing + batched prefill + prefix reuse) is built for.  ``--compare``
+emits each point twice: the legacy exact batch-1 prefill engine
+("prefill_mode": "exact", the SERVE_r01 configuration) and the fast path
+("bucketed"), so a single file records the improvement.
+
+``--smoke`` runs a small greedy parity gate first — every fast-path mode
+(bucketed, chunked, prefix-reuse) must produce token-identical output to
+static ``generate()`` — and exits nonzero on any mismatch, so bench
+numbers can never come from a silently-wrong fast path.
+
 Records append to ``--out`` (default serve_bench.jsonl next to this
 script's cwd) via the shared MetricLogger JSONL sink.
 """
 
 import argparse
-import json
 import os
 import random
 import sys
@@ -30,20 +46,31 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 
 
-def run_point(model, params, cfg, *, n_requests, rate, n_slots, new_tokens,
-              prompt_min, prompt_max, seed):
+def make_prompts(cfg, *, n_requests, prompt_min, prompt_max, prefix_len, seed):
+    """Random prompts; with ``prefix_len`` > 0 every prompt shares one
+    random system-header and [prompt_min, prompt_max] sizes the SUFFIX."""
+    rnd = random.Random(seed)
+    prefix = [rnd.randrange(1, cfg.vocab_size) for _ in range(prefix_len)]
+    prompts = []
+    for _ in range(n_requests):
+        n = rnd.randint(prompt_min, prompt_max)
+        prompts.append(
+            prefix + [rnd.randrange(1, cfg.vocab_size) for _ in range(n)]
+        )
+    return prompts
+
+
+def run_point(model, params, cfg, prompts, *, rate, n_slots, new_tokens,
+              seed, engine_kwargs, label):
     from tpu_parallel.serving import (
         Request,
         SchedulerConfig,
         ServingEngine,
+        ServingMetrics,
     )
 
     rnd = random.Random(seed)
-    lengths = [rnd.randint(prompt_min, prompt_max) for _ in range(n_requests)]
-    prompts = [
-        [rnd.randrange(1, cfg.vocab_size) for _ in range(length)]
-        for length in lengths
-    ]
+    n_requests = len(prompts)
     # Poisson process: exponential inter-arrival gaps at `rate` req/s
     # (rate <= 0 or huge => everything arrives at t=0)
     arrivals, t = [], 0.0
@@ -56,19 +83,21 @@ def run_point(model, params, cfg, *, n_requests, rate, n_slots, new_tokens,
         model, params, n_slots=n_slots,
         scheduler=SchedulerConfig(max_prefills_per_tick=2),
         rng=jax.random.PRNGKey(seed),
+        **engine_kwargs,
     )
-    # warm the compiles outside the measured window: one prefill per
-    # DISTINCT prompt length (jit recompiles per shape) + the one
-    # decode-step program; then start metrics from a clean slate
-    for length in sorted(set(lengths)):
-        eng.add_request(
-            Request(prompt=prompts[lengths.index(length)][:length],
-                    max_new_tokens=2)
-        )
-        eng.run()
-    from tpu_parallel.serving import ServingMetrics
-
+    # warm the compiles outside the measured window (exact mode compiles
+    # per DISTINCT prompt length; bucketed mode per bucket) + the decode
+    # program — ONE drained run over every prompt, not a run per prompt
+    # (batched prefill pads to prefill_batch, so singleton and grouped
+    # admissions share a compile shape); then start metrics — and
+    # prefix-hit tallies — from a clean slate.  The prefix cache itself
+    # stays warm, as a long-lived server's would.
+    for p in prompts:
+        eng.add_request(Request(prompt=p, max_new_tokens=2))
+    eng.run()
     eng.metrics = ServingMetrics()
+    if eng._prefix is not None:
+        eng._prefix.reset_counters()
 
     t0 = time.perf_counter()
     outs, submitted = [], 0
@@ -95,24 +124,88 @@ def run_point(model, params, cfg, *, n_requests, rate, n_slots, new_tokens,
     assert all(out.status == "finished" for out in outs)
 
     summary = eng.metrics.summary()
+    lengths = [len(p) for p in prompts]
     return {
         "bench": "serve",
         "model": getattr(cfg, "_name", None) or (
             "gpt2_125m" if jax.default_backend() == "tpu" else "tiny"
         ),
         "backend": jax.default_backend(),
+        "prefill_mode": label,
         "n_requests": n_requests,
         "arrival_rate_per_sec": rate if rate > 0 else "all_at_once",
         "n_slots": n_slots,
-        "prompt_len": [prompt_min, prompt_max],
+        "prompt_len": [min(lengths), max(lengths)],
+        "distinct_prompt_lens": len(set(lengths)),
         "new_tokens": new_tokens,
         "kv_cache": cfg.kv_cache_dtype,
+        "prefill_buckets": list(eng._buckets) if eng._buckets else None,
+        "prefill_chunk_tokens": eng._chunk_tokens,
+        "prefix_cache_size": (
+            eng._prefix.max_entries if eng._prefix is not None else 0
+        ),
+        # distinct prefill/extend call shapes == jit compiles of the
+        # prefill path (exact mode: one per distinct length; bucketed:
+        # bounded by the bucket set)
+        "prefill_compiles": eng.prefill_compiles,
         "wall_s": round(wall, 3),
         "request_tokens_per_sec": round(
             n_requests * new_tokens / wall, 1
         ),
         **summary,
     }
+
+
+def smoke(model, params, cfg, prompts, new_tokens):
+    """Greedy parity gate: every fast-path mode must match static
+    generate() token-for-token on every prompt.  Returns the number of
+    mismatched (mode, request) pairs."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_parallel.models.generate import generate
+    from tpu_parallel.serving import Request, SchedulerConfig, ServingEngine
+
+    refs = [
+        np.asarray(
+            generate(
+                model, params, jnp.asarray(p, jnp.int32)[None, :],
+                max_new_tokens=new_tokens,
+            )
+        )[0]
+        for p in prompts
+    ]
+    shortest = min(len(p) for p in prompts)
+    modes = {
+        "exact": dict(prefill_buckets=None),
+        "bucketed": {},
+        "chunked": dict(prefill_chunk_tokens=max(2, shortest // 2)),
+        "prefix": dict(prefix_cache_size=4),
+    }
+    failures = 0
+    for name, kwargs in modes.items():
+        eng = ServingEngine(
+            model, params, n_slots=4,
+            scheduler=SchedulerConfig(max_prefills_per_tick=2),
+            **kwargs,
+        )
+        outs = [
+            eng.add_request(Request(prompt=p, max_new_tokens=new_tokens))
+            for p in prompts
+        ]
+        eng.run()
+        for i, (out, ref) in enumerate(zip(outs, refs)):
+            if out.status != "finished" or list(out.tokens) != list(ref):
+                print(
+                    f"SMOKE FAIL [{name}] request {i}: "
+                    f"{out.status} {out.tokens} != {list(ref)}",
+                    file=sys.stderr,
+                )
+                failures += 1
+    print(
+        "smoke: PASS" if failures == 0 else f"smoke: {failures} FAILURES"
+    )
+    return failures
 
 
 def main():
@@ -124,6 +217,23 @@ def main():
                     help="tokens per request (0 = model-dependent default)")
     ap.add_argument("--prompt-min", type=int, default=0)
     ap.add_argument("--prompt-max", type=int, default=0)
+    ap.add_argument("--prompt-dist", action="store_true",
+                    help="prefix-shared mixed-length prompt distribution")
+    ap.add_argument("--prefix-len", type=int, default=0,
+                    help="shared system-prefix tokens (--prompt-dist; "
+                         "0 = backend default)")
+    ap.add_argument("--buckets", type=str, default="auto",
+                    help="'auto', 'off', or comma-separated bucket sizes")
+    ap.add_argument("--chunk", type=int, default=0,
+                    help="prefill chunk budget (0 = off)")
+    ap.add_argument("--prefix-cache", type=int, default=0,
+                    help="prefix-cache LRU entries (0 = off)")
+    ap.add_argument("--compare", action="store_true",
+                    help="emit every point twice: exact (SERVE_r01 "
+                         "config) vs the requested fast path")
+    ap.add_argument("--smoke", action="store_true",
+                    help="run the fast-path parity gate; nonzero exit on "
+                         "mismatch")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", type=str, default="serve_bench")
     args = ap.parse_args()
@@ -138,26 +248,70 @@ def main():
         else tiny_test(remat=False)
     )
     new_tokens = args.new or (64 if on_tpu else 8)
-    prompt_min = args.prompt_min or (128 if on_tpu else 3)
-    prompt_max = args.prompt_max or (
-        min(512, cfg.seq_len - new_tokens) if on_tpu
-        else cfg.seq_len - new_tokens - 2
-    )
+    if args.prompt_dist:
+        prefix_len = args.prefix_len or (128 if on_tpu else 8)
+        prompt_min = args.prompt_min or 1
+        prompt_max = args.prompt_max or (
+            min(384, cfg.seq_len - new_tokens - prefix_len) if on_tpu
+            else cfg.seq_len - new_tokens - prefix_len - 3
+        )
+    else:
+        prefix_len = 0
+        prompt_min = args.prompt_min or (128 if on_tpu else 3)
+        prompt_max = args.prompt_max or (
+            min(512, cfg.seq_len - new_tokens) if on_tpu
+            else cfg.seq_len - new_tokens - 2
+        )
     model = GPTLM(cfg)
-    probe = jax.numpy.zeros((1, prompt_max), jax.numpy.int32)
+    probe = jax.numpy.zeros((1, prompt_max + prefix_len), jax.numpy.int32)
     params = model.init(
         {"params": jax.random.PRNGKey(1)}, probe, train=False
     )["params"]
+    prompts = make_prompts(
+        cfg, n_requests=args.requests, prompt_min=prompt_min,
+        prompt_max=prompt_max, prefix_len=prefix_len, seed=args.seed,
+    )
+
+    if args.smoke:
+        failures = smoke(model, params, cfg, prompts[:6], new_tokens)
+        if failures:
+            sys.exit(1)
+
+    if args.buckets == "off":
+        fast = dict(prefill_buckets=None)
+        fast_label = "exact"
+    else:
+        if args.buckets == "auto" and args.prompt_dist:
+            # align buckets on the shared prefix so prefix reuse can key
+            # off a bucket boundary (the engine appends seq_len itself)
+            buckets = tuple(
+                b for b in (prefix_len, prefix_len * 2, prefix_len * 4)
+                if b < cfg.seq_len
+            )
+        elif args.buckets == "auto":
+            buckets = "auto"
+        else:
+            buckets = tuple(int(b) for b in args.buckets.split(","))
+        fast = dict(prefill_buckets=buckets)
+        fast_label = "bucketed"
+    if args.chunk > 0:
+        fast["prefill_chunk_tokens"] = args.chunk
+    if args.prefix_cache > 0:
+        fast["prefix_cache_size"] = args.prefix_cache
+
+    configs = [(fast_label, fast)]
+    if args.compare and fast_label != "exact":
+        configs.insert(0, ("exact", dict(prefill_buckets=None)))
 
     logger = MetricLogger(logdir=".", name=args.out)
     for rate in (float(r) for r in args.rate.split(",")):
-        record = run_point(
-            model, params, cfg,
-            n_requests=args.requests, rate=rate, n_slots=args.slots,
-            new_tokens=new_tokens, prompt_min=prompt_min,
-            prompt_max=prompt_max, seed=args.seed,
-        )
-        logger.log_record(record)
+        for label, engine_kwargs in configs:
+            record = run_point(
+                model, params, cfg, prompts,
+                rate=rate, n_slots=args.slots, new_tokens=new_tokens,
+                seed=args.seed, engine_kwargs=engine_kwargs, label=label,
+            )
+            logger.log_record(record)
     logger.close()
 
 

@@ -47,6 +47,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "multihost: spawns a real 2-process jax.distributed cluster"
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: >5s perf/timing tests excluded from the tier-1 "
+        "`-m 'not slow'` lane (run explicitly with `-m slow`)",
+    )
 
 
 # Measured call time > ~4s on the round-3 CI box (--durations) — excluded

@@ -143,6 +143,80 @@ def decode_step(
     return hidden, updated["cache"]
 
 
+def padded_prefill_inputs(lengths, width: int):
+    """RIGHT-padded prefill positions for prompts of ``lengths`` in a
+    ``width``-wide bucket: real tokens get 0..len-1, pad slots -1.
+
+    The pad contract mirrors the ragged decode layout everywhere: -1
+    positions are never attended (``decode_attention`` masks ``kp >= 0``),
+    their nn.Embed/RoPE lookups are harmless garbage, and the cache slots
+    they occupy carry position -1 until real tokens (the request's decode
+    steps) overwrite them — so bucket padding costs ZERO cache capacity.
+    Returns ``(positions [b, width] int32, last_idx [b] int32)`` where
+    ``last_idx`` is each row's final REAL token index (the hidden state the
+    lm_head must read — right padding means it is NOT row -1).
+    """
+    lengths = jnp.asarray(lengths, jnp.int32)
+    iota = jnp.arange(width, dtype=jnp.int32)[None, :]
+    positions = jnp.where(iota < lengths[:, None], iota, -1)
+    return positions, lengths - 1
+
+
+def prefill_step(model: GPTLM, params, tokens: jax.Array,
+                 positions: jax.Array):
+    """Fresh-cache prefill over ``tokens`` [b, P] at explicit ``positions``
+    [b, P] — THE pad-aware prefill core of the serving engine's fast path.
+
+    With ``positions`` from :func:`padded_prefill_inputs`, a batch of
+    different-length prompts padded to one bucket width prefills as ONE
+    call compiled per BUCKET shape, not per distinct length: pad slots
+    write position -1 into the per-slot cache table and are never
+    attended, so every real token's K/V (including int8-quantized caches —
+    quantization is per (position, kv-head), invisible to batch
+    composition) is bit-identical to an exact-length prefill.  Returns
+    ``(hidden [b, P, d_model], cache)``.
+    """
+    hidden, variables = model.apply(
+        {"params": params},
+        tokens,
+        positions=positions,
+        train=False,
+        decode=True,
+        hidden_only=True,
+        mutable=["cache"],
+    )
+    return hidden, variables["cache"]
+
+
+def prefill_extend_step(model: GPTLM, params, cache, tokens: jax.Array,
+                        positions: jax.Array, write_start: jax.Array):
+    """Continue a prefill INTO an existing cache: ``tokens`` [b, T] at
+    global ``positions`` [b, T] (pads -1), K/V written at cache slots
+    ``write_start + [0..T)`` per row (the multi-token ``write_index`` path
+    in ``models/layers.py``).
+
+    The chunked-prefill core: a long prompt splits into budget-sized
+    chunks that interleave with the engine's decode ticks — each chunk
+    attends the already-cached prefix plus itself causally, which is
+    mathematically identical to one monolithic prefill (scores depend only
+    on stored positions).  Also the prefix-cache completion core: after
+    ``CachePool.copy_prefix`` lands a cached prefix, the prompt remainder
+    runs through here at ``write_start = prefix_len``.  Returns
+    ``(hidden [b, T, d_model], cache)``.
+    """
+    hidden, updated = model.apply(
+        {"params": params, "cache": cache},
+        tokens,
+        positions=positions,
+        train=False,
+        decode=True,
+        hidden_only=True,
+        mutable=["cache"],
+        write_index=write_start,
+    )
+    return hidden, updated["cache"]
+
+
 def _generate_core(
     model: GPTLM,
     params,

@@ -154,6 +154,16 @@ class GPTLM(nn.Module):
             raise ValueError(
                 "write_index (slot-indexed cache writes) requires decode=True"
             )
+        if write_index is not None and cfg.positional == "relative":
+            # the shared T5 bias table is computed from ROW 0's positions
+            # (for_step below); a slot pool holds rows at different depths,
+            # so every other row would silently get row-0's bias — refuse
+            # loudly instead (serve relative-bias models through generate())
+            raise NotImplementedError(
+                "slot-indexed cache writes with relative position bias "
+                "(the shared bias table assumes row-uniform positions; "
+                "slot-pool rows sit at different depths)"
+            )
         if decode and positions is None:
             # default decode positions from a model-level step counter, so
             # learned positional embeddings see global positions (Attention

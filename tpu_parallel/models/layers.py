@@ -482,11 +482,13 @@ class Attention(nn.Module):
 
     ``write_index`` [batch] enables SLOT-INDEXED cache writes for the
     continuous-batching engine (``tpu_parallel.serving``): each row's
-    single-token K/V lands at its OWN cache slot instead of the shared
-    scalar ``cache_index`` — rows in the same step may sit at different
-    depths of their generations.  The attention read is unchanged (it
-    already keys off the stored per-slot position table, not slot
-    indices), so aligned and slot-indexed layouts read identically.
+    K/V lands at its OWN cache slots (``write_index + [0..tokens)``)
+    instead of the shared scalar ``cache_index`` — rows in the same step
+    may sit at different depths of their generations, and a multi-token
+    step extends a row's cache by one prompt chunk (the engine's chunked
+    prefill).  The attention read is unchanged (it already keys off the
+    stored per-slot position table, not slot indices), so aligned and
+    slot-indexed layouts read identically.
     """
 
     config: TransformerConfig
@@ -654,27 +656,28 @@ class Attention(nn.Module):
                 keep = lambda new, old: jnp.where(cache_valid, new, old)
             if write_index is not None:
                 # per-row slot writes (continuous batching): the update is a
-                # batched scatter at each row's own index, not one contiguous
-                # dynamic-slice.  Single-token steps only — a multi-token
-                # write would need per-row slice semantics nothing asks for.
-                if x.shape[1] != 1:
-                    raise NotImplementedError(
-                        "write_index (slot-indexed cache writes) requires "
-                        f"single-token decode steps, got {x.shape[1]} tokens"
-                    )
+                # batched scatter starting at each row's own index, not one
+                # contiguous dynamic-slice.  Multi-token steps write each
+                # row's tokens at write_index + [0..T) — the chunked-prefill
+                # path (serving engine) extends a slot's cache one prompt
+                # chunk at a time between decode ticks.
                 if cfg.beam_width > 1:
                     raise NotImplementedError(
                         "write_index under lazy beam search (beam_src slot "
                         "bookkeeping assumes the shared scalar cache_index)"
                     )
-                rows = jnp.arange(b)
-                wi = write_index.astype(jnp.int32)
-                # out-of-range rows (e.g. a pool's free slots) fall under
-                # JAX's default scatter semantics: the update is DROPPED,
-                # leaving the cache intact — deliberately not clamped,
-                # which would overwrite a valid boundary entry instead
+                rows = jnp.arange(b)[:, None]
+                wi = (
+                    write_index.astype(jnp.int32)[:, None]
+                    + jnp.arange(x.shape[1])[None, :]
+                )
+                # out-of-range targets (a pool's free slots, a padded
+                # chunk's tail beyond seq_len) fall under JAX's default
+                # scatter semantics: the update is DROPPED, leaving the
+                # cache intact — deliberately not clamped, which would
+                # overwrite a valid boundary entry instead
                 upd = lambda buf, new: buf.at[rows, wi].set(
-                    new[:, 0].astype(buf.dtype)
+                    new.astype(buf.dtype)
                 )
             else:
                 upd = lambda buf, new: lax.dynamic_update_slice_in_dim(

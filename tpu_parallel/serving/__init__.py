@@ -1,9 +1,22 @@
 """Continuous-batching serving: iteration-level scheduling over a slot
-pool of KV caches (docs/10_serving_engine.md)."""
+pool of KV caches, with a bucketed/batched/chunked prefill fast path and
+prefix reuse (docs/10_serving_engine.md)."""
 
-from tpu_parallel.serving.cache_pool import CachePool, insert_rows
-from tpu_parallel.serving.engine import ServingEngine, sample_tokens
+from tpu_parallel.serving.cache_pool import (
+    CachePool,
+    clear_rows,
+    copy_prefix_rows,
+    extract_rows,
+    insert_rows,
+    scatter_rows,
+)
+from tpu_parallel.serving.engine import (
+    ServingEngine,
+    default_prefill_buckets,
+    sample_tokens,
+)
 from tpu_parallel.serving.metrics import ServingMetrics, percentile
+from tpu_parallel.serving.prefix_cache import PrefixCache
 from tpu_parallel.serving.request import (
     EXPIRED,
     FINISHED,
@@ -20,10 +33,16 @@ from tpu_parallel.serving.scheduler import FIFOScheduler, SchedulerConfig
 __all__ = [
     "CachePool",
     "insert_rows",
+    "scatter_rows",
+    "extract_rows",
+    "clear_rows",
+    "copy_prefix_rows",
     "ServingEngine",
+    "default_prefill_buckets",
     "sample_tokens",
     "ServingMetrics",
     "percentile",
+    "PrefixCache",
     "Request",
     "RequestOutput",
     "SamplingParams",

@@ -56,6 +56,93 @@ def insert_rows(pool_cache, fresh_cache, slot):
     return jax.tree_util.tree_map_with_path(ins, pool_cache, fresh_cache)
 
 
+def scatter_rows(pool_cache, fresh_cache, slots):
+    """Write the rows of a batch-N prefill cache into pool rows ``slots``
+    [N] — the batched-prefill generalization of :func:`insert_rows` (one
+    scatter per leaf instead of N dynamic-slice programs).
+
+    Traceable with ``slots`` traced.  Rows whose slot is OUT OF RANGE
+    (the engine passes ``n_slots`` for a padded prefill batch's dummy
+    rows) are DROPPED by JAX's default scatter semantics — the pool leaf
+    keeps its value, which is exactly the discard the padding wants.
+    """
+
+    def ins(path, pool_leaf, fresh_leaf):
+        ax = beam_cache_batch_axis(path, pool_leaf)
+        if ax is None:
+            return pool_leaf
+        idx = (slice(None),) * ax + (slots,)
+        return pool_leaf.at[idx].set(fresh_leaf.astype(pool_leaf.dtype))
+
+    return jax.tree_util.tree_map_with_path(ins, pool_cache, fresh_cache)
+
+
+def extract_rows(pool_cache, slot, n: int = 1):
+    """Slice ``n`` consecutive rows starting at ``slot`` out of the pool —
+    a batch-``n`` cache tree in the model's own layout (scalar counters
+    pass through unchanged; the engine never reads them).  The chunked
+    prefill's read side: extract the slot's row, extend it one chunk
+    (:func:`~tpu_parallel.models.generate.prefill_extend_step`), scatter
+    it back."""
+
+    def ext(path, leaf):
+        ax = beam_cache_batch_axis(path, leaf)
+        if ax is None:
+            return leaf
+        return lax.dynamic_slice_in_dim(leaf, slot, n, axis=ax)
+
+    return jax.tree_util.tree_map_with_path(ext, pool_cache)
+
+
+def clear_rows(pool_cache, slot):
+    """Invalidate pool row ``slot``: every position-table entry to -1, so
+    no query ever attends the row's (stale) K/V again.  The K/V payloads
+    are left untouched — dead bytes until overwritten.  Used before a
+    chunked prefill starts writing a freed slot incrementally (a whole-row
+    insert is not available until the LAST chunk; the stale occupant must
+    not leak into the chunks' attention reads meanwhile)."""
+
+    def clr(path, leaf):
+        if not _leaf_name(path).startswith(("cached_pos", "cross_mask")):
+            return leaf
+        ax = beam_cache_batch_axis(path, leaf)
+        if ax is None:
+            return leaf
+        row_shape = leaf.shape[:ax] + (1,) + leaf.shape[ax + 1:]
+        return lax.dynamic_update_slice_in_dim(
+            leaf, jnp.full(row_shape, -1, leaf.dtype), slot, axis=ax
+        )
+
+    return jax.tree_util.tree_map_with_path(clr, pool_cache)
+
+
+def copy_prefix_rows(pool_cache, prefix_cache, slot, length):
+    """Copy a stored prefix row into pool row ``slot``, trimming validity
+    to the first ``length`` positions: K/V payloads copy whole (slots
+    beyond ``length`` are dead bytes), the position table copies masked to
+    -1 beyond ``length`` so ONLY the prefix is attendable.  The whole-row
+    copy doubles as the slot's invalidation of its previous occupant.
+
+    Exactness: cached K/V is a pure function of (token, position, params)
+    — including the int8 path's per-(position, kv-head) quantization — so
+    a copied prefix row is bit-identical to recomputing the prefill.
+    """
+
+    def ins(path, pool_leaf, fresh_leaf):
+        ax = beam_cache_batch_axis(path, pool_leaf)
+        if ax is None:
+            return pool_leaf
+        fresh_leaf = fresh_leaf.astype(pool_leaf.dtype)
+        if _leaf_name(path).startswith(("cached_pos", "cross_mask")):
+            valid = jnp.arange(fresh_leaf.shape[-1]) < length
+            fresh_leaf = jnp.where(valid, fresh_leaf, -1)
+        return lax.dynamic_update_slice_in_dim(
+            pool_leaf, fresh_leaf, slot, axis=ax
+        )
+
+    return jax.tree_util.tree_map_with_path(ins, pool_cache, prefix_cache)
+
+
 def _pool_cache_shapes(model, params, n_slots: int):
     """abstract shapes of the model's decode cache at batch ``n_slots``,
     via ``jax.eval_shape`` — no forward pass runs.  The ONE shape probe
@@ -144,6 +231,29 @@ def cache_partition_specs(model, params, n_slots: int, mesh):
     return jax.tree_util.tree_map_with_path(spec, shapes)
 
 
+def stack_prefix_rows(rows, length):
+    """Stack batch-1 prefix rows into one batch-N cache tree, position
+    tables trimmed to the first ``length`` entries (-1 beyond) — the
+    BATCHED prefix-hit landing: N same-length hits extend as one padded
+    model call instead of N single-row round-trips.
+
+    ``rows`` is a tuple of stored prefix rows (NOT donated — they stay
+    live in the prefix cache; the concatenate copies).  Scalar leaves take
+    the first row's value (unread).
+    """
+
+    def stk(path, *leaves):
+        ax = beam_cache_batch_axis(path, leaves[0])
+        if ax is None:
+            return leaves[0]
+        out = jnp.concatenate(leaves, axis=ax)
+        if _leaf_name(path).startswith(("cached_pos", "cross_mask")):
+            out = jnp.where(jnp.arange(out.shape[-1]) < length, out, -1)
+        return out
+
+    return jax.tree_util.tree_map_with_path(stk, *rows)
+
+
 class CachePool:
     """Host-side slot bookkeeping + the device cache pytree.
 
@@ -154,7 +264,7 @@ class CachePool:
     """
 
     def __init__(self, model, params, n_slots: int, insert_fn=None,
-                 shardings=None):
+                 shardings=None, row_fns=None):
         if n_slots < 1:
             raise ValueError(f"n_slots={n_slots} < 1")
         self.n_slots = n_slots
@@ -167,6 +277,12 @@ class CachePool:
             if insert_fn is not None
             else jax.jit(insert_rows, donate_argnums=0)
         )
+        # row-level fast-path ops (scatter/extract/clear/copy_prefix),
+        # injectable so the engine's lru-cached jits are shared per model
+        if row_fns is None:
+            row_fns = default_row_fns()
+        (self._scatter, self._extract, self._clear,
+         self._copy_prefix, self.stack_prefix) = row_fns
 
     @property
     def n_free(self) -> int:
@@ -191,3 +307,41 @@ class CachePool:
     def insert(self, fresh_cache, slot: int) -> None:
         """Row-insert a batch-1 prefill cache into ``slot``."""
         self.cache = self._insert(self.cache, fresh_cache, jnp.int32(slot))
+
+    def scatter(self, fresh_cache, slots) -> None:
+        """Scatter a batch-N prefill cache's rows into ``slots`` [N]; pass
+        ``n_slots`` for dummy rows (dropped — see :func:`scatter_rows`)."""
+        self.cache = self._scatter(
+            self.cache, fresh_cache, jnp.asarray(slots, jnp.int32)
+        )
+
+    def extract(self, slot: int):
+        """Pull one slot's row out as a batch-1 cache tree (chunked-prefill
+        read side; also the prefix cache's capture path)."""
+        return self._extract(self.cache, jnp.int32(slot))
+
+    def clear(self, slot: int) -> None:
+        """Invalidate a slot's position table before incremental writes."""
+        self.cache = self._clear(self.cache, jnp.int32(slot))
+
+    def copy_prefix(self, prefix_cache, slot: int, length: int) -> None:
+        """Land a stored prefix row (first ``length`` positions valid)
+        into ``slot`` — the prefix-reuse admission skips recomputing those
+        tokens entirely."""
+        self.cache = self._copy_prefix(
+            self.cache, prefix_cache, jnp.int32(slot), jnp.int32(length)
+        )
+
+
+def default_row_fns():
+    """Jitted (scatter, extract, clear, copy_prefix, stack_prefix) with
+    the pool operand donated on every WRITE op (the old pool tree is dead
+    the moment the call returns; extract reads only, and stack_prefix's
+    inputs stay live in the prefix cache — neither donates)."""
+    return (
+        jax.jit(scatter_rows, donate_argnums=0),
+        jax.jit(extract_rows, static_argnums=2),
+        jax.jit(clear_rows, donate_argnums=0),
+        jax.jit(copy_prefix_rows, donate_argnums=0),
+        jax.jit(stack_prefix_rows),
+    )

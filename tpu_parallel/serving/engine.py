@@ -1,5 +1,5 @@
 """Continuous-batching inference engine: iteration-level scheduling over a
-fixed pool of KV-cache slots.
+fixed pool of KV-cache slots, with a fast-path prefill.
 
 The static path (``models/generate.py``) decodes a batch run-to-completion:
 every request starts together and the whole batch waits for the longest
@@ -8,12 +8,8 @@ single-token step over all ``n_slots`` rows per tick, compiled once — and
 lets requests join (prefill into a freed slot) and leave (EOS / length
 retirement) between ticks:
 
-- tick = [admissions] + [one decode step] + [retirements]
-- admission prefills the request ALONE (batch 1, its exact prompt length)
-  and row-inserts the fresh cache into a free slot
-  (:mod:`~tpu_parallel.serving.cache_pool`); the prefill's last hidden
-  state samples the request's first token, so TTFT is one prefill, not a
-  queue-drain.
+- tick = [chunked-prefill advance] + [admissions] + [one decode step] +
+  [retirements]
 - the decode step threads per-slot positions and per-slot cache write
   indices (``write_index`` — the slot-indexed write path in
   ``models/layers.py``) because rows sit at different depths of their
@@ -23,8 +19,35 @@ retirement) between ticks:
   top_p per slot, :func:`sample_tokens`): two requests with different
   knobs share a tick without recompiling.
 - inactive (free) slots still run through the step — their sampled tokens
-  are ignored and their writes land harmlessly in dead rows; masking work
-  out of a fixed-shape jitted step is the standard slot-pool trade.
+  are ignored and their cache writes are aimed at column ``seq_len``
+  (out of range, dropped by scatter semantics) so an idle or
+  mid-chunked-prefill row is never touched; masking work out of a
+  fixed-shape jitted step is the standard slot-pool trade.
+
+Prefill fast path — three cooperating mechanisms (all EXACT: greedy
+outputs are token-identical to batch-1 exact-length prefill, pinned in
+``tests/test_serving.py``):
+
+1. **Length bucketing** (``prefill_buckets``): prompts pad RIGHT up to a
+   small geometric bucket set, so ``_prefill_core`` compiles O(#buckets)
+   shapes instead of O(#distinct lengths).  Pad slots carry position -1
+   (never attended) and are overwritten by the request's own decode
+   tokens — zero cache-capacity cost.  Same-bucket admissions run as ONE
+   batched prefill (the scheduler groups them; the batch pads to
+   ``prefill_batch`` rows so batch size never adds compile shapes) and
+   the fresh rows scatter into their slots in one call.
+2. **Chunked prefill** (``prefill_chunk_tokens``): prompts above the
+   budget split into budget-sized chunks that interleave with decode
+   ticks — one chunk per tick continues INTO the already-assigned slot's
+   cache via the multi-token ``write_index`` path
+   (:func:`~tpu_parallel.models.generate.prefill_extend_step`), bounding
+   how long any prefill can stall in-flight decodes.
+3. **Prefix reuse** (``prefix_cache_size``): an LRU cache over
+   bucket-aligned prompt prefixes (system prompts, few-shot headers);
+   hits COPY the stored K/V row into the fresh slot
+   (:meth:`CachePool.copy_prefix`) and only the prompt remainder runs the
+   model.  Hit/miss/eviction counters surface in
+   :class:`~tpu_parallel.serving.metrics.ServingMetrics`.
 
 Greedy equivalence: for requests submitted together, per-request outputs
 are token-identical to static ``generate()`` on the same prompts (pinned
@@ -33,7 +56,7 @@ invisible to each row, and both paths share
 :func:`~tpu_parallel.models.generate.decode_step`.
 
 TP serving: pass ``mesh`` (and mesh-sharded ``params``) and the engine
-wraps its prefill/decode cores in the same
+wraps its prefill/extend/decode cores in the same
 :func:`~tpu_parallel.models.generate.build_sharded_serving` harness as
 ``generate_sharded`` — weights stay split, the cache pool shards over
 heads, sampling runs on gathered ``[n_slots, vocab]`` logits (small), with
@@ -46,7 +69,15 @@ from __future__ import annotations
 
 import functools
 import time
-from typing import Callable, List, Optional, Union
+from typing import (
+    Callable,
+    Dict,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+    Union,
+)
 
 import jax
 import jax.numpy as jnp
@@ -57,13 +88,18 @@ from tpu_parallel.models.generate import (
     _HashableTree,
     build_sharded_serving,
     decode_step,
+    padded_prefill_inputs,
+    prefill_extend_step,
+    prefill_step,
 )
 from tpu_parallel.serving.cache_pool import (
     CachePool,
     cache_partition_specs,
+    default_row_fns,
     insert_rows,
 )
 from tpu_parallel.serving.metrics import ServingMetrics
+from tpu_parallel.serving.prefix_cache import PrefixCache
 from tpu_parallel.serving.request import (
     FINISHED,
     REJECTED,
@@ -117,40 +153,58 @@ def sample_tokens(
     return jnp.where(temperature > 0.0, sampled, greedy)
 
 
-def _full_last_logits(cfg, params, hidden):
-    """lm_head over the last position only, FULL vocab width on every rank
+def _full_last_logits(cfg, params, hidden, last_idx=None):
+    """lm_head over ONE position per row, FULL vocab width on every rank
     (one tiny [batch, vocab] all_gather under TP — the per-row knob sampler
-    needs the whole row; batch is n_slots, not tokens)."""
+    needs the whole row; batch is n_slots, not tokens).
+
+    ``last_idx`` [batch] selects each row's position (the bucketed
+    prefill's per-row LAST REAL token — right padding means it is not
+    uniformly -1); None reads the final position (decode steps, exact
+    prefill)."""
     from tpu_parallel.models.gpt import _lm_head_params, _make_lm_head
     from tpu_parallel.parallel.tp import axis_size_or_none
 
+    if last_idx is None:
+        hidden = hidden[:, -1:]
+    else:
+        idx = jnp.broadcast_to(
+            last_idx.astype(jnp.int32)[:, None, None],
+            (hidden.shape[0], 1, hidden.shape[2]),
+        )
+        hidden = jnp.take_along_axis(hidden, idx, axis=1)
     head = _make_lm_head(cfg, name=None, gather=False, fsdp_wrap=False)
-    logits = head.apply(
-        {"params": _lm_head_params(cfg, params)}, hidden[:, -1:]
-    )[:, 0]
+    logits = head.apply({"params": _lm_head_params(cfg, params)}, hidden)[:, 0]
     if axis_size_or_none(cfg.model_axis) is not None:
         logits = lax.all_gather(logits, cfg.model_axis, axis=-1, tiled=True)
     return logits
 
 
-def _prefill_core(model, params, prompt, rng):
-    """Batch-1 (or batch-N) prefill: fills a fresh cache, returns the last
-    position's full-vocab logits + the cache.  ``rng`` unused (sampling
-    happens outside so the prefill compiles per prompt LENGTH only, not
+def _prefill_core(model, params, prompt, positions, last_idx, rng):
+    """Batch-N pad-aware prefill: fills fresh caches, returns each row's
+    last REAL position's full-vocab logits + the cache.  ``positions``
+    carry -1 at pad slots (:func:`padded_prefill_inputs`); with uniform
+    ``arange`` positions this is the exact-length prefill.  ``rng`` unused
+    (sampling happens outside so the prefill compiles per SHAPE only, not
     per knob set)."""
     del rng
-    b, prompt_len = prompt.shape
-    positions = jnp.broadcast_to(jnp.arange(prompt_len), (b, prompt_len))
-    hidden, variables = model.apply(
-        {"params": params},
-        prompt,
-        positions=positions,
-        train=False,
-        decode=True,
-        hidden_only=True,
-        mutable=["cache"],
+    hidden, cache = prefill_step(model, params, prompt, positions)
+    return _full_last_logits(model.config, params, hidden, last_idx), cache
+
+
+def _extend_core(
+    model, params, tokens, positions, last_idx, write_start, cache, rng
+):
+    """Continue a prefill into an existing batch-1 cache row (chunked
+    prefill / prefix-reuse remainder): tokens at global ``positions``
+    (pads -1) write K/V at slots ``write_start + [0..T)``.  Returns the
+    chunk's last real position's logits (read only for the FINAL chunk)
+    + the extended cache."""
+    del rng
+    hidden, cache = prefill_extend_step(
+        model, params, cache, tokens, positions, write_start
     )
-    return _full_last_logits(model.config, params, hidden), variables["cache"]
+    return _full_last_logits(model.config, params, hidden, last_idx), cache
 
 
 def _decode_core(
@@ -171,12 +225,22 @@ def _engine_fns(model):
     """Jitted engine step functions for the single-host path, cached per
     model so every engine instance (tests build many) shares traces.
 
-    The cache-pool operand is DONATED in the decode step and the insert:
-    the old pool tree is dead the moment the call returns, and without
-    donation XLA holds a second full pool (the engine's dominant HBM) at
-    every tick."""
+    The cache-pool operand is DONATED in the decode step, the extend, the
+    insert, and the row ops: the old tree is dead the moment the call
+    returns, and without donation XLA holds a second full pool (the
+    engine's dominant HBM) at every tick."""
     prefill = jax.jit(
-        lambda params, prompt, rng: _prefill_core(model, params, prompt, rng)
+        lambda params, prompt, positions, last_idx, rng: _prefill_core(
+            model, params, prompt, positions, last_idx, rng
+        )
+    )
+    extend = jax.jit(
+        lambda params, tokens, positions, last_idx, wstart, cache, rng: (
+            _extend_core(
+                model, params, tokens, positions, last_idx, wstart, cache, rng
+            )
+        ),
+        donate_argnums=5,
     )
     decode = jax.jit(
         lambda params, tok, pos, widx, temp, tk, tp, cache, rng: _decode_core(
@@ -186,7 +250,7 @@ def _engine_fns(model):
     )
     sample = jax.jit(sample_tokens)
     insert = jax.jit(insert_rows, donate_argnums=0)
-    return prefill, decode, sample, insert
+    return prefill, extend, decode, sample, insert, default_row_fns()
 
 
 @functools.lru_cache(maxsize=8)
@@ -201,8 +265,12 @@ def _sharded_engine_fns(model, mesh, specs: _HashableTree,
     param_specs = specs.tree()
     cspecs = cache_specs.tree()
     prefill = build_sharded_serving(
-        model, mesh, param_specs, (P(),), (P(), cspecs), _prefill_core,
-        fold_axes=(),
+        model, mesh, param_specs, (P(), P(), P()), (P(), cspecs),
+        _prefill_core, fold_axes=(),
+    )
+    extend = build_sharded_serving(
+        model, mesh, param_specs, (P(), P(), P(), P(), cspecs),
+        (P(), cspecs), _extend_core, fold_axes=(),
     )
     decode = build_sharded_serving(
         model, mesh, param_specs,
@@ -212,9 +280,32 @@ def _sharded_engine_fns(model, mesh, specs: _HashableTree,
     sample = jax.jit(sample_tokens)
     # the shard_map-wrapped decode cannot donate (build_sharded_serving
     # does not expose donation), so the TP tick holds a transient second
-    # pool; the insert at least recycles its operand
+    # pool; the insert and row ops at least recycle their operands
     insert = jax.jit(insert_rows, donate_argnums=0)
-    return prefill, decode, sample, insert
+    return prefill, extend, decode, sample, insert, default_row_fns()
+
+
+def default_prefill_buckets(seq_len: int, start: int = 32) -> Tuple[int, ...]:
+    """Geometric bucket set ``(32, 64, ..., seq_len)`` — prompt lengths
+    collapse onto O(log seq_len) compile shapes.  ``seq_len`` is always
+    the last bucket so every admissible prompt fits one."""
+    buckets, b = [], min(start, seq_len)
+    while b < seq_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(seq_len)
+    return tuple(buckets)
+
+
+class _ChunkState:
+    """An in-flight chunked prefill: the request owns its slot, the prompt
+    extends one chunk per tick, activation happens on the final chunk."""
+
+    __slots__ = ("out", "offset")
+
+    def __init__(self, out: RequestOutput, offset: int):
+        self.out = out
+        self.offset = offset
 
 
 class ServingEngine:
@@ -229,6 +320,21 @@ class ServingEngine:
     — ``kv_cache_dtype="int8"`` halves it); ``scheduler`` takes a
     :class:`SchedulerConfig` (or a ready scheduler) for admission policy;
     ``clock`` is injectable for deterministic timeout tests.
+
+    Prefill fast-path knobs (see the module docstring; all exact):
+
+    - ``prefill_buckets``: ``"auto"`` (default — geometric 32..seq_len),
+      an explicit ascending tuple, or None/() for the legacy batch-1
+      exact-length prefill (compiles per distinct prompt length).
+    - ``prefill_batch``: row count every batched prefill call pads to
+      (default: the scheduler's ``max_prefills_per_tick``), so batch size
+      never adds compile shapes.  Dummy rows scatter out of range and
+      vanish.
+    - ``prefill_chunk_tokens``: prompts longer than this split into
+      chunks interleaving with decode ticks (None = monolithic prefill).
+    - ``prefix_cache_size``: LRU entries of bucket-aligned prefix K/V
+      rows (0 = off; each entry is a full seq_len row of HBM).  Requires
+      bucketing.
     """
 
     def __init__(
@@ -242,12 +348,28 @@ class ServingEngine:
         rng: Optional[jax.Array] = None,
         metrics: Optional[ServingMetrics] = None,
         clock: Callable[[], float] = time.monotonic,
+        prefill_buckets: Union[str, Sequence[int], None] = "auto",
+        prefill_batch: Optional[int] = None,
+        prefill_chunk_tokens: Optional[int] = None,
+        prefix_cache_size: int = 0,
     ):
         cfg = model.config
         if getattr(cfg, "pipe_size", 1) > 1:
             raise NotImplementedError(
                 "the serving engine does not run pipeline meshes — serve "
                 "pipe-split models through generate_sharded"
+            )
+        if cfg.positional == "relative":
+            # the shared T5 bias table assumes row-uniform positions; a
+            # slot pool's rows sit at different depths, so the engine's
+            # decode (and the fast path's padded prefill rows) would get
+            # row-0 bias — PR 1 accepted these configs and was silently
+            # wrong; the model now refuses write_index + relative, and the
+            # engine refuses up front with the pointer
+            raise NotImplementedError(
+                "the serving engine does not run positional='relative' "
+                "models (per-row slot depths break the shared bias "
+                "table) — serve those through generate()"
             )
         self.model = model
         self.params = params
@@ -256,8 +378,46 @@ class ServingEngine:
         if isinstance(scheduler, FIFOScheduler):
             self.scheduler = scheduler
         else:
-            self.scheduler = FIFOScheduler(scheduler)
+            self.scheduler = FIFOScheduler(scheduler, clock=clock)
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+
+        if prefill_buckets == "auto":
+            self._buckets: Optional[Tuple[int, ...]] = (
+                default_prefill_buckets(cfg.seq_len)
+            )
+        elif prefill_buckets:
+            bs = tuple(sorted(int(b) for b in prefill_buckets))
+            if bs[0] < 1 or bs[-1] > cfg.seq_len:
+                raise ValueError(
+                    f"prefill_buckets={bs} outside [1, seq_len={cfg.seq_len}]"
+                )
+            if bs[-1] < cfg.seq_len:
+                bs = bs + (cfg.seq_len,)  # every admissible prompt must fit
+            self._buckets = bs
+        else:
+            self._buckets = None
+        if prefill_chunk_tokens is not None and prefill_chunk_tokens < 1:
+            raise ValueError(
+                f"prefill_chunk_tokens={prefill_chunk_tokens} < 1"
+            )
+        self._chunk_tokens = prefill_chunk_tokens
+        if prefix_cache_size > 0 and self._buckets is None:
+            raise ValueError(
+                "prefix_cache_size > 0 requires prefill bucketing (prefix "
+                "keys are bucket-aligned)"
+            )
+        self._prefix = (
+            PrefixCache(prefix_cache_size) if prefix_cache_size > 0 else None
+        )
+        self._prefill_batch = (
+            prefill_batch
+            if prefill_batch is not None
+            else self.scheduler.config.max_prefills_per_tick
+        )
+        if self._prefill_batch < 1:
+            raise ValueError(f"prefill_batch={self._prefill_batch} < 1")
+        self._chunking: Dict[int, _ChunkState] = {}
+        self._prefill_shapes: set = set()
 
         pool_shardings = None
         if mesh is not None:
@@ -280,16 +440,20 @@ class ServingEngine:
             )
         else:
             fns = _engine_fns(model)
-        self._prefill_fn, self._decode_fn, self._sample_fn, insert = fns
+        (self._prefill_fn, self._extend_fn, self._decode_fn,
+         self._sample_fn, insert, row_fns) = fns
         self.pool = CachePool(
             model, params, n_slots, insert_fn=insert,
-            shardings=pool_shardings,
+            shardings=pool_shardings, row_fns=row_fns,
         )
 
         n = n_slots
         self._tok = np.zeros(n, np.int32)
         self._pos = np.zeros(n, np.int32)
-        self._widx = np.zeros(n, np.int32)
+        # inactive rows aim their decode-tick cache writes at column
+        # seq_len — out of range, DROPPED — so a freed or mid-chunked-
+        # prefill row is never dirtied by the shared decode step
+        self._widx = np.full(n, cfg.seq_len, np.int32)
         self._temp = np.zeros(n, np.float32)
         self._topk = np.zeros(n, np.int32)
         self._topp = np.zeros(n, np.float32)
@@ -322,9 +486,11 @@ class ServingEngine:
     # -- the tick ----------------------------------------------------------
 
     def step(self) -> List[StreamEvent]:
-        """One engine tick: expire stale queue entries, admit into free
-        slots (bounded by the scheduler's prefill budget), one decode step
-        over the pool, retire finished slots.  Returns this tick's events."""
+        """One engine tick: expire stale queue entries, advance in-flight
+        chunked prefills by one chunk each, admit into free slots (bounded
+        by the scheduler's prefill budget, same-bucket admissions as one
+        batched prefill), one decode step over the pool, retire finished
+        slots.  Returns this tick's events."""
         now = self.clock()
         events: List[StreamEvent] = []
         for out in self.scheduler.expire(now):
@@ -345,13 +511,25 @@ class ServingEngine:
                 out.request.on_token(event)
             events.append(event)
             self.metrics.record_expired()
-        admitted = self.scheduler.schedule(self.pool.n_free, now)
-        for out in admitted:
-            events.extend(self._admit(out))
+        # chunked prefills first: their slots are already owned, and a
+        # chunk finishing this tick decodes this tick
+        for slot in sorted(self._chunking):
+            events.extend(self._advance_chunk(slot))
+        bucket_key = (
+            self._admission_key
+            if (self._buckets is not None or self._chunk_tokens is not None)
+            else None
+        )
+        admitted = self.scheduler.schedule(
+            self.pool.n_free, now, bucket_key=bucket_key
+        )
+        events.extend(self._admit_batch(admitted))
         decoded = False
         if self._active.any():
             events.extend(self._decode_tick())
             decoded = True
+        if self._prefix is not None:
+            self.metrics.sync_prefix_cache(self._prefix)
         self.metrics.record_tick(
             now=self.clock(),
             queue_depth=self.scheduler.depth,
@@ -364,7 +542,11 @@ class ServingEngine:
         return events
 
     def has_work(self) -> bool:
-        return self.scheduler.depth > 0 or bool(self._active.any())
+        return (
+            self.scheduler.depth > 0
+            or bool(self._active.any())
+            or bool(self._chunking)
+        )
 
     def run(self, max_ticks: Optional[int] = None) -> List[StreamEvent]:
         """Tick until idle (or ``max_ticks``); returns all events."""
@@ -375,31 +557,285 @@ class ServingEngine:
             ticks += 1
         return events
 
+    @property
+    def prefill_compiles(self) -> int:
+        """Distinct prefill/extend call SHAPES this engine has issued —
+        the host-side mirror of jit compile count (the jitted fns are
+        shared across engines of the same model via an lru_cache, so
+        their ``_cache_size()`` counts the whole process)."""
+        return len(self._prefill_shapes)
+
     # -- internals ---------------------------------------------------------
 
     def _next_rng(self) -> jax.Array:
         self._rng, sub = jax.random.split(self._rng)
         return sub
 
-    def _admit(self, out: RequestOutput) -> List[StreamEvent]:
+    def _bucket_for(self, length: int) -> int:
+        for b in self._buckets:
+            if b >= length:
+                return b
+        raise AssertionError(
+            f"no bucket >= {length} (buckets {self._buckets})"
+        )  # unreachable: seq_len is always the last bucket
+
+    def _admission_key(self, out: RequestOutput):
+        """Scheduler grouping key: same-bucket requests batch into one
+        prefill call; chunked prompts get a unique key (they admit alone
+        and proceed chunk-by-chunk).  With bucketing off every short
+        prompt shares one key — the legacy path prefills batch-1 per
+        request regardless, so splitting by length would only serialize
+        admissions across ticks."""
+        length = len(out.request.prompt)
+        if self._chunk_tokens is not None and length > self._chunk_tokens:
+            return ("chunk", id(out))
+        if self._buckets is None:
+            return ("exact",)
+        return ("bucket", self._bucket_for(length))
+
+    def _admit_batch(self, admitted: List[RequestOutput]) -> List[StreamEvent]:
+        """Route one tick's admissions: chunked prompts start their slot,
+        prefix-cache hits run as batched remainder extends (grouped by
+        prefix length and remainder bucket), the rest as one padded
+        batched prefill (or batch-1 exact calls in legacy mode)."""
+        events: List[StreamEvent] = []
+        batch: List[RequestOutput] = []
+        hit_groups: Dict[Tuple[int, int], list] = {}
+        for out in admitted:
+            length = len(out.request.prompt)
+            if self._chunk_tokens is not None and length > self._chunk_tokens:
+                events.extend(self._start_chunked(out))
+                continue
+            if self._prefix is not None:
+                hit = self._prefix.lookup(out.request.prompt, self._buckets)
+                if hit is not None:
+                    row, plen = hit
+                    key = (plen, self._bucket_for(length - plen))
+                    hit_groups.setdefault(key, []).append((out, row))
+                    continue
+            batch.append(out)
+        for (plen, width), group in hit_groups.items():
+            events.extend(self._admit_prefix_batch(group, plen, width))
+        if not batch:
+            return events
+        if self._buckets is None:
+            # legacy exact-length path: batch-1 prefill per request,
+            # compiled per distinct prompt length (the PR 1 behavior)
+            for out in batch:
+                events.append(self._admit_exact(out))
+            return events
+        events.extend(self._admit_bucketed(batch))
+        return events
+
+    def _admit_exact(self, out: RequestOutput) -> StreamEvent:
         req = out.request
         slot = self.pool.acquire()
         assert slot is not None, "scheduler admitted beyond free slots"
+        length = len(req.prompt)
         prompt = jnp.asarray(req.prompt, jnp.int32)[None, :]
-        logits, fresh = self._prefill_fn(
-            self.params, prompt, self._next_rng()
+        positions = jnp.broadcast_to(
+            jnp.arange(length, dtype=jnp.int32), (1, length)
         )
+        logits, fresh = self._prefill_fn(
+            self.params, prompt, positions,
+            jnp.asarray([length - 1], jnp.int32), self._next_rng(),
+        )
+        self._prefill_shapes.add(("prefill", 1, length))
+        self.metrics.record_prefill_call()
         self.pool.insert(fresh, slot)
-        sp = req.sampling
+        tok0 = self._sample_first(logits, [out])[0]
+        return self._activate(slot, out, tok0, length)
+
+    def _admit_bucketed(
+        self, outs: List[RequestOutput]
+    ) -> List[StreamEvent]:
+        """ONE padded batched prefill for a same-bucket admission group:
+        rows pad right to the bucket width, the batch pads to
+        ``prefill_batch`` dummy rows (scattered out of range, dropped),
+        and every real row's fresh cache scatters into its slot in one
+        call."""
+        width = self._bucket_for(max(len(o.request.prompt) for o in outs))
+        nb = max(self._prefill_batch, len(outs))
+        tokens = np.zeros((nb, width), np.int32)
+        lengths = np.ones(nb, np.int32)  # dummy rows: 1 real token
+        slots = np.full(nb, self.pool.n_slots, np.int32)  # dummies drop
+        for i, out in enumerate(outs):
+            prompt = out.request.prompt
+            tokens[i, : len(prompt)] = prompt
+            lengths[i] = len(prompt)
+            slot = self.pool.acquire()
+            assert slot is not None, "scheduler admitted beyond free slots"
+            slots[i] = slot
+        positions, last_idx = padded_prefill_inputs(lengths, width)
+        logits, fresh = self._prefill_fn(
+            self.params, jnp.asarray(tokens), positions, last_idx,
+            self._next_rng(),
+        )
+        self._prefill_shapes.add(("prefill", nb, width))
+        self.metrics.record_prefill_call()
+        self.pool.scatter(fresh, slots)
+        firsts = self._sample_first(logits, outs)
+        events = []
+        for i, out in enumerate(outs):
+            events.append(
+                self._activate(int(slots[i]), out, firsts[i], int(lengths[i]))
+            )
+            self._maybe_store_prefix(out, int(slots[i]))
+        return events
+
+    def _admit_prefix_batch(
+        self, group: List[tuple], prefix_len: int, width: int
+    ) -> List[StreamEvent]:
+        """Prefix-cache hits sharing (prefix length, remainder bucket):
+        stack the stored K/V rows into ONE batch-N cache (positions
+        trimmed to the prefix), run every remainder as one padded extend
+        call, scatter the completed rows into their slots.  Skips
+        recomputing ``prefix_len`` tokens per request AND keeps hits
+        batched like cold prefills."""
+        nb = max(self._prefill_batch, len(group))
+        rows = [row for (_, row) in group]
+        rows += [rows[0]] * (nb - len(rows))  # dummy rows: dropped slots
+        stacked = self.pool.stack_prefix(
+            tuple(rows), jnp.int32(prefix_len)
+        )
+        tokens = np.zeros((nb, width), np.int32)
+        rems = np.ones(nb, np.int32)
+        slots = np.full(nb, self.pool.n_slots, np.int32)
+        for i, (out, _) in enumerate(group):
+            rem = out.request.prompt[prefix_len:]
+            tokens[i, : len(rem)] = rem
+            rems[i] = len(rem)
+            slot = self.pool.acquire()
+            assert slot is not None, "scheduler admitted beyond free slots"
+            slots[i] = slot
+        base, last_idx = padded_prefill_inputs(rems, width)
+        positions = jnp.where(base >= 0, base + prefix_len, -1)
+        logits, ext = self._extend_fn(
+            self.params, jnp.asarray(tokens), positions, last_idx,
+            jnp.full((nb,), prefix_len, jnp.int32), stacked,
+            self._next_rng(),
+        )
+        self._prefill_shapes.add(("extend", nb, width))
+        self.metrics.record_prefill_call()
+        self.pool.scatter(ext, slots)
+        outs = [out for (out, _) in group]
+        firsts = self._sample_first(logits, outs)
+        events = []
+        for i, out in enumerate(outs):
+            events.append(
+                self._activate(
+                    int(slots[i]), out, firsts[i], len(out.request.prompt)
+                )
+            )
+            # a request hitting on a SHORT prefix may carry a longer
+            # bucket-aligned prefix that was LRU-evicted — re-seed it
+            # (no-op unless some key is actually new)
+            self._maybe_store_prefix(out, int(slots[i]))
+        return events
+
+    def _extend_slot(
+        self, slot: int, tokens_seq, offset: int, width: int
+    ):
+        """Extract the slot's row, extend it with ``tokens_seq`` (padded
+        right to ``width``) writing at cache columns ``offset + [0..)``,
+        scatter it back; returns the extension's last real logits."""
+        take = len(tokens_seq)
+        tokens = np.zeros((1, width), np.int32)
+        tokens[0, :take] = tokens_seq
+        base, last_idx = padded_prefill_inputs([take], width)
+        positions = jnp.where(base >= 0, base + offset, -1)
+        row = self.pool.extract(slot)
+        logits, row = self._extend_fn(
+            self.params, jnp.asarray(tokens), positions, last_idx,
+            jnp.asarray([offset], jnp.int32), row, self._next_rng(),
+        )
+        self._prefill_shapes.add(("extend", 1, width))
+        self.pool.insert(row, slot)
+        return logits
+
+    def _start_chunked(self, out: RequestOutput) -> List[StreamEvent]:
+        """Claim a slot for a long prompt and run its first chunk (the
+        remaining chunks advance one per tick).  A prefix-cache hit seeds
+        the slot and the chunking starts at the prefix boundary."""
+        slot = self.pool.acquire()
+        assert slot is not None, "scheduler admitted beyond free slots"
+        offset = 0
+        if self._prefix is not None:
+            hit = self._prefix.lookup(out.request.prompt, self._buckets)
+            if hit is not None:
+                row, offset = hit
+                self.pool.copy_prefix(row, slot, offset)
+        if offset == 0:
+            # incremental writes only from here on: invalidate the slot's
+            # previous occupant NOW (a whole-row insert never happens)
+            self.pool.clear(slot)
+        out.status = RUNNING
+        self._slot_out[slot] = out
+        self._chunking[slot] = _ChunkState(out, offset)
+        return self._advance_chunk(slot)
+
+    def _advance_chunk(self, slot: int) -> List[StreamEvent]:
+        """Run ONE chunk of the slot's in-flight prefill; on the final
+        chunk, sample the request's first token and activate the slot for
+        decode."""
+        st = self._chunking[slot]
+        prompt = st.out.request.prompt
+        take = min(self._chunk_tokens, len(prompt) - st.offset)
+        logits = self._extend_slot(
+            slot, prompt[st.offset : st.offset + take],
+            offset=st.offset, width=self._chunk_tokens,
+        )
+        st.offset += take
+        self.metrics.record_prefill_call(chunks=1)
+        if st.offset < len(prompt):
+            return []
+        del self._chunking[slot]
+        tok0 = self._sample_first(logits, [st.out])[0]
+        event = self._activate(slot, st.out, tok0, len(prompt))
+        self._maybe_store_prefix(st.out, slot)
+        return [event]
+
+    def _maybe_store_prefix(self, out: RequestOutput, slot: int) -> None:
+        """Seed the prefix cache from a freshly prefilled slot row (every
+        bucket-aligned proper prefix of the prompt, first writer wins).
+        The extract only runs when at least one key would be new."""
+        if self._prefix is None:
+            return
+        prompt = tuple(int(t) for t in out.request.prompt)
+        if all(
+            b >= len(prompt) or prompt[:b] in self._prefix
+            for b in self._buckets
+        ):
+            return
+        self._prefix.store(prompt, self._buckets, self.pool.extract(slot))
+
+    def _sample_first(self, logits, outs: List[RequestOutput]) -> List[int]:
+        """Sample each admitted request's FIRST token from its prefill
+        logits (rows beyond ``outs`` are a padded batch's dummies —
+        sampled greedily and discarded)."""
+        nb = logits.shape[0]
+        temp = np.zeros(nb, np.float32)
+        topk = np.zeros(nb, np.int32)
+        topp = np.zeros(nb, np.float32)
+        for i, out in enumerate(outs):
+            sp = out.request.sampling
+            temp[i], topk[i], topp[i] = sp.temperature, sp.top_k, sp.top_p
         first = self._sample_fn(
             logits,
             self._next_rng(),
-            jnp.asarray([sp.temperature], jnp.float32),
-            jnp.asarray([sp.top_k], jnp.int32),
-            jnp.asarray([sp.top_p], jnp.float32),
+            jnp.asarray(temp),
+            jnp.asarray(topk),
+            jnp.asarray(topp),
         )
-        tok0 = int(np.asarray(first)[0])
-        prompt_len = len(req.prompt)
+        first = np.asarray(first)
+        return [int(first[i]) for i in range(len(outs))]
+
+    def _activate(
+        self, slot: int, out: RequestOutput, tok0: int, prompt_len: int
+    ) -> StreamEvent:
+        """Commit an admitted request to its slot: decode state, knobs,
+        first-token delivery."""
+        sp = out.request.sampling
         self._tok[slot] = tok0
         self._pos[slot] = prompt_len
         self._widx[slot] = prompt_len
@@ -410,7 +846,7 @@ class ServingEngine:
         self._slot_out[slot] = out
         out.status = RUNNING
         out.first_token_time = self.clock()
-        return [self._deliver(slot, tok0)]
+        return self._deliver(slot, tok0)
 
     def _decode_tick(self) -> List[StreamEvent]:
         nxt, self.pool.cache = self._decode_fn(
@@ -461,6 +897,8 @@ class ServingEngine:
             out.finish_time = now
             self._active[slot] = False
             self._slot_out[slot] = None
+            # park the freed row's decode writes out of range (see __init__)
+            self._widx[slot] = self.model.config.seq_len
             self.pool.release(slot)
             self.metrics.record_finished(out)
         if req.on_token is not None:

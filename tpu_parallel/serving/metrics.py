@@ -18,13 +18,16 @@ from tpu_parallel.utils.logging_utils import MetricLogger
 
 
 def percentile(values: Sequence[float], p: float) -> Optional[float]:
-    """Linear-interpolated percentile (``p`` in [0, 100]); None on empty —
-    the empty-safe wrapper every summary stat here needs."""
-    if not values:
+    """Linear-interpolated percentile (``p`` clamped into [0, 100]); None
+    on empty — the empty-safe wrapper every summary stat here needs (a run
+    with ZERO finished requests must still produce a serializable summary,
+    not an IndexError/NaN in the JSONL sink)."""
+    vals = [v for v in values if v is not None]
+    if not vals:
         return None
     import numpy as np
 
-    return float(np.percentile(list(values), p))
+    return float(np.percentile(vals, min(max(p, 0.0), 100.0)))
 
 
 class ServingMetrics:
@@ -60,6 +63,14 @@ class ServingMetrics:
         self.finished = 0
         self.rejected = 0
         self.expired = 0
+        # prefill fast path: batched prefill device calls (vs. `prefills`,
+        # which counts admitted REQUESTS), chunk continuations, and the
+        # prefix cache's hit/miss/eviction tallies
+        self.prefill_calls = 0
+        self.prefill_chunks = 0
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_evictions = 0
         self._t_start: Optional[float] = None
         self._t_last: Optional[float] = None
 
@@ -109,6 +120,20 @@ class ServingMetrics:
     def record_expired(self) -> None:
         self.expired += 1
 
+    def record_prefill_call(self, chunks: int = 0) -> None:
+        """One batched prefill device call (``chunks`` counts any chunk
+        continuations it was split into)."""
+        self.prefill_calls += 1
+        self.prefill_chunks += chunks
+
+    def sync_prefix_cache(self, prefix_cache) -> None:
+        """Mirror a :class:`~tpu_parallel.serving.prefix_cache.PrefixCache`'s
+        cumulative counters (the cache owns the tallies; metrics snapshots
+        them so ``summary()`` is self-contained)."""
+        self.prefix_hits = prefix_cache.hits
+        self.prefix_misses = prefix_cache.misses
+        self.prefix_evictions = prefix_cache.evictions
+
     def throughput(self) -> Optional[float]:
         """Generated tokens per wall-second over the ticks observed."""
         if self._t_start is None or self._t_last is None:
@@ -123,10 +148,19 @@ class ServingMetrics:
             return None if x is None else round(x * 1000.0, 3)
 
         mean = lambda xs: (sum(xs) / len(xs)) if xs else None
+        probes = self.prefix_hits + self.prefix_misses
         return {
             "ticks": self.ticks,
             "decode_ticks": self.decode_ticks,
             "prefills": self.prefills,
+            "prefill_calls": self.prefill_calls,
+            "prefill_chunks": self.prefill_chunks,
+            "prefix_hits": self.prefix_hits,
+            "prefix_misses": self.prefix_misses,
+            "prefix_evictions": self.prefix_evictions,
+            "prefix_hit_rate": (
+                round(self.prefix_hits / probes, 4) if probes else None
+            ),
             "finished": self.finished,
             "rejected": self.rejected,
             "expired": self.expired,

@@ -1,0 +1,102 @@
+"""LRU prefix cache: skip recomputing shared prompt prefixes entirely.
+
+Production prompt streams are heavily prefix-shared — system prompts,
+few-shot headers, templated instructions — and a continuous-batching
+engine re-prefills those identical tokens for every request.  Cached K/V
+is a pure function of (token ids, positions, params), including the int8
+path's per-(position, kv-head) quantization, so a prefix computed once
+can be COPIED into a fresh slot (:meth:`CachePool.copy_prefix`) with
+bit-identical results; only the prompt remainder runs the model.
+
+Keys are BUCKET-ALIGNED token prefixes (the engine's prefill buckets), so
+lookups are O(#buckets) exact-match probes instead of a longest-common-
+prefix search: for a prompt of length L the engine probes the largest
+bucket B <= L-1 downward and takes the first hit.  (L-1, not L: a full-
+prompt hit would leave no remainder token, and the FIRST sampled token
+needs the last real token's hidden state — cached K/V alone cannot
+produce logits.)
+
+Entries are whole pool rows (seq_len-long K/V per layer) — real HBM — so
+the cache is small and LRU-evicted; ``max_entries`` bounds it.  Hit/miss/
+eviction counters feed :class:`~tpu_parallel.serving.metrics.ServingMetrics`.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional, Sequence, Tuple
+
+
+class PrefixCache:
+    """Exact-match LRU over bucket-aligned token prefixes.
+
+    Keys are token-id tuples (dict hashing gives the "hash-keyed" lookup
+    with zero collision risk); values are ``(row_tree, length)`` where
+    ``row_tree`` is a batch-1 cache row whose first ``length`` positions
+    hold the prefix (the engine trims validity at copy time, so rows are
+    stored as extracted — no rewrite on the store path).
+    """
+
+    def __init__(self, max_entries: int = 8):
+        if max_entries < 1:
+            raise ValueError(f"max_entries={max_entries} < 1")
+        self.max_entries = max_entries
+        self._entries: "OrderedDict[Tuple[int, ...], tuple]" = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key) -> bool:
+        return tuple(key) in self._entries
+
+    def reset_counters(self) -> None:
+        """Zero the hit/miss/eviction tallies (entries stay) — benches
+        call this after a warm-up phase so measured-window rates are not
+        polluted by warm traffic."""
+        self.hits = self.misses = self.evictions = 0
+
+    def lookup(self, prompt: Sequence[int], buckets: Sequence[int]):
+        """Longest bucket-aligned cached prefix of ``prompt`` STRICTLY
+        shorter than the prompt; returns ``(row_tree, length)`` or None.
+        One counted hit or miss per call (per admission, not per probe).
+        """
+        prompt = tuple(int(t) for t in prompt)
+        for b in sorted(buckets, reverse=True):
+            if b >= len(prompt):
+                continue
+            entry = self._entries.get(prompt[:b])
+            if entry is not None:
+                self._entries.move_to_end(prompt[:b])
+                self.hits += 1
+                return entry
+        self.misses += 1
+        return None
+
+    def store(self, prompt: Sequence[int], buckets: Sequence[int],
+              row_tree) -> list:
+        """Store ``row_tree`` (a freshly prefilled slot row for ``prompt``)
+        under EVERY bucket-aligned proper-prefix key not already cached —
+        a long prompt seeds its short shared header (the system-prompt
+        case) and its long few-shot prefix in one pass, all referencing
+        the SAME immutable row (copy_prefix trims validity to each key's
+        length at hit time, so one stored row serves every aligned
+        sub-prefix).  First writer wins per key.  Returns the newly stored
+        prefix lengths."""
+        prompt = tuple(int(t) for t in prompt)
+        stored = []
+        for b in sorted(buckets, reverse=True):
+            if b >= len(prompt):
+                continue
+            key = prompt[:b]
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                continue
+            self._entries[key] = (row_tree, b)
+            stored.append(b)
+        while len(self._entries) > self.max_entries:
+            self._entries.popitem(last=False)
+            self.evictions += 1
+        return stored

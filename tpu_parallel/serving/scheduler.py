@@ -12,17 +12,24 @@ scheduler owns the first: a FIFO queue with three policy knobs —
   runs a full prompt forward between decode ticks, stalling every running
   request's next token; capping admissions per tick bounds that
   head-of-line latency hit (1 = smoothest inter-token latency, higher =
-  faster queue drain).
+  faster queue drain).  With the engine's bucketed prefill the whole
+  admission set runs as ONE batched call, so higher values also amortize
+  per-call overhead instead of multiplying it.
 - ``max_wait``: queue timeout.  Requests that cannot reach a slot within
   ``max_wait`` seconds EXPIRE (dropped with status ``expired``) rather
   than serving a reply the client already abandoned.
+
+Time is injectable: ``clock`` (default ``time.monotonic``) supplies "now"
+whenever a caller does not pass it explicitly, so queue-timeout tests run
+deterministically on a fake clock instead of sleeping.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import time
 from collections import deque
-from typing import List, Optional
+from typing import Callable, List, Optional
 
 from tpu_parallel.serving.request import EXPIRED, QUEUED, RequestOutput
 
@@ -39,16 +46,22 @@ class FIFOScheduler:
 
     The engine calls ``submit`` at ``add_request`` time, then once per
     tick: ``expire(now)`` to drop timed-out entries, and
-    ``schedule(n_free, now)`` to pop the tick's admissions.
+    ``schedule(n_free, now)`` to pop the tick's admissions.  ``now``
+    defaults to the scheduler's own ``clock`` when omitted.
     """
 
-    def __init__(self, config: Optional[SchedulerConfig] = None):
+    def __init__(
+        self,
+        config: Optional[SchedulerConfig] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
         self.config = config or SchedulerConfig()
         if self.config.max_prefills_per_tick < 1:
             raise ValueError(
                 f"max_prefills_per_tick="
                 f"{self.config.max_prefills_per_tick} < 1"
             )
+        self.clock = clock
         self._queue: deque = deque()
 
     @property
@@ -64,10 +77,12 @@ class FIFOScheduler:
         self._queue.append(out)
         return True
 
-    def expire(self, now: float) -> List[RequestOutput]:
+    def expire(self, now: Optional[float] = None) -> List[RequestOutput]:
         """Drop queued entries older than ``max_wait``; returns them."""
         if self.config.max_wait is None:
             return []
+        if now is None:
+            now = self.clock()
         expired = []
         kept = deque()
         for out in self._queue:
@@ -81,12 +96,40 @@ class FIFOScheduler:
         self._queue = kept
         return expired
 
-    def schedule(self, n_free: int, now: float) -> List[RequestOutput]:
-        """Pop up to ``min(n_free, max_prefills_per_tick)`` admissions."""
+    def schedule(
+        self,
+        n_free: int,
+        now: Optional[float] = None,
+        bucket_key: Optional[Callable[[RequestOutput], object]] = None,
+    ) -> List[RequestOutput]:
+        """Pop up to ``min(n_free, max_prefills_per_tick)`` admissions.
+
+        ``bucket_key`` (the engine's bucketed-prefill grouping) constrains
+        the tick's admissions to ONE batchable group: the FIFO head always
+        admits, and the rest of the budget fills with later queued entries
+        sharing the head's key — those jump ahead of earlier entries in
+        OTHER buckets (bounded unfairness: a request can be overtaken only
+        while the head of the queue, which admits this tick regardless,
+        shares a bucket with someone behind it).  The engine runs the
+        returned set as one padded batched prefill call.
+        """
         del now  # FIFO ignores it; priority policies would not
         n = min(n_free, self.config.max_prefills_per_tick)
-        admitted = []
-        while n > 0 and self._queue:
-            admitted.append(self._queue.popleft())
-            n -= 1
+        if n <= 0 or not self._queue:
+            return []
+        if bucket_key is None:
+            admitted = []
+            while n > 0 and self._queue:
+                admitted.append(self._queue.popleft())
+                n -= 1
+            return admitted
+        head = self._queue.popleft()
+        admitted, key = [head], bucket_key(head)
+        kept = deque()
+        for out in self._queue:
+            if len(admitted) < n and bucket_key(out) == key:
+                admitted.append(out)
+            else:
+                kept.append(out)
+        self._queue = kept
         return admitted
