@@ -2,16 +2,33 @@
 
 Prints one JSON line per (batch, new_tokens) point.  Not part of the driver
 contract — perf evidence for the generation path (prefill + lax.scan decode,
-last-position lm_head, int8-cache variant).
+last-position lm_head, int8-cache variant, speculative draft-verify).
 
-Usage: python scripts/decode_bench.py [batch,prompt,new[,kv_cache_dtype]] ...
-Defaults exercise batch 8/32 at prompt 512, 128 new tokens, bf16 + int8 cache.
+Usage:
+  python scripts/decode_bench.py [--reps N] [--warmup N]
+      [batch,prompt,new[,kv_cache_dtype]] ...
+  python scripts/decode_bench.py --spec [--draft-k K1,K2,...] [combos ...]
+  python scripts/decode_bench.py beam [batch prompt new num_beams]
 
-Beam mode: python scripts/decode_bench.py beam [batch prompt new num_beams]
-— times lazy vs eager beam search against the aligned-greedy floor at the
-same effective rows (defaults 2 x 512 + 128, 4 beams).
+Defaults exercise batch 8/32 at prompt 512, 128 new tokens, bf16 + int8
+cache.  ``--reps``/``--warmup`` control the timing loop (previously
+hard-coded at 3 reps / 1 warmup call).
+
+``--spec`` measures speculative decoding (``serving/spec_decode.py``) on a
+REPETITIVE prompt (a short pattern tiled to the prompt length — the
+workload shape prompt-lookup drafting wins on): for each combo it times
+the engine-style per-token host loop (``draft_tokens=0`` — the honest
+non-spec baseline: the serving engine dispatches per tick and cannot use
+``generate()``'s fused scan), then the draft-verify loop across the
+``--draft-k`` sweep, asserting greedy token parity between every pair and
+reporting acceptance rate, tokens/tick, and the speedup.  The fused-scan
+``generate()`` time rides along for reference.
+
+Beam mode: times lazy vs eager beam search against the aligned-greedy
+floor at the same effective rows (defaults 2 x 512 + 128, 4 beams).
 """
 
+import argparse
 import json
 import os
 import sys
@@ -23,9 +40,8 @@ import jax
 import jax.numpy as jnp
 
 
-def run_one(batch, prompt_len, new_tokens, kv_dtype="bf16"):
+def _build(kv_dtype="bf16"):
     from tpu_parallel.models import GPTLM, gpt2_125m, tiny_test
-    from tpu_parallel.models.generate import generate
 
     on_tpu = jax.default_backend() == "tpu"
     cfg = (
@@ -36,7 +52,13 @@ def run_one(batch, prompt_len, new_tokens, kv_dtype="bf16"):
         if on_tpu
         else tiny_test(kv_cache_dtype=kv_dtype)
     )
-    model = GPTLM(cfg)
+    return GPTLM(cfg), cfg, on_tpu
+
+
+def run_one(batch, prompt_len, new_tokens, kv_dtype="bf16", reps=3, warmup=1):
+    from tpu_parallel.models.generate import generate
+
+    model, cfg, on_tpu = _build(kv_dtype)
     # clamp BOTH knobs to the model's window (the CPU tiny model has
     # seq_len 32, far below the TPU defaults)
     new_tokens = min(new_tokens, cfg.seq_len // 2)
@@ -48,11 +70,12 @@ def run_one(batch, prompt_len, new_tokens, kv_dtype="bf16"):
         "params"
     ]
 
-    def timed(n_new, reps=3):
-        # warmup (compile), then time; finish with a device->host read —
-        # block_until_ready can lie on some transports (the same pitfall
-        # scripts/attn_microbench.py documents)
-        out = generate(model, params, prompt, max_new_tokens=n_new)
+    def timed(n_new):
+        # warmup (compile + extra reps), then time; finish with a
+        # device->host read — block_until_ready can lie on some transports
+        # (the same pitfall scripts/attn_microbench.py documents)
+        for _ in range(max(warmup, 1)):
+            out = generate(model, params, prompt, max_new_tokens=n_new)
         jax.device_get(out[0, -1])
         t0 = time.perf_counter()
         for _ in range(reps):
@@ -77,19 +100,120 @@ def run_one(batch, prompt_len, new_tokens, kv_dtype="bf16"):
     )
 
 
+def run_spec(batch, prompt_len, new_tokens, kv_dtype="bf16", ks=(2, 4, 8),
+             reps=3, warmup=1):
+    """Speculative vs per-token host-loop decode on a repetitive prompt;
+    one JSON line per point.  Parity-asserted: every variant must produce
+    the same greedy tokens."""
+    import numpy as np
+
+    from tpu_parallel.models.generate import generate
+    from tpu_parallel.serving.spec_decode import generate_speculative
+
+    from tpu_parallel.models import GPTLM, tiny_test
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        model, cfg, _ = _build(kv_dtype)
+    else:
+        # CPU stand-in tuned for the workload under test: a longer window
+        # than the 32-token test default (cycles need decode length to
+        # form and amortize) and the RoPE/RMSNorm variant, whose untrained
+        # greedy continuations actually lock onto the prompt's repetition
+        # (the learned-positions tiny model wanders chaotically — ~0.35
+        # acceptance vs ~0.8 here — which starves any drafter)
+        cfg = tiny_test(
+            seq_len=256, positional="rope", norm="rmsnorm",
+            kv_cache_dtype=kv_dtype,
+        )
+        model = GPTLM(cfg)
+    new_tokens = min(new_tokens, cfg.seq_len // 2)
+    prompt_len = max(1, min(prompt_len, cfg.seq_len - new_tokens))
+    # repetitive prompt: a short random pattern tiled to length — the
+    # prompt-lookup drafter's home turf (greedy continuations of a cycle)
+    period = 16 if on_tpu else 4
+    pattern = jax.random.randint(
+        jax.random.PRNGKey(0), (batch, period), 0, cfg.vocab_size
+    )
+    prompt = jnp.tile(pattern, (1, prompt_len // period + 1))[:, :prompt_len]
+    params = model.init({"params": jax.random.PRNGKey(1)}, prompt, train=False)[
+        "params"
+    ]
+
+    def timed(fn):
+        for _ in range(max(warmup, 1)):
+            out = fn()
+        jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[-1])
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn()
+        jax.device_get(jax.tree_util.tree_leaves(out)[0].ravel()[-1])
+        return (time.perf_counter() - t0) / reps, out
+
+    # fused-scan generate: the static-batch reference (no host dispatch
+    # at all — the engine can't use it, but it bounds what decode costs)
+    dt_scan, ref = timed(
+        lambda: generate(model, params, prompt, max_new_tokens=new_tokens)
+    )
+    ref = np.asarray(ref)
+
+    def spec(k):
+        return generate_speculative(
+            model, params, prompt, max_new_tokens=new_tokens, draft_tokens=k,
+        )
+
+    # prefill share of the host loop (prefill + first sample, zero ticks)
+    dt_pre, _ = timed(
+        lambda: generate_speculative(
+            model, params, prompt, max_new_tokens=1, draft_tokens=0,
+        )
+    )
+    dt_step, base = timed(lambda: spec(0))
+    assert np.array_equal(np.asarray(base), ref), "stepwise != scan tokens"
+    base_decode = max(dt_step - dt_pre, 1e-9)
+    record = dict(
+        bench="spec_decode",
+        batch=batch,
+        prompt=prompt_len,
+        new_tokens=new_tokens,
+        kv_cache=kv_dtype,
+        model="gpt2_125m" if on_tpu else "tiny_rope_256",
+        pattern_period=period,
+        scan_decode_tokens_per_sec=round(
+            batch * (new_tokens - 1) / max(dt_scan - dt_pre, 1e-9), 1
+        ),
+        stepwise_decode_tokens_per_sec=round(
+            batch * (new_tokens - 1) / base_decode, 1
+        ),
+    )
+    for k in ks:
+        # stats come from the FIRST (untimed, compiling) call — the loop
+        # is deterministic, so re-running purely for stats would double
+        # the sweep's wall-clock for nothing
+        toks, stats = generate_speculative(
+            model, params, prompt, max_new_tokens=new_tokens, draft_tokens=k,
+            return_stats=True,
+        )
+        assert np.array_equal(np.asarray(toks), ref), f"spec K={k} mismatch"
+        dt_k, _ = timed(lambda k=k: spec(k))
+        k_decode = max(dt_k - dt_pre, 1e-9)
+        record[f"spec_k{k}_decode_tokens_per_sec"] = round(
+            batch * (new_tokens - 1) / k_decode, 1
+        )
+        record[f"spec_k{k}_speedup_vs_stepwise"] = round(
+            base_decode / k_decode, 3
+        )
+        record[f"spec_k{k}_acceptance_rate"] = stats["acceptance_rate"]
+        record[f"spec_k{k}_tokens_per_tick"] = stats["tokens_per_tick"]
+    return record
+
+
 def run_beam(batch=2, prompt_len=512, new_tokens=128, num_beams=4):
     """Lazy vs eager beam search vs the aligned-greedy floor at the same
     effective rows (batch * num_beams) — one JSON line per variant."""
-    from tpu_parallel.models import GPTLM, gpt2_125m, tiny_test
     from tpu_parallel.models.generate import generate, generate_beam
 
-    on_tpu = jax.default_backend() == "tpu"
-    cfg = (
-        gpt2_125m(dropout_rate=0.0, remat=False, scan_layers=True)
-        if on_tpu
-        else tiny_test()
-    )
-    model = GPTLM(cfg)
+    model, cfg, on_tpu = _build()
     new_tokens = min(new_tokens, cfg.seq_len // 2)
     prompt_len = max(1, min(prompt_len, cfg.seq_len - new_tokens))
     prompt = jax.random.randint(
@@ -134,22 +258,46 @@ def main():
     if len(sys.argv) > 1 and sys.argv[1] == "beam":
         run_beam(*(int(a) for a in sys.argv[2:]))
         return
+    ap = argparse.ArgumentParser()
+    ap.add_argument("combos", nargs="*",
+                    help="batch,prompt,new[,kv_cache_dtype] points")
+    ap.add_argument("--reps", type=int, default=3,
+                    help="timed repetitions per point")
+    ap.add_argument("--warmup", type=int, default=1,
+                    help="untimed warmup calls per point (>=1: the first "
+                         "call compiles)")
+    ap.add_argument("--spec", action="store_true",
+                    help="speculative-decode sweep on repetitive prompts")
+    ap.add_argument("--draft-k", type=str, default="2,4,8",
+                    help="draft lengths the --spec sweep measures")
+    args = ap.parse_args()
+
     combos = []
-    for arg in sys.argv[1:]:
+    for arg in args.combos:
         parts = arg.split(",")
         combos.append(
             (int(parts[0]), int(parts[1]), int(parts[2]),
              parts[3] if len(parts) > 3 else "bf16")
         )
     if not combos:
-        combos = [
-            (8, 512, 128, "bf16"),
-            (32, 512, 128, "bf16"),
-            (32, 512, 128, "int8"),
-        ]
+        combos = (
+            [(8, 512, 128, "bf16")]
+            if args.spec
+            else [
+                (8, 512, 128, "bf16"),
+                (32, 512, 128, "bf16"),
+                (32, 512, 128, "int8"),
+            ]
+        )
+    ks = tuple(int(k) for k in args.draft_k.split(","))
     for combo in combos:
         try:
-            print(json.dumps(run_one(*combo)), flush=True)
+            if args.spec:
+                record = run_spec(*combo, ks=ks, reps=args.reps,
+                                  warmup=args.warmup)
+            else:
+                record = run_one(*combo, reps=args.reps, warmup=args.warmup)
+            print(json.dumps(record), flush=True)
         except Exception as e:  # OOM etc — report and continue
             print(
                 json.dumps(dict(combo=list(combo), error=repr(e)[:200])),

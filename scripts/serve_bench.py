@@ -12,7 +12,7 @@ Usage:
   python scripts/serve_bench.py [--requests N] [--rate R[,R2,...]]
       [--slots S] [--new T] [--prompt-min P] [--prompt-max P]
       [--prompt-dist] [--prefix-len P] [--buckets auto|off|B1,B2,...]
-      [--chunk C] [--prefix-cache N] [--compare] [--smoke]
+      [--chunk C] [--prefix-cache N] [--spec K] [--compare] [--smoke]
       [--seed K] [--out FILE]
 
 Defaults exercise 32 requests at rates 8 and 0 (0 = all-at-once) on the
@@ -27,9 +27,13 @@ emits each point twice: the legacy exact batch-1 prefill engine
 ("bucketed"), so a single file records the improvement.
 
 ``--smoke`` runs a small greedy parity gate first — every fast-path mode
-(bucketed, chunked, prefix-reuse) must produce token-identical output to
-static ``generate()`` — and exits nonzero on any mismatch, so bench
-numbers can never come from a silently-wrong fast path.
+(bucketed, chunked, prefix-reuse, and the SPECULATIVE engine with both
+the n-gram drafter and an adversarial all-wrong drafter) must produce
+token-identical output to static ``generate()`` — and exits nonzero on
+any mismatch, so bench numbers can never come from a silently-wrong fast
+path.  ``--spec K`` turns speculative decoding on for the measured
+points; the record then reports ``spec_acceptance_rate`` and
+``tokens_per_decode_tick`` from the engine metrics.
 
 Records append to ``--out`` (default serve_bench.jsonl next to this
 script's cwd) via the shared MetricLogger JSONL sink.
@@ -144,6 +148,10 @@ def run_point(model, params, cfg, prompts, *, rate, n_slots, new_tokens,
         "prefix_cache_size": (
             eng._prefix.max_entries if eng._prefix is not None else 0
         ),
+        # speculative decode config (0 = off); acceptance rate, wasted
+        # verify positions, and tokens_per_decode_tick ride in via the
+        # metrics summary below
+        "draft_tokens": eng._spec_width,
         # distinct prefill/extend call shapes == jit compiles of the
         # prefill path (exact mode: one per distinct length; bucketed:
         # bounded by the bucket set)
@@ -156,10 +164,33 @@ def run_point(model, params, cfg, prompts, *, rate, n_slots, new_tokens,
     }
 
 
+class _GarbageDrafter:
+    """Adversarial smoke drafter: drafts one more than the true greedy
+    next token (it knows the references), so every draft is wrong and the
+    spec engine must survive on pure rejection.  (Scripts stay
+    self-contained: this mirrors ``tests/_spec_drafters.AntiOracleDrafter``
+    rather than importing from the test tree.)"""
+
+    def __init__(self, refs_by_prompt, vocab):
+        self.refs = refs_by_prompt
+        self.vocab = vocab
+
+    def draft(self, context, k):
+        for prompt, ref in self.refs.items():
+            if tuple(context[: len(prompt)]) == prompt:
+                idx = len(context) - len(prompt)
+                truth = int(ref[idx]) if idx < len(ref) else 0
+                return [(truth + 1) % self.vocab] * k
+        return [0] * k
+
+
 def smoke(model, params, cfg, prompts, new_tokens):
-    """Greedy parity gate: every fast-path mode must match static
-    generate() token-for-token on every prompt.  Returns the number of
-    mismatched (mode, request) pairs."""
+    """Greedy parity gate: every fast-path mode — including the
+    SPECULATIVE engine, with both the real n-gram drafter and an
+    adversarial all-wrong drafter — must match static generate()
+    token-for-token on every prompt (the non-spec engine modes are pinned
+    against the same references, so spec-vs-nonspec parity is implied).
+    Returns the number of mismatched (mode, request) pairs."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -175,12 +206,20 @@ def smoke(model, params, cfg, prompts, new_tokens):
         )[0]
         for p in prompts
     ]
+    refs_by_prompt = {
+        tuple(p): [int(t) for t in ref] for p, ref in zip(prompts, refs)
+    }
     shortest = min(len(p) for p in prompts)
     modes = {
         "exact": dict(prefill_buckets=None),
         "bucketed": {},
         "chunked": dict(prefill_chunk_tokens=max(2, shortest // 2)),
         "prefix": dict(prefix_cache_size=4),
+        "spec": dict(draft_tokens=3),
+        "spec_adversarial": dict(
+            draft_tokens=3,
+            drafter=_GarbageDrafter(refs_by_prompt, cfg.vocab_size),
+        ),
     }
     failures = 0
     for name, kwargs in modes.items():
@@ -228,6 +267,10 @@ def main():
                     help="prefill chunk budget (0 = off)")
     ap.add_argument("--prefix-cache", type=int, default=0,
                     help="prefix-cache LRU entries (0 = off)")
+    ap.add_argument("--spec", type=int, default=0,
+                    help="speculative decode draft tokens (0 = off); the "
+                         "record then carries acceptance rate and "
+                         "tokens_per_decode_tick")
     ap.add_argument("--compare", action="store_true",
                     help="emit every point twice: exact (SERVE_r01 "
                          "config) vs the requested fast path")
@@ -298,6 +341,9 @@ def main():
         fast["prefill_chunk_tokens"] = args.chunk
     if args.prefix_cache > 0:
         fast["prefix_cache_size"] = args.prefix_cache
+    if args.spec > 0:
+        fast["draft_tokens"] = args.spec
+        fast_label += "+spec"
 
     configs = [(fast_label, fast)]
     if args.compare and fast_label != "exact":

@@ -1,0 +1,49 @@
+"""Shared reference-lookup drafters for the speculative-decoding suites
+(ONE definition for tests/test_spec_decode.py and tests/test_serving.py —
+a fix to the prefix-match or index logic must not silently miss a copy).
+
+Both drafters key the request off its prompt prefix in a
+``{prompt tuple -> greedy continuation}`` map built from static
+``generate()`` references (:func:`ref_map`).
+"""
+
+
+def ref_map(prompts, refs):
+    return {
+        tuple(int(t) for t in p): [int(t) for t in ref]
+        for p, ref in zip(prompts, refs)
+    }
+
+
+class AntiOracleDrafter:
+    """Adversarial: knowing each context's true greedy next token, always
+    drafts something ELSE — guaranteed 0 acceptance, and the output must
+    STILL be exact (the no-rollback story under pure rejection)."""
+
+    def __init__(self, refs, vocab):
+        self.refs = refs
+        self.vocab = vocab
+
+    def draft(self, context, k):
+        for prompt, ref in self.refs.items():
+            if tuple(context[: len(prompt)]) == prompt:
+                idx = len(context) - len(prompt)
+                truth = ref[idx] if idx < len(ref) else 0
+                return [(int(truth) + 1) % self.vocab] * k
+        return [0] * k
+
+
+class OracleDrafter:
+    """Drafts the true greedy continuation — maximal acceptance, used to
+    pin multi-token progress and EOS-mid-block behavior
+    deterministically."""
+
+    def __init__(self, refs):
+        self.refs = refs
+
+    def draft(self, context, k):
+        for prompt, ref in self.refs.items():
+            if tuple(context[: len(prompt)]) == prompt:
+                idx = len(context) - len(prompt)
+                return [int(t) for t in ref[idx: idx + k]]
+        return []

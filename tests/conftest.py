@@ -66,6 +66,7 @@ _SLOW_FILES = {
     "test_train_lib.py",
     "test_generate.py",
     "test_serving.py",
+    "test_spec_decode.py",
 }
 _SLOW_TESTS = {
     "test_pp_aux_gradient_invariance",

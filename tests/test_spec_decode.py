@@ -1,0 +1,256 @@
+"""Speculative decoding units: the n-gram drafter, the greedy and
+Leviathan-rejection acceptance rules (tier-1 — the exactness argument
+lives here), the adaptive draft length, and standalone
+``generate_speculative`` parity with ``generate()``."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from _spec_drafters import AntiOracleDrafter, OracleDrafter, ref_map
+
+from tpu_parallel.models import GPTLM, tiny_test
+from tpu_parallel.models.generate import generate
+from tpu_parallel.serving.spec_decode import (
+    NGramDrafter,
+    adapt_draft_len,
+    generate_speculative,
+    greedy_verify,
+    rejection_verify,
+    verify_tokens,
+)
+
+
+# -- drafter ----------------------------------------------------------------
+
+
+def test_ngram_drafter_cycle():
+    """A cyclic context drafts its own continuation, deterministically."""
+    d = NGramDrafter(max_ngram=3, min_ngram=1)
+    ctx = [1, 2, 3, 1, 2, 3, 1, 2]
+    assert d.draft(ctx, 3) == [3, 1, 2]
+    assert d.draft(ctx, 1) == [3]
+    assert d.draft(ctx, 3) == d.draft(ctx, 3)  # deterministic
+
+
+def test_ngram_drafter_no_match_and_bounds():
+    d = NGramDrafter(max_ngram=3, min_ngram=2)
+    assert d.draft([1, 2, 3, 4, 5], 4) == []  # no repeated 2-gram
+    assert d.draft([7], 4) == []  # too short to match anything
+    assert d.draft([1, 2, 1, 2], 0) == []  # k=0 asks for nothing
+    # most RECENT earlier occurrence wins: [5, 9, 5, 8, 5] suffix [5]
+    # matches at index 2 (-> 8), not index 0 (-> 9)
+    assert NGramDrafter(max_ngram=1).draft([5, 9, 5, 8, 5], 1) == [8]
+    with pytest.raises(ValueError):
+        NGramDrafter(max_ngram=2, min_ngram=3)
+
+
+def test_ngram_drafter_prefers_longer_match():
+    """The longest matching suffix n-gram disambiguates: after [1,2] the
+    1-gram [2] alone would copy the most recent 2's continuation (9), but
+    the 2-gram [1,2] occurred earlier with continuation 7."""
+    d = NGramDrafter(max_ngram=2, min_ngram=1)
+    assert d.draft([1, 2, 7, 4, 2, 9, 1, 2], 1) == [7]
+
+
+# -- acceptance rules -------------------------------------------------------
+
+
+def test_greedy_verify_longest_prefix_plus_bonus():
+    drafts = jnp.asarray([[5, 6, 7], [5, 6, 7], [1, 1, 1]])
+    dlen = jnp.asarray([3, 3, 2])
+    # targets[i] = argmax following offset i; row 0 matches twice then
+    # diverges, row 1 matches fully, row 2 mismatches immediately
+    targets = jnp.asarray(
+        [[5, 6, 9, 4], [5, 6, 7, 8], [2, 3, 4, 5]]
+    )
+    out, acc = greedy_verify(drafts, dlen, targets)
+    np.testing.assert_array_equal(np.asarray(acc), [2, 3, 0])
+    out = np.asarray(out)
+    # emitted tokens = accepted drafts + the bonus at the cut
+    assert list(out[0][:3]) == [5, 6, 9]
+    assert list(out[1][:4]) == [5, 6, 7, 8]  # full accept: bonus = t[3]
+    assert out[2][0] == 2  # immediate mismatch: bonus only
+
+
+def test_greedy_verify_draft_len_caps_acceptance():
+    """Pad drafts beyond draft_len can NEVER be accepted, even when they
+    happen to equal the target (the garbage-pad safety property)."""
+    drafts = jnp.asarray([[5, 6, 7]])
+    targets = jnp.asarray([[5, 6, 7, 8]])
+    out, acc = greedy_verify(drafts, jnp.asarray([1]), targets)
+    assert int(acc[0]) == 1
+    assert list(np.asarray(out)[0][:2]) == [5, 6]  # d1 + bonus t[1]
+
+
+def test_rejection_verify_pointmass_always_accepts():
+    """When the target distribution IS the draft (p(d)=1), the Leviathan
+    rule accepts every draft and the bonus draws from the next
+    distribution — fully deterministic here."""
+    vocab = 8
+    drafts = jnp.asarray([[3, 5]])
+    dlen = jnp.asarray([2])
+    probs = jnp.stack(
+        [jax.nn.one_hot(jnp.asarray([3, 5, 6]), vocab)]
+    )  # [1, K+1, vocab]
+    out, acc = rejection_verify(drafts, dlen, probs, jax.random.PRNGKey(0))
+    assert int(acc[0]) == 2
+    assert list(np.asarray(out)[0]) == [3, 5, 6]
+
+
+def test_rejection_verify_zero_prob_always_rejects():
+    """p(d)=0 rejects immediately and the bonus resamples from the
+    residual — which can never be the rejected draft."""
+    vocab = 8
+    drafts = jnp.asarray([[3]])
+    dlen = jnp.asarray([1])
+    p = jnp.full((1, 2, vocab), 1.0 / vocab)
+    p = p.at[0, 0, 3].set(0.0)
+    p = p / p.sum(-1, keepdims=True)
+    for seed in range(5):
+        out, acc = rejection_verify(
+            drafts, dlen, p, jax.random.PRNGKey(seed)
+        )
+        assert int(acc[0]) == 0
+        assert int(np.asarray(out)[0, 0]) != 3
+
+
+def test_rejection_verify_first_token_distribution():
+    """THE exactness property: the marginal of the first emitted token
+    equals the target distribution p, regardless of what was drafted
+    (accept with prob p(d); on rejection, resample from the residual).
+    Pinned statistically over many parallel rows."""
+    n, vocab = 4000, 4
+    p_row = jnp.asarray([0.1, 0.2, 0.3, 0.4])
+    drafts = jnp.full((n, 1), 3, jnp.int32)  # always draft the 0.4 token
+    dlen = jnp.ones((n,), jnp.int32)
+    probs = jnp.broadcast_to(p_row, (n, 2, vocab))
+    out, _ = rejection_verify(drafts, dlen, probs, jax.random.PRNGKey(7))
+    first = np.asarray(out)[:, 0]
+    emp = np.bincount(first, minlength=vocab) / n
+    np.testing.assert_allclose(emp, np.asarray(p_row), atol=0.03)
+
+
+def test_verify_tokens_mixed_greedy_and_sampled():
+    """Per-row dispatch: a greedy row takes the argmax chain (bitwise),
+    a sampled row the rejection rule, in one call."""
+    vocab = 6
+    logits = jnp.log(
+        jnp.stack(
+            [
+                jnp.stack([jax.nn.one_hot(jnp.asarray(t), vocab) + 1e-6
+                           for t in [2, 4, 1]]),
+                jnp.stack([jax.nn.one_hot(jnp.asarray(t), vocab) + 1e-6
+                           for t in [2, 4, 1]]),
+            ]
+        )
+    )  # both rows: targets [2, 4, 1], near-deterministic
+    drafts = jnp.asarray([[2, 4], [2, 9]])
+    dlen = jnp.asarray([2, 2])
+    out, acc = verify_tokens(
+        drafts, dlen, logits, jax.random.PRNGKey(0),
+        jnp.asarray([0.0, 0.5]),  # row 0 greedy, row 1 sampled
+        jnp.zeros(2, jnp.int32), jnp.zeros(2, jnp.float32),
+    )
+    out, acc = np.asarray(out), np.asarray(acc)
+    assert int(acc[0]) == 2 and list(out[0]) == [2, 4, 1]
+    # sampled row: first draft (prob ~1) accepted, second (prob ~0)
+    # rejected, bonus resampled from the near-point-mass on 4
+    assert int(acc[1]) == 1 and list(out[1][:2]) == [2, 4]
+
+
+def test_adapt_draft_len_rule():
+    assert adapt_draft_len(4, drafted=4, accepted=4, k_max=8) == 5  # grow
+    assert adapt_draft_len(8, drafted=8, accepted=8, k_max=8) == 8  # capped
+    assert adapt_draft_len(8, drafted=8, accepted=2, k_max=8) == 3  # shrink
+    assert adapt_draft_len(4, drafted=4, accepted=0, k_max=8) == 1  # floor
+    assert adapt_draft_len(3, drafted=0, accepted=0, k_max=8) == 3  # no info
+
+
+# -- standalone speculative generation --------------------------------------
+
+
+def _build(rng, n_rows=2, prompt_len=5, **overrides):
+    cfg = tiny_test(dtype=jnp.float32, remat=False, **overrides)
+    model = GPTLM(cfg)
+    prompt = jax.random.randint(rng, (n_rows, prompt_len), 1, cfg.vocab_size)
+    params = model.init(
+        {"params": jax.random.PRNGKey(1)}, prompt, train=False
+    )["params"]
+    return cfg, model, prompt, params
+
+
+def _refs(prompt, want):
+    rows = [np.asarray(prompt[i]) for i in range(prompt.shape[0])]
+    return ref_map(rows, want)
+
+
+@pytest.mark.parametrize("draft_tokens", [0, 3])
+def test_generate_speculative_greedy_parity(rng, draft_tokens):
+    """Acceptance: the draft-verify loop is token-identical to the fused
+    ``generate()`` scan for greedy decoding — including ``draft_tokens=0``
+    (the degenerate per-token host loop) and the n-gram drafter."""
+    cfg, model, prompt, params = _build(rng, n_rows=3)
+    want = np.asarray(generate(model, params, prompt, max_new_tokens=10))
+    got = generate_speculative(
+        model, params, prompt, max_new_tokens=10, draft_tokens=draft_tokens,
+    )
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_generate_speculative_adversarial_drafter_exact(rng):
+    """A drafter that deliberately drafts wrong tokens costs only wasted
+    verify positions: zero acceptance, exact output."""
+    cfg, model, prompt, params = _build(rng, n_rows=2)
+    want = np.asarray(generate(model, params, prompt, max_new_tokens=8))
+    drafter = AntiOracleDrafter(_refs(prompt, want), cfg.vocab_size)
+    got, stats = generate_speculative(
+        model, params, prompt, max_new_tokens=8, draft_tokens=3,
+        drafter=drafter, return_stats=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got), want)
+    assert stats["drafted"] > 0 and stats["accepted"] == 0
+    assert stats["acceptance_rate"] == 0.0
+
+
+def test_generate_speculative_oracle_multi_token_ticks(rng):
+    """With a perfect drafter the loop provably emits multiple tokens per
+    verify tick: far fewer ticks than tokens, exact output — the
+    deterministic (non-timing) form of the spec-decode win."""
+    cfg, model, prompt, params = _build(rng, n_rows=2)
+    n_new = 12
+    want = np.asarray(generate(model, params, prompt, max_new_tokens=n_new))
+    got, stats = generate_speculative(
+        model, params, prompt, max_new_tokens=n_new, draft_tokens=4,
+        drafter=OracleDrafter(_refs(prompt, want)),
+        return_stats=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got), want)
+    # 11 post-first tokens per row in <= ceil(11/5)+1 ticks of width 4+1
+    assert stats["ticks"] <= 4
+    assert stats["acceptance_rate"] == 1.0
+    assert stats["tokens_per_tick"] > 2.0
+
+
+def test_generate_speculative_int8_cache_parity(rng):
+    """Verify writes quantize per (position, kv-head) exactly like
+    single-token decode — int8-cache speculative output matches the int8
+    static reference."""
+    cfg, model, prompt, params = _build(rng, n_rows=2, kv_cache_dtype="int8")
+    want = np.asarray(generate(model, params, prompt, max_new_tokens=8))
+    got = generate_speculative(
+        model, params, prompt, max_new_tokens=8, draft_tokens=3,
+    )
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_generate_speculative_rejects_overlong(rng):
+    cfg, model, prompt, params = _build(rng)
+    with pytest.raises(ValueError, match="seq_len"):
+        generate_speculative(
+            model, params, prompt, max_new_tokens=cfg.seq_len,
+        )
+    with pytest.raises(ValueError, match="draft_tokens"):
+        generate_speculative(
+            model, params, prompt, max_new_tokens=4, draft_tokens=-1,
+        )

@@ -217,6 +217,43 @@ def prefill_extend_step(model: GPTLM, params, cache, tokens: jax.Array,
     return hidden, updated["cache"]
 
 
+def verify_step(model: GPTLM, params, cache, tokens: jax.Array,
+                positions: jax.Array, write_index: jax.Array):
+    """Score T tokens per row in ONE forward — the speculative-decoding
+    verify core.  ``tokens`` [b, T] is each row's current token followed by
+    its draft tokens, at global ``positions`` [b, T] (pads -1); K/V land at
+    cache slots ``write_index + [0..T)`` per row (the same multi-token
+    ``write_index`` scatter chunked prefill uses).
+
+    Exactness: each token's attention reads the post-write cache and masks
+    by STORED positions, so position ``p + i`` attends the prefix plus the
+    drafts before it — token-for-token identical to ``i`` sequential
+    :func:`decode_step` calls (the chunked-prefill argument: scores depend
+    only on stored positions, and every op is row/position-parallel).  The
+    returned ``hidden`` [b, T, d_model] therefore yields EXACT next-token
+    distributions at every draft offset in one pass.
+
+    Rejected drafts need NO cache rollback: their K/V sit at columns
+    beyond the accepted frontier, and in the engine's aligned layout
+    (column == stored position, :meth:`CachePool.assert_slot_aligned`)
+    every stale column holds a position strictly greater than any query
+    position that can occur before the column is overwritten — the mask
+    ``kp <= qp`` keeps them invisible.  Pad offsets (positions -1) write
+    -1 into the position table, invalidating their columns outright.
+    """
+    hidden, updated = model.apply(
+        {"params": params, "cache": cache},
+        tokens,
+        positions=positions,
+        train=False,
+        decode=True,
+        hidden_only=True,
+        mutable=["cache"],
+        write_index=write_index,
+    )
+    return hidden, updated["cache"]
+
+
 def _generate_core(
     model: GPTLM,
     params,

@@ -1,6 +1,7 @@
 """Continuous-batching serving: iteration-level scheduling over a slot
-pool of KV caches, with a bucketed/batched/chunked prefill fast path and
-prefix reuse (docs/10_serving_engine.md)."""
+pool of KV caches, with a bucketed/batched/chunked prefill fast path,
+prefix reuse, and exact speculative (draft-verify) decoding
+(docs/10_serving_engine.md)."""
 
 from tpu_parallel.serving.cache_pool import (
     CachePool,
@@ -29,6 +30,15 @@ from tpu_parallel.serving.request import (
     StreamEvent,
 )
 from tpu_parallel.serving.scheduler import FIFOScheduler, SchedulerConfig
+from tpu_parallel.serving.spec_decode import (
+    Drafter,
+    NGramDrafter,
+    adapt_draft_len,
+    generate_speculative,
+    greedy_verify,
+    rejection_verify,
+    verify_tokens,
+)
 
 __all__ = [
     "CachePool",
@@ -54,4 +64,11 @@ __all__ = [
     "EXPIRED",
     "FIFOScheduler",
     "SchedulerConfig",
+    "Drafter",
+    "NGramDrafter",
+    "adapt_draft_len",
+    "generate_speculative",
+    "greedy_verify",
+    "rejection_verify",
+    "verify_tokens",
 ]

@@ -332,6 +332,46 @@ class CachePool:
             self.cache, prefix_cache, jnp.int32(slot), jnp.int32(length)
         )
 
+    def assert_slot_aligned(self, slot: int) -> None:
+        """Assert the ALIGNED-layout invariant speculative decoding's
+        no-rollback story rests on: every valid entry of ``slot``'s
+        position table stores exactly its own column index
+        (``pos[col] in {-1, col}``).
+
+        Why this is THE invariant: the engine always writes position p at
+        column p (prefill from 0, decode/verify at ``write_index == pos``),
+        so a REJECTED draft's stale K/V at column c holds position c — and
+        c necessarily exceeds the slot's accepted frontier.  Any later
+        forward writes its tokens (columns L..L+T-1) before its attention
+        read, so surviving stale columns satisfy c >= L+T > every query
+        position and the ``kp <= qp`` mask keeps them invisible; -1
+        entries (pads, cleared rows) never attend at all.  If alignment
+        ever broke — a stale column holding a SMALLER position — stale
+        K/V could silently enter attention, which is why this is an
+        assert, not a repair.  Debug/test aid (one small device->host
+        fetch per call): the engine runs it per verify tick under
+        ``spec_check_invariants=True``.
+        """
+        import numpy as np
+
+        def check(path, leaf):
+            if not _leaf_name(path).startswith("cached_pos"):
+                return leaf
+            ax = beam_cache_batch_axis(path, leaf)
+            row = np.asarray(
+                lax.dynamic_slice_in_dim(leaf, slot, 1, axis=ax)
+            ).reshape(-1, leaf.shape[-1])
+            cols = np.arange(leaf.shape[-1])[None, :]
+            bad = (row != -1) & (row != cols)
+            assert not bad.any(), (
+                f"slot {slot} position table misaligned at "
+                f"(layer, col) {np.argwhere(bad)[:4].tolist()}: stale "
+                f"columns would enter attention (pos != col)"
+            )
+            return leaf
+
+        jax.tree_util.tree_map_with_path(check, self.cache)
+
 
 def default_row_fns():
     """Jitted (scatter, extract, clear, copy_prefix, stack_prefix) with

@@ -9,7 +9,12 @@ lets requests join (prefill into a freed slot) and leave (EOS / length
 retirement) between ticks:
 
 - tick = [chunked-prefill advance] + [admissions] + [one decode step] +
-  [retirements]
+  [retirements].  With ``draft_tokens > 0`` the decode step becomes a
+  SPECULATIVE verify tick (``serving/spec_decode.py``): a host-side
+  drafter proposes up to K tokens per slot, one multi-token forward
+  scores them all, and each slot advances by its accepted prefix + one
+  bonus token — output provably identical to one-token ticks (greedy:
+  bitwise; sampled: in distribution via the Leviathan rejection rule).
 - the decode step threads per-slot positions and per-slot cache write
   indices (``write_index`` — the slot-indexed write path in
   ``models/layers.py``) because rows sit at different depths of their
@@ -91,6 +96,7 @@ from tpu_parallel.models.generate import (
     padded_prefill_inputs,
     prefill_extend_step,
     prefill_step,
+    verify_step,
 )
 from tpu_parallel.serving.cache_pool import (
     CachePool,
@@ -109,6 +115,14 @@ from tpu_parallel.serving.request import (
     StreamEvent,
 )
 from tpu_parallel.serving.scheduler import FIFOScheduler, SchedulerConfig
+from tpu_parallel.serving.spec_decode import (
+    Drafter,
+    NGramDrafter,
+    adapt_draft_len,
+    draft_for_row,
+    filter_logits,
+    verify_tokens,
+)
 
 
 def sample_tokens(
@@ -125,30 +139,16 @@ def sample_tokens(
     combination in the pool.  Same semantics per row — ``temperature == 0``
     is exact argmax; ``top_k``/``top_p`` compose by intersection after the
     temperature scale; ``top_k <= 0`` / ``top_p`` outside (0, 1) disable
-    that filter; the argmax token always survives the nucleus cut.
+    that filter; the argmax token always survives the nucleus cut.  The
+    filter math lives in ``spec_decode.filter_logits`` — the speculative
+    rejection rule needs the SAME target distribution this sampler draws
+    from, or spec-vs-nonspec would silently drift.
     """
     lf = logits.astype(jnp.float32)
     greedy = jnp.argmax(lf, axis=-1).astype(jnp.int32)
-    # guard the temperature divide: greedy rows take the argmax branch of
-    # the final where, so their scaled logits are never read
-    t = jnp.where(temperature > 0.0, temperature, 1.0)[:, None]
-    x = lf / t
-    vocab = x.shape[-1]
-    # per-row top-k with traced k: the kth-largest value via one sort
-    k = jnp.clip(top_k.astype(jnp.int32), 0, vocab)
-    asc = jnp.sort(x, axis=-1)
-    kth = jnp.take_along_axis(
-        asc, jnp.clip(vocab - k, 0, vocab - 1)[:, None], axis=-1
-    )
-    x = jnp.where((k > 0)[:, None] & (x < kth), -jnp.inf, x)
-    # per-row nucleus on the (already top-k-filtered) distribution
-    desc = jnp.sort(x, axis=-1)[:, ::-1]
-    probs = jax.nn.softmax(desc, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    keep = cum - probs < top_p[:, None]  # mass BEFORE the token < p
-    cutoff = jnp.min(jnp.where(keep, desc, jnp.inf), axis=-1, keepdims=True)
-    use_p = ((top_p > 0.0) & (top_p < 1.0))[:, None]
-    x = jnp.where(use_p & (x < cutoff), -jnp.inf, x)
+    # greedy rows take the argmax branch of the final where, so their
+    # filtered (guard-divided) logits are never read
+    x = filter_logits(lf, temperature, top_k, top_p)
     sampled = jax.random.categorical(rng, x, axis=-1).astype(jnp.int32)
     return jnp.where(temperature > 0.0, sampled, greedy)
 
@@ -175,6 +175,21 @@ def _full_last_logits(cfg, params, hidden, last_idx=None):
         hidden = jnp.take_along_axis(hidden, idx, axis=1)
     head = _make_lm_head(cfg, name=None, gather=False, fsdp_wrap=False)
     logits = head.apply({"params": _lm_head_params(cfg, params)}, hidden)[:, 0]
+    if axis_size_or_none(cfg.model_axis) is not None:
+        logits = lax.all_gather(logits, cfg.model_axis, axis=-1, tiled=True)
+    return logits
+
+
+def _full_logits(cfg, params, hidden):
+    """lm_head over EVERY position of [batch, T, d_model] hidden, full
+    vocab width on every rank — the speculative verify needs all T target
+    distributions, not just the last (one [batch, T, vocab] all_gather
+    under TP; T = draft_tokens + 1, batch = n_slots — still tiny)."""
+    from tpu_parallel.models.gpt import _lm_head_params, _make_lm_head
+    from tpu_parallel.parallel.tp import axis_size_or_none
+
+    head = _make_lm_head(cfg, name=None, gather=False, fsdp_wrap=False)
+    logits = head.apply({"params": _lm_head_params(cfg, params)}, hidden)
     if axis_size_or_none(cfg.model_axis) is not None:
         logits = lax.all_gather(logits, cfg.model_axis, axis=-1, tiled=True)
     return logits
@@ -220,6 +235,40 @@ def _decode_core(
     return nxt, cache
 
 
+def _verify_core(
+    model, params, tok, drafts, draft_len, pos, widx, temperature, top_k,
+    top_p, cache, rng,
+):
+    """One SPECULATIVE engine tick over the slot pool: each row feeds its
+    current token plus its (padded) draft block through one multi-token
+    forward (:func:`~tpu_parallel.models.generate.verify_step`), scores
+    every offset, and the per-row acceptance rule
+    (:func:`~tpu_parallel.serving.spec_decode.verify_tokens`) keeps the
+    longest exact prefix + one bonus token.
+
+    Padding discipline: offsets beyond a row's ``draft_len`` carry
+    position -1 — their cache writes land -1 in the position table
+    (column invalidated outright, never attended) and their logits are
+    garbage the acceptance rule cannot reach (``accepted <= draft_len``).
+    Inactive/parked rows (``widx == seq_len``) drop every write out of
+    range exactly as on the plain decode tick.  Returns
+    ``(tokens [n, K+1], accepted [n], new cache)``; the host delivers
+    ``accepted + 1`` tokens per active row.
+    """
+    k = drafts.shape[1]
+    tokens = jnp.concatenate([tok[:, None], drafts], axis=1)
+    offs = jnp.arange(k + 1, dtype=jnp.int32)[None, :]
+    positions = jnp.where(
+        offs <= draft_len[:, None], pos[:, None] + offs, -1
+    )
+    hidden, cache = verify_step(model, params, cache, tokens, positions, widx)
+    logits = _full_logits(model.config, params, hidden)
+    out_tokens, accepted = verify_tokens(
+        drafts, draft_len, logits, rng, temperature, top_k, top_p
+    )
+    return out_tokens, accepted, cache
+
+
 @functools.lru_cache(maxsize=8)
 def _engine_fns(model):
     """Jitted engine step functions for the single-host path, cached per
@@ -248,9 +297,17 @@ def _engine_fns(model):
         ),
         donate_argnums=7,
     )
+    verify = jax.jit(
+        lambda params, tok, drafts, dlen, pos, widx, temp, tk, tp, cache, \
+            rng: _verify_core(
+                model, params, tok, drafts, dlen, pos, widx, temp, tk, tp,
+                cache, rng,
+            ),
+        donate_argnums=9,
+    )
     sample = jax.jit(sample_tokens)
     insert = jax.jit(insert_rows, donate_argnums=0)
-    return prefill, extend, decode, sample, insert, default_row_fns()
+    return prefill, extend, decode, verify, sample, insert, default_row_fns()
 
 
 @functools.lru_cache(maxsize=8)
@@ -277,12 +334,17 @@ def _sharded_engine_fns(model, mesh, specs: _HashableTree,
         (P(), P(), P(), P(), P(), P(), cspecs), (P(), cspecs), _decode_core,
         fold_axes=(),
     )
+    verify = build_sharded_serving(
+        model, mesh, param_specs,
+        (P(), P(), P(), P(), P(), P(), P(), P(), cspecs),
+        (P(), P(), cspecs), _verify_core, fold_axes=(),
+    )
     sample = jax.jit(sample_tokens)
     # the shard_map-wrapped decode cannot donate (build_sharded_serving
     # does not expose donation), so the TP tick holds a transient second
     # pool; the insert and row ops at least recycle their operands
     insert = jax.jit(insert_rows, donate_argnums=0)
-    return prefill, extend, decode, sample, insert, default_row_fns()
+    return prefill, extend, decode, verify, sample, insert, default_row_fns()
 
 
 def default_prefill_buckets(seq_len: int, start: int = 32) -> Tuple[int, ...]:
@@ -335,6 +397,21 @@ class ServingEngine:
     - ``prefix_cache_size``: LRU entries of bucket-aligned prefix K/V
       rows (0 = off; each entry is a full seq_len row of HBM).  Requires
       bucketing.
+
+    Speculative decode knobs (exact for every drafter — see the module
+    docstring and ``docs/10_serving_engine.md``):
+
+    - ``draft_tokens``: max drafts per slot per tick; the verify program
+      compiles ONCE at width ``draft_tokens + 1`` (0 = off, the plain
+      single-token tick).  Per-request override: ``Request.draft_tokens``.
+    - ``drafter``: a :class:`~tpu_parallel.serving.spec_decode.Drafter`
+      (default: model-free prompt-lookup
+      :class:`~tpu_parallel.serving.spec_decode.NGramDrafter`).
+    - ``spec_adaptive``: acceptance-adaptive per-slot draft lengths
+      (grow after full acceptance, shrink to the cut otherwise).
+    - ``spec_check_invariants``: assert the aligned-layout no-rollback
+      invariant (:meth:`CachePool.assert_slot_aligned`) every verify
+      tick — debug aid, one device fetch per slot per tick.
     """
 
     def __init__(
@@ -352,6 +429,10 @@ class ServingEngine:
         prefill_batch: Optional[int] = None,
         prefill_chunk_tokens: Optional[int] = None,
         prefix_cache_size: int = 0,
+        draft_tokens: int = 0,
+        drafter: Optional[Drafter] = None,
+        spec_adaptive: bool = True,
+        spec_check_invariants: bool = False,
     ):
         cfg = model.config
         if getattr(cfg, "pipe_size", 1) > 1:
@@ -419,6 +500,19 @@ class ServingEngine:
         self._chunking: Dict[int, _ChunkState] = {}
         self._prefill_shapes: set = set()
 
+        # speculative decode: draft_tokens > 0 switches the decode tick to
+        # draft-verify blocks of COMPILED width draft_tokens + 1 (per-slot
+        # draft lengths vary underneath via -1-position padding; the
+        # program shape never changes)
+        if draft_tokens < 0:
+            raise ValueError(f"draft_tokens={draft_tokens} < 0")
+        self._spec_width = draft_tokens
+        self._drafter: Drafter = (
+            drafter if drafter is not None else NGramDrafter()
+        )
+        self._spec_adaptive = spec_adaptive
+        self._spec_check = spec_check_invariants
+
         pool_shardings = None
         if mesh is not None:
             import flax.linen as nn
@@ -441,7 +535,7 @@ class ServingEngine:
         else:
             fns = _engine_fns(model)
         (self._prefill_fn, self._extend_fn, self._decode_fn,
-         self._sample_fn, insert, row_fns) = fns
+         self._verify_fn, self._sample_fn, insert, row_fns) = fns
         self.pool = CachePool(
             model, params, n_slots, insert_fn=insert,
             shardings=pool_shardings, row_fns=row_fns,
@@ -459,6 +553,10 @@ class ServingEngine:
         self._topp = np.zeros(n, np.float32)
         self._active = np.zeros(n, bool)
         self._slot_out: List[Optional[RequestOutput]] = [None] * n
+        # per-slot speculative state: the request's draft cap and the
+        # acceptance-adaptive effective draft length (<= cap)
+        self._spec_max = np.zeros(n, np.int32)
+        self._spec_k = np.zeros(n, np.int32)
 
     # -- submission --------------------------------------------------------
 
@@ -842,6 +940,16 @@ class ServingEngine:
         self._temp[slot] = sp.temperature
         self._topk[slot] = sp.top_k
         self._topp[slot] = sp.top_p
+        # per-request speculative cap: None inherits the engine's
+        # draft_tokens; an explicit value clamps to it (the verify
+        # program is compiled at the engine width — a larger request
+        # ask cannot widen it)
+        req_k = out.request.draft_tokens
+        cap = self._spec_width if req_k is None else min(
+            req_k, self._spec_width
+        )
+        self._spec_max[slot] = cap
+        self._spec_k[slot] = cap
         self._active[slot] = True
         self._slot_out[slot] = out
         out.status = RUNNING
@@ -849,6 +957,8 @@ class ServingEngine:
         return self._deliver(slot, tok0)
 
     def _decode_tick(self) -> List[StreamEvent]:
+        if self._spec_width > 0:
+            return self._spec_tick()
         nxt, self.pool.cache = self._decode_fn(
             self.params,
             jnp.asarray(self._tok),
@@ -869,6 +979,88 @@ class ServingEngine:
             self._widx[slot] += 1
             self._tok[slot] = int(nxt[slot])
             events.append(self._deliver(int(slot), int(nxt[slot])))
+        return events
+
+    def _spec_tick(self) -> List[StreamEvent]:
+        """One speculative verify tick: draft per active slot (host-side,
+        capped by the adaptive length, the slot's remaining token budget,
+        and seq_len), verify every slot's block in ONE multi-token
+        forward, deliver each slot's accepted prefix + bonus token.
+
+        Per-slot variable acceptance rides the FIXED compiled width: short
+        drafts pad with -1 positions (columns invalidated, never
+        attended), inactive and mid-chunked-prefill slots park their whole
+        block at column seq_len exactly as on the plain decode tick.  A
+        request whose budget or EOS lands mid-block truncates delivery
+        there — the surplus accepted K/V beyond the finish is dead weight
+        in a slot that is being released anyway.
+        """
+        cfg = self.model.config
+        k = self._spec_width
+        n = self.pool.n_slots
+        drafts = np.zeros((n, k), np.int32)
+        dlen = np.zeros(n, np.int32)
+        active = np.nonzero(self._active)[0]
+        for slot in active:
+            out = self._slot_out[slot]
+            # rem >= 1 for an active slot; draft_for_row clamps so a
+            # block never overshoots the budget or writes out of range
+            d = draft_for_row(
+                self._drafter,
+                list(out.request.prompt) + out.tokens,
+                int(self._spec_k[slot]),
+                int(self._widx[slot]),
+                cfg.seq_len,
+                out.request.max_new_tokens - len(out.tokens),
+            )
+            dlen[slot] = len(d)
+            drafts[slot, : len(d)] = d
+        block, accepted, self.pool.cache = self._verify_fn(
+            self.params,
+            jnp.asarray(self._tok),
+            jnp.asarray(drafts),
+            jnp.asarray(dlen),
+            jnp.asarray(self._pos),
+            jnp.asarray(self._widx),
+            jnp.asarray(self._temp),
+            jnp.asarray(self._topk),
+            jnp.asarray(self._topp),
+            self.pool.cache,
+            self._next_rng(),
+        )
+        block, accepted = np.asarray(block), np.asarray(accepted)
+        events = []
+        for slot in active:
+            a = int(accepted[slot])
+            drafted = int(dlen[slot])
+            # current token + a accepted drafts entered the cache; the
+            # bonus (block[a]) is the new current token, written next tick
+            self._pos[slot] += a + 1
+            self._widx[slot] += a + 1
+            self._tok[slot] = int(block[slot, a])
+            delivered = 0
+            for tok in block[slot, : a + 1]:
+                event = self._deliver(int(slot), int(tok))
+                events.append(event)
+                delivered += 1
+                if event.finished:
+                    break  # EOS/length mid-block: drop the surplus
+            self.metrics.record_spec(
+                drafted=drafted,
+                accepted=a,
+                wasted=(k + 1) - delivered,
+            )
+            if (
+                self._spec_adaptive
+                and self._active[slot]
+                and self._spec_max[slot] > 0
+            ):
+                self._spec_k[slot] = adapt_draft_len(
+                    int(self._spec_k[slot]), drafted, a,
+                    int(self._spec_max[slot]),
+                )
+            if self._spec_check:
+                self.pool.assert_slot_aligned(int(slot))
         return events
 
     def _deliver(self, slot: int, token: int) -> StreamEvent:

@@ -71,6 +71,14 @@ class ServingMetrics:
         self.prefix_hits = 0
         self.prefix_misses = 0
         self.prefix_evictions = 0
+        # speculative decode: drafted vs accepted tokens (acceptance rate
+        # = the drafter's hit quality), and verify positions computed but
+        # not delivered (pads + rejected drafts + post-finish surplus —
+        # the FLOP overhead speculative decode pays for its win)
+        self.spec_slot_ticks = 0
+        self.tokens_drafted = 0
+        self.tokens_accepted = 0
+        self.spec_wasted_positions = 0
         self._t_start: Optional[float] = None
         self._t_last: Optional[float] = None
 
@@ -126,6 +134,15 @@ class ServingMetrics:
         self.prefill_calls += 1
         self.prefill_chunks += chunks
 
+    def record_spec(self, drafted: int, accepted: int, wasted: int) -> None:
+        """One active slot's share of a speculative verify tick: how many
+        draft tokens it proposed, how many the verify accepted, and how
+        many of its compiled verify positions went undelivered."""
+        self.spec_slot_ticks += 1
+        self.tokens_drafted += drafted
+        self.tokens_accepted += accepted
+        self.spec_wasted_positions += wasted
+
     def sync_prefix_cache(self, prefix_cache) -> None:
         """Mirror a :class:`~tpu_parallel.serving.prefix_cache.PrefixCache`'s
         cumulative counters (the cache owns the tallies; metrics snapshots
@@ -165,6 +182,19 @@ class ServingMetrics:
             "rejected": self.rejected,
             "expired": self.expired,
             "tokens_out": self.tokens_out,
+            "tokens_drafted": self.tokens_drafted,
+            "tokens_accepted": self.tokens_accepted,
+            "spec_acceptance_rate": (
+                round(self.tokens_accepted / self.tokens_drafted, 4)
+                if self.tokens_drafted
+                else None
+            ),
+            "spec_wasted_positions": self.spec_wasted_positions,
+            "tokens_per_decode_tick": (
+                round(self.tokens_out / self.decode_ticks, 3)
+                if self.decode_ticks
+                else None
+            ),
             "tokens_per_sec": (
                 round(self.throughput(), 1)
                 if self.throughput() is not None

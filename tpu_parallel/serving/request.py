@@ -60,6 +60,13 @@ class Request:
     sampling: SamplingParams = dataclasses.field(default_factory=SamplingParams)
     eos_token_id: Optional[int] = None
     request_id: Optional[str] = None
+    # speculative decoding: None inherits the engine's draft_tokens
+    # setting, 0 disables drafting for THIS request (it still shares
+    # verify ticks, as a one-token block), >0 caps the request's draft
+    # length (clamped to the engine's compiled width).  Output stays
+    # exact either way — the knob trades wasted verify positions against
+    # multi-token ticks per request.
+    draft_tokens: Optional[int] = None
     # called synchronously with each StreamEvent for this request
     on_token: Optional[Callable[["StreamEvent"], None]] = None
 
@@ -70,6 +77,8 @@ class Request:
             raise ValueError("empty prompt")
         if self.max_new_tokens < 1:
             raise ValueError(f"max_new_tokens={self.max_new_tokens} < 1")
+        if self.draft_tokens is not None and self.draft_tokens < 0:
+            raise ValueError(f"draft_tokens={self.draft_tokens} < 0")
 
 
 @dataclasses.dataclass(frozen=True)
