@@ -1,0 +1,128 @@
+"""Static check: every collective call sits inside a ``jax.named_scope``.
+
+``jax.named_scope`` labels are how collectives show up legibly in XProf/
+Perfetto traces (docs/11_observability.md) — the reference repo's entire
+observability story, and this framework's contract since PR 1 ("every
+collective in the framework is scoped", ``utils/profiling.py``).  That
+contract used to be prose; this makes it a tier-1 test
+(``tests/test_obs.py::test_collectives_named_scoped``): a new ``psum`` /
+``all_gather`` / ``psum_scatter`` / ``ppermute`` / ``all_to_all`` landing
+in ``tpu_parallel/parallel/`` or ``tpu_parallel/ops/`` outside a scope
+fails fast instead of shipping an unlabelable trace.
+
+A call counts as scoped when it is lexically inside (a) a ``with
+jax.named_scope(...)`` block, or (b) a function decorated with
+``@jax.named_scope(...)`` (nested defs inherit the enclosing scope —
+scan/loop bodies defined inside a scoped function carry its label).
+``psum(1, axis)`` is exempt: it is the idiomatic static axis-size query,
+folded to a constant by XLA — no collective is emitted.
+
+Usage: ``python scripts/check_scopes.py [paths...]`` — prints one
+``file:line: <call> outside jax.named_scope`` per violation, exits
+nonzero on any.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List
+
+COLLECTIVES = frozenset(
+    {"psum", "all_gather", "psum_scatter", "ppermute", "all_to_all"}
+)
+
+DEFAULT_PATHS = ("tpu_parallel/parallel", "tpu_parallel/ops")
+
+
+def _call_name(node: ast.Call) -> str:
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _is_named_scope_call(node: ast.expr) -> bool:
+    return isinstance(node, ast.Call) and _call_name(node) == "named_scope"
+
+
+def _is_axis_size_query(node: ast.Call) -> bool:
+    """``psum(1, axis)`` — a static size probe, not a real collective."""
+    if _call_name(node) != "psum" or not node.args:
+        return False
+    first = node.args[0]
+    return isinstance(first, ast.Constant) and first.value == 1
+
+
+def check_source(source: str, filename: str) -> List[str]:
+    """Return ``file:line: message`` strings for every unscoped collective
+    call in ``source``."""
+    tree = ast.parse(source, filename=filename)
+    problems: List[str] = []
+
+    def visit(node: ast.AST, scoped: bool) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            scoped = scoped or any(
+                _is_named_scope_call(dec) for dec in node.decorator_list
+            )
+        elif isinstance(node, ast.With):
+            scoped = scoped or any(
+                _is_named_scope_call(item.context_expr)
+                for item in node.items
+            )
+        elif isinstance(node, ast.Call):
+            name = _call_name(node)
+            if (
+                name in COLLECTIVES
+                and not scoped
+                and not _is_axis_size_query(node)
+            ):
+                problems.append(
+                    f"{filename}:{node.lineno}: {name} outside "
+                    "jax.named_scope"
+                )
+        for child in ast.iter_child_nodes(node):
+            visit(child, scoped)
+
+    visit(tree, False)
+    return problems
+
+
+def check_paths(paths=DEFAULT_PATHS) -> List[str]:
+    problems: List[str] = []
+    for path in paths:
+        if os.path.isfile(path):
+            files = [path]
+        else:
+            files = sorted(
+                os.path.join(root, f)
+                for root, _, names in os.walk(path)
+                for f in names
+                if f.endswith(".py")
+            )
+        for fname in files:
+            with open(fname) as fh:
+                problems.extend(check_source(fh.read(), fname))
+    return problems
+
+
+def main(argv: List[str]) -> int:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    os.chdir(repo_root)
+    paths = argv[1:] or list(DEFAULT_PATHS)
+    problems = check_paths(paths)
+    for problem in problems:
+        print(problem, file=sys.stderr)
+    if problems:
+        print(f"check_scopes: {len(problems)} unscoped collective(s)",
+              file=sys.stderr)
+        return 1
+    print("check_scopes: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

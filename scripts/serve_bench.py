@@ -13,7 +13,13 @@ Usage:
       [--slots S] [--new T] [--prompt-min P] [--prompt-max P]
       [--prompt-dist] [--prefix-len P] [--buckets auto|off|B1,B2,...]
       [--chunk C] [--prefix-cache N] [--spec K] [--compare] [--smoke]
-      [--seed K] [--out FILE]
+      [--trace-out FILE] [--metrics-out FILE] [--seed K] [--out FILE]
+
+``--trace-out`` records every measured point's request lifecycles
+(queue -> prefill[/chunk] -> decode/verify -> finish, one Perfetto track
+per slot plus the scheduler track) and writes ONE Chrome trace-event
+JSON at exit; ``--metrics-out`` writes the LAST point's metric-registry
+snapshot as Prometheus text exposition (docs/11_observability.md).
 
 Defaults exercise 32 requests at rates 8 and 0 (0 = all-at-once) on the
 CPU tiny model (gpt2_125m on TPU).
@@ -65,12 +71,11 @@ def make_prompts(cfg, *, n_requests, prompt_min, prompt_max, prefix_len, seed):
 
 
 def run_point(model, params, cfg, prompts, *, rate, n_slots, new_tokens,
-              seed, engine_kwargs, label):
+              seed, engine_kwargs, label, tracer=None):
     from tpu_parallel.serving import (
         Request,
         SchedulerConfig,
         ServingEngine,
-        ServingMetrics,
     )
 
     rnd = random.Random(seed)
@@ -99,9 +104,13 @@ def run_point(model, params, cfg, prompts, *, rate, n_slots, new_tokens,
     for p in prompts:
         eng.add_request(Request(prompt=p, max_new_tokens=2))
     eng.run()
-    eng.metrics = ServingMetrics()
+    eng.reset_metrics()
     if eng._prefix is not None:
         eng._prefix.reset_counters()
+    if tracer is not None:
+        # trace only the measured window (the warmup's spans would bury
+        # the burst under compile-length rectangles)
+        eng.tracer = tracer
 
     t0 = time.perf_counter()
     outs, submitted = [], 0
@@ -129,7 +138,7 @@ def run_point(model, params, cfg, prompts, *, rate, n_slots, new_tokens,
 
     summary = eng.metrics.summary()
     lengths = [len(p) for p in prompts]
-    return {
+    return eng, {
         "bench": "serve",
         "model": getattr(cfg, "_name", None) or (
             "gpt2_125m" if jax.default_backend() == "tpu" else "tiny"
@@ -190,11 +199,16 @@ def smoke(model, params, cfg, prompts, new_tokens):
     adversarial all-wrong drafter — must match static generate()
     token-for-token on every prompt (the non-spec engine modes are pinned
     against the same references, so spec-vs-nonspec parity is implied).
-    Returns the number of mismatched (mode, request) pairs."""
+    Each mode's metric-registry snapshot is additionally validated
+    against the exporter schema (``obs.validate_snapshot``), so a bench
+    record can never come from a registry an exporter would choke on.
+    Returns the number of mismatched (mode, request) pairs + schema
+    problems."""
     import jax.numpy as jnp
     import numpy as np
 
     from tpu_parallel.models.generate import generate
+    from tpu_parallel.obs import validate_snapshot
     from tpu_parallel.serving import Request, SchedulerConfig, ServingEngine
 
     refs = [
@@ -241,6 +255,12 @@ def smoke(model, params, cfg, prompts, new_tokens):
                     file=sys.stderr,
                 )
                 failures += 1
+        for problem in validate_snapshot(eng.registry.snapshot()):
+            print(
+                f"SMOKE FAIL [{name}] registry snapshot: {problem}",
+                file=sys.stderr,
+            )
+            failures += 1
     print(
         "smoke: PASS" if failures == 0 else f"smoke: {failures} FAILURES"
     )
@@ -275,8 +295,16 @@ def main():
                     help="emit every point twice: exact (SERVE_r01 "
                          "config) vs the requested fast path")
     ap.add_argument("--smoke", action="store_true",
-                    help="run the fast-path parity gate; nonzero exit on "
+                    help="run the fast-path parity gate (+ registry "
+                         "snapshot schema check); nonzero exit on "
                          "mismatch")
+    ap.add_argument("--trace-out", type=str, default="",
+                    help="write a Chrome trace-event JSON of every "
+                         "measured point's request lifecycles "
+                         "(Perfetto-openable)")
+    ap.add_argument("--metrics-out", type=str, default="",
+                    help="write the last point's registry snapshot as "
+                         "Prometheus text exposition")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--out", type=str, default="serve_bench")
     args = ap.parse_args()
@@ -349,16 +377,33 @@ def main():
     if args.compare and fast_label != "exact":
         configs.insert(0, ("exact", dict(prefill_buckets=None)))
 
+    tracer = None
+    if args.trace_out:
+        from tpu_parallel.obs import Tracer
+
+        tracer = Tracer()
+
     logger = MetricLogger(logdir=".", name=args.out)
+    eng = None
     for rate in (float(r) for r in args.rate.split(",")):
         for label, engine_kwargs in configs:
-            record = run_point(
+            eng, record = run_point(
                 model, params, cfg, prompts,
                 rate=rate, n_slots=args.slots, new_tokens=new_tokens,
                 seed=args.seed, engine_kwargs=engine_kwargs, label=label,
+                tracer=tracer,
             )
             logger.log_record(record)
     logger.close()
+
+    if tracer is not None:
+        from tpu_parallel.obs import write_chrome_trace
+
+        print(f"trace: {write_chrome_trace(tracer, args.trace_out)}")
+    if args.metrics_out and eng is not None:
+        from tpu_parallel.obs import write_prometheus
+
+        print(f"metrics: {write_prometheus(eng.registry, args.metrics_out)}")
 
 
 if __name__ == "__main__":

@@ -655,7 +655,7 @@ def test_burst_ttft_improves_with_fast_path(rng):
         for p in prompts:  # warm compiles
             eng.add_request(_req(p, 2))
         eng.run()
-        eng.metrics = ServingMetrics()
+        eng.reset_metrics()
         t0 = _time.perf_counter()
         outs = [eng.add_request(_req(p, 8)) for p in prompts]
         eng.run()
@@ -1006,3 +1006,146 @@ def test_engine_sharded_tp_matches_static(mesh_data4_model2, rng):
     eng.run()
     for i, out in enumerate(outs):
         np.testing.assert_array_equal(np.asarray(out.tokens), want[i])
+
+
+# -- unified telemetry: lifecycle tracing through the engine ---------------
+
+
+def test_engine_trace_complete_span_chain_per_request(rng):
+    """Acceptance: a mixed burst (bucketed + chunked + speculative) under
+    a Tracer yields ONE complete span chain per request — queue ->
+    prefill[/chunk] -> decode/verify -> finish — on one track per slot
+    plus the scheduler track, and the Chrome export round-trips."""
+    import json
+
+    from tpu_parallel.obs import Tracer, write_chrome_trace
+
+    cfg, model, _, params = _build(rng)
+    tracer = Tracer()
+    eng = ServingEngine(
+        model, params, n_slots=2,
+        scheduler=SchedulerConfig(max_prefills_per_tick=2),
+        tracer=tracer, prefill_chunk_tokens=4, draft_tokens=3,
+    )
+    prompts = [
+        [1, 2, 3, 4, 5, 6, 7, 8, 9, 10],  # > chunk budget: chunked path
+        [3, 4, 5],  # bucketed path
+        [5, 6, 7, 8],  # joins after a slot frees
+    ]
+    outs = [eng.add_request(_req(p, 5)) for p in prompts]
+    eng.run()
+    assert all(out.status == FINISHED for out in outs)
+
+    assert tracer.tracks() == ["scheduler", "slot 0", "slot 1"]
+    for out in outs:
+        rid = out.request.request_id
+        chain = [
+            s.name for s in tracer.spans if s.attrs.get("request_id") == rid
+        ]
+        assert chain[0] == "queue", chain
+        assert any(name.startswith("prefill") for name in chain), chain
+        assert any(name in ("decode", "verify") for name in chain), chain
+        finishes = [
+            ev for ev in tracer.instants
+            if ev["attrs"].get("request_id") == rid
+        ]
+        assert len(finishes) == 1 and finishes[0]["name"] == "finish"
+        # span chain is time-ordered within the request
+        starts = [
+            s.start for s in tracer.spans
+            if s.attrs.get("request_id") == rid
+        ]
+        assert starts == sorted(starts)
+    # chunked request: one prefill_chunk span per chunk, indexed
+    chunked = [
+        s for s in tracer.spans
+        if s.name == "prefill_chunk"
+        and s.attrs["request_id"] == outs[0].request.request_id
+    ]
+    assert [s.attrs["chunk"] for s in chunked] == list(range(len(chunked)))
+    assert len(chunked) == 3  # 10 tokens / chunk 4 -> 3 chunks
+    assert chunked[-1].attrs["final"] is True
+    # verify spans carry draft K + acceptance attrs
+    verifies = [s for s in tracer.spans if s.name == "verify"]
+    assert verifies and all(
+        "draft_k" in s.attrs and "accepted" in s.attrs for s in verifies
+    )
+    # export round-trips (field-level contract pinned in test_obs.py)
+    path = write_chrome_trace(tracer, "/tmp/test_engine_trace.json")
+    events = json.load(open(path))["traceEvents"]
+    assert {e["ph"] for e in events} >= {"M", "X", "i", "b", "e"}
+
+
+def test_engine_prefix_hit_trace_attrs_and_queue_span(rng):
+    """Prefix-cache hits mark their prefill spans cache_hit=True, and a
+    request that waits in the queue records a queue span covering the
+    wait (fake clock: deterministic widths)."""
+    from tpu_parallel.obs import Tracer
+
+    cfg, model, _, params = _build(rng)
+    clock = [0.0]
+
+    def fake_clock():
+        clock[0] += 0.25
+        return clock[0]
+
+    tracer = Tracer(clock=fake_clock)
+    eng = ServingEngine(
+        model, params, n_slots=1, clock=fake_clock,
+        prefill_buckets=(8, 16), prefix_cache_size=2, tracer=tracer,
+    )
+    shared = [7, 3, 5, 2, 9, 4, 6, 1]  # one full bucket: a storable prefix
+    outs = [
+        eng.add_request(_req(shared + [5, 6], 4)),
+        eng.add_request(_req(shared + [8, 2], 4)),
+    ]
+    eng.run()
+    assert all(out.status == FINISHED for out in outs)
+    assert eng.metrics.prefix_hits >= 1
+    prefills = {
+        s.attrs["request_id"]: s for s in tracer.spans if s.name == "prefill"
+    }
+    assert prefills[outs[0].request.request_id].attrs["cache_hit"] is False
+    hit_span = prefills[outs[1].request.request_id]
+    assert hit_span.attrs["cache_hit"] is True
+    assert hit_span.attrs["prefix_len"] == len(shared)
+    # the second request queued behind a 1-slot pool: its queue span is
+    # wider than the first's and closed before its prefill began
+    queues = {
+        s.attrs["request_id"]: s for s in tracer.spans if s.name == "queue"
+    }
+    q0 = queues[outs[0].request.request_id]
+    q1 = queues[outs[1].request.request_id]
+    assert q1.end - q1.start > q0.end - q0.start
+    assert q1.end <= hit_span.start
+    # stall-cause counters cover the run: prefill ticks + decode ticks
+    stalls = {
+        row["labels"]["cause"]: row["value"]
+        for row in eng.registry.snapshot()["counters"]
+        if row["name"] == "serving_tick_stall_total"
+    }
+    assert stalls["prefill"] >= 2 and stalls["none"] >= 1
+    # scheduler published queue telemetry into the engine registry
+    waits = [
+        row for row in eng.registry.snapshot()["histograms"]
+        if row["name"] == "serving_queue_wait_seconds"
+    ]
+    assert waits and waits[0]["count"] == 2
+
+
+def test_engine_reset_metrics_rewires_scheduler_registry(rng):
+    cfg, model, prompt, params = _build(rng, n_rows=1)
+    eng = ServingEngine(model, params, n_slots=1)
+    assert eng.scheduler.registry is eng.registry
+    old_registry = eng.registry
+    eng.add_request(_req(prompt[0], 3))
+    eng.run()
+    fresh = eng.reset_metrics()
+    assert fresh is eng.metrics
+    assert eng.registry is fresh.registry is not old_registry
+    assert eng.scheduler.registry is eng.registry
+    assert eng.metrics.ticks == 0
+    # the engine still serves correctly after the swap
+    out = eng.add_request(_req(prompt[0], 3))
+    eng.run()
+    assert out.status == FINISHED and eng.metrics.finished == 1

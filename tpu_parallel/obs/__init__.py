@@ -1,0 +1,50 @@
+"""Unified telemetry: labeled metric registry, request-lifecycle span
+tracer, and pluggable exporters (Chrome trace / Prometheus text / JSONL).
+
+Shared by the serving engine and the trainer (docs/11_observability.md):
+``MetricRegistry`` is the one store every counter/gauge/histogram lives
+in, ``Tracer`` records lifecycle spans on per-slot tracks, and the
+exporters serialize both without touching instrumentation.
+"""
+
+from tpu_parallel.obs.exporters import (
+    chrome_trace_events,
+    export_snapshot_jsonl,
+    prometheus_lines,
+    prometheus_text,
+    write_chrome_trace,
+    write_prometheus,
+)
+from tpu_parallel.obs.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricRegistry,
+    validate_snapshot,
+)
+from tpu_parallel.obs.tracer import (
+    NULL_SPAN,
+    NULL_TRACER,
+    NullTracer,
+    Span,
+    Tracer,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricRegistry",
+    "validate_snapshot",
+    "Span",
+    "Tracer",
+    "NullTracer",
+    "NULL_SPAN",
+    "NULL_TRACER",
+    "chrome_trace_events",
+    "write_chrome_trace",
+    "prometheus_lines",
+    "prometheus_text",
+    "write_prometheus",
+    "export_snapshot_jsonl",
+]

@@ -1,0 +1,197 @@
+"""Request-lifecycle span tracer.
+
+Records WHAT happened WHEN as named spans on named tracks: the serving
+engine opens one track per cache slot plus a ``scheduler`` track, the
+trainer a ``trainer`` track, and :mod:`tpu_parallel.obs.exporters` lays
+the spans out as a Chrome trace-event file Perfetto opens directly — one
+request's life reads left to right as
+``queue -> prefill[chunk i] -> decode/verify... -> finish``.
+
+Two span shapes:
+
+- **Complete spans** (the default): a ``[start, end]`` interval on one
+  track.  Spans on a track must be sequential or properly nested (the
+  Chrome ``X`` event contract); everything the engine emits per tick is.
+- **Async spans** (``start_async``): intervals that legitimately overlap
+  others on their track — queue-wait spans of concurrently queued
+  requests.  Exported as Chrome ``b``/``e`` nestable-async pairs, which
+  Perfetto renders on per-id sub-rows instead of corrupting the track.
+
+Timestamps come from an injectable monotonic ``clock`` so lifecycle tests
+run on a fake clock, deterministically.
+
+**Disabled tracing is near-zero cost**: the module-level :data:`NULL_TRACER`
+(the engine/trainer default) returns one shared no-op span from every
+call — no timestamp read, no allocation, no list append.  Hot loops that
+would even BUILD attribute dicts per token guard on ``tracer.enabled``.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+
+class Span:
+    """One named interval on a track.  Usable as a context manager for
+    lexically-scoped work, or held across ticks and closed with
+    :meth:`finish` (the engine's queue-wait spans live for many ticks)."""
+
+    __slots__ = ("name", "track", "start", "end", "attrs", "async_id",
+                 "_tracer")
+
+    def __init__(self, tracer: "Tracer", name: str, track: str,
+                 attrs: Dict[str, object], start: float,
+                 async_id: Optional[str] = None):
+        self._tracer = tracer
+        self.name = name
+        self.track = track
+        self.attrs = attrs
+        self.start = start
+        self.end: Optional[float] = None
+        self.async_id = async_id
+
+    def set(self, **attrs) -> "Span":
+        self.attrs.update(attrs)
+        return self
+
+    def finish(self, **attrs) -> "Span":
+        if attrs:
+            self.attrs.update(attrs)
+        if self.end is None:
+            self.end = self._tracer.now()
+        return self
+
+    def __enter__(self) -> "Span":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.finish()
+
+
+class _NullSpan:
+    """The shared do-nothing span: every NullTracer call returns THIS
+    object, so a disabled tracer allocates nothing per call."""
+
+    __slots__ = ()
+
+    def set(self, **attrs) -> "_NullSpan":
+        return self
+
+    def finish(self, **attrs) -> "_NullSpan":
+        return self
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        pass
+
+
+NULL_SPAN = _NullSpan()
+
+
+class Tracer:
+    """Append-only span/instant recorder.
+
+    ``span``/``start`` open a complete span (``span`` reads better under
+    ``with``; they are the same call), ``start_async`` an overlap-safe
+    async span, ``record`` retro-records an interval measured by the
+    caller (the engine's batched prefill fans one device call out into
+    per-slot spans sharing the measured window), ``instant`` drops a
+    zero-duration marker.
+    """
+
+    enabled = True
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self.clock = clock
+        self.spans: List[Span] = []
+        self.instants: List[Dict] = []
+
+    def now(self) -> float:
+        return self.clock()
+
+    def start(self, name: str, track: str = "main", **attrs) -> Span:
+        span = Span(self, name, track, attrs, self.clock())
+        self.spans.append(span)
+        return span
+
+    span = start
+
+    def start_async(self, name: str, track: str, async_id: str,
+                    **attrs) -> Span:
+        span = Span(self, name, track, attrs, self.clock(),
+                    async_id=async_id)
+        self.spans.append(span)
+        return span
+
+    def record(self, name: str, track: str, start: float, end: float,
+               **attrs) -> Span:
+        span = Span(self, name, track, attrs, start)
+        span.end = end
+        self.spans.append(span)
+        return span
+
+    def instant(self, name: str, track: str = "main", **attrs) -> None:
+        self.instants.append(
+            {"name": name, "track": track, "ts": self.clock(),
+             "attrs": attrs}
+        )
+
+    def tracks(self) -> List[str]:
+        """Every track touched, ``scheduler`` and ``trainer`` first, the
+        rest natural-sorted (``slot 2`` before ``slot 10``) — the
+        exporter's row order."""
+        seen = {s.track for s in self.spans}
+        seen.update(ev["track"] for ev in self.instants)
+        head = [t for t in ("scheduler", "trainer") if t in seen]
+
+        def natural(track: str):
+            prefix, _, tail = track.rpartition(" ")
+            if tail.isdigit():
+                return (prefix, int(tail))
+            return (track, -1)
+
+        return head + sorted(seen - set(head), key=natural)
+
+
+class NullTracer:
+    """The disabled tracer: same surface as :class:`Tracer`, no clock
+    reads, no storage.  ``enabled`` is False so hot loops can skip even
+    building the attribute dicts."""
+
+    enabled = False
+
+    def now(self) -> float:
+        return 0.0
+
+    def start(self, name: str, track: str = "main", **attrs) -> _NullSpan:
+        return NULL_SPAN
+
+    span = start
+
+    def start_async(self, name: str, track: str, async_id: str,
+                    **attrs) -> _NullSpan:
+        return NULL_SPAN
+
+    def record(self, name: str, track: str, start: float, end: float,
+               **attrs) -> _NullSpan:
+        return NULL_SPAN
+
+    def instant(self, name: str, track: str = "main", **attrs) -> None:
+        pass
+
+    def tracks(self) -> List[str]:
+        return []
+
+    @property
+    def spans(self) -> List[Span]:
+        return []
+
+    @property
+    def instants(self) -> List[Dict]:
+        return []
+
+
+NULL_TRACER = NullTracer()

@@ -111,12 +111,17 @@ def _gather_with_scattered_grad(x: jax.Array, axis_name: str, axis: int) -> jax.
     @jax.custom_gradient
     def gather(p):
         def grad_fn(g):
-            return (
-                lax.psum_scatter(g, axis_name, scatter_dimension=axis, tiled=True)
-                / lax.psum(1, axis_name)
-            )
+            with jax.named_scope("fsdp_grad_reduce_scatter"):
+                return (
+                    lax.psum_scatter(
+                        g, axis_name, scatter_dimension=axis, tiled=True
+                    )
+                    / lax.psum(1, axis_name)
+                )
 
-        return lax.all_gather(p, axis_name, axis=axis, tiled=True), grad_fn
+        with jax.named_scope("fsdp_weight_all_gather"):
+            full = lax.all_gather(p, axis_name, axis=axis, tiled=True)
+        return full, grad_fn
 
     return gather(x)
 

@@ -134,11 +134,12 @@ def execute_pipeline_step(
     collected = jnp.where(stage == num_stages - 1, outputs, jnp.zeros_like(outputs))
     # Rotate: rank i -> rank i+1; the wrap-around edge (last -> 0) carries no
     # information (rank 0 ignores its carry) but keeps the permutation total.
-    carry_next = lax.ppermute(
-        outputs,
-        axis_name,
-        perm=[(i, (i + 1) % num_stages) for i in range(num_stages)],
-    )
+    with jax.named_scope("pipeline_rotate"):
+        carry_next = lax.ppermute(
+            outputs,
+            axis_name,
+            perm=[(i, (i + 1) % num_stages) for i in range(num_stages)],
+        )
     return carry_next, collected
 
 
@@ -409,11 +410,12 @@ class _InterleavedScanWrapper(nn.Module):
         out_buf = lax.dynamic_update_index_in_dim(
             out_buf, jnp.where(done, outputs, cur), idx, axis=0
         )
-        carry_next = lax.ppermute(
-            outputs,
-            self.axis_name,
-            perm=[(i, (i + 1) % num_stages) for i in range(num_stages)],
-        )
+        with jax.named_scope("pipeline_rotate"):
+            carry_next = lax.ppermute(
+                outputs,
+                self.axis_name,
+                perm=[(i, (i + 1) % num_stages) for i in range(num_stages)],
+            )
         return (carry_next, out_buf), None
 
 

@@ -208,7 +208,8 @@ class TPDense(nn.Module):
             )
             y = ModuleShard(dense_fn, axis_name=self.axis_name, name="shard")(x)
             if self.gather_output:
-                y = lax.all_gather(y, self.axis_name, axis=-1, tiled=True)
+                with jax.named_scope("tp_col_all_gather"):
+                    y = lax.all_gather(y, self.axis_name, axis=-1, tiled=True)
             return y
         elif self.style == "row":
             if self.split_input:

@@ -98,13 +98,21 @@ from tpu_parallel.models.generate import (
     prefill_step,
     verify_step,
 )
+from tpu_parallel.obs.registry import MetricRegistry
+from tpu_parallel.obs.tracer import NULL_TRACER, Tracer
 from tpu_parallel.serving.cache_pool import (
     CachePool,
     cache_partition_specs,
     default_row_fns,
     insert_rows,
 )
-from tpu_parallel.serving.metrics import ServingMetrics
+from tpu_parallel.serving.metrics import (
+    STALL_NONE,
+    STALL_PREFILL,
+    STALL_QUEUE_EMPTY,
+    STALL_SPEC_VERIFY,
+    ServingMetrics,
+)
 from tpu_parallel.serving.prefix_cache import PrefixCache
 from tpu_parallel.serving.request import (
     FINISHED,
@@ -412,6 +420,23 @@ class ServingEngine:
     - ``spec_check_invariants``: assert the aligned-layout no-rollback
       invariant (:meth:`CachePool.assert_slot_aligned`) every verify
       tick — debug aid, one device fetch per slot per tick.
+
+    Telemetry (docs/11_observability.md):
+
+    - ``tracer``: a :class:`~tpu_parallel.obs.tracer.Tracer` records each
+      request's lifecycle as spans (``queue -> prefill[chunk i] ->
+      decode/verify -> finish``) on one track per slot plus a scheduler
+      track — export with
+      :func:`~tpu_parallel.obs.exporters.write_chrome_trace` and open in
+      Perfetto.  Default is the no-op ``NULL_TRACER`` (near-zero cost:
+      no timestamps, no allocation).
+    - ``registry``: the :class:`~tpu_parallel.obs.registry.MetricRegistry`
+      backing every counter/gauge/histogram (``ServingMetrics`` owns one
+      by default; pass a shared registry to co-locate serving + trainer
+      series for one Prometheus/JSONL export).  Per-tick the engine
+      publishes queue depth, occupancy, and a stall-cause counter
+      (``queue_empty`` / ``prefill`` / ``spec_verify`` / ``none``); the
+      scheduler adds the queue-age gauge.
     """
 
     def __init__(
@@ -424,6 +449,8 @@ class ServingEngine:
         param_specs=None,
         rng: Optional[jax.Array] = None,
         metrics: Optional[ServingMetrics] = None,
+        tracer: Optional[Tracer] = None,
+        registry: Optional[MetricRegistry] = None,
         clock: Callable[[], float] = time.monotonic,
         prefill_buckets: Union[str, Sequence[int], None] = "auto",
         prefill_batch: Optional[int] = None,
@@ -455,11 +482,27 @@ class ServingEngine:
         self.model = model
         self.params = params
         self.clock = clock
-        self.metrics = metrics if metrics is not None else ServingMetrics()
+        # telemetry: the tracer records lifecycle spans (one track per
+        # slot + a scheduler track; NULL_TRACER = disabled, near-zero
+        # cost), the registry backs every counter/gauge/histogram.
+        # Metrics own the registry so `registry` is only consulted when
+        # metrics are engine-built; the scheduler publishes its queue-age
+        # gauge into the same store.
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        if metrics is not None:
+            self.metrics = metrics
+        else:
+            self.metrics = ServingMetrics(registry=registry)
+        self.registry = self.metrics.registry
         if isinstance(scheduler, FIFOScheduler):
             self.scheduler = scheduler
+            if self.scheduler.registry is None:
+                self.scheduler.registry = self.registry
         else:
-            self.scheduler = FIFOScheduler(scheduler, clock=clock)
+            self.scheduler = FIFOScheduler(
+                scheduler, clock=clock, registry=self.registry
+            )
+        self._queue_spans: Dict[str, object] = {}
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
 
         if prefill_buckets == "auto":
@@ -579,6 +622,13 @@ class ServingEngine:
             out.finish_reason = "queue full"
             self.metrics.record_rejected()
             return out
+        if self.tracer.enabled:
+            # async span (queue waits of concurrent requests overlap on
+            # the scheduler track); closed at admission or expiry
+            rid = request.request_id
+            self._queue_spans[rid] = self.tracer.start_async(
+                "queue", track="scheduler", async_id=rid, request_id=rid
+            )
         return out
 
     # -- the tick ----------------------------------------------------------
@@ -590,6 +640,12 @@ class ServingEngine:
         batched prefill), one decode step over the pool, retire finished
         slots.  Returns this tick's events."""
         now = self.clock()
+        tr = self.tracer
+        tick_span = (
+            tr.span("tick", track="scheduler", tick=self.metrics.ticks)
+            if tr.enabled
+            else None
+        )
         events: List[StreamEvent] = []
         for out in self.scheduler.expire(now):
             # terminal notification with no token (token/index = -1):
@@ -598,8 +654,12 @@ class ServingEngine:
             # the event or they wait forever
             out.finish_reason = "max_wait"
             out.finish_time = now
+            rid = out.request.request_id
+            span = self._queue_spans.pop(rid, None)
+            if span is not None:
+                span.finish(expired=True)
             event = StreamEvent(
-                request_id=out.request.request_id,
+                request_id=rid,
                 token=-1,
                 index=-1,
                 finished=True,
@@ -611,6 +671,7 @@ class ServingEngine:
             self.metrics.record_expired()
         # chunked prefills first: their slots are already owned, and a
         # chunk finishing this tick decodes this tick
+        chunks_advanced = len(self._chunking)
         for slot in sorted(self._chunking):
             events.extend(self._advance_chunk(slot))
         bucket_key = (
@@ -628,6 +689,18 @@ class ServingEngine:
             decoded = True
         if self._prefix is not None:
             self.metrics.sync_prefix_cache(self._prefix)
+        # stall attribution, most-specific first: any prefill work this
+        # tick stalled the pool's decode; a speculative tick spent its
+        # decode slot verifying; an undecoded tick with nothing admitted
+        # was starved by an empty queue; else a clean decode tick
+        if admitted or chunks_advanced:
+            stall = STALL_PREFILL
+        elif decoded and self._spec_width > 0:
+            stall = STALL_SPEC_VERIFY
+        elif not decoded:
+            stall = STALL_QUEUE_EMPTY
+        else:
+            stall = STALL_NONE
         self.metrics.record_tick(
             now=self.clock(),
             queue_depth=self.scheduler.depth,
@@ -636,7 +709,15 @@ class ServingEngine:
             new_tokens=sum(1 for ev in events if ev.token >= 0),
             prefills=len(admitted),
             decoded=decoded,
+            stall=stall,
         )
+        if tick_span is not None:
+            tick_span.finish(
+                stall=stall,
+                queue_depth=self.scheduler.depth,
+                admitted=len(admitted),
+                decoded=decoded,
+            )
         return events
 
     def has_work(self) -> bool:
@@ -654,6 +735,27 @@ class ServingEngine:
             events.extend(self.step())
             ticks += 1
         return events
+
+    def reset_metrics(
+        self, metrics: Optional[ServingMetrics] = None
+    ) -> ServingMetrics:
+        """Swap in a fresh metrics record and rewire the scheduler's
+        telemetry to it — the bench's measure-after-warmup reset.
+
+        The default replacement keeps the old record's ``logger`` /
+        ``log_every`` streaming config but owns a NEW registry: registry
+        instruments are monotone (a shared one cannot be zeroed without
+        lying to its other writers), so a reset always starts new series
+        — re-share explicitly by passing ``metrics`` built on the
+        registry you want.  Returns the new metrics."""
+        if metrics is None:
+            metrics = ServingMetrics(
+                logger=self.metrics.logger, log_every=self.metrics.log_every
+            )
+        self.metrics = metrics
+        self.registry = self.metrics.registry
+        self.scheduler.registry = self.registry
+        return self.metrics
 
     @property
     def prefill_compiles(self) -> int:
@@ -699,6 +801,11 @@ class ServingEngine:
         events: List[StreamEvent] = []
         batch: List[RequestOutput] = []
         hit_groups: Dict[Tuple[int, int], list] = {}
+        if self.tracer.enabled:
+            for out in admitted:
+                span = self._queue_spans.pop(out.request.request_id, None)
+                if span is not None:
+                    span.finish()
         for out in admitted:
             length = len(out.request.prompt)
             if self._chunk_tokens is not None and length > self._chunk_tokens:
@@ -729,6 +836,7 @@ class ServingEngine:
         req = out.request
         slot = self.pool.acquire()
         assert slot is not None, "scheduler admitted beyond free slots"
+        t0 = self.tracer.now()
         length = len(req.prompt)
         prompt = jnp.asarray(req.prompt, jnp.int32)[None, :]
         positions = jnp.broadcast_to(
@@ -742,6 +850,12 @@ class ServingEngine:
         self.metrics.record_prefill_call()
         self.pool.insert(fresh, slot)
         tok0 = self._sample_first(logits, [out])[0]
+        if self.tracer.enabled:
+            self.tracer.record(
+                "prefill", f"slot {slot}", t0, self.tracer.now(),
+                request_id=req.request_id, slot=slot, bucket=length,
+                cache_hit=False,
+            )
         return self._activate(slot, out, tok0, length)
 
     def _admit_bucketed(
@@ -752,6 +866,7 @@ class ServingEngine:
         ``prefill_batch`` dummy rows (scattered out of range, dropped),
         and every real row's fresh cache scatters into its slot in one
         call."""
+        t0 = self.tracer.now()
         width = self._bucket_for(max(len(o.request.prompt) for o in outs))
         nb = max(self._prefill_batch, len(outs))
         tokens = np.zeros((nb, width), np.int32)
@@ -773,6 +888,21 @@ class ServingEngine:
         self.metrics.record_prefill_call()
         self.pool.scatter(fresh, slots)
         firsts = self._sample_first(logits, outs)
+        if self.tracer.enabled:
+            # one batched device call fans out to a span per admitted
+            # slot (same measured window), plus the batch-level span on
+            # the scheduler track
+            t1 = self.tracer.now()
+            self.tracer.record(
+                "prefill_batch", "scheduler", t0, t1, bucket=width, rows=nb,
+                requests=len(outs),
+            )
+            for i, out in enumerate(outs):
+                self.tracer.record(
+                    "prefill", f"slot {int(slots[i])}", t0, t1,
+                    request_id=out.request.request_id, slot=int(slots[i]),
+                    bucket=width, cache_hit=False,
+                )
         events = []
         for i, out in enumerate(outs):
             events.append(
@@ -790,6 +920,7 @@ class ServingEngine:
         call, scatter the completed rows into their slots.  Skips
         recomputing ``prefix_len`` tokens per request AND keeps hits
         batched like cold prefills."""
+        t0 = self.tracer.now()
         nb = max(self._prefill_batch, len(group))
         rows = [row for (_, row) in group]
         rows += [rows[0]] * (nb - len(rows))  # dummy rows: dropped slots
@@ -818,6 +949,18 @@ class ServingEngine:
         self.pool.scatter(ext, slots)
         outs = [out for (out, _) in group]
         firsts = self._sample_first(logits, outs)
+        if self.tracer.enabled:
+            t1 = self.tracer.now()
+            self.tracer.record(
+                "prefill_batch", "scheduler", t0, t1, bucket=width, rows=nb,
+                requests=len(outs), prefix_len=prefix_len,
+            )
+            for i, out in enumerate(outs):
+                self.tracer.record(
+                    "prefill", f"slot {int(slots[i])}", t0, t1,
+                    request_id=out.request.request_id, slot=int(slots[i]),
+                    bucket=width, cache_hit=True, prefix_len=prefix_len,
+                )
         events = []
         for i, out in enumerate(outs):
             events.append(
@@ -879,12 +1022,21 @@ class ServingEngine:
         st = self._chunking[slot]
         prompt = st.out.request.prompt
         take = min(self._chunk_tokens, len(prompt) - st.offset)
+        t0 = self.tracer.now()
+        chunk_index = st.offset // self._chunk_tokens
         logits = self._extend_slot(
             slot, prompt[st.offset : st.offset + take],
             offset=st.offset, width=self._chunk_tokens,
         )
         st.offset += take
         self.metrics.record_prefill_call(chunks=1)
+        if self.tracer.enabled:
+            self.tracer.record(
+                "prefill_chunk", f"slot {slot}", t0, self.tracer.now(),
+                request_id=st.out.request.request_id, slot=slot,
+                chunk=chunk_index, offset=st.offset,
+                final=st.offset >= len(prompt),
+            )
         if st.offset < len(prompt):
             return []
         del self._chunking[slot]
@@ -959,6 +1111,7 @@ class ServingEngine:
     def _decode_tick(self) -> List[StreamEvent]:
         if self._spec_width > 0:
             return self._spec_tick()
+        t0 = self.tracer.now()
         nxt, self.pool.cache = self._decode_fn(
             self.params,
             jnp.asarray(self._tok),
@@ -970,11 +1123,22 @@ class ServingEngine:
             self.pool.cache,
             self._next_rng(),
         )
-        nxt = np.asarray(nxt)
+        nxt = np.asarray(nxt)  # forces the async dispatch; t1 is real time
         events = []
+        trace = self.tracer.enabled
+        t1 = self.tracer.now()
+        if trace:
+            self.tracer.record("decode_tick", "scheduler", t0, t1)
         # every slot's current token was just written into the cache;
         # advance even the slots that retire on this token's delivery
         for slot in np.nonzero(self._active)[0]:
+            if trace:
+                out = self._slot_out[slot]
+                self.tracer.record(
+                    "decode", f"slot {int(slot)}", t0, t1,
+                    request_id=out.request.request_id, slot=int(slot),
+                    token_index=len(out.tokens),
+                )
             self._pos[slot] += 1
             self._widx[slot] += 1
             self._tok[slot] = int(nxt[slot])
@@ -1015,6 +1179,7 @@ class ServingEngine:
             )
             dlen[slot] = len(d)
             drafts[slot, : len(d)] = d
+        t0 = self.tracer.now()
         block, accepted, self.pool.cache = self._verify_fn(
             self.params,
             jnp.asarray(self._tok),
@@ -1030,9 +1195,21 @@ class ServingEngine:
         )
         block, accepted = np.asarray(block), np.asarray(accepted)
         events = []
+        trace = self.tracer.enabled
+        t1 = self.tracer.now()
+        if trace:
+            self.tracer.record("verify_tick", "scheduler", t0, t1, width=k)
         for slot in active:
             a = int(accepted[slot])
             drafted = int(dlen[slot])
+            if trace:
+                out = self._slot_out[slot]
+                self.tracer.record(
+                    "verify", f"slot {int(slot)}", t0, t1,
+                    request_id=out.request.request_id, slot=int(slot),
+                    draft_k=drafted, accepted=a,
+                    token_index=len(out.tokens),
+                )
             # current token + a accepted drafts entered the cache; the
             # bonus (block[a]) is the new current token, written next tick
             self._pos[slot] += a + 1
@@ -1084,6 +1261,12 @@ class ServingEngine:
             finish_reason=finish_reason,
         )
         if finish_reason is not None:
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "finish", track=f"slot {slot}",
+                    request_id=req.request_id, reason=finish_reason,
+                    tokens=len(out.tokens),
+                )
             out.status = FINISHED
             out.finish_reason = finish_reason
             out.finish_time = now

@@ -1,20 +1,43 @@
 """Serving observability: queue depth, TTFT, inter-token latency, slot
-occupancy, throughput.
+occupancy, throughput — backed by the shared labeled metric registry.
 
-Two consumers: (1) live per-tick export through
+Three consumers: (1) live per-tick export through
 :class:`~tpu_parallel.utils.logging_utils.MetricLogger` (stdout +
 machine-readable JSONL, process-0-only on multi-host — the same sink the
-trainer uses), and (2) an end-of-run :meth:`ServingMetrics.summary` dict
+trainer uses), (2) an end-of-run :meth:`ServingMetrics.summary` dict
 (the record ``scripts/serve_bench.py`` emits next to the ``DECODE_r*``
-decode-bench lines).
+decode-bench lines), and (3) the registry itself
+(:class:`~tpu_parallel.obs.registry.MetricRegistry`), which any exporter
+— Prometheus text, JSONL snapshot — can serialize at any moment.
+
+The PR-1 sliding-window deques are gone: latency/depth distributions live
+in the registry's LOG-BUCKETED histograms, so a long-lived engine's
+memory stays flat without a sample cap, counters and means are exact over
+the whole lifetime (the deques' "mean" silently covered only the newest
+``max_samples``), and percentiles are exact to one bucket width (~10%
+relative at the default growth).  The public attribute surface (``ticks``,
+``finished``, ``prefix_hits``...) and the :meth:`summary` schema are
+unchanged — attributes read through to the registry instruments.
 """
 
 from __future__ import annotations
 
-from collections import deque
 from typing import Dict, Optional, Sequence
 
+import numpy as np
+
+from tpu_parallel.obs.registry import MetricRegistry
 from tpu_parallel.utils.logging_utils import MetricLogger
+
+# engine tick stall-cause labels (serving_tick_stall_total): why THIS
+# tick produced fewer tokens than a pure decode tick would have
+STALL_QUEUE_EMPTY = "queue_empty"  # nothing to decode, nothing queued
+STALL_PREFILL = "prefill"  # prefill/chunk work ran before the decode
+STALL_SPEC_VERIFY = "spec_verify"  # decode tick spent verifying drafts
+STALL_NONE = "none"  # plain unstalled decode tick
+STALL_CAUSES = (
+    STALL_QUEUE_EMPTY, STALL_PREFILL, STALL_SPEC_VERIFY, STALL_NONE
+)
 
 
 def percentile(values: Sequence[float], p: float) -> Optional[float]:
@@ -25,23 +48,22 @@ def percentile(values: Sequence[float], p: float) -> Optional[float]:
     vals = [v for v in values if v is not None]
     if not vals:
         return None
-    import numpy as np
-
     return float(np.percentile(vals, min(max(p, 0.0), 100.0)))
 
 
 class ServingMetrics:
-    """Accumulates per-tick and per-request serving statistics.
+    """Accumulates per-tick and per-request serving statistics in a
+    :class:`MetricRegistry`.
 
     The engine calls :meth:`record_tick` once per ``step()`` and
     :meth:`record_finished` as requests retire; everything else derives.
     ``logger``/``log_every`` stream tick metrics through the shared
     :class:`MetricLogger` (queue depth, occupancy, cumulative tokens/sec).
 
-    Sample collections are BOUNDED (``max_samples`` most-recent entries,
-    sliding window) so a long-lived engine's memory stays flat — counters
-    and throughput remain exact over the whole lifetime; percentiles and
-    means in :meth:`summary` cover the window.
+    Pass ``registry`` to share one store across subsystems (engine +
+    trainer + exporters); by default each instance owns a fresh one.
+    ``max_samples`` is kept for call-site compatibility but unused — the
+    log-bucketed histograms are bounded by construction, not by a window.
     """
 
     def __init__(
@@ -49,38 +71,123 @@ class ServingMetrics:
         logger: Optional[MetricLogger] = None,
         log_every: int = 0,
         max_samples: int = 100_000,
+        registry: Optional[MetricRegistry] = None,
     ):
+        del max_samples  # windowing replaced by bounded log-bucketing
         self.logger = logger
         self.log_every = log_every
-        self.ticks = 0
-        self.decode_ticks = 0
-        self.tokens_out = 0
-        self.prefills = 0
-        self.queue_depths: deque = deque(maxlen=max_samples)
-        self.occupancies: deque = deque(maxlen=max_samples)
-        self.ttfts: deque = deque(maxlen=max_samples)
-        self.inter_token: deque = deque(maxlen=max_samples)
-        self.finished = 0
-        self.rejected = 0
-        self.expired = 0
+        self.registry = registry if registry is not None else MetricRegistry()
+        r = self.registry
+        self._ticks = r.counter("serving_ticks_total")
+        self._decode_ticks = r.counter("serving_decode_ticks_total")
+        self._tokens_out = r.counter("serving_tokens_out_total")
+        self._prefills = r.counter("serving_prefills_total")
+        self._finished = r.counter("serving_finished_total")
+        self._rejected = r.counter("serving_rejected_total")
+        self._expired = r.counter("serving_expired_total")
         # prefill fast path: batched prefill device calls (vs. `prefills`,
         # which counts admitted REQUESTS), chunk continuations, and the
-        # prefix cache's hit/miss/eviction tallies
-        self.prefill_calls = 0
-        self.prefill_chunks = 0
-        self.prefix_hits = 0
-        self.prefix_misses = 0
-        self.prefix_evictions = 0
+        # prefix cache's hit/miss/eviction tallies (mirrored gauges — the
+        # cache owns the counts, metrics snapshots them)
+        self._prefill_calls = r.counter("serving_prefill_calls_total")
+        self._prefill_chunks = r.counter("serving_prefill_chunks_total")
+        self._prefix_hits = r.gauge("serving_prefix_hits")
+        self._prefix_misses = r.gauge("serving_prefix_misses")
+        self._prefix_evictions = r.gauge("serving_prefix_evictions")
         # speculative decode: drafted vs accepted tokens (acceptance rate
         # = the drafter's hit quality), and verify positions computed but
         # not delivered (pads + rejected drafts + post-finish surplus —
         # the FLOP overhead speculative decode pays for its win)
-        self.spec_slot_ticks = 0
-        self.tokens_drafted = 0
-        self.tokens_accepted = 0
-        self.spec_wasted_positions = 0
+        self._spec_slot_ticks = r.counter("serving_spec_slot_ticks_total")
+        self._tokens_drafted = r.counter("serving_tokens_drafted_total")
+        self._tokens_accepted = r.counter("serving_tokens_accepted_total")
+        self._spec_wasted = r.counter("serving_spec_wasted_positions_total")
+        self._spec_acceptance = r.histogram("serving_spec_acceptance_ratio")
+        # per-tick stall attribution, pre-registered so every cause shows
+        # a (possibly zero) series in exports
+        self._stall = {
+            cause: r.counter("serving_tick_stall_total", cause=cause)
+            for cause in STALL_CAUSES
+        }
+        # distributions: log-bucketed histograms (exact count/sum/max,
+        # percentile within one bucket width) + last-value gauges for
+        # scrape-style consumers
+        self._ttft = r.histogram("serving_ttft_seconds")
+        self._itl = r.histogram("serving_itl_seconds")
+        self._queue_depth = r.histogram("serving_queue_depth")
+        self._occupancy = r.histogram("serving_slot_occupancy")
+        self._queue_depth_last = r.gauge("serving_queue_depth_last")
+        self._occupancy_last = r.gauge("serving_slot_occupancy_last")
         self._t_start: Optional[float] = None
         self._t_last: Optional[float] = None
+
+    # -- counter attribute surface (unchanged names, registry-backed) ------
+
+    @property
+    def ticks(self) -> int:
+        return int(self._ticks.value)
+
+    @property
+    def decode_ticks(self) -> int:
+        return int(self._decode_ticks.value)
+
+    @property
+    def tokens_out(self) -> int:
+        return int(self._tokens_out.value)
+
+    @property
+    def prefills(self) -> int:
+        return int(self._prefills.value)
+
+    @property
+    def finished(self) -> int:
+        return int(self._finished.value)
+
+    @property
+    def rejected(self) -> int:
+        return int(self._rejected.value)
+
+    @property
+    def expired(self) -> int:
+        return int(self._expired.value)
+
+    @property
+    def prefill_calls(self) -> int:
+        return int(self._prefill_calls.value)
+
+    @property
+    def prefill_chunks(self) -> int:
+        return int(self._prefill_chunks.value)
+
+    @property
+    def prefix_hits(self) -> int:
+        return int(self._prefix_hits.value)
+
+    @property
+    def prefix_misses(self) -> int:
+        return int(self._prefix_misses.value)
+
+    @property
+    def prefix_evictions(self) -> int:
+        return int(self._prefix_evictions.value)
+
+    @property
+    def spec_slot_ticks(self) -> int:
+        return int(self._spec_slot_ticks.value)
+
+    @property
+    def tokens_drafted(self) -> int:
+        return int(self._tokens_drafted.value)
+
+    @property
+    def tokens_accepted(self) -> int:
+        return int(self._tokens_accepted.value)
+
+    @property
+    def spec_wasted_positions(self) -> int:
+        return int(self._spec_wasted.value)
+
+    # -- recording ---------------------------------------------------------
 
     def record_tick(
         self,
@@ -90,16 +197,22 @@ class ServingMetrics:
         new_tokens: int,
         prefills: int,
         decoded: bool,
+        stall: Optional[str] = None,
     ) -> None:
         if self._t_start is None:
             self._t_start = now
         self._t_last = now
-        self.ticks += 1
-        self.decode_ticks += int(decoded)
-        self.tokens_out += new_tokens
-        self.prefills += prefills
-        self.queue_depths.append(queue_depth)
-        self.occupancies.append(occupancy)
+        self._ticks.inc()
+        if decoded:
+            self._decode_ticks.inc()
+        self._tokens_out.inc(new_tokens)
+        self._prefills.inc(prefills)
+        self._queue_depth.observe(queue_depth)
+        self._occupancy.observe(occupancy)
+        self._queue_depth_last.set(queue_depth)
+        self._occupancy_last.set(occupancy)
+        if stall is not None:
+            self._stall.get(stall, self._stall[STALL_NONE]).inc()
         if (
             self.logger is not None
             and self.log_every > 0
@@ -117,39 +230,42 @@ class ServingMetrics:
 
     def record_finished(self, out) -> None:
         """Fold one retired RequestOutput's latencies in."""
-        self.finished += 1
+        self._finished.inc()
         if out.ttft is not None:
-            self.ttfts.append(out.ttft)
-        self.inter_token.extend(out.inter_token_latencies())
+            self._ttft.observe(out.ttft)
+        for gap in out.inter_token_latencies():
+            self._itl.observe(gap)
 
     def record_rejected(self) -> None:
-        self.rejected += 1
+        self._rejected.inc()
 
     def record_expired(self) -> None:
-        self.expired += 1
+        self._expired.inc()
 
     def record_prefill_call(self, chunks: int = 0) -> None:
         """One batched prefill device call (``chunks`` counts any chunk
         continuations it was split into)."""
-        self.prefill_calls += 1
-        self.prefill_chunks += chunks
+        self._prefill_calls.inc()
+        self._prefill_chunks.inc(chunks)
 
     def record_spec(self, drafted: int, accepted: int, wasted: int) -> None:
         """One active slot's share of a speculative verify tick: how many
         draft tokens it proposed, how many the verify accepted, and how
         many of its compiled verify positions went undelivered."""
-        self.spec_slot_ticks += 1
-        self.tokens_drafted += drafted
-        self.tokens_accepted += accepted
-        self.spec_wasted_positions += wasted
+        self._spec_slot_ticks.inc()
+        self._tokens_drafted.inc(drafted)
+        self._tokens_accepted.inc(accepted)
+        self._spec_wasted.inc(wasted)
+        if drafted > 0:
+            self._spec_acceptance.observe(accepted / drafted)
 
     def sync_prefix_cache(self, prefix_cache) -> None:
         """Mirror a :class:`~tpu_parallel.serving.prefix_cache.PrefixCache`'s
         cumulative counters (the cache owns the tallies; metrics snapshots
         them so ``summary()`` is self-contained)."""
-        self.prefix_hits = prefix_cache.hits
-        self.prefix_misses = prefix_cache.misses
-        self.prefix_evictions = prefix_cache.evictions
+        self._prefix_hits.set(prefix_cache.hits)
+        self._prefix_misses.set(prefix_cache.misses)
+        self._prefix_evictions.set(prefix_cache.evictions)
 
     def throughput(self) -> Optional[float]:
         """Generated tokens per wall-second over the ticks observed."""
@@ -164,8 +280,12 @@ class ServingMetrics:
         def ms(x):
             return None if x is None else round(x * 1000.0, 3)
 
-        mean = lambda xs: (sum(xs) / len(xs)) if xs else None
+        def hist_mean(h, digits):
+            m = h.mean()
+            return None if m is None else round(m, digits)
+
         probes = self.prefix_hits + self.prefix_misses
+        qd_max = self._queue_depth.max
         return {
             "ticks": self.ticks,
             "decode_ticks": self.decode_ticks,
@@ -200,21 +320,13 @@ class ServingMetrics:
                 if self.throughput() is not None
                 else None
             ),
-            "ttft_ms_p50": ms(percentile(self.ttfts, 50)),
-            "ttft_ms_p95": ms(percentile(self.ttfts, 95)),
-            "itl_ms_p50": ms(percentile(self.inter_token, 50)),
-            "itl_ms_p95": ms(percentile(self.inter_token, 95)),
-            "slot_occupancy_mean": (
-                round(mean(self.occupancies), 4)
-                if self.occupancies
-                else None
-            ),
-            "queue_depth_mean": (
-                round(mean(self.queue_depths), 2)
-                if self.queue_depths
-                else None
-            ),
+            "ttft_ms_p50": ms(self._ttft.percentile(50)),
+            "ttft_ms_p95": ms(self._ttft.percentile(95)),
+            "itl_ms_p50": ms(self._itl.percentile(50)),
+            "itl_ms_p95": ms(self._itl.percentile(95)),
+            "slot_occupancy_mean": hist_mean(self._occupancy, 4),
+            "queue_depth_mean": hist_mean(self._queue_depth, 2),
             "queue_depth_max": (
-                max(self.queue_depths) if self.queue_depths else None
+                None if qd_max is None else int(qd_max)
             ),
         }

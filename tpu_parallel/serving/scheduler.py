@@ -22,6 +22,14 @@ scheduler owns the first: a FIFO queue with three policy knobs —
 Time is injectable: ``clock`` (default ``time.monotonic``) supplies "now"
 whenever a caller does not pass it explicitly, so queue-timeout tests run
 deterministically on a fake clock instead of sleeping.
+
+Telemetry: given a ``registry``
+(:class:`~tpu_parallel.obs.registry.MetricRegistry` — the engine wires
+its own in), every ``schedule()`` call publishes the
+``serving_queue_age_seconds`` gauge (how long the OLDEST queued request
+has waited — the head-of-line latency a new arrival is behind) and
+observes each admitted request's queue wait into the
+``serving_queue_wait_seconds`` histogram.
 """
 
 from __future__ import annotations
@@ -54,6 +62,7 @@ class FIFOScheduler:
         self,
         config: Optional[SchedulerConfig] = None,
         clock: Callable[[], float] = time.monotonic,
+        registry=None,
     ):
         self.config = config or SchedulerConfig()
         if self.config.max_prefills_per_tick < 1:
@@ -62,11 +71,37 @@ class FIFOScheduler:
                 f"{self.config.max_prefills_per_tick} < 1"
             )
         self.clock = clock
+        self.registry = registry
         self._queue: deque = deque()
 
     @property
     def depth(self) -> int:
         return len(self._queue)
+
+    def oldest_age(self, now: Optional[float] = None) -> float:
+        """Seconds the head-of-queue request has waited (0.0 when empty
+        or the head has no arrival time)."""
+        if not self._queue:
+            return 0.0
+        arrival = self._queue[0].arrival_time
+        if arrival is None:
+            return 0.0
+        if now is None:
+            now = self.clock()
+        return max(0.0, now - arrival)
+
+    def _observe(self, now: float, admitted: List[RequestOutput]) -> None:
+        """Publish the queue-age gauge + admitted queue waits (no-op
+        without a registry)."""
+        if self.registry is None:
+            return
+        self.registry.gauge("serving_queue_age_seconds").set(
+            self.oldest_age(now)
+        )
+        wait = self.registry.histogram("serving_queue_wait_seconds")
+        for out in admitted:
+            if out.arrival_time is not None:
+                wait.observe(max(0.0, now - out.arrival_time))
 
     def submit(self, out: RequestOutput) -> bool:
         """Enqueue; False when admission control refuses (queue full)."""
@@ -104,7 +139,10 @@ class FIFOScheduler:
     ) -> List[RequestOutput]:
         """Pop up to ``min(n_free, max_prefills_per_tick)`` admissions.
 
-        ``bucket_key`` (the engine's bucketed-prefill grouping) constrains
+        ``now`` feeds the telemetry (queue-age gauge, admitted queue
+        waits); FIFO ordering itself ignores it — priority policies
+        would not.  ``bucket_key`` (the engine's bucketed-prefill
+        grouping) constrains
         the tick's admissions to ONE batchable group: the FIFO head always
         admits, and the rest of the budget fills with later queued entries
         sharing the head's key — those jump ahead of earlier entries in
@@ -113,15 +151,18 @@ class FIFOScheduler:
         shares a bucket with someone behind it).  The engine runs the
         returned set as one padded batched prefill call.
         """
-        del now  # FIFO ignores it; priority policies would not
+        if now is None:
+            now = self.clock()
         n = min(n_free, self.config.max_prefills_per_tick)
         if n <= 0 or not self._queue:
+            self._observe(now, [])
             return []
         if bucket_key is None:
             admitted = []
             while n > 0 and self._queue:
                 admitted.append(self._queue.popleft())
                 n -= 1
+            self._observe(now, admitted)
             return admitted
         head = self._queue.popleft()
         admitted, key = [head], bucket_key(head)
@@ -132,4 +173,5 @@ class FIFOScheduler:
             else:
                 kept.append(out)
         self._queue = kept
+        self._observe(now, admitted)
         return admitted

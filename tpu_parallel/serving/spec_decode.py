@@ -349,6 +349,7 @@ def generate_speculative(
     top_k: int = 0,
     top_p: float = 0.0,
     return_stats: bool = False,
+    registry=None,
 ):
     """Standalone batch speculative decoding — ``generate()``'s contract
     (returns [batch, max_new_tokens]; greedy output is token-identical,
@@ -367,7 +368,12 @@ def generate_speculative(
     writes out of range exactly like the engine's freed slots.
 
     With ``return_stats`` also returns ``{"ticks", "drafted", "accepted",
-    "acceptance_rate", "tokens_per_tick"}``.
+    "acceptance_rate", "tokens_per_tick"}``.  ``registry`` (a
+    :class:`~tpu_parallel.obs.registry.MetricRegistry`) additionally
+    observes each row-tick's acceptance fraction into the SAME
+    ``serving_spec_acceptance_ratio`` histogram the engine's spec tick
+    feeds, so standalone decode-bench runs and engine runs export
+    comparable acceptance distributions.
     """
     from tpu_parallel.serving.engine import _engine_fns
 
@@ -412,6 +418,11 @@ def generate_speculative(
     kmax = draft_tokens
     k_eff = np.full(b, max(kmax, 0), np.int32)
     ticks = drafted_total = accepted_total = 0
+    acceptance_hist = (
+        registry.histogram("serving_spec_acceptance_ratio")
+        if registry is not None
+        else None
+    )
 
     while any(len(t) < max_new_tokens for t in out):
         drafts = np.zeros((b, kmax), np.int32)
@@ -444,6 +455,8 @@ def generate_speculative(
             widx[r] += a + 1
             drafted_total += int(dlen[r])
             accepted_total += a
+            if acceptance_hist is not None and int(dlen[r]) > 0:
+                acceptance_hist.observe(a / int(dlen[r]))
             if adaptive and kmax > 0:
                 k_eff[r] = adapt_draft_len(
                     int(k_eff[r]), int(dlen[r]), a, kmax

@@ -34,6 +34,8 @@ from tpu_parallel.models import (
     tiny_seq2seq,
     tiny_test,
 )
+from tpu_parallel.obs.registry import MetricRegistry
+from tpu_parallel.obs.tracer import NULL_TRACER, Tracer
 from tpu_parallel.parallel.spmd import TrainFunctions, build_train_functions
 from tpu_parallel.runtime import MeshConfig, make_mesh
 from tpu_parallel.utils.profiling import mfu
@@ -167,9 +169,25 @@ def make_optimizer(config: TrainerConfig) -> optax.GradientTransformation:
 
 
 class Trainer:
-    """Owns the mesh, the model, and the compiled train step."""
+    """Owns the mesh, the model, and the compiled train step.
 
-    def __init__(self, config: TrainerConfig, mesh=None):
+    Telemetry (docs/11_observability.md): pass ``tracer`` (a
+    :class:`~tpu_parallel.obs.tracer.Tracer`) to record per-step spans on
+    the ``trainer`` track, split into ``data_wait`` (host-side batch
+    fetch) and ``compute`` (dispatch + ``block_until_ready`` fence, so
+    the span's width IS the device step — the fence costs pipelining,
+    which is why it only runs when tracing is enabled).  ``registry`` (a
+    shared :class:`~tpu_parallel.obs.registry.MetricRegistry`) receives
+    ``train_mfu`` / ``train_tokens_per_sec`` / ``train_loss`` gauges at
+    every log point — the same store the serving engine exports, so one
+    Prometheus/JSONL snapshot covers both.
+    """
+
+    def __init__(self, config: TrainerConfig, mesh=None, *,
+                 tracer: Optional[Tracer] = None,
+                 registry: Optional[MetricRegistry] = None):
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        self.registry = registry if registry is not None else MetricRegistry()
         self.config = config
         self.mesh = mesh if mesh is not None else make_mesh(config.mesh)
         mesh_sizes = dict(self.mesh.shape)
@@ -355,6 +373,15 @@ class Trainer:
         self.state = self.funcs.init_fn(rng, self.example_batch)
         return self.state
 
+    def _publish_gauges(self, metrics: Dict[str, float]) -> None:
+        """Mirror one log point's metrics into the registry as
+        ``train_*`` gauges (``mfu``/``tokens_per_sec``/``loss``/...), so
+        a registry export taken at any moment carries the trainer's
+        latest state alongside the serving series."""
+        for key, value in metrics.items():
+            if isinstance(value, (int, float)):
+                self.registry.gauge(f"train_{key}").set(value)
+
     def train(
         self,
         batch_iter=None,
@@ -376,8 +403,21 @@ class Trainer:
         last = {}
         t_start = t0 = time.perf_counter()
         timed_from = 0  # throughput covers steps AFTER this one
+        tr = self.tracer
         for step in range(1, steps + 1):
-            batch = next(batch_iter) if batch_iter is not None else self.example_batch
+            if tr.enabled:
+                with tr.span("data_wait", track="trainer", step=step):
+                    batch = (
+                        next(batch_iter)
+                        if batch_iter is not None
+                        else self.example_batch
+                    )
+            else:
+                batch = (
+                    next(batch_iter)
+                    if batch_iter is not None
+                    else self.example_batch
+                )
             if step == 1 and self.is_seq2seq and not hasattr(batch, "src_tokens"):
                 # the token-stream DataLoader yields TextBatch — refusing
                 # here beats an AttributeError deep inside the jitted step
@@ -387,7 +427,16 @@ class Trainer:
                     f"DataLoader yields {type(batch).__name__} — provide a "
                     "paired-data iterator"
                 )
-            state, metrics = self.funcs.step_fn(state, metrics, batch)
+            if tr.enabled:
+                # the block_until_ready fence pins the span to the step's
+                # real device time (and attributes host-side input waits
+                # to data_wait above, not here) — the pipelining it costs
+                # is the price of an honest trace, paid only when tracing
+                with tr.span("compute", track="trainer", step=step):
+                    state, metrics = self.funcs.step_fn(state, metrics, batch)
+                    jax.block_until_ready(metrics)
+            else:
+                state, metrics = self.funcs.step_fn(state, metrics, batch)
             if step == 1:
                 # steady-state timing: the first step carries compilation —
                 # restart the clock so tokens_per_sec reflects the machine,
@@ -420,6 +469,7 @@ class Trainer:
                 )
                 if util is not None:  # None off-TPU (no known peak FLOPs)
                     last["mfu"] = util
+                self._publish_gauges(last)
                 if log_fn is not None:
                     log_fn(step, last)
         jax.block_until_ready(state)
@@ -544,21 +594,44 @@ class Trainer:
                 metrics = None
                 return int(self.state.step)
 
+            tr = self.tracer
             while step < steps:
+                data_span = (
+                    tr.span("data_wait", track="trainer", step=step + 1)
+                    if tr.enabled
+                    else None
+                )
                 if data_loader is not None:
                     batch = data_loader.batch_at(step)
                 elif batch_iter is not None:
                     batch = next(batch_iter)
                 else:
                     batch = self.example_batch
+                if data_span is not None:
+                    data_span.finish()
+                # fit's rollback contract already fences every step
+                # (block_until_ready below), so tracing adds no extra
+                # synchronization here — the compute span is free
+                step_span = (
+                    tr.span("compute", track="trainer", step=step + 1)
+                    if tr.enabled
+                    else None
+                )
                 try:
                     new_state, metrics = self.funcs.step_fn(
                         self.state, metrics, batch
                     )
                     jax.block_until_ready(new_state)
                 except Exception as exc:  # noqa: BLE001 — device/transport failure
+                    if step_span is not None:
+                        # close at the failure, not at export time — an
+                        # unfinished span would render as one giant
+                        # rectangle over the rest of the trainer track
+                        step_span.finish(failed=True)
                     step = rollback_or_reraise(exc)
                     continue
+                if step_span is not None:
+                    step_span.finish()
                 self.state = new_state
                 step += 1
                 if step % checkpoint_every == 0 or step == steps:
@@ -584,6 +657,7 @@ class Trainer:
                             _json.dump({"loss": best_loss, "step": step}, fh)
                 if step % self.config.log_every == 0 or step == steps:
                     last = compute_metrics(metrics)
+                    self._publish_gauges(last)
                     if log_fn is not None:
                         log_fn(step, last)
             ckpt.wait()

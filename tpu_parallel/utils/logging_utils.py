@@ -38,6 +38,25 @@ def print_exception(exc: BaseException, *, width: int = 100) -> str:
     return rendered
 
 
+def _to_scalar(value):
+    """Coerce a metric value to a JSON-serializable Python scalar.
+
+    Trainer/engine metrics routinely arrive as 0-d jax/numpy arrays (a
+    ``loss`` straight off the device); ``json.dumps`` rejects those and
+    used to crash the sink mid-run.  ``item()`` unwraps any 0-d array
+    (host transfer for a jax scalar); everything else passes through for
+    ``json.dumps`` to judge."""
+    if isinstance(value, (int, float, str, bool)) or value is None:
+        return value
+    item = getattr(value, "item", None)
+    if item is not None and getattr(value, "ndim", 0) == 0:
+        value = item()
+        # np.item() yields Python scalars; keep only JSON-native results
+        if isinstance(value, (int, float, str, bool)):
+            return value
+    return value
+
+
 class MetricLogger:
     def __init__(self, logdir: Optional[str] = None, name: str = "train"):
         self.is_main = jax.process_index() == 0
@@ -59,6 +78,7 @@ class MetricLogger:
     def log(self, step: int, metrics: Dict[str, float]) -> None:
         if not self.is_main:
             return
+        metrics = {k: _to_scalar(v) for k, v in metrics.items()}
         record = {"step": step, "time": round(time.time() - self._t0, 3), **metrics}
         parts = " ".join(f"{k}={v:.5g}" for k, v in sorted(metrics.items()))
         self._emit(record, f"[step {step}] {parts}")

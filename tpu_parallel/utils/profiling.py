@@ -15,11 +15,15 @@ from typing import Callable, Dict, Optional
 
 import jax
 
-# Dense bf16 peak FLOPs/s per chip.
+# Dense bf16 peak FLOPs/s per chip.  Matching is SUBSTRING-in-device_kind,
+# so more-specific kinds must precede their prefixes ("tpu v4i" before
+# "tpu v4", "tpu v5p" before "tpu v5") — dicts iterate in insertion order.
 PEAK_FLOPS_BY_KIND = {
     "tpu v5 lite": 197e12,
     "tpu v5litepod": 197e12,
+    "tpu v5p": 459e12,
     "tpu v5": 197e12,
+    "tpu v4i": 138e12,
     "tpu v4": 275e12,
     "tpu v6 lite": 918e12,
     "tpu v6": 918e12,

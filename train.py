@@ -11,14 +11,31 @@ Usage:
 Any config field can be overridden on the CLI (``--config.steps=100``,
 ``--config.mesh.model=2`` ...) — the flag system the reference imported but
 never wired up (SURVEY.md §5, config/flag row).
+
+Telemetry (docs/11_observability.md): ``--trace-out PATH`` records
+per-step ``data_wait``/``compute`` spans and writes a Perfetto-openable
+Chrome trace at exit; ``--metrics-out PATH`` writes a Prometheus
+text-exposition snapshot of the trainer's metric registry (MFU,
+tokens/sec, loss gauges).
 """
 
 import os
+import sys
 
 from absl import app, flags, logging
 from ml_collections import config_flags
 
 _CONFIG = config_flags.DEFINE_config_file("config", None, "Training config file.")
+_TRACE_OUT = flags.DEFINE_string(
+    "trace_out", "",
+    "write a Chrome trace-event JSON of per-step data_wait/compute spans "
+    "here (opens in Perfetto; forces a per-step device fence)",
+)
+_METRICS_OUT = flags.DEFINE_string(
+    "metrics_out", "",
+    "write a Prometheus text-exposition snapshot of the trainer's metric "
+    "registry here at exit",
+)
 
 
 def main(argv):
@@ -61,7 +78,12 @@ def main(argv):
         "eval_fraction", 0.1 if (eval_steps or eval_every) else 0.0
     )
     config = TrainerConfig.from_config_dict(trainer_cd)
-    trainer = Trainer(config)
+    tracer = None
+    if _TRACE_OUT.value:
+        from tpu_parallel.obs import Tracer
+
+        tracer = Tracer()
+    trainer = Trainer(config, tracer=tracer)
     logging.info(
         "model=%s params=%.1fM mesh=%s",
         config.model,
@@ -132,7 +154,26 @@ def main(argv):
         eval_iter = iter(data_loader.eval_view()) if data_loader else None
         ev = trainer.evaluate(batch_iter=eval_iter, steps=eval_steps)
         logging.info("eval: %s", ev)
+    if tracer is not None:
+        from tpu_parallel.obs import write_chrome_trace
+
+        logging.info("trace: %s", write_chrome_trace(tracer, _TRACE_OUT.value))
+    if _METRICS_OUT.value:
+        from tpu_parallel.obs import write_prometheus
+
+        logging.info(
+            "metrics: %s",
+            write_prometheus(trainer.registry, _METRICS_OUT.value),
+        )
 
 
 if __name__ == "__main__":
+    # absl flags spell underscores; accept the GNU-style dashed forms the
+    # docs advertise (--trace-out / --metrics-out) too
+    sys.argv = [
+        a.replace("--trace-out", "--trace_out").replace(
+            "--metrics-out", "--metrics_out"
+        )
+        for a in sys.argv
+    ]
     app.run(main)
