@@ -1,0 +1,116 @@
+"""Static check: serving/cluster code never reads wall time directly.
+
+Every timestamp in ``tpu_parallel/serving/`` and ``tpu_parallel/cluster/``
+must flow through the INJECTABLE clock (the ``clock`` callable the engine,
+scheduler, tracer and cluster frontend all accept).  That is what makes
+queue-timeout, deadline, aging and failover tests deterministic — they
+advance a fake clock instead of sleeping — and what keeps every subsystem
+on ONE time axis (an engine on ``time.monotonic`` and a frontend on a
+fake clock would disagree about every deadline).  A direct
+``time.time()`` / ``time.monotonic()`` / ``time.perf_counter()`` call is
+a hole in that contract: code that works under pytest but measures
+something else in production.
+
+Like ``check_scopes.py``, the contract used to be prose; this makes it a
+tier-1 test (``tests/test_cluster.py::test_serving_time_flows_through_clock``).
+A REFERENCE to a clock function (``clock: Callable = time.monotonic`` as
+a default argument) is fine — only CALLS are flagged, because a call is
+a read of wall time while a reference is dependency injection of the
+default time source.
+
+Usage: ``python scripts/check_clock.py [paths...]`` — prints one
+``file:line: <call> bypasses the injectable clock`` per violation,
+exits nonzero on any.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List
+
+# direct wall-time reads; sleep is included because a sleeping serving
+# module is equally untestable on a fake clock
+CLOCK_CALLS = frozenset(
+    {"time", "monotonic", "perf_counter", "monotonic_ns", "time_ns",
+     "perf_counter_ns", "sleep"}
+)
+
+DEFAULT_PATHS = ("tpu_parallel/serving", "tpu_parallel/cluster")
+
+
+def check_source(source: str, filename: str) -> List[str]:
+    """Return ``file:line: message`` strings for every direct wall-time
+    CALL in ``source`` — ``time.<fn>()`` attribute calls, and bare
+    ``<fn>()`` calls when ``<fn>`` was imported from the time module."""
+    tree = ast.parse(source, filename=filename)
+    problems: List[str] = []
+
+    # names bound by `from time import monotonic [as mono]`
+    from_time: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for alias in node.names:
+                if alias.name in CLOCK_CALLS:
+                    from_time.add(alias.asname or alias.name)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        flagged = None
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr in CLOCK_CALLS
+            and isinstance(func.value, ast.Name)
+            and func.value.id == "time"
+        ):
+            flagged = f"time.{func.attr}"
+        elif isinstance(func, ast.Name) and func.id in from_time:
+            flagged = func.id
+        if flagged is not None:
+            problems.append(
+                f"{filename}:{node.lineno}: {flagged}() bypasses the "
+                "injectable clock"
+            )
+    return problems
+
+
+def check_paths(paths=DEFAULT_PATHS) -> List[str]:
+    problems: List[str] = []
+    for path in paths:
+        if os.path.isfile(path):
+            files = [path]
+        else:
+            files = sorted(
+                os.path.join(root, f)
+                for root, _, names in os.walk(path)
+                for f in names
+                if f.endswith(".py")
+            )
+        for fname in files:
+            with open(fname) as fh:
+                problems.extend(check_source(fh.read(), fname))
+    return problems
+
+
+def main(argv: List[str]) -> int:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    os.chdir(repo_root)
+    paths = argv[1:] or list(DEFAULT_PATHS)
+    problems = check_paths(paths)
+    for problem in problems:
+        print(problem, file=sys.stderr)
+    if problems:
+        print(
+            f"check_clock: {len(problems)} direct wall-time call(s)",
+            file=sys.stderr,
+        )
+        return 1
+    print("check_clock: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

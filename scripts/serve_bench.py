@@ -13,7 +13,20 @@ Usage:
       [--slots S] [--new T] [--prompt-min P] [--prompt-max P]
       [--prompt-dist] [--prefix-len P] [--buckets auto|off|B1,B2,...]
       [--chunk C] [--prefix-cache N] [--spec K] [--compare] [--smoke]
-      [--trace-out FILE] [--metrics-out FILE] [--seed K] [--out FILE]
+      [--replicas N] [--router rr|least|prefix[,...]] [--fault]
+      [--prefix-groups G] [--trace-out FILE] [--metrics-out FILE]
+      [--seed K] [--out FILE]
+
+``--replicas N`` (N > 1) switches to CLUSTER mode: N engine replicas
+behind the ``tpu_parallel.cluster`` Frontend, one record per (rate,
+router policy) — ``--router`` takes a comma list (rr, least, prefix) so
+one run compares policies on identical workloads (TTFT p95, aggregate
+prefix hit rate, retries).  ``--prefix-groups G`` shapes the workload as
+G distinct shared system-headers assigned randomly across requests — the
+repeated-prefix stream prefix-affinity routing exists for.  ``--fault``
+arms a FaultPlan that CRASHES one replica mid-run; the record then also
+shows the retry/failover cost (every request still completes, replayed
+via forced-prefix re-prefill on the survivors).
 
 ``--trace-out`` records every measured point's request lifecycles
 (queue -> prefill[/chunk] -> decode/verify -> finish, one Perfetto track
@@ -56,16 +69,30 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 
 
-def make_prompts(cfg, *, n_requests, prompt_min, prompt_max, prefix_len, seed):
-    """Random prompts; with ``prefix_len`` > 0 every prompt shares one
-    random system-header and [prompt_min, prompt_max] sizes the SUFFIX."""
+def make_prompts(cfg, *, n_requests, prompt_min, prompt_max, prefix_len,
+                 seed, prefix_groups=1):
+    """Random prompts; with ``prefix_len`` > 0 every prompt opens with one
+    of ``prefix_groups`` random system-headers (assigned randomly, so
+    routing policy — not submission order — decides placement) and
+    [prompt_min, prompt_max] sizes the SUFFIX."""
     rnd = random.Random(seed)
-    prefix = [rnd.randrange(1, cfg.vocab_size) for _ in range(prefix_len)]
+    headers = [
+        [rnd.randrange(1, cfg.vocab_size) for _ in range(prefix_len)]
+        for _ in range(max(1, prefix_groups))
+    ]
     prompts = []
     for _ in range(n_requests):
         n = rnd.randint(prompt_min, prompt_max)
+        # single-group draws NO group index, preserving the exact RNG
+        # stream (and therefore the workload) of pre-cluster SERVE_r01/
+        # r02 records at the same --seed
+        header = (
+            headers[0]
+            if len(headers) == 1
+            else headers[rnd.randrange(len(headers))]
+        )
         prompts.append(
-            prefix + [rnd.randrange(1, cfg.vocab_size) for _ in range(n)]
+            header + [rnd.randrange(1, cfg.vocab_size) for _ in range(n)]
         )
     return prompts
 
@@ -170,6 +197,114 @@ def run_point(model, params, cfg, prompts, *, rate, n_slots, new_tokens,
             n_requests * new_tokens / wall, 1
         ),
         **summary,
+    }
+
+
+def run_cluster_point(model, params, cfg, prompts, *, rate, n_replicas,
+                      router, n_slots, new_tokens, seed, engine_kwargs,
+                      fault=False, warm=True, tracer=None):
+    """One cluster-mode measurement: ``n_replicas`` engines behind the
+    Frontend under the given router policy, same Poisson arrival stream
+    as :func:`run_point`.  ``fault=True`` arms a FaultPlan crashing
+    replica 0 mid-run (the survivors absorb its work via forced-prefix
+    retries).  Engine jits are shared per model, so ``warm`` drives one
+    throwaway frontend to compile everything outside the measured
+    window."""
+    from tpu_parallel.cluster import FaultPlan, Frontend, ReplicaHandle
+    from tpu_parallel.serving import Request, SchedulerConfig, ServingEngine
+
+    def make_engines():
+        return [
+            ServingEngine(
+                model, params, n_slots=n_slots,
+                scheduler=SchedulerConfig(max_prefills_per_tick=2),
+                rng=jax.random.PRNGKey(seed + 1000 * i),
+                **engine_kwargs,
+            )
+            for i in range(n_replicas)
+        ]
+
+    if warm:
+        fe = Frontend(make_engines(), router=router)
+        for p in prompts:
+            fe.submit(Request(prompt=p, max_new_tokens=2))
+        fe.run()
+
+    rnd = random.Random(seed)
+    arrivals, t = [], 0.0
+    for _ in range(len(prompts)):
+        arrivals.append(t)
+        if rate > 0:
+            t += rnd.expovariate(rate)
+
+    handles = []
+    for i, eng in enumerate(make_engines()):
+        plan = (
+            FaultPlan(crash_at_tick=8) if (fault and i == 0) else None
+        )
+        handles.append(ReplicaHandle(i, eng, fault_plan=plan))
+    fe = Frontend(handles, router=router, tracer=tracer)
+
+    t0 = time.perf_counter()
+    outs, submitted = [], 0
+    n_requests = len(prompts)
+    while submitted < n_requests or fe.has_work():
+        now = time.perf_counter() - t0
+        while submitted < n_requests and arrivals[submitted] <= now:
+            outs.append(
+                fe.submit(
+                    Request(
+                        prompt=prompts[submitted],
+                        max_new_tokens=new_tokens,
+                    )
+                )
+            )
+            submitted += 1
+        if fe.has_work():
+            fe.step()
+        else:
+            time.sleep(
+                max(0.0, arrivals[submitted] - (time.perf_counter() - t0))
+            )
+    wall = time.perf_counter() - t0
+    assert all(out.status == "finished" for out in outs), (
+        [out.status for out in outs]
+    )
+
+    s = fe.summary()
+    lengths = [len(p) for p in prompts]
+    tokens_out = sum(
+        h.engine.metrics.tokens_out for h in fe.replicas
+    )
+    return fe, {
+        "bench": "serve_cluster",
+        "model": getattr(cfg, "_name", None) or (
+            "gpt2_125m" if jax.default_backend() == "tpu" else "tiny"
+        ),
+        "backend": jax.default_backend(),
+        "router": s["router"],
+        "replicas": n_replicas,
+        "fault": bool(fault),
+        "n_requests": n_requests,
+        "arrival_rate_per_sec": rate if rate > 0 else "all_at_once",
+        "n_slots": n_slots,
+        "prompt_len": [min(lengths), max(lengths)],
+        "new_tokens": new_tokens,
+        "prefix_cache_size": engine_kwargs.get("prefix_cache_size", 0),
+        "draft_tokens": engine_kwargs.get("draft_tokens", 0),
+        "wall_s": round(wall, 3),
+        "tokens_out": tokens_out,
+        "request_tokens_per_sec": round(
+            n_requests * new_tokens / wall, 1
+        ),
+        "finished": s["finished"],
+        "retries": s["retries"],
+        "requeued": s["requeued"],
+        "replica_deaths": s["replica_deaths"],
+        "prefix_hit_rate": s["prefix_hit_rate"],
+        "ttft_ms_p50": s["ttft_ms_p50"],
+        "ttft_ms_p95": s["ttft_ms_p95"],
+        "e2e_ms_p95": s["e2e_ms_p95"],
     }
 
 
@@ -291,6 +426,19 @@ def main():
                     help="speculative decode draft tokens (0 = off); the "
                          "record then carries acceptance rate and "
                          "tokens_per_decode_tick")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="engine replicas behind the cluster frontend "
+                         "(1 = single-engine mode, the pre-cluster bench)")
+    ap.add_argument("--router", type=str, default="least",
+                    help="cluster routing policy or comma list to "
+                         "compare: rr | least | prefix")
+    ap.add_argument("--fault", action="store_true",
+                    help="cluster mode: crash replica 0 mid-run via a "
+                         "FaultPlan; records the failover cost")
+    ap.add_argument("--prefix-groups", type=int, default=4,
+                    help="distinct shared system-headers in the "
+                         "--prompt-dist workload (cluster mode: the "
+                         "prefix-affinity placement unit)")
     ap.add_argument("--compare", action="store_true",
                     help="emit every point twice: exact (SERVE_r01 "
                          "config) vs the requested fast path")
@@ -341,6 +489,7 @@ def main():
     prompts = make_prompts(
         cfg, n_requests=args.requests, prompt_min=prompt_min,
         prompt_max=prompt_max, prefix_len=prefix_len, seed=args.seed,
+        prefix_groups=(args.prefix_groups if args.prompt_dist else 1),
     )
 
     if args.smoke:
@@ -372,6 +521,49 @@ def main():
     if args.spec > 0:
         fast["draft_tokens"] = args.spec
         fast_label += "+spec"
+
+    if args.replicas > 1:
+        # cluster mode: one record per (rate, router policy) on the SAME
+        # workload, so policies compare apples to apples (--compare is a
+        # single-engine knob; the policy list IS the comparison here)
+        if args.compare:
+            print(
+                "serve_bench: --compare ignored with --replicas > 1 "
+                "(compare router policies via --router rr,least,prefix)",
+                file=sys.stderr,
+            )
+        tracer = None
+        if args.trace_out:
+            from tpu_parallel.obs import Tracer
+
+            tracer = Tracer()
+        logger = MetricLogger(logdir=".", name=args.out)
+        warm = True
+        fe = None
+        for rate in (float(r) for r in args.rate.split(",")):
+            for policy in args.router.split(","):
+                fe, record = run_cluster_point(
+                    model, params, cfg, prompts,
+                    rate=rate, n_replicas=args.replicas, router=policy,
+                    n_slots=args.slots, new_tokens=new_tokens,
+                    seed=args.seed, engine_kwargs=dict(fast),
+                    fault=args.fault, warm=warm, tracer=tracer,
+                )
+                warm = False  # jits shared per model: warm once
+                logger.log_record(record)
+        logger.close()
+        if tracer is not None:
+            from tpu_parallel.obs import write_chrome_trace
+
+            print(f"trace: {write_chrome_trace(tracer, args.trace_out)}")
+        if args.metrics_out and fe is not None:
+            from tpu_parallel.obs import write_prometheus
+
+            print(
+                "metrics: "
+                f"{write_prometheus(fe.registry, args.metrics_out)}"
+            )
+        return
 
     configs = [(fast_label, fast)]
     if args.compare and fast_label != "exact":

@@ -155,7 +155,7 @@ def test_admission_control_rejects_when_full(rng):
     b = eng.add_request(_req(prompt[1], 6))
     c = eng.add_request(_req(prompt[2], 6))
     assert b.status == "queued"
-    assert c.status == REJECTED and c.finish_reason == "queue full"
+    assert c.status == REJECTED and c.finish_reason == "queue_full"
     eng.run()
     assert a.status == FINISHED and b.status == FINISHED
     assert c.tokens == []
@@ -260,7 +260,8 @@ def test_capacity_rejected_at_submit(rng):
     cfg, model, prompt, params = _build(rng, n_rows=1)
     eng = ServingEngine(model, params, n_slots=1)
     out = eng.add_request(_req(prompt[0], cfg.seq_len))
-    assert out.status == REJECTED and "seq_len" in out.finish_reason
+    assert out.status == REJECTED and out.finish_reason == "capacity"
+    assert "seq_len" in out.detail
 
 
 def test_scheduler_policies_host_only():

@@ -1,0 +1,53 @@
+"""Replicated serving cluster: a router + admission-control frontend
+driving N continuous-batching engine replicas with a fault-tolerant
+request lifecycle (docs/12_cluster.md).
+
+``Frontend.submit()/step()/drain()`` is the whole surface: pluggable
+routing (round-robin / least-loaded / prefix-affinity consistent
+hashing), token-budget backpressure with typed rejections, priority
+classes with anti-starvation aging, per-request deadlines that cancel
+in-engine work, and replica-death retries that replay delivered tokens
+as a forced prefix so streamed output stays exactly consistent.
+"""
+
+from tpu_parallel.cluster.frontend import (
+    ClusterOutput,
+    Frontend,
+    FrontendConfig,
+)
+from tpu_parallel.cluster.replica import (
+    DEAD,
+    DEGRADED,
+    HEALTHY,
+    FaultPlan,
+    ReplicaDead,
+    ReplicaHandle,
+)
+from tpu_parallel.cluster.router import (
+    LeastLoadedRouter,
+    PrefixAffinityRouter,
+    RoundRobinRouter,
+    Router,
+    least_loaded,
+    make_router,
+    prefix_route_key,
+)
+
+__all__ = [
+    "Frontend",
+    "FrontendConfig",
+    "ClusterOutput",
+    "ReplicaHandle",
+    "ReplicaDead",
+    "FaultPlan",
+    "HEALTHY",
+    "DEGRADED",
+    "DEAD",
+    "Router",
+    "RoundRobinRouter",
+    "LeastLoadedRouter",
+    "PrefixAffinityRouter",
+    "least_loaded",
+    "make_router",
+    "prefix_route_key",
+]

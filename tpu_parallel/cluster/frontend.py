@@ -1,0 +1,679 @@
+"""The cluster frontend: one submit/step/drain surface over N replicas.
+
+This is the piece that turns a pile of :class:`~tpu_parallel.serving.
+engine.ServingEngine` replicas into something a service can sit behind.
+``submit()`` is the cluster's ONE admission gate; everything past it is
+accepted work the frontend is responsible for finishing — on whichever
+replica, after however many failures:
+
+- **Admission control** (all typed, same ``finish_reason`` vocabulary as
+  the engine): global token-budget backpressure (``token_budget`` — the
+  sum of every open request's ``prompt + max_new_tokens`` reservation is
+  capped, the scale-out generalization of the scheduler's ``max_queue``),
+  per-client concurrency caps (``client_limit``), capacity (``capacity``)
+  and the drain gate (``draining``).
+- **Priority with aging**: dispatch order is effective priority =
+  ``priority + waited / aging_seconds`` — higher classes go first, but
+  every pending request gains one priority class per ``aging_seconds``
+  waited, so a starving low-priority request provably overtakes any
+  fixed-priority flood (the no-starvation test pins this).
+- **Deadlines**: a request past ``deadline`` seconds from arrival is
+  cancelled WHEREVER it is — pending here, queued in a replica, or
+  holding a cache slot mid-decode (``ServingEngine.cancel`` releases the
+  slot) — with a tokenless terminal event, because a reply the client
+  stopped waiting for is pure wasted compute.
+- **Fault-tolerant lifecycle**: a replica death (fault plan or real
+  exception) orphans its queued AND running requests; each is re-routed
+  with the dead replica excluded and its prompt FORCED-PREFIXED with the
+  tokens already streamed (``prompt + delivered``), so the retry re-
+  prefills exactly the context the dead replica had and greedy output is
+  bitwise identical to a never-failed run — the stream just continues.
+  Tokens are never re-streamed and never lost.  ``retry_limit`` bounds
+  the replay of a request that keeps landing on dying replicas
+  (``failed``/``retry_limit``), and a cluster with no live replica fails
+  pending work loudly (``no_replica``) instead of queueing forever.
+- **Graceful drain**: ``drain()`` closes the admission gate, pulls every
+  replica's QUEUED remainder back and re-routes it across live replicas
+  (the queue stuck behind one busy engine redistributes), then ticks
+  until all in-flight work finishes.  Every cache slot comes back free —
+  the acceptance suite asserts slot counts and table alignment.
+
+Observability: the frontend owns its own ``cluster_*`` metric namespace
+(per-replica load/health gauges labeled by replica, typed rejection and
+dispatch-reject counters, retry/requeue/cancel counters, a route-
+imbalance histogram, TTFT/E2E latency histograms) and traces routing
+decisions, deaths, retries and drains on a dedicated ``router`` tracer
+track alongside the engines' per-slot tracks.  Engine registries stay
+per-replica — their unlabeled ``serving_*`` series would collide across
+replicas in one store.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+from tpu_parallel.cluster.replica import (
+    DEAD,
+    DEGRADED,
+    HEALTHY,
+    ReplicaDead,
+    ReplicaHandle,
+)
+from tpu_parallel.cluster.router import (
+    PrefixAffinityRouter,
+    Router,
+    make_router,
+)
+from tpu_parallel.obs.registry import MetricRegistry
+from tpu_parallel.obs.tracer import NULL_TRACER, Tracer
+from tpu_parallel.serving.engine import ServingEngine
+from tpu_parallel.serving.request import (
+    CANCELLED,
+    EXPIRED,
+    FAILED,
+    FINISHED,
+    REJECT_CAPACITY,
+    REJECT_CLIENT_LIMIT,
+    REJECT_DRAINING,
+    REJECT_TOKEN_BUDGET,
+    REJECTED,
+    RUNNING,
+    Request,
+    RequestOutput,
+    StreamEvent,
+)
+
+_HEALTH_CODE = {HEALTHY: 0.0, DEGRADED: 1.0, DEAD: 2.0}
+
+
+@dataclasses.dataclass(frozen=True)
+class FrontendConfig:
+    """Admission-control and retry policy knobs.
+
+    - ``max_inflight_tokens``: global token-budget backpressure — the sum
+      of ``len(prompt) + max_new_tokens`` over every OPEN request (from
+      accept to terminal) may not exceed this; beyond it ``submit``
+      rejects typed ``token_budget``.  None = unbounded.
+    - ``max_per_client``: per-``client_id`` cap on open requests
+      (requests without a ``client_id`` are uncapped).
+    - ``aging_seconds``: a pending request gains one effective priority
+      class per this many seconds waited — the anti-starvation dial
+      (must be > 0; infinity-like values approximate strict priority).
+    - ``retry_limit``: replica-death replays allowed per request before
+      it fails with ``retry_limit``.
+    - ``dispatch_queue_depth``: how deep a replica's engine queue the
+      frontend will dispatch into (None = the replica's slot count).
+      This is LATE BINDING, and priority depends on it: a request handed
+      to an engine joins a FIFO the frontend can no longer reorder, so
+      the frontend keeps just enough queued per replica to refill every
+      slot and holds the rest HERE, where effective priority (with
+      aging) re-sorts the backlog every tick.
+    """
+
+    max_inflight_tokens: Optional[int] = None
+    max_per_client: Optional[int] = None
+    aging_seconds: float = 10.0
+    retry_limit: int = 3
+    dispatch_queue_depth: Optional[int] = None
+
+    def __post_init__(self):
+        if self.aging_seconds <= 0:
+            raise ValueError(f"aging_seconds={self.aging_seconds} <= 0")
+        if self.retry_limit < 0:
+            raise ValueError(f"retry_limit={self.retry_limit} < 0")
+        if self.dispatch_queue_depth is not None and (
+            self.dispatch_queue_depth < 1
+        ):
+            raise ValueError(
+                f"dispatch_queue_depth={self.dispatch_queue_depth} < 1"
+            )
+
+
+@dataclasses.dataclass
+class ClusterOutput(RequestOutput):
+    """The client-visible record: a :class:`RequestOutput` whose tokens
+    accumulate ACROSS replica attempts, plus the attempt history."""
+
+    replicas: List[int] = dataclasses.field(default_factory=list)
+    retries: int = 0
+
+
+class _ClientState:
+    """Frontend-internal bookkeeping for one accepted request."""
+
+    __slots__ = (
+        "out", "seq", "budget", "excluded", "handle", "engine_rid", "base",
+    )
+
+    def __init__(self, out: ClusterOutput, seq: int, budget: int):
+        self.out = out
+        self.seq = seq
+        self.budget = budget  # reserved tokens (prompt + max_new)
+        self.excluded: set = set()  # replica ids this request must avoid
+        self.handle: Optional[ReplicaHandle] = None  # current attempt
+        self.engine_rid: Optional[str] = None
+        self.base = 0  # tokens delivered before the current attempt
+
+
+class Frontend:
+    """Replicated serving frontend (see the module docstring).
+
+    ``replicas`` is a sequence of :class:`ReplicaHandle` (or bare
+    :class:`ServingEngine`, wrapped with ids 0..N-1 and no fault plan).
+    ``router`` is a policy name (``rr`` / ``least`` / ``prefix``) or a
+    ready :class:`Router`; the prefix policy reads its bucket alignment
+    from replica 0's engine.  ``clock`` is injectable — every timestamp
+    in the frontend flows through it (``scripts/check_clock.py`` enforces
+    that no cluster/serving module reads wall time directly).
+    """
+
+    def __init__(
+        self,
+        replicas: Sequence[Union[ReplicaHandle, ServingEngine]],
+        router: Union[str, Router] = "least",
+        config: Optional[FrontendConfig] = None,
+        clock: Callable[[], float] = time.monotonic,
+        tracer: Optional[Tracer] = None,
+        registry: Optional[MetricRegistry] = None,
+    ):
+        if not replicas:
+            raise ValueError("Frontend needs at least one replica")
+        handles: List[ReplicaHandle] = []
+        for i, rep in enumerate(replicas):
+            if isinstance(rep, ReplicaHandle):
+                handles.append(rep)
+            else:
+                handles.append(ReplicaHandle(i, rep))
+        ids = [h.replica_id for h in handles]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate replica ids {ids}")
+        self.replicas = sorted(handles, key=lambda h: h.replica_id)
+        self.config = config or FrontendConfig()
+        self.clock = clock
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        self.registry = registry if registry is not None else MetricRegistry()
+        if isinstance(router, str):
+            buckets = self.replicas[0].engine.prefill_buckets
+            router = make_router(router, ids, buckets=buckets)
+        self.router = router
+        self.draining = False
+        self._seq = itertools.count()
+        self._pending: List[_ClientState] = []
+        self._by_attempt: Dict[str, _ClientState] = {}
+        self._reserved = 0  # open token-budget reservations
+        self._events: List[StreamEvent] = []
+        r = self.registry
+        self._submitted = r.counter("cluster_submitted_total")
+        self._finished = r.counter("cluster_finished_total")
+        self._retries = r.counter("cluster_retries_total")
+        self._requeued = r.counter("cluster_requeued_total")
+        self._cancelled = r.counter("cluster_cancelled_total")
+        self._failed = r.counter("cluster_failed_total")
+        self._deaths = r.counter("cluster_replica_deaths_total")
+        self._imbalance = r.histogram("cluster_route_imbalance")
+        self._ttft = r.histogram("cluster_ttft_seconds")
+        self._e2e = r.histogram("cluster_e2e_seconds")
+
+    # -- admission ---------------------------------------------------------
+
+    @property
+    def seq_len(self) -> int:
+        return self.replicas[0].engine.model.config.seq_len
+
+    def _open_states(self) -> List[_ClientState]:
+        return self._pending + list(self._by_attempt.values())
+
+    def submit(self, request: Request) -> ClusterOutput:
+        """The cluster's admission gate.  Returns the live record; a
+        REJECTED status carries the typed reason (``draining`` /
+        ``capacity`` / ``client_limit`` / ``token_budget``)."""
+        now = self.clock()
+        out = ClusterOutput(request=request, arrival_time=now)
+        self._submitted.inc()
+
+        def reject(reason: str, detail: Optional[str] = None):
+            out.status = REJECTED
+            out.finish_reason = reason
+            out.detail = detail
+            self.registry.counter(
+                "cluster_rejected_total", reason=reason
+            ).inc()
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "reject", track="router",
+                    request_id=request.request_id, reason=reason,
+                )
+            return out
+
+        if self.draining:
+            return reject(REJECT_DRAINING)
+        need = len(request.prompt) + request.max_new_tokens
+        if need > self.seq_len:
+            return reject(
+                REJECT_CAPACITY,
+                detail=(
+                    f"prompt ({len(request.prompt)}) + max_new_tokens "
+                    f"({request.max_new_tokens}) exceeds seq_len "
+                    f"({self.seq_len})"
+                ),
+            )
+        cfg = self.config
+        if cfg.max_per_client is not None and request.client_id is not None:
+            open_for_client = sum(
+                1
+                for st in self._open_states()
+                if st.out.request.client_id == request.client_id
+            )
+            if open_for_client >= cfg.max_per_client:
+                return reject(REJECT_CLIENT_LIMIT)
+        if (
+            cfg.max_inflight_tokens is not None
+            and self._reserved + need > cfg.max_inflight_tokens
+        ):
+            return reject(REJECT_TOKEN_BUDGET)
+        self._reserved += need
+        self._pending.append(_ClientState(out, next(self._seq), need))
+        return out
+
+    # -- the tick ----------------------------------------------------------
+
+    def step(self) -> List[StreamEvent]:
+        """One cluster tick: enforce deadlines, dispatch pending work
+        through the router, tick every live replica (deaths collected and
+        their work re-routed THIS tick), publish per-replica telemetry.
+        Returns the tick's cluster-level StreamEvents (client request
+        ids, cluster token indices)."""
+        now = self.clock()
+        self._events = []
+        self._enforce_deadlines(now)
+        self._dispatch(now)
+        for handle in self.replicas:
+            if handle.health == DEAD:
+                continue
+            try:
+                handle.step()
+            except ReplicaDead:
+                self._on_death(handle)
+        # re-place retries and bounced attempts without losing a tick
+        self._dispatch(self.clock())
+        if all(h.health == DEAD for h in self.replicas):
+            for st in list(self._pending):
+                self._pending.remove(st)
+                self._finalize(st, FAILED, "no_replica", self.clock())
+                self._failed.inc()
+                self._emit_terminal(st, "no_replica")
+        self._publish()
+        events, self._events = self._events, []
+        return events
+
+    def has_work(self) -> bool:
+        return bool(self._pending) or bool(self._by_attempt)
+
+    def run(self, max_ticks: Optional[int] = None) -> List[StreamEvent]:
+        """Tick until every accepted request is terminal (or ``max_ticks``)."""
+        events: List[StreamEvent] = []
+        ticks = 0
+        while self.has_work() and (max_ticks is None or ticks < max_ticks):
+            events.extend(self.step())
+            ticks += 1
+        return events
+
+    def drain(self, max_ticks: Optional[int] = None) -> List[StreamEvent]:
+        """Graceful shutdown: stop admitting (typed ``draining``
+        rejections), gate every live engine, pull the engines' queued
+        remainders back and re-route them across live replicas, then run
+        to completion.  On return every accepted request is terminal and
+        every replica's cache pool is fully released."""
+        self.draining = True
+        span = (
+            self.tracer.span("drain", track="router")
+            if self.tracer.enabled
+            else None
+        )
+        for handle in self.replicas:
+            if handle.health == DEAD:
+                continue
+            handle.engine.begin_drain()
+        for handle in self.replicas:
+            if handle.health == DEAD:
+                continue
+            for eout in handle.take_queued():
+                st = self._by_attempt.pop(eout.request.request_id, None)
+                if st is None or st.out.done:
+                    continue
+                st.handle = None
+                st.engine_rid = None
+                self._requeued.inc()
+                self._pending.append(st)
+        events = self.run(max_ticks)
+        if span is not None:
+            span.finish(requeued=int(self._requeued.value))
+        return events
+
+    def cancel(self, request_id: str, reason: str = "cancelled") -> bool:
+        """Client-initiated cancellation by CLUSTER request id — pending,
+        queued-in-replica, or mid-decode alike.  False if unknown/done."""
+        for st in self._open_states():
+            if st.out.request.request_id == request_id and not st.out.done:
+                self._cancel_state(st, reason, self.clock())
+                return True
+        return False
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _dispatch_depth(self, handle: ReplicaHandle) -> int:
+        """Per-replica dispatch bound (see ``dispatch_queue_depth``)."""
+        if self.config.dispatch_queue_depth is not None:
+            return self.config.dispatch_queue_depth
+        return handle.engine.pool.n_slots
+
+    def _effective_priority(self, st: _ClientState, now: float) -> float:
+        arrival = st.out.arrival_time
+        waited = max(0.0, now - arrival) if arrival is not None else 0.0
+        return st.out.request.priority + waited / self.config.aging_seconds
+
+    def _dispatch(self, now: float) -> None:
+        if not self._pending:
+            return
+        order = sorted(
+            self._pending,
+            key=lambda st: (-self._effective_priority(st, now), st.seq),
+        )
+        leftover = []
+        for st in order:
+            if not self._try_place(st, now):
+                leftover.append(st)
+        self._pending = leftover
+
+    def _try_place(self, st: _ClientState, now: float) -> bool:
+        """Route one pending request: the policy picks among routable
+        candidates (healthy preferred over degraded, exclusions and
+        non-accepting replicas filtered), synchronous engine rejections
+        (queue_full) exclude that replica FOR THIS PASS and re-route.
+        False leaves the request pending for the next tick."""
+        req = st.out.request
+        tried: set = set()
+        while True:
+            cands = [
+                h
+                for h in self.replicas
+                if h.routable
+                and h.queue_depth < self._dispatch_depth(h)
+                and h.replica_id not in st.excluded
+                and h.replica_id not in tried
+            ]
+            healthy = [h for h in cands if h.health == HEALTHY]
+            cands = healthy or cands
+            pick = self.router.route(req.prompt, cands)
+            if pick is None:
+                return False
+            loads = [h.load() for h in cands]
+            self._imbalance.observe(pick.load() - min(loads))
+            ereq = self._attempt_request(st)
+            # requeue=True: frontend-accepted work being PLACED is not a
+            # new admission from the engine's point of view — the drain
+            # gate guards direct engine submissions, the frontend's gate
+            # already guarded this one
+            eout = pick.submit(
+                ereq, requeue=True, arrival_time=st.out.arrival_time
+            )
+            if eout.done:  # synchronous engine rejection (queue_full)
+                self.registry.counter(
+                    "cluster_dispatch_rejects_total",
+                    reason=eout.finish_reason or "unknown",
+                ).inc()
+                if self.tracer.enabled:
+                    self.tracer.instant(
+                        "dispatch_reject", track="router",
+                        request_id=req.request_id,
+                        replica=pick.replica_id,
+                        reason=eout.finish_reason,
+                    )
+                tried.add(pick.replica_id)
+                continue
+            if isinstance(self.router, PrefixAffinityRouter):
+                # the router counts overload fallbacks it decides itself;
+                # spills it never SAW — the hash-owner filtered out of
+                # the candidate list by the dispatch bound, an exclusion
+                # or death — are counted here, so the fallback gauge is
+                # meaningful under the frontend's pre-filtering too
+                owner = self.router.owner(req.prompt)
+                if owner != pick.replica_id and owner not in {
+                    c.replica_id for c in cands
+                }:
+                    self.router.fallbacks += 1
+            st.handle = pick
+            st.engine_rid = ereq.request_id
+            st.out.replicas.append(pick.replica_id)
+            self._by_attempt[ereq.request_id] = st
+            self.registry.counter(
+                "cluster_dispatched_total", replica=pick.replica_id
+            ).inc()
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "route", track="router", request_id=req.request_id,
+                    replica=pick.replica_id, policy=self.router.name,
+                    attempt=len(st.out.replicas),
+                )
+            return True
+
+    def _attempt_request(self, st: _ClientState) -> Request:
+        """Build the engine-level request for the next attempt: the
+        prompt is FORCED-PREFIXED with every token already delivered, so
+        a replay re-prefills exactly the context the previous replica
+        held and the stream continues bit-for-bit (greedy) where it
+        stopped.  The attempt's budget is the REMAINDER, so engine-side
+        length retirement equals cluster-side length retirement."""
+        req = st.out.request
+        st.base = len(st.out.tokens)
+        return Request(
+            prompt=list(req.prompt) + list(st.out.tokens),
+            max_new_tokens=req.max_new_tokens - st.base,
+            sampling=req.sampling,
+            eos_token_id=req.eos_token_id,
+            request_id=f"{req.request_id}@{len(st.out.replicas)}",
+            draft_tokens=req.draft_tokens,
+            on_token=self._make_on_token(st),
+        )
+
+    def _make_on_token(self, st: _ClientState):
+        def on_token(ev: StreamEvent) -> None:
+            if st.out.done:
+                return  # frontend already finalized (cancel/deadline)
+            if ev.token < 0:
+                # attempt-level terminal notification without a token
+                # (engine queue expiry): the attempt died before
+                # producing.  Each bounce COUNTS AGAINST retry_limit —
+                # the retry preserves the original arrival, so on an
+                # engine whose max_wait the request has already blown it
+                # would expire again every tick, forever.  Past the
+                # limit the request terminates EXPIRED instead of
+                # livelocking run()/drain().
+                if st.handle is None:
+                    return
+                self._by_attempt.pop(st.engine_rid, None)
+                st.handle = None
+                st.engine_rid = None
+                st.out.retries += 1
+                self._retries.inc()
+                if st.out.retries > self.config.retry_limit:
+                    self._finalize(st, EXPIRED, "max_wait", self.clock())
+                    self._emit_terminal(st, "max_wait")
+                    return
+                self._requeued.inc()
+                self._pending.append(st)
+                return
+            now = self.clock()
+            index = st.base + ev.index
+            if st.out.first_token_time is None:
+                st.out.first_token_time = now
+            st.out.status = RUNNING
+            st.out.tokens.append(ev.token)
+            st.out.token_times.append(now)
+            cev = StreamEvent(
+                request_id=st.out.request.request_id,
+                token=ev.token,
+                index=index,
+                finished=ev.finished,
+                finish_reason=ev.finish_reason,
+            )
+            if ev.finished:
+                self._finalize(st, FINISHED, ev.finish_reason, now)
+                self._finished.inc()
+                if st.out.ttft is not None:
+                    self._ttft.observe(st.out.ttft)
+                self._e2e.observe(now - st.out.arrival_time)
+            self._events.append(cev)
+            if st.out.request.on_token is not None:
+                st.out.request.on_token(cev)
+
+        return on_token
+
+    # -- failure / cancellation -------------------------------------------
+
+    def _on_death(self, handle: ReplicaHandle) -> None:
+        """A replica died mid-tick: exclude it for every orphaned request
+        and replay each (forced-prefix) elsewhere; requests out of
+        retries fail loudly."""
+        now = self.clock()
+        self._deaths.inc()
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "replica_death", track="router", replica=handle.replica_id,
+                orphans=len(handle.orphans()),
+            )
+        for eout in handle.orphans():
+            st = self._by_attempt.pop(eout.request.request_id, None)
+            if st is None or st.out.done:
+                continue
+            st.excluded.add(handle.replica_id)
+            st.handle = None
+            st.engine_rid = None
+            st.out.retries += 1
+            self._retries.inc()
+            if st.out.retries > self.config.retry_limit:
+                self._finalize(st, FAILED, "retry_limit", now)
+                self._failed.inc()
+                self._emit_terminal(st, "retry_limit")
+                continue
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "retry", track="router",
+                    request_id=st.out.request.request_id,
+                    from_replica=handle.replica_id,
+                    delivered=len(st.out.tokens),
+                )
+            self._pending.append(st)
+
+    def _enforce_deadlines(self, now: float) -> None:
+        for st in self._open_states():
+            deadline = st.out.request.deadline
+            if deadline is None or st.out.done:
+                continue
+            if now - st.out.arrival_time > deadline:
+                self._cancel_state(st, "deadline", now)
+
+    def _cancel_state(self, st: _ClientState, reason: str, now: float) -> None:
+        """Cancel wherever the request is.  Finalizes the cluster record
+        FIRST so the engine's own cancel notification no-ops in the
+        attempt callback, then releases any in-engine work (slot freed)."""
+        handle, engine_rid = st.handle, st.engine_rid
+        if st in self._pending:
+            self._pending.remove(st)
+        self._finalize(st, CANCELLED, reason, now)
+        if handle is not None and handle.health != DEAD:
+            handle.engine.cancel(engine_rid, reason=reason)
+            handle.forget(engine_rid)
+        self._cancelled.inc()
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "cancel", track="router",
+                request_id=st.out.request.request_id, reason=reason,
+            )
+        self._emit_terminal(st, reason)
+
+    def _finalize(
+        self, st: _ClientState, status: str, reason: Optional[str], now: float
+    ) -> None:
+        st.out.status = status
+        st.out.finish_reason = reason
+        st.out.finish_time = now
+        if st.engine_rid is not None:
+            self._by_attempt.pop(st.engine_rid, None)
+        st.handle = None
+        st.engine_rid = None
+        self._reserved -= st.budget
+
+    def _emit_terminal(self, st: _ClientState, reason: str) -> None:
+        event = StreamEvent(
+            request_id=st.out.request.request_id,
+            token=-1,
+            index=-1,
+            finished=True,
+            finish_reason=reason,
+        )
+        self._events.append(event)
+        if st.out.request.on_token is not None:
+            st.out.request.on_token(event)
+
+    # -- telemetry ---------------------------------------------------------
+
+    def _publish(self) -> None:
+        r = self.registry
+        for h in self.replicas:
+            lab = {"replica": h.replica_id}
+            r.gauge("cluster_replica_health", **lab).set(
+                _HEALTH_CODE[h.health]
+            )
+            r.gauge("cluster_replica_load", **lab).set(
+                0.0 if h.health == DEAD else h.load()
+            )
+            r.gauge("cluster_replica_queue_depth", **lab).set(h.queue_depth)
+            r.gauge("cluster_replica_active_slots", **lab).set(h.active_slots)
+        r.gauge("cluster_inflight_tokens").set(self._reserved)
+        r.gauge("cluster_pending_requests").set(len(self._pending))
+        if isinstance(self.router, PrefixAffinityRouter):
+            r.gauge("cluster_affinity_fallbacks").set(self.router.fallbacks)
+
+    def prefix_hit_rate(self) -> Optional[float]:
+        """Aggregate prefix-cache hit rate across every replica whose
+        engine runs a prefix cache (None when none do or nothing probed) —
+        the number prefix-affinity routing exists to maximize."""
+        hits = misses = 0
+        for h in self.replicas:
+            pc = h.engine._prefix
+            if pc is not None:
+                hits += pc.hits
+                misses += pc.misses
+        probes = hits + misses
+        if probes == 0:
+            return None
+        return hits / probes
+
+    def summary(self) -> dict:
+        hit_rate = self.prefix_hit_rate()
+        return {
+            "replicas": [h.summary() for h in self.replicas],
+            "router": self.router.name,
+            "submitted": int(self._submitted.value),
+            "finished": int(self._finished.value),
+            "retries": int(self._retries.value),
+            "requeued": int(self._requeued.value),
+            "cancelled": int(self._cancelled.value),
+            "failed": int(self._failed.value),
+            "replica_deaths": int(self._deaths.value),
+            "inflight_tokens": self._reserved,
+            "prefix_hit_rate": (
+                None if hit_rate is None else round(hit_rate, 4)
+            ),
+            "ttft_ms_p50": _ms(self._ttft.percentile(50)),
+            "ttft_ms_p95": _ms(self._ttft.percentile(95)),
+            "e2e_ms_p95": _ms(self._e2e.percentile(95)),
+        }
+
+
+def _ms(x: Optional[float]) -> Optional[float]:
+    return None if x is None else round(x * 1000.0, 3)

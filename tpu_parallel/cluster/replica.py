@@ -1,0 +1,261 @@
+"""One serving replica as the cluster sees it: an engine plus health.
+
+A production cluster never talks to a :class:`~tpu_parallel.serving.engine.
+ServingEngine` directly — it talks to a :class:`ReplicaHandle`, which adds
+the three things scale-out needs on top of the engine's tick loop:
+
+- **Health state** (``healthy`` / ``degraded`` / ``dead``): routers skip
+  dead replicas outright and deprioritize degraded (stalled) ones; the
+  frontend retries a dead replica's in-flight work elsewhere.  ANY
+  exception escaping ``engine.step()`` marks the replica dead — a replica
+  that throws mid-tick has an engine in an unknown state, and the only
+  safe move is to stop routing to it and replay its work.
+- **Load accounting**: queue depth + active slots + estimated pending
+  prefill tokens, combined into one comparable ``load()`` scalar (the
+  least-loaded router's sort key).  Everything is host-side bookkeeping
+  the engine already tracks — reading load never touches the device.
+- **Fault injection** (:class:`FaultPlan`): deterministic crash / stall /
+  admission-reject faults keyed on the replica's own tick count, so
+  failover tests replay EXACTLY (crash on tick 7 is crash on tick 7,
+  every run).  A ``FaultPlan`` is how the acceptance suite proves the
+  bitwise-exactness-under-failure story without flaky process killing.
+
+The handle also keeps the replica-local request ledger (every submitted,
+not-yet-terminal engine :class:`RequestOutput`): when the replica dies,
+``orphans()`` is precisely the work the frontend must re-route.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from tpu_parallel.serving.engine import ServingEngine
+from tpu_parallel.serving.request import Request, RequestOutput
+
+# replica health states
+HEALTHY = "healthy"  # routable
+DEGRADED = "degraded"  # stalled/slow: routable only when nothing healthy is
+DEAD = "dead"  # never routable; in-flight work must be replayed elsewhere
+
+# ``load()`` weight of one pending prefill token relative to one queued
+# request / one active slot: a slot decodes one token per tick while a
+# queued prompt costs its whole length in prefill work, so tokens are
+# discounted to rough slot-tick equivalents (64 prompt tokens ~ one
+# request's worth of near-term work).  The constant only needs to rank
+# replicas consistently, not model latency.
+PREFILL_TOKEN_WEIGHT = 1.0 / 64.0
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultPlan:
+    """Deterministic fault schedule keyed on the replica's OWN tick count
+    (the number of ``step()`` calls it has served).
+
+    - ``crash_at_tick``: the step with this index raises
+      :class:`ReplicaDead` instead of running — the engine is abandoned
+      mid-flight exactly as a process kill would leave it.
+    - ``stall_at_tick`` + ``stall_ticks``: steps in
+      ``[stall_at_tick, stall_at_tick + stall_ticks)`` do nothing (no
+      engine tick) and the replica reports DEGRADED — the GC-pause /
+      preemption shape.
+    - ``reject_at_tick`` + ``reject_ticks``: during that tick window the
+      replica refuses NEW admissions (``accepting`` is False) while
+      in-flight work proceeds — the overload-shedding shape.
+    """
+
+    crash_at_tick: Optional[int] = None
+    stall_at_tick: Optional[int] = None
+    stall_ticks: int = 0
+    reject_at_tick: Optional[int] = None
+    reject_ticks: int = 0
+
+    def stalled(self, tick: int) -> bool:
+        return (
+            self.stall_at_tick is not None
+            and self.stall_at_tick <= tick < self.stall_at_tick + self.stall_ticks
+        )
+
+    def rejecting(self, tick: int) -> bool:
+        return (
+            self.reject_at_tick is not None
+            and self.reject_at_tick
+            <= tick
+            < self.reject_at_tick + self.reject_ticks
+        )
+
+
+class ReplicaDead(RuntimeError):
+    """Raised by ``ReplicaHandle.step()`` when the replica dies — by
+    FaultPlan schedule or by a real exception escaping the engine tick.
+    The frontend catches it, collects ``orphans()``, and re-routes."""
+
+    def __init__(self, replica_id: int, cause: Optional[str] = None):
+        super().__init__(
+            f"replica {replica_id} died"
+            + (f" ({cause})" if cause else "")
+        )
+        self.replica_id = replica_id
+
+
+class ReplicaHandle:
+    """Cluster-side wrapper of one :class:`ServingEngine`.
+
+    ``submit()``/``step()`` mirror the engine surface but maintain the
+    health state, the tick counter the :class:`FaultPlan` keys off, and
+    the not-yet-terminal request ledger that ``orphans()`` reports after
+    a death.  The handle never constructs engines — the caller owns model
+    and params placement (same process here; the design point is that
+    nothing in the cluster layer assumes it).
+    """
+
+    def __init__(
+        self,
+        replica_id: int,
+        engine: ServingEngine,
+        fault_plan: Optional[FaultPlan] = None,
+    ):
+        self.replica_id = replica_id
+        self.engine = engine
+        self.fault_plan = fault_plan
+        self.health = HEALTHY
+        self.ticks = 0
+        # engine request_id -> live engine RequestOutput; pruned as
+        # requests reach a terminal state
+        self._ledger: Dict[str, RequestOutput] = {}
+
+    # -- load signals ------------------------------------------------------
+
+    @property
+    def queue_depth(self) -> int:
+        return self.engine.scheduler.depth
+
+    @property
+    def active_slots(self) -> int:
+        return self.engine.in_flight
+
+    @property
+    def pending_prefill_tokens(self) -> int:
+        return self.engine.pending_prefill_tokens
+
+    def load(self) -> float:
+        """One comparable scalar: queued requests + occupied slots +
+        discounted pending prefill tokens (see ``PREFILL_TOKEN_WEIGHT``).
+        A dead replica reports infinite load so any ranking consumer that
+        forgets to filter by health still never picks it."""
+        if self.health == DEAD:
+            return float("inf")
+        return (
+            self.queue_depth
+            + self.active_slots
+            + self.pending_prefill_tokens * PREFILL_TOKEN_WEIGHT
+        )
+
+    @property
+    def routable(self) -> bool:
+        """Placeable for frontend dispatch: alive and not inside a
+        FaultPlan admission-reject window.  Deliberately IGNORES the
+        engine's drain gate — frontend dispatch relocates already-
+        accepted work (``requeue=True``), which the gate waves through;
+        a draining cluster must still be able to land its re-routed
+        queue remainders."""
+        if self.health == DEAD:
+            return False
+        if self.fault_plan is not None and self.fault_plan.rejecting(
+            self.ticks
+        ):
+            return False
+        return True
+
+    @property
+    def accepting(self) -> bool:
+        """Accepting NEW admissions: routable AND not drain-gated."""
+        return self.routable and not self.engine.draining
+
+    # -- work --------------------------------------------------------------
+
+    def submit(
+        self,
+        request: Request,
+        requeue: bool = False,
+        arrival_time: Optional[float] = None,
+    ) -> RequestOutput:
+        """Hand one request to the replica's engine; tracks it in the
+        ledger unless the engine rejected it synchronously."""
+        if self.health == DEAD:
+            raise ReplicaDead(self.replica_id, "submit to dead replica")
+        out = self.engine.add_request(
+            request, requeue=requeue, arrival_time=arrival_time
+        )
+        if not out.done:
+            self._ledger[request.request_id] = out
+        return out
+
+    def step(self) -> list:
+        """One engine tick under the fault plan.  Raises
+        :class:`ReplicaDead` on a scheduled crash or any engine exception
+        (health flips to DEAD first, so the raiser's view and a later
+        reader's view agree); returns the tick's StreamEvents, or [] for
+        a stalled (DEGRADED) tick."""
+        if self.health == DEAD:
+            raise ReplicaDead(self.replica_id, "step on dead replica")
+        tick = self.ticks
+        self.ticks += 1
+        fp = self.fault_plan
+        if fp is not None:
+            if fp.crash_at_tick is not None and tick >= fp.crash_at_tick:
+                self.health = DEAD
+                raise ReplicaDead(self.replica_id, f"fault plan, tick {tick}")
+            if fp.stalled(tick):
+                self.health = DEGRADED
+                return []
+        if self.health == DEGRADED:
+            self.health = HEALTHY  # stall window over
+        try:
+            events = self.engine.step()
+        except Exception as exc:  # engine state unknown: replica is gone
+            self.health = DEAD
+            raise ReplicaDead(self.replica_id, repr(exc)) from exc
+        self._prune()
+        return events
+
+    def has_work(self) -> bool:
+        return self.health != DEAD and self.engine.has_work()
+
+    def _prune(self) -> None:
+        done = [rid for rid, out in self._ledger.items() if out.done]
+        for rid in done:
+            del self._ledger[rid]
+
+    def orphans(self) -> List[RequestOutput]:
+        """Every tracked request that had NOT reached a terminal state —
+        queued or holding a slot — in submission order.  After a death
+        this is exactly the work the frontend replays elsewhere (tokens
+        already delivered ride along on each RequestOutput, so the replay
+        can force-prefix them)."""
+        self._prune()
+        return list(self._ledger.values())
+
+    def forget(self, request_id: str) -> None:
+        """Drop one request from the ledger (the frontend pulled it back
+        for re-routing — e.g. a drain's queued remainder)."""
+        self._ledger.pop(request_id, None)
+
+    def take_queued(self) -> List[RequestOutput]:
+        """Pull the engine's queued remainder (FIFO order) out of this
+        replica for re-routing, dropping each from the ledger."""
+        taken = self.engine.scheduler.take_queued()
+        for out in taken:
+            self.forget(out.request.request_id)
+        return taken
+
+    def summary(self) -> dict:
+        return {
+            "replica": self.replica_id,
+            "health": self.health,
+            "ticks": self.ticks,
+            "queue_depth": self.queue_depth,
+            "active_slots": self.active_slots,
+            "pending_prefill_tokens": self.pending_prefill_tokens,
+            "load": None if self.health == DEAD else round(self.load(), 3),
+        }

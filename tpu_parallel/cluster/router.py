@@ -1,0 +1,208 @@
+"""Pluggable request routing across serving replicas.
+
+Three policies, one contract: ``route(prompt, candidates)`` returns the
+replica to try first (or None when no candidate exists).  ``candidates``
+is the frontend's pre-filtered view — alive, accepting, not excluded for
+this request — ordered by replica id, so policies stay pure ranking
+logic with no health bookkeeping of their own.
+
+- :class:`RoundRobinRouter` — the baseline: cycle the candidate list.
+  Ignores load AND locality; every comparison in ``SERVE_r03.json``
+  starts here.
+- :class:`LeastLoadedRouter` — rank by :meth:`ReplicaHandle.load`
+  (queue depth + active slots + discounted pending prefill tokens),
+  ties to the lowest replica id.  The right default when prompts share
+  nothing.
+- :class:`PrefixAffinityRouter` — SGLang-style cache-aware routing:
+  consistent-hash the request's BUCKET-ALIGNED prompt prefix onto a
+  replica, so repeated prefixes (system prompts, few-shot headers) land
+  where that replica's :class:`~tpu_parallel.serving.prefix_cache.
+  PrefixCache` already holds their K/V.  Two properties matter and both
+  come from the hash RING (not ``hash(prefix) % n``):
+
+  * **Stability under failure** — when a replica dies, only the keys it
+    owned move (to their ring successors); every other prefix keeps its
+    replica and its warm cache.  Modulo hashing would reshuffle nearly
+    everything on any membership change.
+  * **Deterministic placement** — positions come from ``sha1``, not
+    Python's salted ``hash``, so placement is identical across processes
+    and runs (routing tests and multi-frontend deployments see one map).
+
+  Affinity yields to load: when the hash-owner is OVERLOADED (queue
+  depth at/over ``overload_queue_depth``), the router falls back to
+  least-loaded — a hot prefix must not melt one replica while its peers
+  idle.  Fallbacks are counted (``fallbacks``) and surface in the
+  frontend's ``cluster_affinity_fallbacks`` gauge.
+
+The prefix key mirrors :meth:`PrefixCache.lookup` alignment: the largest
+bucket STRICTLY shorter than the prompt (a full-prompt hit can't exist —
+the first sampled token needs the last real token's forward pass), whole
+prompt when no bucket is shorter.  Aligning router and cache on the same
+boundary is the point: the router's unit of placement is exactly the
+cache's unit of reuse.
+"""
+
+from __future__ import annotations
+
+import bisect
+import hashlib
+from typing import List, Optional, Sequence, Tuple
+
+from tpu_parallel.cluster.replica import ReplicaHandle
+
+
+def prefix_route_key(
+    prompt: Sequence[int], buckets: Optional[Sequence[int]]
+) -> Tuple[int, ...]:
+    """The bucket-aligned placement key for ``prompt``: its largest
+    proper bucket-prefix (the longest prefix a :class:`PrefixCache`
+    could ever serve), or the whole prompt when every bucket is too
+    long / no buckets exist."""
+    prompt = tuple(int(t) for t in prompt)
+    if buckets:
+        for b in sorted(buckets, reverse=True):
+            if b < len(prompt):
+                return prompt[:b]
+    return prompt
+
+
+def _stable_hash(data: bytes) -> int:
+    """Process-stable 64-bit hash (sha1 prefix) — Python's ``hash`` is
+    salted per process and would scramble placement every run."""
+    return int.from_bytes(hashlib.sha1(data).digest()[:8], "big")
+
+
+class Router:
+    """Routing-policy contract (and registry of the built-in names)."""
+
+    name = "base"
+
+    def route(
+        self,
+        prompt: Sequence[int],
+        candidates: List[ReplicaHandle],
+    ) -> Optional[ReplicaHandle]:
+        raise NotImplementedError
+
+
+class RoundRobinRouter(Router):
+    """Cycle through candidates in replica-id order, one per decision."""
+
+    name = "rr"
+
+    def __init__(self):
+        self._next = 0
+
+    def route(self, prompt, candidates):
+        if not candidates:
+            return None
+        pick = candidates[self._next % len(candidates)]
+        self._next += 1
+        return pick
+
+
+def least_loaded(candidates: List[ReplicaHandle]) -> Optional[ReplicaHandle]:
+    if not candidates:
+        return None
+    return min(candidates, key=lambda h: (h.load(), h.replica_id))
+
+
+class LeastLoadedRouter(Router):
+    """Lowest ``load()`` wins; ties break to the lowest replica id so
+    placement is deterministic."""
+
+    name = "least"
+
+    def route(self, prompt, candidates):
+        return least_loaded(candidates)
+
+
+class PrefixAffinityRouter(Router):
+    """Consistent-hash placement on the bucket-aligned prompt prefix,
+    least-loaded fallback on overload (see the module docstring).
+
+    ``replica_ids`` fixes the ring membership up front (every replica the
+    cluster was built with, dead or alive — the ring never changes, only
+    which owners are currently routable).  ``vnodes`` virtual nodes per
+    replica smooth the key distribution; 64 keeps per-replica share
+    within a few percent of fair for any realistic replica count.
+    """
+
+    name = "prefix"
+
+    def __init__(
+        self,
+        replica_ids: Sequence[int],
+        buckets: Optional[Sequence[int]] = None,
+        vnodes: int = 64,
+        overload_queue_depth: int = 8,
+    ):
+        if not replica_ids:
+            raise ValueError("PrefixAffinityRouter needs at least 1 replica")
+        if vnodes < 1:
+            raise ValueError(f"vnodes={vnodes} < 1")
+        self.buckets = tuple(buckets) if buckets else None
+        self.overload_queue_depth = overload_queue_depth
+        self.fallbacks = 0  # affinity target overloaded -> least-loaded
+        ring = []
+        for rid in replica_ids:
+            for v in range(vnodes):
+                ring.append((_stable_hash(f"{rid}:{v}".encode()), rid))
+        ring.sort()
+        self._ring_points = [p for p, _ in ring]
+        self._ring_ids = [rid for _, rid in ring]
+
+    def owner(self, prompt: Sequence[int]) -> int:
+        """The ring owner of this prompt's prefix key, ignoring health —
+        the stable answer to "where does this prefix live?"."""
+        key = prefix_route_key(prompt, self.buckets)
+        h = _stable_hash(
+            b"".join(int(t).to_bytes(8, "big", signed=True) for t in key)
+        )
+        i = bisect.bisect_right(self._ring_points, h) % len(self._ring_points)
+        return self._ring_ids[i]
+
+    def route(self, prompt, candidates):
+        if not candidates:
+            return None
+        key = prefix_route_key(prompt, self.buckets)
+        h = _stable_hash(
+            b"".join(int(t).to_bytes(8, "big", signed=True) for t in key)
+        )
+        # walk the ring clockwise; first ROUTABLE owner wins, so keys of
+        # dead/excluded replicas slide to their successors while every
+        # other key keeps its home
+        by_id = {c.replica_id: c for c in candidates}
+        start = bisect.bisect_right(self._ring_points, h)
+        pick = None
+        n = len(self._ring_ids)
+        for off in range(n):
+            rid = self._ring_ids[(start + off) % n]
+            if rid in by_id:
+                pick = by_id[rid]
+                break
+        if pick is None:
+            return None
+        if pick.queue_depth >= self.overload_queue_depth:
+            self.fallbacks += 1
+            return least_loaded(candidates)
+        return pick
+
+
+def make_router(
+    policy: str,
+    replica_ids: Sequence[int],
+    buckets: Optional[Sequence[int]] = None,
+    **kwargs,
+) -> Router:
+    """Build a router by policy name (``rr`` / ``least`` / ``prefix``) —
+    the string surface ``serve_bench --router`` and the frontend expose."""
+    if policy == "rr":
+        return RoundRobinRouter()
+    if policy == "least":
+        return LeastLoadedRouter()
+    if policy == "prefix":
+        return PrefixAffinityRouter(replica_ids, buckets=buckets, **kwargs)
+    raise ValueError(
+        f"unknown router policy {policy!r} (want rr | least | prefix)"
+    )

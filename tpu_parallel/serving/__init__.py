@@ -19,9 +19,16 @@ from tpu_parallel.serving.engine import (
 from tpu_parallel.serving.metrics import ServingMetrics, percentile
 from tpu_parallel.serving.prefix_cache import PrefixCache
 from tpu_parallel.serving.request import (
+    CANCELLED,
     EXPIRED,
+    FAILED,
     FINISHED,
     QUEUED,
+    REJECT_CAPACITY,
+    REJECT_CLIENT_LIMIT,
+    REJECT_DRAINING,
+    REJECT_QUEUE_FULL,
+    REJECT_TOKEN_BUDGET,
     REJECTED,
     RUNNING,
     Request,
@@ -29,7 +36,11 @@ from tpu_parallel.serving.request import (
     SamplingParams,
     StreamEvent,
 )
-from tpu_parallel.serving.scheduler import FIFOScheduler, SchedulerConfig
+from tpu_parallel.serving.scheduler import (
+    FIFOScheduler,
+    SchedulerConfig,
+    SubmitResult,
+)
 from tpu_parallel.serving.spec_decode import (
     Drafter,
     NGramDrafter,
@@ -62,8 +73,16 @@ __all__ = [
     "FINISHED",
     "REJECTED",
     "EXPIRED",
+    "CANCELLED",
+    "FAILED",
+    "REJECT_QUEUE_FULL",
+    "REJECT_DRAINING",
+    "REJECT_CAPACITY",
+    "REJECT_TOKEN_BUDGET",
+    "REJECT_CLIENT_LIMIT",
     "FIFOScheduler",
     "SchedulerConfig",
+    "SubmitResult",
     "Drafter",
     "NGramDrafter",
     "adapt_draft_len",

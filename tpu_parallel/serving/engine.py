@@ -115,7 +115,9 @@ from tpu_parallel.serving.metrics import (
 )
 from tpu_parallel.serving.prefix_cache import PrefixCache
 from tpu_parallel.serving.request import (
+    CANCELLED,
     FINISHED,
+    REJECT_CAPACITY,
     REJECTED,
     RUNNING,
     Request,
@@ -603,23 +605,44 @@ class ServingEngine:
 
     # -- submission --------------------------------------------------------
 
-    def add_request(self, request: Request) -> RequestOutput:
-        """Submit; returns the live output record (status REJECTED when the
-        prompt cannot fit or admission control refuses)."""
-        out = RequestOutput(request, arrival_time=self.clock())
+    def add_request(
+        self,
+        request: Request,
+        requeue: bool = False,
+        arrival_time: Optional[float] = None,
+    ) -> RequestOutput:
+        """Submit; returns the live output record (status REJECTED with a
+        TYPED ``finish_reason`` — ``capacity`` / ``queue_full`` /
+        ``draining`` — when the prompt cannot fit or admission refuses;
+        human detail in ``out.detail``).
+
+        ``requeue=True`` marks accepted work being relocated by the
+        cluster frontend (bypasses the drain gate, not the queue bound);
+        ``arrival_time`` preserves the ORIGINAL arrival across replica
+        retries so queue-wait telemetry stays cumulative — a retried
+        request's wait is everything since the client submitted, not
+        since the failover."""
+        out = RequestOutput(
+            request,
+            arrival_time=(
+                arrival_time if arrival_time is not None else self.clock()
+            ),
+        )
         total = len(request.prompt) + request.max_new_tokens
         if total > self.model.config.seq_len:
             out.status = REJECTED
-            out.finish_reason = (
+            out.finish_reason = REJECT_CAPACITY
+            out.detail = (
                 f"prompt ({len(request.prompt)}) + max_new_tokens "
                 f"({request.max_new_tokens}) exceeds seq_len "
                 f"({self.model.config.seq_len})"
             )
             self.metrics.record_rejected()
             return out
-        if not self.scheduler.submit(out):
+        verdict = self.scheduler.submit(out, requeue=requeue)
+        if not verdict:
             out.status = REJECTED
-            out.finish_reason = "queue full"
+            out.finish_reason = verdict.reason
             self.metrics.record_rejected()
             return out
         if self.tracer.enabled:
@@ -630,6 +653,89 @@ class ServingEngine:
                 "queue", track="scheduler", async_id=rid, request_id=rid
             )
         return out
+
+    # -- lifecycle control (cancellation / drain) --------------------------
+
+    def cancel(self, request_id: str, reason: str = "cancelled") -> bool:
+        """Cancel a request wherever it is — queued (pulled from the
+        scheduler) or in-engine (its slot released, mid-chunked-prefill
+        included).  Terminal tokenless StreamEvent to the stream, status
+        CANCELLED, cache slot returned to the free list.  False when the
+        request is unknown or already terminal (nothing to cancel)."""
+        out = self.scheduler.remove(request_id)
+        slot: Optional[int] = None
+        if out is None:
+            for i, candidate in enumerate(self._slot_out):
+                if (
+                    candidate is not None
+                    and candidate.request.request_id == request_id
+                ):
+                    slot, out = i, candidate
+                    break
+            if out is None:
+                return False
+            self.release_slot(slot)
+        now = self.clock()
+        span = self._queue_spans.pop(request_id, None)
+        if span is not None:
+            span.finish(cancelled=True)
+        out.status = CANCELLED
+        out.finish_reason = reason
+        out.finish_time = now
+        self.metrics.record_cancelled()
+        if self.tracer.enabled:
+            track = "scheduler" if slot is None else f"slot {slot}"
+            self.tracer.instant(
+                "cancel", track=track, request_id=request_id, reason=reason
+            )
+        event = StreamEvent(
+            request_id=request_id,
+            token=-1,
+            index=-1,
+            finished=True,
+            finish_reason=reason,
+        )
+        if out.request.on_token is not None:
+            out.request.on_token(event)
+        return True
+
+    def release_slot(self, slot: int) -> None:
+        """Free ``slot`` without delivering anything: drop any in-flight
+        chunked prefill, park the row's decode writes out of range (column
+        seq_len — dropped by scatter semantics, see ``__init__``), return
+        the slot to the pool.  The retirement AND cancellation path."""
+        self._chunking.pop(slot, None)
+        self._active[slot] = False
+        self._slot_out[slot] = None
+        self._widx[slot] = self.model.config.seq_len
+        self.pool.release(slot)
+
+    def begin_drain(self) -> None:
+        """Graceful-drain admission gate: new ``add_request`` submissions
+        reject with the typed ``draining`` reason; queued and in-flight
+        work runs to completion (the cluster frontend additionally
+        re-routes the queued remainder across live replicas)."""
+        self.scheduler.begin_drain()
+
+    @property
+    def draining(self) -> bool:
+        return self.scheduler.draining
+
+    @property
+    def in_flight(self) -> int:
+        """Requests holding a cache slot right now — decoding slots plus
+        mid-chunked-prefill slots (the router's active-slot load term)."""
+        return int(self._active.sum()) + len(self._chunking)
+
+    @property
+    def pending_prefill_tokens(self) -> int:
+        """Estimated prompt tokens still to prefill: queued prompts plus
+        the unwritten remainders of in-flight chunked prefills."""
+        chunk_rest = sum(
+            len(st.out.request.prompt) - st.offset
+            for st in self._chunking.values()
+        )
+        return self.scheduler.pending_prefill_tokens + chunk_rest
 
     # -- the tick ----------------------------------------------------------
 
@@ -756,6 +862,13 @@ class ServingEngine:
         self.registry = self.metrics.registry
         self.scheduler.registry = self.registry
         return self.metrics
+
+    @property
+    def prefill_buckets(self) -> Optional[Tuple[int, ...]]:
+        """The engine's prefill bucket set (None in legacy exact mode) —
+        the alignment the prefix cache AND the cluster's prefix-affinity
+        router key off."""
+        return self._buckets
 
     @property
     def prefill_compiles(self) -> int:
@@ -1270,11 +1383,7 @@ class ServingEngine:
             out.status = FINISHED
             out.finish_reason = finish_reason
             out.finish_time = now
-            self._active[slot] = False
-            self._slot_out[slot] = None
-            # park the freed row's decode writes out of range (see __init__)
-            self._widx[slot] = self.model.config.seq_len
-            self.pool.release(slot)
+            self.release_slot(slot)
             self.metrics.record_finished(out)
         if req.on_token is not None:
             req.on_token(event)

@@ -85,6 +85,7 @@ class ServingMetrics:
         self._finished = r.counter("serving_finished_total")
         self._rejected = r.counter("serving_rejected_total")
         self._expired = r.counter("serving_expired_total")
+        self._cancelled = r.counter("serving_cancelled_total")
         # prefill fast path: batched prefill device calls (vs. `prefills`,
         # which counts admitted REQUESTS), chunk continuations, and the
         # prefix cache's hit/miss/eviction tallies (mirrored gauges — the
@@ -150,6 +151,10 @@ class ServingMetrics:
     @property
     def expired(self) -> int:
         return int(self._expired.value)
+
+    @property
+    def cancelled(self) -> int:
+        return int(self._cancelled.value)
 
     @property
     def prefill_calls(self) -> int:
@@ -242,6 +247,9 @@ class ServingMetrics:
     def record_expired(self) -> None:
         self._expired.inc()
 
+    def record_cancelled(self) -> None:
+        self._cancelled.inc()
+
     def record_prefill_call(self, chunks: int = 0) -> None:
         """One batched prefill device call (``chunks`` counts any chunk
         continuations it was split into)."""
@@ -301,6 +309,7 @@ class ServingMetrics:
             "finished": self.finished,
             "rejected": self.rejected,
             "expired": self.expired,
+            "cancelled": self.cancelled,
             "tokens_out": self.tokens_out,
             "tokens_drafted": self.tokens_drafted,
             "tokens_accepted": self.tokens_accepted,

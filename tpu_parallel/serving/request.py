@@ -43,6 +43,19 @@ RUNNING = "running"  # occupies a cache slot, decoding
 FINISHED = "finished"  # completed (see finish_reason)
 REJECTED = "rejected"  # refused at submission (queue full / capacity)
 EXPIRED = "expired"  # timed out in the queue (scheduler max_wait)
+CANCELLED = "cancelled"  # cancelled mid-flight (deadline / client cancel)
+FAILED = "failed"  # the cluster gave up (retry limit, no live replica)
+
+# typed rejection reasons — machine-readable ``finish_reason`` values a
+# front end can switch on (human detail, when any, rides in
+# ``RequestOutput.detail``).  The engine and the cluster frontend use the
+# SAME vocabulary so a client sees identical reporting regardless of
+# which layer refused.
+REJECT_QUEUE_FULL = "queue_full"  # scheduler admission control
+REJECT_DRAINING = "draining"  # drain gate: no new work accepted
+REJECT_CAPACITY = "capacity"  # prompt + budget exceed seq_len
+REJECT_TOKEN_BUDGET = "token_budget"  # cluster-wide token backpressure
+REJECT_CLIENT_LIMIT = "client_limit"  # per-client concurrency cap
 
 
 @dataclasses.dataclass
@@ -67,6 +80,15 @@ class Request:
     # exact either way — the knob trades wasted verify positions against
     # multi-token ticks per request.
     draft_tokens: Optional[int] = None
+    # cluster-frontend fields (tpu_parallel/cluster/ — the engine itself
+    # ignores all three): per-client concurrency caps key off client_id;
+    # priority reorders frontend admission (higher first, aged so lower
+    # classes never starve); deadline is a per-request completion budget
+    # in SECONDS FROM ARRIVAL — past it the frontend cancels the request
+    # wherever it is, including in-engine work.
+    client_id: Optional[str] = None
+    priority: int = 0
+    deadline: Optional[float] = None
     # called synchronously with each StreamEvent for this request
     on_token: Optional[Callable[["StreamEvent"], None]] = None
 
@@ -85,16 +107,18 @@ class Request:
 class StreamEvent:
     """One incrementally-delivered token — or a terminal notification.
 
-    Queue expiry delivers a tokenless terminal event (``token == -1``,
-    ``index == -1``, ``finish_reason == "max_wait"``) so stream consumers
-    learn the request died; every other event carries a real token.
+    Queue expiry and cancellation deliver a tokenless terminal event
+    (``token == -1``, ``index == -1``, ``finish_reason`` naming the cause)
+    so stream consumers learn the request died; every other event carries
+    a real token.
     """
 
     request_id: str
     token: int
     index: int  # 0-based position among the request's generated tokens
     finished: bool = False
-    # "eos" | "length" | "max_wait" when finished
+    # "eos" | "length" | "max_wait" | "cancelled" | "deadline" |
+    # "retry_limit" | "no_replica" when finished
     finish_reason: Optional[str] = None
 
 
@@ -107,6 +131,9 @@ class RequestOutput:
     status: str = QUEUED
     tokens: List[int] = dataclasses.field(default_factory=list)
     finish_reason: Optional[str] = None
+    # human-readable detail behind a TYPED finish_reason (e.g. the exact
+    # capacity arithmetic behind "capacity") — never switch on this
+    detail: Optional[str] = None
     # timing (engine clock; None until the event happens)
     arrival_time: Optional[float] = None
     first_token_time: Optional[float] = None
@@ -115,7 +142,7 @@ class RequestOutput:
 
     @property
     def done(self) -> bool:
-        return self.status in (FINISHED, REJECTED, EXPIRED)
+        return self.status in (FINISHED, REJECTED, EXPIRED, CANCELLED, FAILED)
 
     @property
     def ttft(self) -> Optional[float]:

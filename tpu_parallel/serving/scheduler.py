@@ -39,7 +39,13 @@ import time
 from collections import deque
 from typing import Callable, List, Optional
 
-from tpu_parallel.serving.request import EXPIRED, QUEUED, RequestOutput
+from tpu_parallel.serving.request import (
+    EXPIRED,
+    QUEUED,
+    REJECT_DRAINING,
+    REJECT_QUEUE_FULL,
+    RequestOutput,
+)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -47,6 +53,37 @@ class SchedulerConfig:
     max_queue: Optional[int] = None  # None = unbounded queue
     max_prefills_per_tick: int = 1
     max_wait: Optional[float] = None  # seconds; None = wait forever
+
+
+class SubmitResult:
+    """Typed admission verdict: truthy on accept, falsy on reject with a
+    machine-readable ``reason`` (``REJECT_QUEUE_FULL`` / ``REJECT_DRAINING``).
+
+    Replaces the PR-1 bare bool so callers — the engine surfacing
+    ``RequestOutput.finish_reason``, the cluster frontend deciding whether
+    to try another replica — see WHY admission refused, not just that it
+    did.  Still usable exactly like the old bool (``if not submit(...)``).
+    """
+
+    __slots__ = ("reason",)
+
+    ACCEPTED: "SubmitResult"
+
+    def __init__(self, reason: Optional[str] = None):
+        self.reason = reason
+
+    def __bool__(self) -> bool:
+        return self.reason is None
+
+    def __repr__(self) -> str:
+        return (
+            "SubmitResult(accepted)"
+            if self.reason is None
+            else f"SubmitResult(rejected: {self.reason})"
+        )
+
+
+SubmitResult.ACCEPTED = SubmitResult()
 
 
 class FIFOScheduler:
@@ -73,10 +110,25 @@ class FIFOScheduler:
         self.clock = clock
         self.registry = registry
         self._queue: deque = deque()
+        # drain gate: True refuses NEW submissions (typed REJECT_DRAINING)
+        # while already-queued entries keep admitting — set by
+        # ``begin_drain()`` for graceful shutdown / replica retirement
+        self.draining = False
 
     @property
     def depth(self) -> int:
         return len(self._queue)
+
+    @property
+    def pending_prefill_tokens(self) -> int:
+        """Total prompt tokens waiting in the queue — the prefill work a
+        new admission is behind (the cluster router's least-loaded signal,
+        alongside queue depth and active slots)."""
+        return sum(len(out.request.prompt) for out in self._queue)
+
+    def queued(self) -> List[RequestOutput]:
+        """Snapshot of the queue in FIFO order (no mutation)."""
+        return list(self._queue)
 
     def oldest_age(self, now: Optional[float] = None) -> float:
         """Seconds the head-of-queue request has waited (0.0 when empty
@@ -103,14 +155,44 @@ class FIFOScheduler:
             if out.arrival_time is not None:
                 wait.observe(max(0.0, now - out.arrival_time))
 
-    def submit(self, out: RequestOutput) -> bool:
-        """Enqueue; False when admission control refuses (queue full)."""
+    def submit(self, out: RequestOutput, requeue: bool = False) -> SubmitResult:
+        """Enqueue; a falsy :class:`SubmitResult` carrying the typed reason
+        when admission control refuses (queue full / draining).
+
+        ``requeue=True`` marks accepted work being RELOCATED (the cluster
+        frontend re-routing a draining or dead replica's queue) rather than
+        new work — it bypasses the drain gate, never the queue bound.
+        """
         cfg = self.config
+        if self.draining and not requeue:
+            return SubmitResult(REJECT_DRAINING)
         if cfg.max_queue is not None and len(self._queue) >= cfg.max_queue:
-            return False
+            return SubmitResult(REJECT_QUEUE_FULL)
         out.status = QUEUED
         self._queue.append(out)
-        return True
+        return SubmitResult.ACCEPTED
+
+    def begin_drain(self) -> None:
+        """Close the admission gate: subsequent ``submit()`` calls reject
+        with ``REJECT_DRAINING``; queued entries still schedule."""
+        self.draining = True
+
+    def take_queued(self) -> List[RequestOutput]:
+        """Remove and return EVERY queued entry (FIFO order, status left
+        QUEUED) — the drain/failover path that re-routes a replica's
+        queued remainder to its peers."""
+        taken = list(self._queue)
+        self._queue.clear()
+        return taken
+
+    def remove(self, request_id: str) -> Optional[RequestOutput]:
+        """Pull one queued entry by request id (cancellation before the
+        request ever reached a slot); None when not queued here."""
+        for out in self._queue:
+            if out.request.request_id == request_id:
+                self._queue.remove(out)
+                return out
+        return None
 
     def expire(self, now: Optional[float] = None) -> List[RequestOutput]:
         """Drop queued entries older than ``max_wait``; returns them."""
