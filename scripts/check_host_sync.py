@@ -1,0 +1,166 @@
+"""Static check: serving code never syncs the device inside a host loop.
+
+The serving engine's whole perf story is dispatch amortization — one
+device round-trip per TICK (the fused decode tick pays one per
+``decode_steps_per_tick`` tokens).  A ``np.asarray(...)`` /
+``.block_until_ready()`` / ``jax.device_get(...)`` call INSIDE a ``for``
+or ``while`` loop under ``tpu_parallel/serving/`` is the tell-tale of a
+per-slot (or per-item) device sync: each iteration stalls the host on
+the device pipeline, and the DECODE_r06 measurement says that tax is
+worth 14x at batch 1.  Tick-BOUNDARY syncs — one per engine tick, before
+the host unpacks a token block — are the intended pattern and sit
+outside loops by construction; a loop that genuinely needs one (e.g. the
+standalone speculative host loop, which syncs once per verify tick)
+annotates the line with ``# host-sync: <why>`` and is whitelisted.
+
+Like ``check_clock.py`` (the injectable-clock contract) this turns a
+prose rule into a tier-1 test
+(``tests/test_cluster.py::test_serving_no_per_slot_host_sync``).  The
+check is LEXICAL: it sees calls written inside loop bodies, not syncs
+reached through function calls — the gated debug fetch in
+``CachePool.assert_slot_aligned`` (called per slot under
+``spec_check_invariants=True``) is out of scope by design.
+
+Usage: ``python scripts/check_host_sync.py [paths...]`` — prints one
+``file:line: <call> syncs the device inside a host loop`` per violation,
+exits nonzero on any.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List
+
+# device-sync reads: np/numpy.asarray + np/numpy.array materialize a jax
+# array on the host; .block_until_ready() and jax.device_get() are
+# explicit fences
+SYNC_ATTRS = frozenset({"asarray", "array"})
+SYNC_MODULES = frozenset({"np", "numpy"})
+FENCE_ATTRS = frozenset({"block_until_ready", "device_get"})
+
+DEFAULT_PATHS = ("tpu_parallel/serving",)
+
+WHITELIST_MARK = "# host-sync:"
+
+
+def _flag_of(node: ast.Call) -> str | None:
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        if (
+            func.attr in SYNC_ATTRS
+            and isinstance(func.value, ast.Name)
+            and func.value.id in SYNC_MODULES
+        ):
+            return f"{func.value.id}.{func.attr}"
+        if func.attr in FENCE_ATTRS:
+            return f"<...>.{func.attr}"
+    return None
+
+
+def check_source(source: str, filename: str) -> List[str]:
+    """Return ``file:line: message`` strings for every device-sync call
+    lexically inside a ``for``/``while`` body or a comprehension's
+    per-iteration positions, minus lines carrying the
+    ``# host-sync: <why>`` whitelist annotation."""
+    tree = ast.parse(source, filename=filename)
+    lines = source.splitlines()
+    problems: List[str] = []
+
+    def flag(node: ast.Call) -> None:
+        flagged = _flag_of(node)
+        if flagged is None:
+            return
+        # the annotation may land on any physical line of a wrapped call
+        # (black puts the closing paren — and the trailing comment — on
+        # its own line), so scan the call's whole lineno..end_lineno span
+        span = lines[node.lineno - 1 : (node.end_lineno or node.lineno)]
+        if not any(WHITELIST_MARK in line for line in span):
+            problems.append(
+                f"{filename}:{node.lineno}: {flagged}() syncs the "
+                "device inside a host loop (per-slot sync — hoist "
+                "to the tick boundary, or annotate "
+                "'# host-sync: <why>')"
+            )
+
+    def walk(node: ast.AST, in_loop: bool) -> None:
+        if isinstance(node, ast.Call) and in_loop:
+            flag(node)
+        if isinstance(
+            node, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+        ):
+            # comprehensions are loops too: the element expression, the
+            # `if` clauses, and every generator after the first run PER
+            # ITERATION; only the FIRST generator's iterable evaluates
+            # once (so `np.asarray(x)` as the thing being iterated stays
+            # legal while `[np.asarray(f(s)) for s in slots]` flags)
+            walk(node.generators[0].iter, in_loop)
+            for i, gen in enumerate(node.generators):
+                if i > 0:
+                    walk(gen.iter, True)
+                walk(gen.target, True)
+                for cond in gen.ifs:
+                    walk(cond, True)
+            if isinstance(node, ast.DictComp):
+                walk(node.key, True)
+                walk(node.value, True)
+            else:
+                walk(node.elt, True)
+            return
+        enter_loop = in_loop or isinstance(node, (ast.For, ast.While))
+        # a nested function DEF inside a loop body is not executed per
+        # iteration at its definition site's cost — but calls inside it
+        # are only flagged if ITS body contains a loop of its own, so
+        # reset the loop context at function boundaries
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            enter_loop = False
+        for child in ast.iter_child_nodes(node):
+            walk(child, enter_loop)
+
+    walk(tree, False)
+    return problems
+
+
+def check_paths(paths=DEFAULT_PATHS) -> List[str]:
+    problems: List[str] = []
+    for path in paths:
+        if not os.path.exists(path):
+            # a typo'd path must not walk zero files and report OK
+            raise FileNotFoundError(f"check_host_sync: no such path: {path}")
+        if os.path.isfile(path):
+            files = [path]
+        else:
+            files = sorted(
+                os.path.join(root, f)
+                for root, _, names in os.walk(path)
+                for f in names
+                if f.endswith(".py")
+            )
+        for fname in files:
+            with open(fname) as fh:
+                problems.extend(check_source(fh.read(), fname))
+    return problems
+
+
+def main(argv: List[str]) -> int:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    os.chdir(repo_root)
+    paths = argv[1:] or list(DEFAULT_PATHS)
+    problems = check_paths(paths)
+    for problem in problems:
+        print(problem, file=sys.stderr)
+    if problems:
+        print(
+            f"check_host_sync: {len(problems)} per-slot device sync(s)",
+            file=sys.stderr,
+        )
+        return 1
+    print("check_host_sync: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -8,7 +8,17 @@ Usage:
   python scripts/decode_bench.py [--reps N] [--warmup N]
       [batch,prompt,new[,kv_cache_dtype]] ...
   python scripts/decode_bench.py --spec [--draft-k K1,K2,...] [combos ...]
+  python scripts/decode_bench.py --engine [--fused-tick T1,T2,...] [combos]
   python scripts/decode_bench.py beam [batch prompt new num_beams]
+
+``--engine`` measures the SERVING ENGINE's decode hot loop across the
+``--fused-tick`` sweep (decode_steps_per_tick 1,4,8,16 by default): T=1
+is the per-step tick paying one host dispatch + one sync per token, T>1
+the fused lax.scan tick paying them once per T tokens.  Greedy output is
+parity-asserted against static generate() for every T; records carry the
+engine's dispatch metrics (tokens_per_dispatch, host_ms_per_tick).  The
+default engine combos sweep batch 1 (the 14x dispatch-tax case) and the
+batch-32 int8-vs-bf16 pair (the int8-native attention read's crossover).
 
 Defaults exercise batch 8/32 at prompt 512, 128 new tokens, bf16 + int8
 cache.  ``--reps``/``--warmup`` control the timing loop (previously
@@ -208,6 +218,100 @@ def run_spec(batch, prompt_len, new_tokens, kv_dtype="bf16", ks=(2, 4, 8),
     return record
 
 
+def run_engine(batch, prompt_len, new_tokens, kv_dtype="bf16",
+               ticks=(1, 4, 8, 16), reps=3, warmup=1):
+    """ENGINE-mode decode throughput: the ServingEngine's decode hot loop
+    across the ``--fused-tick`` sweep — T=1 is the per-step tick (one
+    host dispatch + sync per token, the DECODE_r06 348-tok/s-at-batch-1
+    configuration), T>1 the fused lax.scan tick with donated cache +
+    slot state.  One JSON record per T, parity-asserted against static
+    ``generate()`` (greedy bitwise), carrying the engine's own dispatch
+    metrics (tokens_per_dispatch, host_ms_per_tick) so the record shows
+    WHERE the speedup comes from, not just that it happened."""
+    import numpy as np
+
+    from tpu_parallel.models import GPTLM, tiny_test
+    from tpu_parallel.models.generate import generate
+    from tpu_parallel.serving import Request, SchedulerConfig, ServingEngine
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        model, cfg, _ = _build(kv_dtype)
+    else:
+        # CPU stand-in with a real decode window: seq 256 gives the
+        # cache-read side enough weight that the int8-native read's
+        # bandwidth story is visible (the 32-token test default is all
+        # fixed overhead)
+        cfg = tiny_test(seq_len=256, kv_cache_dtype=kv_dtype)
+        model = GPTLM(cfg)
+    new_tokens = min(new_tokens, cfg.seq_len // 2)
+    prompt_len = max(1, min(prompt_len, cfg.seq_len - new_tokens))
+    prompt = jax.random.randint(
+        jax.random.PRNGKey(0), (batch, prompt_len), 0, cfg.vocab_size
+    )
+    params = model.init({"params": jax.random.PRNGKey(1)}, prompt, train=False)[
+        "params"
+    ]
+    refs = np.asarray(
+        generate(model, params, prompt, max_new_tokens=new_tokens)
+    )
+    prompts = [[int(t) for t in np.asarray(prompt[i])] for i in range(batch)]
+
+    for steps in ticks:
+        # ONE long-lived engine per T (a server doesn't rebuild its pool
+        # per request): the timed window is submission + drain only
+        eng = ServingEngine(
+            model, params, n_slots=batch,
+            scheduler=SchedulerConfig(max_prefills_per_tick=batch),
+            decode_steps_per_tick=steps,
+        )
+
+        def run_once(n_new):
+            outs = [
+                eng.add_request(Request(prompt=p, max_new_tokens=n_new))
+                for p in prompts
+            ]
+            eng.run()
+            return outs
+
+        for _ in range(max(warmup, 1)):
+            outs = run_once(new_tokens)
+        for i, out in enumerate(outs):
+            assert out.status == "finished" and list(out.tokens) == [
+                int(t) for t in refs[i]
+            ], f"engine T={steps} greedy mismatch on row {i}"
+        eng.reset_metrics()
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            run_once(new_tokens)
+        dt_full = (time.perf_counter() - t0) / reps
+        s = eng.metrics.summary()
+        run_once(1)  # warm the prefill-only shape set
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            run_once(1)
+        dt_pre = (time.perf_counter() - t0) / reps
+        decode_dt = max(dt_full - dt_pre, 1e-9)
+        print(json.dumps(dict(
+            bench="engine_decode",
+            batch=batch,
+            prompt=prompt_len,
+            new_tokens=new_tokens,
+            kv_cache=kv_dtype,
+            model="gpt2_125m" if on_tpu else "tiny_256",
+            decode_steps_per_tick=steps,
+            engine_decode_tokens_per_sec=round(
+                batch * (new_tokens - 1) / decode_dt, 1
+            ),
+            tokens_per_decode_tick=s["tokens_per_decode_tick"],
+            tokens_per_dispatch=s["tokens_per_dispatch_mean"],
+            # metrics accumulate over the `reps` timed runs; report the
+            # PER-RUN dispatch count so records compare across --reps
+            host_dispatches=round(s["host_dispatches"] / reps),
+            host_ms_per_tick_p50=s["host_ms_per_tick_p50"],
+        )), flush=True)
+
+
 def run_beam(batch=2, prompt_len=512, new_tokens=128, num_beams=4):
     """Lazy vs eager beam search vs the aligned-greedy floor at the same
     effective rows (batch * num_beams) — one JSON line per variant."""
@@ -270,6 +374,13 @@ def main():
                     help="speculative-decode sweep on repetitive prompts")
     ap.add_argument("--draft-k", type=str, default="2,4,8",
                     help="draft lengths the --spec sweep measures")
+    ap.add_argument("--engine", action="store_true",
+                    help="ServingEngine decode hot loop across the "
+                         "--fused-tick sweep (parity-asserted; records "
+                         "dispatch-amortization metrics)")
+    ap.add_argument("--fused-tick", type=str, default="1,4,8,16",
+                    help="decode_steps_per_tick values the --engine "
+                         "sweep measures (1 = the per-step tick)")
     args = ap.parse_args()
 
     combos = []
@@ -280,21 +391,34 @@ def main():
              parts[3] if len(parts) > 3 else "bf16")
         )
     if not combos:
-        combos = (
-            [(8, 512, 128, "bf16")]
-            if args.spec
-            else [
+        if args.spec:
+            combos = [(8, 512, 128, "bf16")]
+        elif args.engine:
+            # the batch-1 dispatch-amortization curve + the batch-32
+            # int8-vs-bf16 crossover the int8-native read closes
+            combos = [
+                (1, 32, 64, "bf16"),
+                (8, 32, 64, "bf16"),
+                (32, 32, 64, "bf16"),
+                (32, 32, 64, "int8"),
+            ]
+        else:
+            combos = [
                 (8, 512, 128, "bf16"),
                 (32, 512, 128, "bf16"),
                 (32, 512, 128, "int8"),
             ]
-        )
     ks = tuple(int(k) for k in args.draft_k.split(","))
+    fused_ticks = tuple(int(t) for t in args.fused_tick.split(","))
     for combo in combos:
         try:
             if args.spec:
                 record = run_spec(*combo, ks=ks, reps=args.reps,
                                   warmup=args.warmup)
+            elif args.engine:
+                run_engine(*combo, ticks=fused_ticks, reps=args.reps,
+                           warmup=args.warmup)
+                continue  # run_engine prints one record per T itself
             else:
                 record = run_one(*combo, reps=args.reps, warmup=args.warmup)
             print(json.dumps(record), flush=True)

@@ -46,11 +46,14 @@ emits each point twice: the legacy exact batch-1 prefill engine
 ("bucketed"), so a single file records the improvement.
 
 ``--smoke`` runs a small greedy parity gate first — every fast-path mode
-(bucketed, chunked, prefix-reuse, and the SPECULATIVE engine with both
-the n-gram drafter and an adversarial all-wrong drafter) must produce
-token-identical output to static ``generate()`` — and exits nonzero on
-any mismatch, so bench numbers can never come from a silently-wrong fast
-path.  ``--spec K`` turns speculative decoding on for the measured
+(bucketed, chunked, prefix-reuse, the FUSED multi-step tick both alone
+and composed with chunked prefill, the per-step T=1 engine, and the
+SPECULATIVE engine with both the n-gram drafter and an adversarial
+all-wrong drafter) must produce token-identical output to static
+``generate()`` — and exits nonzero on any mismatch, so bench numbers can
+never come from a silently-wrong fast path.  ``--fused-tick T`` pins
+``decode_steps_per_tick`` for the measured points (1 = the per-step
+engine, the pre-fused baseline).  ``--spec K`` turns speculative decoding on for the measured
 points; the record then reports ``spec_acceptance_rate`` and
 ``tokens_per_decode_tick`` from the engine metrics.
 
@@ -188,6 +191,7 @@ def run_point(model, params, cfg, prompts, *, rate, n_slots, new_tokens,
         # verify positions, and tokens_per_decode_tick ride in via the
         # metrics summary below
         "draft_tokens": eng._spec_width,
+        "decode_steps_per_tick": eng.decode_steps_per_tick,
         # distinct prefill/extend call shapes == jit compiles of the
         # prefill path (exact mode: one per distinct length; bucketed:
         # bounded by the bucket set)
@@ -359,9 +363,18 @@ def smoke(model, params, cfg, prompts, new_tokens):
         tuple(p): [int(t) for t in ref] for p, ref in zip(prompts, refs)
     }
     shortest = min(len(p) for p in prompts)
+    # "bucketed"/"chunked"/"prefix" run the engine DEFAULT fused tick
+    # (decode_steps_per_tick auto=8); "per_step" pins the T=1 engine and
+    # "fused_chunked" the fused tick composed with chunked prefill, so a
+    # fused-vs-per-step divergence fails the gate from both directions
     modes = {
         "exact": dict(prefill_buckets=None),
         "bucketed": {},
+        "per_step": dict(decode_steps_per_tick=1),
+        "fused_chunked": dict(
+            decode_steps_per_tick=4,
+            prefill_chunk_tokens=max(2, shortest // 2),
+        ),
         "chunked": dict(prefill_chunk_tokens=max(2, shortest // 2)),
         "prefix": dict(prefix_cache_size=4),
         "spec": dict(draft_tokens=3),
@@ -426,6 +439,10 @@ def main():
                     help="speculative decode draft tokens (0 = off); the "
                          "record then carries acceptance rate and "
                          "tokens_per_decode_tick")
+    ap.add_argument("--fused-tick", type=int, default=0,
+                    help="decode_steps_per_tick for the measured engines "
+                         "(0 = engine default 'auto'; 1 = the per-step "
+                         "tick, the pre-fused configuration)")
     ap.add_argument("--replicas", type=int, default=1,
                     help="engine replicas behind the cluster frontend "
                          "(1 = single-engine mode, the pre-cluster bench)")
@@ -521,6 +538,10 @@ def main():
     if args.spec > 0:
         fast["draft_tokens"] = args.spec
         fast_label += "+spec"
+    if args.fused_tick > 0:
+        fast["decode_steps_per_tick"] = args.fused_tick
+        if args.fused_tick == 1:
+            fast_label += "+per_step"
 
     if args.replicas > 1:
         # cluster mode: one record per (rate, router policy) on the SAME

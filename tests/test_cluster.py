@@ -76,8 +76,14 @@ def env():
 
 def _engine(env, clock=None, **kw):
     cfg, model, params, _, _ = env
+    # per-step decode tick by default: the fault-injection choreography
+    # in this file (crash_at_tick / stall windows / retry counts) is
+    # pinned at one-token-per-tick granularity so crashes land
+    # mid-request; the FUSED default is covered by
+    # test_crash_midflight_exact_fused_tick and the serving parity suite
     kwargs = dict(
-        n_slots=2, scheduler=SchedulerConfig(max_prefills_per_tick=2)
+        n_slots=2, scheduler=SchedulerConfig(max_prefills_per_tick=2),
+        decode_steps_per_tick=1,
     )
     kwargs.update(kw)
     if clock is not None:
@@ -525,6 +531,43 @@ def test_crash_midflight_bitwise_exact(env, mode):
         )
 
 
+def test_crash_midflight_exact_fused_tick(env):
+    """The headline crash guarantee holds under the FUSED decode tick
+    (the engine default): a replica dying between multi-token ticks is
+    replayed forced-prefix on the survivor, greedy output bitwise equal
+    to a no-fault fused baseline — which itself equals the per-step
+    engine (serving parity suite)."""
+    _, _, _, prompts, _ = env
+    kw = dict(prefill_buckets=(4, 8, 16), decode_steps_per_tick=4)
+
+    baseline_eng = _engine(env, **kw)
+    base_outs = [
+        baseline_eng.add_request(Request(prompt=p, max_new_tokens=16))
+        for p in prompts
+    ]
+    baseline_eng.run()
+    assert all(o.status == FINISHED for o in base_outs)
+
+    h0 = ReplicaHandle(
+        0, _engine(env, **kw), fault_plan=FaultPlan(crash_at_tick=2)
+    )
+    h1 = ReplicaHandle(1, _engine(env, **kw))
+    fe = Frontend([h0, h1], router="rr")
+    outs = [fe.submit(Request(prompt=p, max_new_tokens=16)) for p in prompts]
+    fe.run(max_ticks=400)
+    assert h0.health == DEAD
+    s = fe.summary()
+    assert s["replica_deaths"] == 1 and s["retries"] > 0
+    for i, (out, base) in enumerate(zip(outs, base_outs)):
+        assert out.status == FINISHED, (
+            f"request {i}: {out.status} ({out.finish_reason})"
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out.tokens), np.asarray(base.tokens),
+            err_msg=f"request {i} diverged after fused-tick failover",
+        )
+
+
 def test_crash_stream_indices_stay_contiguous(env):
     """Across a failover the client stream never re-delivers or skips:
     every request's event indices are exactly 0..n-1 in order."""
@@ -739,6 +782,64 @@ def test_serving_time_flows_through_clock():
     assert any("time.time()" in p for p in found)
     assert any("mono()" in p for p in found)
     assert any("time.sleep()" in p for p in found)
+
+
+def test_serving_no_per_slot_host_sync():
+    """Tier-1 wiring of scripts/check_host_sync.py: no module under
+    tpu_parallel/serving/ syncs the device inside a host loop (per-slot
+    syncs are the dispatch tax the fused tick exists to kill; the one
+    tick-boundary sync in the speculative host loop carries the
+    ``# host-sync:`` annotation) — plus a self-test that the checker
+    catches violations and honors the whitelist."""
+    import os
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "scripts"))
+    try:
+        import check_host_sync
+    finally:
+        sys.path.pop(0)
+    problems = check_host_sync.check_paths(
+        (os.path.join(repo, "tpu_parallel", "serving"),)
+    )
+    assert problems == [], "\n".join(problems)
+    bad = (
+        "import numpy as np\n"
+        "def f(slots, fetch):\n"
+        "    for s in slots:\n"
+        "        a = np.asarray(fetch(s))\n"
+        "        fetch(s).block_until_ready()\n"
+        "    while slots:\n"
+        "        b = np.asarray(slots.pop())  # host-sync: tick-boundary\n"
+        "    c = np.asarray(fetch(0))\n"
+        "def g(xs, fetch):\n"
+        "    return [np.asarray(fetch(x)) for x in xs]\n"
+        "def h(dev_batch):\n"
+        "    return [int(t) for t in np.asarray(dev_batch)]\n"
+    )
+    found = check_host_sync.check_source(bad, "x.py")
+    # the two for-body calls AND the per-iteration comprehension call
+    # flag; the annotated while-body call, the loop-free call, and the
+    # iterate-ONCE comprehension iterable stay legal
+    assert len(found) == 3, found
+    assert any("np.asarray" in p and ":4:" in p for p in found)
+    assert any("block_until_ready" in p for p in found)
+    assert any(":10:" in p for p in found)
+    # the whitelist annotation counts anywhere in a wrapped call's span
+    # (black parks the trailing comment on the closing-paren line)
+    wrapped = (
+        "import numpy as np\n"
+        "def f(slots, fetch):\n"
+        "    while slots:\n"
+        "        b = np.asarray(\n"
+        "            fetch(slots.pop())\n"
+        "        )  # host-sync: tick-boundary\n"
+    )
+    assert check_host_sync.check_source(wrapped, "x.py") == []
+    # a typo'd path must fail loudly, never walk zero files and pass
+    with pytest.raises(FileNotFoundError):
+        check_host_sync.check_paths((os.path.join(repo, "no_such_dir"),))
 
 
 # -- prefix affinity wins (slow) -------------------------------------------

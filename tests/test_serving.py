@@ -107,13 +107,14 @@ def test_engine_staggered_arrivals_match_reference(rng):
 def test_slot_reuse_after_completion(rng):
     """A single-slot pool serves requests strictly in sequence: the second
     runs only after the first retires and reuses its slot, with outputs
-    unpolluted by the slot's previous occupant."""
+    unpolluted by the slot's previous occupant.  Per-step tick: the
+    admitted-but-not-finished checkpoint below needs one-token ticks."""
     cfg, model, prompt, params = _build(rng, n_rows=2)
     refs = [
         np.asarray(generate(model, params, prompt[i : i + 1], max_new_tokens=5))
         for i in range(2)
     ]
-    eng = ServingEngine(model, params, n_slots=1)
+    eng = ServingEngine(model, params, n_slots=1, decode_steps_per_tick=1)
     a = eng.add_request(_req(prompt[0], 5))
     b = eng.add_request(_req(prompt[1], 5))
     # first tick admits only request a (one slot)
@@ -241,7 +242,10 @@ def test_streaming_events_and_metrics(rng):
     in order, and the summary's counters/latency stats are coherent."""
     cfg, model, prompt, params = _build(rng, n_rows=2)
     seen = []
-    eng = ServingEngine(model, params, n_slots=2)
+    # per-step tick: the occupancy-mean assertion needs ticks where the
+    # request is still in its slot at tick end (a fused tick would
+    # finish it within the first decode tick)
+    eng = ServingEngine(model, params, n_slots=2, decode_steps_per_tick=1)
     out = eng.add_request(
         _req(prompt[0], 5, on_token=lambda ev: seen.append(ev))
     )
@@ -440,6 +444,7 @@ def test_chunked_prefill_interleaves_decode(rng):
     eng = ServingEngine(
         model, params, n_slots=2,
         prefill_buckets=(4, 8, 16), prefill_chunk_tokens=4,
+        decode_steps_per_tick=1,  # per-tick progress accounting below
     )
     a = eng.add_request(_req(short, 10))
     eng.step()  # a running
@@ -820,7 +825,7 @@ def test_spec_engine_oracle_fewer_decode_ticks(rng):
             np.testing.assert_array_equal(np.asarray(out.tokens), want[i])
         return eng.metrics
 
-    plain = drive()
+    plain = drive(decode_steps_per_tick=1)  # the per-step baseline
     spec = drive(
         draft_tokens=4, drafter=OracleDrafter(_ref_map(prompts, want)),
     )
@@ -914,6 +919,348 @@ def test_cache_pool_slot_aligned_guard(rng):
     eng.pool.cache = jax.tree_util.tree_map_with_path(corrupt, eng.pool.cache)
     with pytest.raises(AssertionError, match="misaligned"):
         eng.pool.assert_slot_aligned(0)
+
+
+# -- fused multi-step decode tick -------------------------------------------
+
+
+def _drive_engine(model, params, prompts, budgets, staggered=False, **kw):
+    """Submit ``prompts`` (optionally staggered across ticks) and run to
+    idle; returns (engine, outputs)."""
+    eng = ServingEngine(
+        model, params,
+        scheduler=SchedulerConfig(max_prefills_per_tick=2), **kw,
+    )
+    outs = []
+    if staggered:
+        outs.append(eng.add_request(_req(prompts[0], budgets[0])))
+        outs.append(eng.add_request(_req(prompts[1], budgets[1])))
+        eng.step(), eng.step()
+        outs.append(eng.add_request(_req(prompts[2], budgets[2])))
+        eng.step()
+        for p, n in zip(prompts[3:], budgets[3:]):
+            outs.append(eng.add_request(_req(p, n)))
+    else:
+        outs = [
+            eng.add_request(_req(p, n)) for p, n in zip(prompts, budgets)
+        ]
+    eng.run()
+    return eng, outs
+
+
+def test_fused_tick_greedy_parity_staggered(rng):
+    """Acceptance: the fused tick (T=4) is BITWISE identical to the
+    per-step engine across staggered arrivals into reused slots, with
+    budgets deliberately not multiples of T so every request exhausts
+    its budget MID-scan-block."""
+    cfg, model, _, params = _build(rng)
+    lens, budgets = [3, 9, 6, 12, 5], [6, 5, 9, 3, 7]
+    prompts = [
+        [int(t) for t in np.asarray(
+            jax.random.randint(
+                jax.random.fold_in(rng, i), (L,), 1, cfg.vocab_size
+            )
+        )]
+        for i, L in enumerate(lens)
+    ]
+    kw = dict(n_slots=2, prefill_buckets=(4, 8, 16))
+    plain_eng, plain = _drive_engine(
+        model, params, prompts, budgets, staggered=True,
+        decode_steps_per_tick=1, **kw,
+    )
+    fused_eng, fused = _drive_engine(
+        model, params, prompts, budgets, staggered=True,
+        decode_steps_per_tick=4, **kw,
+    )
+    for i, (a, b) in enumerate(zip(plain, fused)):
+        assert a.status == FINISHED and b.status == FINISHED
+        assert a.finish_reason == b.finish_reason == "length"
+        np.testing.assert_array_equal(
+            np.asarray(b.tokens), np.asarray(a.tokens),
+            err_msg=f"request {i}",
+        )
+    # the fused engine really amortized: far fewer decode ticks
+    assert fused_eng.metrics.decode_ticks < plain_eng.metrics.decode_ticks
+    assert fused_eng.pool.n_free == 2
+
+
+def test_fused_tick_eos_mid_block(rng):
+    """EOS sampled MID-scan-block: delivery truncates at the EOS token,
+    the surplus scan steps park their writes, and the retired slot is
+    clean for its next occupant — bitwise equal to the per-step engine."""
+    cfg, model, prompt, params = _build(rng, n_rows=2, prompt_len=4)
+    ref = list(np.asarray(
+        generate(model, params, prompt[:1], max_new_tokens=12)
+    )[0])
+    # an EOS whose first occurrence is deep enough that a T=8 block
+    # spans it mid-scan
+    eos_idx = next(i for i in range(2, 7) if ref[i] not in ref[:i])
+    eos = int(ref[eos_idx])
+    prompts = [[int(t) for t in np.asarray(prompt[0])]]
+
+    def drive(**kw):
+        eng = ServingEngine(model, params, n_slots=1, **kw)
+        out = eng.add_request(_req(prompts[0], 12, eos_token_id=eos))
+        eng.run()
+        # the slot is reusable and unpolluted after the mid-block retire
+        nxt = eng.add_request(_req(prompts[0], 4))
+        eng.run()
+        return eng, out, nxt
+
+    _, plain, plain_next = drive(decode_steps_per_tick=1)
+    eng, fused, fused_next = drive(decode_steps_per_tick=8)
+    assert plain.finish_reason == fused.finish_reason == "eos"
+    assert fused.tokens == ref[: eos_idx + 1] == plain.tokens
+    assert fused_next.tokens == plain_next.tokens
+    assert eng.pool.n_free == 1
+    eng.pool.assert_slot_aligned(0)
+
+
+def test_fused_tick_int8_parity(rng):
+    """Fused tick over an int8 KV cache (the int8-native attention read):
+    bitwise equal to the per-step int8 engine and the static int8
+    reference."""
+    cfg, model, prompt, params = _build(rng, n_rows=2, kv_cache_dtype="int8")
+    want = np.asarray(generate(model, params, prompt, max_new_tokens=9))
+    prompts = [[int(t) for t in np.asarray(prompt[i])] for i in range(2)]
+    _, plain = _drive_engine(
+        model, params, prompts, [9, 9], n_slots=2, decode_steps_per_tick=1,
+    )
+    _, fused = _drive_engine(
+        model, params, prompts, [9, 9], n_slots=2, decode_steps_per_tick=4,
+    )
+    for i in range(2):
+        np.testing.assert_array_equal(np.asarray(plain[i].tokens), want[i])
+        np.testing.assert_array_equal(np.asarray(fused[i].tokens), want[i])
+
+
+def test_fused_tick_chunked_prefill_interleave_parity(rng):
+    """Fused decode ticks compose with chunked prefill: a long prompt's
+    chunks keep riding one-per-tick while fused blocks advance running
+    requests; both requests stay bitwise exact."""
+    cfg, model, _, params = _build(rng)
+    short = [int(t) for t in np.asarray(
+        jax.random.randint(rng, (3,), 1, cfg.vocab_size)
+    )]
+    long = [int(t) for t in np.asarray(
+        jax.random.randint(jax.random.fold_in(rng, 1), (12,), 1,
+                           cfg.vocab_size)
+    )]
+    refs = [
+        np.asarray(generate(
+            model, params, jnp.asarray(p, jnp.int32)[None, :],
+            max_new_tokens=n,
+        ))[0]
+        for p, n in ((short, 11), (long, 5))
+    ]
+    eng = ServingEngine(
+        model, params, n_slots=2,
+        prefill_buckets=(4, 8, 16), prefill_chunk_tokens=4,
+        decode_steps_per_tick=4,
+    )
+    a = eng.add_request(_req(short, 11))
+    eng.step()
+    b = eng.add_request(_req(long, 5))
+    eng.run()
+    np.testing.assert_array_equal(np.asarray(a.tokens), refs[0])
+    np.testing.assert_array_equal(np.asarray(b.tokens), refs[1])
+    assert eng.metrics.prefill_chunks >= 3  # 12 tokens / 4-chunks
+
+
+def test_fused_tick_donation_invalidates_old_buffers(rng):
+    """Satellite (buffer-donation audit): the cache pool AND the device
+    slot-state operands are DONATED — after a tick the previous tick's
+    buffers are deleted, so no second pool copy can exist.  Pinned for
+    the fused tick and the per-step ``_decode_fn`` alike; a stale
+    reference held across a tick raises on use."""
+    cfg, model, prompt, params = _build(rng, n_rows=1)
+    for steps in (1, 4):
+        eng = ServingEngine(
+            model, params, n_slots=2, decode_steps_per_tick=steps,
+        )
+        out = eng.add_request(_req(prompt[0], 12))
+        eng.step()  # admit + first decode tick
+        old_cache = jax.tree_util.tree_leaves(eng.pool.cache)
+        old_state = (
+            jax.tree_util.tree_leaves(eng._dev_state) if steps > 1 else []
+        )
+        eng.step()  # decode-only tick: donates cache (and fused state)
+        assert all(leaf.is_deleted() for leaf in old_cache), (
+            f"T={steps}: old pool buffers survived the tick (donation "
+            "regressed — a second full pool copy is alive)"
+        )
+        assert all(leaf.is_deleted() for leaf in old_state)
+        eng.run()
+        assert out.status == FINISHED and len(out.tokens) == 12
+
+
+def test_fused_tick_compile_count_pin(rng):
+    """The fused tick compiles ONCE: its state/cache shapes are fixed by
+    (n_slots, seq_len), so a mixed workload — staggered arrivals, EOS,
+    varying budgets, prefix hits — adds prefill shapes only, bounded by
+    the bucket set (+1 extend shape per distinct hit group width)."""
+    from tpu_parallel.serving import engine as engine_mod
+
+    engine_mod._engine_fns.cache_clear()
+    engine_mod._fused_engine_fn.cache_clear()
+    cfg, model, _, params = _build(rng)
+    eng = ServingEngine(
+        model, params, n_slots=4,
+        scheduler=SchedulerConfig(max_prefills_per_tick=2),
+        prefill_buckets=(4, 8, 16), prefix_cache_size=2,
+        decode_steps_per_tick=8,
+    )
+    if not hasattr(eng._fused_fn, "_cache_size"):
+        pytest.skip("jax.jit cache inspection unavailable")
+    shared = [7, 3, 5, 2]
+    lengths = [3, 4, 5, 6, 9, 11, 15]
+    for i, L in enumerate(lengths):
+        sfx = jax.random.randint(
+            jax.random.fold_in(rng, i), (max(1, L - 4),), 1, cfg.vocab_size
+        )
+        p = shared + [int(t) for t in np.asarray(sfx)]
+        eng.add_request(_req(p, 2 + (i % 5)))
+        if i % 2:
+            eng.step()
+    eng.run()
+    assert eng.metrics.finished == len(lengths)
+    n_buckets = 4  # (4, 8, 16) + seq_len appended
+    assert eng._fused_fn._cache_size() == 1  # ONE fused program, ever
+    assert eng._prefill_fn._cache_size() <= n_buckets
+    # total jitted decode+prefill+extend shapes stay <= #buckets + 2
+    assert (
+        eng._fused_fn._cache_size()
+        + eng._prefill_fn._cache_size()
+        + eng._extend_fn._cache_size()
+    ) <= n_buckets + 2
+
+
+def test_fused_tick_dispatch_metrics(rng):
+    """Satellite (dispatch observability): host_dispatches /
+    tokens_per_dispatch / host_ms_per_tick flow registry -> summary ->
+    Prometheus text, and the fused tick's amortization is visible —
+    tokens per dispatch strictly above the per-step engine's."""
+    from tpu_parallel.obs import write_prometheus
+
+    cfg, model, prompt, params = _build(rng, n_rows=1)
+    prompts = [[int(t) for t in np.asarray(prompt[0])]]
+
+    def drive(steps):
+        eng, _ = _drive_engine(
+            model, params, prompts, [12], n_slots=1,
+            decode_steps_per_tick=steps,
+        )
+        return eng
+
+    plain, fused = drive(1), drive(8)
+    for eng in (plain, fused):
+        s = eng.metrics.summary()
+        assert s["host_dispatches"] == eng.metrics.host_dispatches > 0
+        assert s["tokens_per_dispatch_mean"] > 0
+        assert s["host_ms_per_tick_p95"] is not None
+    assert (
+        fused.metrics.summary()["tokens_per_dispatch_mean"]
+        > plain.metrics.summary()["tokens_per_dispatch_mean"]
+    )
+    # far fewer host round-trips for the same 12 tokens
+    assert fused.metrics.host_dispatches < plain.metrics.host_dispatches
+    text = write_prometheus(fused.registry, "/tmp/test_dispatch_prom.txt")
+    exposition = open(text).read()
+    for name in (
+        "serving_host_dispatches_total",
+        "serving_tokens_per_dispatch",
+        "serving_host_ms_per_tick",
+    ):
+        assert name in exposition, name
+
+
+def test_fused_tick_cancel_from_stream_callback(rng):
+    """Regression: cancel() issued from inside an on_token stream
+    callback (the client-disconnect pattern) mid-fused-block must drop
+    the slot's surplus device tokens and leave neighbours delivering —
+    not crash the tick on the released slot's None record."""
+    cfg, model, prompt, params = _build(rng, n_rows=2)
+    ref = np.asarray(generate(model, params, prompt[1:2], max_new_tokens=12))
+    eng = ServingEngine(
+        model, params, n_slots=2,
+        scheduler=SchedulerConfig(max_prefills_per_tick=2),
+        decode_steps_per_tick=8,
+    )
+    got = []
+
+    def disconnect(ev):
+        got.append(ev.token)
+        if len(got) == 3:  # mid-block: 8-token device blocks
+            assert eng.cancel(victim.request.request_id)
+
+    victim = eng.add_request(
+        _req(prompt[0], 12, on_token=disconnect)
+    )
+    neighbour = eng.add_request(_req(prompt[1], 12))
+    eng.run()
+    assert victim.status == "cancelled"
+    assert len(victim.tokens) == 3  # surplus block tokens dropped
+    assert neighbour.status == FINISHED
+    np.testing.assert_array_equal(np.asarray(neighbour.tokens), ref[0])
+    assert eng.pool.n_free == 2
+    # a callback cancelling a DIFFERENT slot mid-loop is survived too
+    eng2 = ServingEngine(
+        model, params, n_slots=2,
+        scheduler=SchedulerConfig(max_prefills_per_tick=2),
+        decode_steps_per_tick=8,
+    )
+    outs = {}
+
+    def shoot_other(ev):
+        other = outs.get("b")
+        if other is not None and not other.done:
+            eng2.cancel(other.request.request_id)
+
+    outs["a"] = eng2.add_request(_req(prompt[0], 12, on_token=shoot_other))
+    outs["b"] = eng2.add_request(_req(prompt[1], 12))
+    eng2.run()
+    assert outs["a"].status == FINISHED
+    assert outs["b"].status == "cancelled"
+    assert eng2.pool.n_free == 2
+    # ... and on the SPECULATIVE per-step tick (same cancel-mid-loop class)
+    eng3 = ServingEngine(
+        model, params, n_slots=2,
+        scheduler=SchedulerConfig(max_prefills_per_tick=2), draft_tokens=3,
+    )
+    souts = {}
+
+    def spec_shoot(ev):
+        other = souts.get("b")
+        if other is not None and not other.done:
+            eng3.cancel(other.request.request_id)
+
+    souts["a"] = eng3.add_request(_req(prompt[0], 12, on_token=spec_shoot))
+    souts["b"] = eng3.add_request(_req(prompt[1], 12))
+    eng3.run()
+    assert souts["a"].status == FINISHED
+    assert souts["b"].status == "cancelled"
+    assert eng3.pool.n_free == 2
+
+
+def test_fused_tick_knob_validation(rng):
+    """decode_steps_per_tick < 1 refuses; explicit T > 1 with an
+    engine-level drafter refuses (spec keeps its per-step verify tick);
+    'auto' resolves to 8 plain and 1 speculative."""
+    cfg, model, _, params = _build(rng)
+    with pytest.raises(ValueError, match="decode_steps_per_tick"):
+        ServingEngine(model, params, n_slots=1, decode_steps_per_tick=0)
+    with pytest.raises(NotImplementedError, match="draft_tokens"):
+        ServingEngine(
+            model, params, n_slots=1, decode_steps_per_tick=4,
+            draft_tokens=2,
+        )
+    assert ServingEngine(model, params, n_slots=1).decode_steps_per_tick == 8
+    assert (
+        ServingEngine(
+            model, params, n_slots=1, draft_tokens=2
+        ).decode_steps_per_tick
+        == 1
+    )
 
 
 @pytest.mark.slow
@@ -1091,9 +1438,12 @@ def test_engine_prefix_hit_trace_attrs_and_queue_span(rng):
         return clock[0]
 
     tracer = Tracer(clock=fake_clock)
+    # per-step tick: the stall-cause assertions below need pure decode
+    # ticks ("none") to exist, which a fused tick folds away
     eng = ServingEngine(
         model, params, n_slots=1, clock=fake_clock,
         prefill_buckets=(8, 16), prefix_cache_size=2, tracer=tracer,
+        decode_steps_per_tick=1,
     )
     shared = [7, 3, 5, 2, 9, 4, 6, 1]  # one full bucket: a storable prefix
     outs = [

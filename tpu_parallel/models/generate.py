@@ -128,7 +128,9 @@ def decode_step(
     :func:`_generate_core` scan body (aligned batches, ``write_index=None``)
     and the continuous-batching engine (``tpu_parallel.serving.engine``,
     which passes per-row ``write_index`` so each slot's K/V lands at its own
-    cache depth).
+    cache depth — both on its per-step tick and as the scan body of its
+    FUSED multi-step tick, which is what makes fused-vs-per-step greedy
+    output bitwise identical by construction).
     """
     hidden, updated = model.apply(
         {"params": params, "cache": cache},

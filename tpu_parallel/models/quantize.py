@@ -8,8 +8,11 @@ than fp32, ~2x smaller than bf16 on disk and over the wire.
 
 Scope note: this compresses weights *at rest*.  Runtime HBM during decode
 is dominated by the KV cache, which has its own int8 option
-(``TransformerConfig.kv_cache_dtype`` — layers.py); dequantizing the whole
-tree before ``model.apply`` means the live weights are bf16 as usual.
+(``TransformerConfig.kv_cache_dtype`` — layers.py) read int8-NATIVELY at
+attention time (the per-(position, kv-head) scales fold into the score
+and value matmuls, so no dequantized cache copy is materialized);
+dequantizing the whole weight tree before ``model.apply`` means the live
+weights are bf16 as usual.
 
 No reference capability (the reference has no inference path at all).
 """

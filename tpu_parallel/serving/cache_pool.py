@@ -16,6 +16,16 @@ Memory model: pool bytes are fixed at construction —
 requests are in flight.  There is no paging/fragmentation (slots are
 whole-sequence rows, the simplest correct layout); ``kv_cache_dtype="int8"``
 halves the payload exactly as on the static path.
+
+Donation invariant: every WRITE op on the pool (insert / scatter / clear
+/ copy_prefix) and every engine decode tick — per-step, verify, and the
+fused multi-step tick — DONATES the pool operand, so exactly ONE pool's
+worth of device memory is ever live and XLA recycles it in place.  The
+flip side is an ownership contract: ``pool.cache`` is the only valid
+handle, and a reference to the tree held across any tick or write op
+points at deleted buffers (reads raise; pinned in
+``tests/test_serving.py::test_fused_tick_donation_invalidates_old_buffers``).
+Read-side ops (``extract``, ``stack_prefix``) copy and may be held.
 """
 
 from __future__ import annotations

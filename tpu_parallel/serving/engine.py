@@ -3,12 +3,16 @@ fixed pool of KV-cache slots, with a fast-path prefill.
 
 The static path (``models/generate.py``) decodes a batch run-to-completion:
 every request starts together and the whole batch waits for the longest
-generation.  This engine decodes the SLOT POOL instead — one jitted
-single-token step over all ``n_slots`` rows per tick, compiled once — and
-lets requests join (prefill into a freed slot) and leave (EOS / length
-retirement) between ticks:
+generation.  This engine decodes the SLOT POOL instead — by default one
+jitted FUSED tick of ``decode_steps_per_tick`` (8) masked single-token
+steps in a ``lax.scan`` over all ``n_slots`` rows, with the KV cache and
+the per-slot serving state (device-resident between ticks) donated, so
+the host pays one dispatch + one sync per 8 tokens instead of per token —
+and lets requests join (prefill into a freed slot) and leave (EOS /
+length retirement) between ticks:
 
-- tick = [chunked-prefill advance] + [admissions] + [one decode step] +
+- tick = [chunked-prefill advance] + [admissions] + [one decode tick:
+  ``decode_steps_per_tick`` fused scan steps, each masked per slot] +
   [retirements].  With ``draft_tokens > 0`` the decode step becomes a
   SPECULATIVE verify tick (``serving/spec_decode.py``): a host-side
   drafter proposes up to K tokens per slot, one multi-token forward
@@ -245,6 +249,65 @@ def _decode_core(
     return nxt, cache
 
 
+def _fused_decode_core(
+    model, params, steps, tok, pos, widx, live, budget, eos, temp, topk,
+    topp, cache, rng,
+):
+    """``steps`` masked single-token decode ticks in ONE jitted
+    ``lax.scan`` — the fused engine tick's device body.  Per-slot serving
+    state rides the scan carry as device arrays (current token, cache
+    position, write index, live mask, remaining token budget); the host
+    uploads it only after admissions/releases and otherwise re-donates
+    the returned arrays, so a steady-state decode pays ONE dispatch +
+    ONE sync per ``steps`` tokens instead of per token.
+
+    Each scan step is bit-identical to one per-step ``_decode_core``
+    tick: same ``decode_step``, same last-position lm_head, same per-slot
+    sampler (greedy output is therefore bitwise identical; sampled rows
+    draw from the same per-knob distributions under a per-step folded
+    rng).  A slot that finishes MID-SCAN — EOS sampled, or its budget
+    decremented to zero — drops out of the ``live`` mask: subsequent
+    steps park its cache writes at column ``seq_len`` exactly as
+    inactive slots do on the per-step tick, its state stops advancing,
+    and its emitted positions carry -1.  ``eos`` is -1 for requests
+    without an EOS id (sampled tokens are nonnegative, so -1 never
+    matches).
+
+    Returns ``(block [steps, n_slots], counts [n_slots], state, cache)``
+    where ``block`` holds each step's emitted token per slot (-1 where
+    the slot was not live) and ``counts`` is each slot's progress this
+    tick — live steps form a PREFIX of the scan, so the host delivers
+    ``block[:counts[s], s]`` through the existing StreamEvent path.
+    """
+    cfg = model.config
+    seq_len = cfg.seq_len
+
+    def body(carry, step_rng):
+        tok, pos, widx, live, budget, cache = carry
+        widx_eff = jnp.where(live, widx, seq_len)
+        hidden, cache = decode_step(
+            model, params, cache, tok, pos, write_index=widx_eff
+        )
+        logits = _full_last_logits(cfg, params, hidden)
+        nxt = sample_tokens(logits, step_rng, temp, topk, topp)
+        emitted = jnp.where(live, nxt, -1)
+        budget = budget - live.astype(budget.dtype)
+        done = live & ((nxt == eos) | (budget <= 0))
+        adv = live.astype(pos.dtype)
+        pos = pos + adv
+        widx = widx + adv
+        tok = jnp.where(live, nxt, tok)
+        live = live & ~done
+        return (tok, pos, widx, live, budget, cache), emitted
+
+    (tok, pos, widx, live, budget, cache), block = lax.scan(
+        body, (tok, pos, widx, live, budget, cache),
+        jax.random.split(rng, steps),
+    )
+    counts = (block >= 0).sum(axis=0).astype(jnp.int32)
+    return block, counts, (tok, pos, widx, live, budget), cache
+
+
 def _verify_core(
     model, params, tok, drafts, draft_len, pos, widx, temperature, top_k,
     top_p, cache, rng,
@@ -318,6 +381,48 @@ def _engine_fns(model):
     sample = jax.jit(sample_tokens)
     insert = jax.jit(insert_rows, donate_argnums=0)
     return prefill, extend, decode, verify, sample, insert, default_row_fns()
+
+
+@jax.jit
+def _own_slot_state(tok, pos, widx, live, budget, eos, temp, topk, topp):
+    """ONE dispatch that turns the host's slot-state upload into
+    XLA-OWNED buffers.  ``jnp.asarray`` of a numpy array can be a
+    zero-copy VIEW of host memory on CPU, and the fused tick DONATES the
+    state tuple — donating a borrowed buffer lets XLA recycle memory it
+    does not own, so the returned state would alias freed numpy storage
+    and later host allocations scribble over the live slot state
+    (observed as flaky mid-run corruption under heap churn).  Routing
+    every array through an actual computation defeats jax's
+    input->output forwarding, so the results are always
+    device-allocated; doing all nine in one jitted call keeps the
+    upload at one dispatch instead of nine eager ones."""
+
+    def own(x):
+        if x.dtype == jnp.bool_:
+            return jnp.logical_and(x, True)
+        return x + jnp.zeros((), x.dtype)
+
+    return (
+        tuple(own(x) for x in (tok, pos, widx, live, budget)),
+        tuple(own(x) for x in (eos, temp, topk, topp)),
+    )
+
+
+@functools.lru_cache(maxsize=8)
+def _fused_engine_fn(model, steps: int):
+    """The jitted fused decode tick at compiled width ``steps``, cached
+    per (model, steps) so engines sharing a model share the trace.  The
+    slot-state tuple (argnum 1) and the cache pool (argnum 3) are both
+    DONATED: the engine re-donates the state arrays the previous tick
+    returned, so steady-state decode recycles every buffer in place; the
+    knob tuple (eos/temperature/top_k/top_p, argnum 2) is NOT donated —
+    it only changes on admission, when the host re-uploads anyway."""
+    return jax.jit(
+        lambda params, state, knobs, cache, rng: _fused_decode_core(
+            model, params, steps, *state, *knobs, cache, rng
+        ),
+        donate_argnums=(1, 3),
+    )
 
 
 @functools.lru_cache(maxsize=8)
@@ -408,6 +513,23 @@ class ServingEngine:
       rows (0 = off; each entry is a full seq_len row of HBM).  Requires
       bucketing.
 
+    Fused decode tick (exact — greedy output bitwise identical to the
+    per-step engine, pinned in ``tests/test_serving.py``):
+
+    - ``decode_steps_per_tick``: T > 1 runs T masked decode steps in ONE
+      jitted ``lax.scan`` with the KV cache and per-slot state buffers
+      donated — one host dispatch + one device sync per T tokens instead
+      of per token (the per-step tick's dominant cost at small batch).
+      Slot state (current token, cache position, write index, live mask,
+      remaining budget) lives in device arrays between ticks; the host
+      re-uploads only after admissions/retirements.  Slots finishing
+      mid-scan (EOS, budget) park their writes at column ``seq_len`` for
+      the remaining steps.  Streaming granularity becomes per-tick
+      (bounded by T).  ``"auto"`` (default) = 8; spec engines
+      (``draft_tokens > 0``) and mesh serving keep their per-step paths
+      (auto resolves to 1 there; explicit T > 1 raises).  1 = the
+      per-step engine.
+
     Speculative decode knobs (exact for every drafter — see the module
     docstring and ``docs/10_serving_engine.md``):
 
@@ -458,6 +580,7 @@ class ServingEngine:
         prefill_batch: Optional[int] = None,
         prefill_chunk_tokens: Optional[int] = None,
         prefix_cache_size: int = 0,
+        decode_steps_per_tick: Union[int, str] = "auto",
         draft_tokens: int = 0,
         drafter: Optional[Drafter] = None,
         spec_adaptive: bool = True,
@@ -557,6 +680,40 @@ class ServingEngine:
         )
         self._spec_adaptive = spec_adaptive
         self._spec_check = spec_check_invariants
+
+        # fused multi-step decode tick: T > 1 runs T masked decode steps
+        # in one jitted lax.scan with the cache AND the per-slot state
+        # donated — one host dispatch + one sync per T tokens ("auto" =
+        # 8; the spec path keeps its per-step verify tick, and the
+        # shard_map harness exposes no donation, so both resolve to 1)
+        if decode_steps_per_tick == "auto":
+            fused = 1 if (draft_tokens > 0 or mesh is not None) else 8
+        else:
+            fused = int(decode_steps_per_tick)
+            if fused < 1:
+                raise ValueError(
+                    f"decode_steps_per_tick={decode_steps_per_tick} < 1"
+                )
+            if fused > 1 and draft_tokens > 0:
+                raise NotImplementedError(
+                    "decode_steps_per_tick > 1 with draft_tokens > 0 — "
+                    "speculative slots keep the per-step verify tick "
+                    "(draft-verify is itself a multi-token tick)"
+                )
+            if fused > 1 and mesh is not None:
+                raise NotImplementedError(
+                    "decode_steps_per_tick > 1 under a mesh "
+                    "(build_sharded_serving exposes no buffer donation) "
+                    "— mesh serving decodes per-step"
+                )
+        self._fused_steps = fused
+        self._fused_fn = _fused_engine_fn(model, fused) if fused > 1 else None
+        # device-resident slot state (fused path): uploaded lazily after
+        # host-side mutations, otherwise the previous tick's returned
+        # arrays are re-donated — steady-state decode never re-uploads
+        self._dev_state = None
+        self._dev_knobs = None
+        self._state_dirty = True
 
         pool_shardings = None
         if mesh is not None:
@@ -708,6 +865,7 @@ class ServingEngine:
         self._active[slot] = False
         self._slot_out[slot] = None
         self._widx[slot] = self.model.config.seq_len
+        self._state_dirty = True  # fused path re-uploads before its next tick
         self.pool.release(slot)
 
     def begin_drain(self) -> None:
@@ -743,8 +901,10 @@ class ServingEngine:
         """One engine tick: expire stale queue entries, advance in-flight
         chunked prefills by one chunk each, admit into free slots (bounded
         by the scheduler's prefill budget, same-bucket admissions as one
-        batched prefill), one decode step over the pool, retire finished
-        slots.  Returns this tick's events."""
+        batched prefill), one decode tick over the pool
+        (``decode_steps_per_tick`` fused scan steps — or one per-step /
+        speculative-verify step), retire finished slots.  Returns this
+        tick's events."""
         now = self.clock()
         tr = self.tracer
         tick_span = (
@@ -807,8 +967,9 @@ class ServingEngine:
             stall = STALL_QUEUE_EMPTY
         else:
             stall = STALL_NONE
+        end = self.clock()
         self.metrics.record_tick(
-            now=self.clock(),
+            now=end,
             queue_depth=self.scheduler.depth,
             occupancy=self.pool.occupancy,
             # expiry notifications carry token=-1 — not generated tokens
@@ -816,6 +977,7 @@ class ServingEngine:
             prefills=len(admitted),
             decoded=decoded,
             stall=stall,
+            host_ms=(end - now) * 1000.0,
         )
         if tick_span is not None:
             tick_span.finish(
@@ -862,6 +1024,12 @@ class ServingEngine:
         self.registry = self.metrics.registry
         self.scheduler.registry = self.registry
         return self.metrics
+
+    @property
+    def decode_steps_per_tick(self) -> int:
+        """Decode steps per fused tick (1 = the per-step engine — spec
+        and mesh serving resolve here; ``"auto"`` resolves to 8)."""
+        return self._fused_steps
 
     @property
     def prefill_buckets(self) -> Optional[Tuple[int, ...]]:
@@ -1217,6 +1385,7 @@ class ServingEngine:
         self._spec_k[slot] = cap
         self._active[slot] = True
         self._slot_out[slot] = out
+        self._state_dirty = True  # fused path re-uploads before its next tick
         out.status = RUNNING
         out.first_token_time = self.clock()
         return self._deliver(slot, tok0)
@@ -1224,6 +1393,8 @@ class ServingEngine:
     def _decode_tick(self) -> List[StreamEvent]:
         if self._spec_width > 0:
             return self._spec_tick()
+        if self._fused_steps > 1:
+            return self._fused_tick()
         t0 = self.tracer.now()
         nxt, self.pool.cache = self._decode_fn(
             self.params,
@@ -1245,6 +1416,10 @@ class ServingEngine:
         # every slot's current token was just written into the cache;
         # advance even the slots that retire on this token's delivery
         for slot in np.nonzero(self._active)[0]:
+            if not self._active[slot]:
+                # an earlier slot's on_token callback cancel()ed this one
+                # mid-loop: its slot is released, nothing to deliver
+                continue
             if trace:
                 out = self._slot_out[slot]
                 self.tracer.record(
@@ -1256,6 +1431,115 @@ class ServingEngine:
             self._widx[slot] += 1
             self._tok[slot] = int(nxt[slot])
             events.append(self._deliver(int(slot), int(nxt[slot])))
+        # DELIVERED tokens (== _spec_tick's numerator): a slot cancelled
+        # mid-loop by a stream callback contributes nothing
+        self.metrics.record_dispatch(tokens=len(events))
+        return events
+
+    def _upload_slot_state(self) -> None:
+        """Rebuild the device-resident slot-state arrays from the host
+        mirrors.  Runs only after a host-side mutation (admission,
+        retirement, cancel); between mutations the fused tick re-donates
+        the arrays the previous tick returned, so a steady-state decode
+        never re-uploads.  Budget and EOS derive from the live request
+        records (budget = remaining new tokens; EOS -1 = no stop id)."""
+        n = self.pool.n_slots
+        budget = np.zeros(n, np.int32)
+        eos = np.full(n, -1, np.int32)
+        for slot in np.nonzero(self._active)[0]:
+            out = self._slot_out[slot]
+            budget[slot] = out.request.max_new_tokens - len(out.tokens)
+            if out.request.eos_token_id is not None:
+                eos[slot] = int(out.request.eos_token_id)
+
+        # one jitted call producing XLA-OWNED buffers (never zero-copy
+        # views of the host mirrors — see _own_slot_state for why
+        # donating a borrowed buffer corrupts live state)
+        self._dev_state, self._dev_knobs = _own_slot_state(
+            self._tok, self._pos, self._widx, self._active, budget,
+            eos, self._temp, self._topk, self._topp,
+        )
+        self._state_dirty = False
+
+    def _fused_tick(self) -> List[StreamEvent]:
+        """One FUSED decode tick: ``_fused_steps`` masked decode steps in
+        one jitted lax.scan with the cache and slot-state buffers donated
+        (:func:`_fused_decode_core`).  The host unpacks the returned
+        ``[T, n_slots]`` token block and per-slot progress counts through
+        the existing per-token delivery path — greedy output is bitwise
+        identical to the per-step tick; streaming granularity becomes
+        per-tick (at most ``decode_steps_per_tick`` tokens per event
+        flush)."""
+        t0 = self.tracer.now()
+        if self._state_dirty or self._dev_state is None:
+            self._upload_slot_state()
+        block, counts, self._dev_state, self.pool.cache = self._fused_fn(
+            self.params, self._dev_state, self._dev_knobs,
+            self.pool.cache, self._next_rng(),
+        )
+        # ONE device->host sync per T decode steps — the whole point
+        block, counts = np.asarray(block), np.asarray(counts)
+        stuck = [
+            int(s) for s in np.nonzero(self._active)[0] if counts[s] == 0
+        ]
+        if stuck:
+            # an active slot always enters the scan live with budget >= 1,
+            # so zero progress means the device state desynced from the
+            # host mirrors — fail loudly instead of spinning run() forever
+            raise RuntimeError(
+                f"fused tick made no progress on active slots {stuck} "
+                f"(device live={np.asarray(self._dev_state[3])}, "
+                f"budget={np.asarray(self._dev_state[4])}) — slot state "
+                "desynced from host mirrors"
+            )
+        events: List[StreamEvent] = []
+        trace = self.tracer.enabled
+        t1 = self.tracer.now()
+        if trace:
+            self.tracer.record(
+                "decode_tick", "scheduler", t0, t1,
+                steps=self._fused_steps, tokens=int(counts.sum()),
+            )
+        for slot in np.nonzero(self._active)[0]:
+            c = int(counts[slot])
+            # re-check liveness: a stream callback may have cancel()ed
+            # this slot (releasing it, _slot_out -> None) while an
+            # earlier slot's tokens were being delivered
+            if c == 0 or not self._active[slot]:
+                continue
+            if trace:
+                out = self._slot_out[slot]
+                self.tracer.record(
+                    "decode", f"slot {int(slot)}", t0, t1,
+                    request_id=out.request.request_id, slot=int(slot),
+                    token_index=len(out.tokens), tokens=c,
+                )
+            # host mirrors advance by the slot's full progress BEFORE
+            # delivery (delivery may finish the request and release the
+            # slot, which parks the mirror at seq_len again)
+            self._pos[slot] += c
+            self._widx[slot] += c
+            self._tok[slot] = int(block[c - 1, slot])
+            for t in range(c):
+                event = self._deliver(int(slot), int(block[t, slot]))
+                events.append(event)
+                if event.finished and t != c - 1:
+                    # the scan stopped emitting AT the finish: the
+                    # device's EOS/budget logic and _deliver's must agree
+                    # token-for-token, or tokens would silently vanish
+                    raise AssertionError(
+                        f"slot {slot}: host finished at token {t + 1} of "
+                        f"a {c}-token device block"
+                    )
+                if not self._active[slot]:
+                    # finished naturally, or the on_token callback
+                    # cancelled the request mid-block: the surplus
+                    # device tokens die with the released slot
+                    break
+        # DELIVERED tokens, not counts.sum(): cancelled slots' surplus
+        # device tokens are dropped above, and all three tick types keep
+        # the same amortization numerator (see record_dispatch docstring)
+        self.metrics.record_dispatch(tokens=len(events))
         return events
 
     def _spec_tick(self) -> List[StreamEvent]:
@@ -1313,6 +1597,10 @@ class ServingEngine:
         if trace:
             self.tracer.record("verify_tick", "scheduler", t0, t1, width=k)
         for slot in active:
+            if not self._active[slot]:
+                # an earlier slot's on_token callback cancel()ed this one
+                # mid-loop: slot released, its accepted block dies with it
+                continue
             a = int(accepted[slot])
             drafted = int(dlen[slot])
             if trace:
@@ -1351,6 +1639,7 @@ class ServingEngine:
                 )
             if self._spec_check:
                 self.pool.assert_slot_aligned(int(slot))
+        self.metrics.record_dispatch(tokens=len(events))
         return events
 
     def _deliver(self, slot: int, token: int) -> StreamEvent:

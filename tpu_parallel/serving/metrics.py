@@ -104,6 +104,19 @@ class ServingMetrics:
         self._tokens_accepted = r.counter("serving_tokens_accepted_total")
         self._spec_wasted = r.counter("serving_spec_wasted_positions_total")
         self._spec_acceptance = r.histogram("serving_spec_acceptance_ratio")
+        # dispatch amortization: every jitted model-forward the engine
+        # issues (prefill/extend/chunk/decode/verify/fused) counts one
+        # host dispatch; decode-family dispatches additionally observe
+        # how many generated tokens they delivered — the fused tick's
+        # whole win is this histogram's mean moving from ~batch to
+        # ~batch * decode_steps_per_tick.  host_ms_per_tick is the
+        # engine-clock wall time of each step() (host bookkeeping +
+        # device wait), the per-tick cost the amortization divides.
+        self._host_dispatches = r.counter("serving_host_dispatches_total")
+        self._tokens_per_dispatch = r.histogram(
+            "serving_tokens_per_dispatch"
+        )
+        self._host_ms_per_tick = r.histogram("serving_host_ms_per_tick")
         # per-tick stall attribution, pre-registered so every cause shows
         # a (possibly zero) series in exports
         self._stall = {
@@ -192,6 +205,10 @@ class ServingMetrics:
     def spec_wasted_positions(self) -> int:
         return int(self._spec_wasted.value)
 
+    @property
+    def host_dispatches(self) -> int:
+        return int(self._host_dispatches.value)
+
     # -- recording ---------------------------------------------------------
 
     def record_tick(
@@ -203,11 +220,14 @@ class ServingMetrics:
         prefills: int,
         decoded: bool,
         stall: Optional[str] = None,
+        host_ms: Optional[float] = None,
     ) -> None:
         if self._t_start is None:
             self._t_start = now
         self._t_last = now
         self._ticks.inc()
+        if host_ms is not None:
+            self._host_ms_per_tick.observe(host_ms)
         if decoded:
             self._decode_ticks.inc()
         self._tokens_out.inc(new_tokens)
@@ -252,9 +272,19 @@ class ServingMetrics:
 
     def record_prefill_call(self, chunks: int = 0) -> None:
         """One batched prefill device call (``chunks`` counts any chunk
-        continuations it was split into)."""
+        continuations it was split into).  Every prefill call is also a
+        host dispatch."""
         self._prefill_calls.inc()
         self._prefill_chunks.inc(chunks)
+        self._host_dispatches.inc()
+
+    def record_dispatch(self, tokens: Optional[int] = None) -> None:
+        """One decode-family host->device dispatch (per-step decode,
+        speculative verify, or fused tick); ``tokens`` is how many
+        generated tokens it delivered — the amortization numerator."""
+        self._host_dispatches.inc()
+        if tokens is not None:
+            self._tokens_per_dispatch.observe(tokens)
 
     def record_spec(self, drafted: int, accepted: int, wasted: int) -> None:
         """One active slot's share of a speculative verify tick: how many
@@ -323,6 +353,20 @@ class ServingMetrics:
                 round(self.tokens_out / self.decode_ticks, 3)
                 if self.decode_ticks
                 else None
+            ),
+            "host_dispatches": self.host_dispatches,
+            "tokens_per_dispatch_mean": hist_mean(
+                self._tokens_per_dispatch, 3
+            ),
+            "host_ms_per_tick_p50": (
+                None
+                if self._host_ms_per_tick.percentile(50) is None
+                else round(self._host_ms_per_tick.percentile(50), 3)
+            ),
+            "host_ms_per_tick_p95": (
+                None
+                if self._host_ms_per_tick.percentile(95) is None
+                else round(self._host_ms_per_tick.percentile(95), 3)
             ),
             "tokens_per_sec": (
                 round(self.throughput(), 1)

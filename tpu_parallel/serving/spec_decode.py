@@ -443,7 +443,8 @@ def generate_speculative(
             jnp.asarray(dlen), jnp.asarray(pos), jnp.asarray(widx),
             temp, tk, tp, cache, split(),
         )
-        block, accepted = np.asarray(block), np.asarray(accepted)
+        # one sync per verify tick — the tick boundary, not per slot
+        block, accepted = np.asarray(block), np.asarray(accepted)  # host-sync: tick-boundary
         ticks += 1
         for r in range(b):
             if len(out[r]) >= max_new_tokens:
