@@ -23,10 +23,19 @@ router policy) — ``--router`` takes a comma list (rr, least, prefix) so
 one run compares policies on identical workloads (TTFT p95, aggregate
 prefix hit rate, retries).  ``--prefix-groups G`` shapes the workload as
 G distinct shared system-headers assigned randomly across requests — the
-repeated-prefix stream prefix-affinity routing exists for.  ``--fault``
-arms a FaultPlan that CRASHES one replica mid-run; the record then also
-shows the retry/failover cost (every request still completes, replayed
-via forced-prefix re-prefill on the survivors).
+repeated-prefix stream prefix-affinity routing exists for.
+``--fault-spec`` arms per-replica FaultPlans from a comma list of
+``RID:KIND@ARG`` entries — ``0:crash@8`` (crash replica 0 at its tick
+8), ``1:stall@4+6`` (6 no-op ticks from tick 4), ``2:flap@10``
+(crash-loop every 10th incarnation tick), ``0:reject@3+5`` (admission
+refusals); entries for the same replica merge.  ``--fault`` stays as an
+alias for the original ``0:crash@8``.  When any spec includes a flap —
+or with ``--chaos SEED``, which draws the whole per-replica schedule
+from ``FaultPlan.from_seed`` — replicas get engine factories and the
+frontend's RestartPolicy circuit breaker, so the record carries the
+full fault-storm story: deaths, watchdog trips, restarts, probation
+promotions (every request still completes, replayed via forced-prefix
+re-prefill).
 
 ``--trace-out`` records every measured point's request lifecycles
 (queue -> prefill[/chunk] -> decode/verify -> finish, one Perfetto track
@@ -204,29 +213,85 @@ def run_point(model, params, cfg, prompts, *, rate, n_slots, new_tokens,
     }
 
 
+def parse_fault_spec(spec: str):
+    """``RID:KIND@ARG`` comma list -> per-replica FaultPlan dict.
+    Kinds: ``crash@T`` (one-shot crash at tick T), ``stall@T+N`` (N
+    no-op ticks from T; N defaults 4), ``flap@K`` (crash-loop: every
+    incarnation dies on its K-th step), ``reject@T+N`` (admission-reject
+    window).  Entries for one replica merge into a single plan."""
+    import dataclasses
+
+    from tpu_parallel.cluster import FaultPlan
+
+    plans = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            rid_s, rest = part.split(":", 1)
+            kind, _, arg = rest.partition("@")
+            rid = int(rid_s)
+            kw = {}
+            if kind == "crash":
+                kw["crash_at_tick"] = int(arg)
+            elif kind == "stall":
+                at, _, n = arg.partition("+")
+                kw["stall_at_tick"] = int(at)
+                kw["stall_ticks"] = int(n) if n else 4
+            elif kind == "flap":
+                kw["crash_every"] = int(arg)
+            elif kind == "reject":
+                at, _, n = arg.partition("+")
+                kw["reject_at_tick"] = int(at)
+                kw["reject_ticks"] = int(n) if n else 4
+            else:
+                raise SystemExit(
+                    f"bad --fault-spec kind {kind!r} "
+                    "(want crash | stall | flap | reject)"
+                )
+        except ValueError:
+            raise SystemExit(f"bad --fault-spec entry {part!r}")
+        plans[rid] = dataclasses.replace(
+            plans.get(rid, FaultPlan()), **kw
+        )
+    return plans
+
+
 def run_cluster_point(model, params, cfg, prompts, *, rate, n_replicas,
                       router, n_slots, new_tokens, seed, engine_kwargs,
-                      fault=False, warm=True, tracer=None):
+                      fault_plans=None, chaos_seed=None, warm=True,
+                      tracer=None):
     """One cluster-mode measurement: ``n_replicas`` engines behind the
     Frontend under the given router policy, same Poisson arrival stream
-    as :func:`run_point`.  ``fault=True`` arms a FaultPlan crashing
-    replica 0 mid-run (the survivors absorb its work via forced-prefix
-    retries).  Engine jits are shared per model, so ``warm`` drives one
-    throwaway frontend to compile everything outside the measured
-    window."""
-    from tpu_parallel.cluster import FaultPlan, Frontend, ReplicaHandle
+    as :func:`run_point`.  ``fault_plans`` (replica id -> FaultPlan, see
+    :func:`parse_fault_spec`) injects deterministic faults mid-run;
+    ``chaos_seed`` instead draws every replica's schedule from
+    ``FaultPlan.from_seed``.  Whenever faults can kill replicas
+    repeatedly (any flap, or chaos mode) the replicas get engine
+    factories so the frontend's RestartPolicy circuit breaker can
+    heal the fleet mid-run — the record carries the storm counters.
+    Engine jits are shared per model, so ``warm`` drives one throwaway
+    frontend to compile everything outside the measured window."""
+    from tpu_parallel.cluster import (
+        FaultPlan,
+        Frontend,
+        FrontendConfig,
+        ReplicaHandle,
+        RestartPolicy,
+    )
     from tpu_parallel.serving import Request, SchedulerConfig, ServingEngine
 
+    def make_engine(i):
+        return ServingEngine(
+            model, params, n_slots=n_slots,
+            scheduler=SchedulerConfig(max_prefills_per_tick=2),
+            rng=jax.random.PRNGKey(seed + 1000 * i),
+            **engine_kwargs,
+        )
+
     def make_engines():
-        return [
-            ServingEngine(
-                model, params, n_slots=n_slots,
-                scheduler=SchedulerConfig(max_prefills_per_tick=2),
-                rng=jax.random.PRNGKey(seed + 1000 * i),
-                **engine_kwargs,
-            )
-            for i in range(n_replicas)
-        ]
+        return [make_engine(i) for i in range(n_replicas)]
 
     if warm:
         fe = Frontend(make_engines(), router=router)
@@ -241,13 +306,39 @@ def run_cluster_point(model, params, cfg, prompts, *, rate, n_replicas,
         if rate > 0:
             t += rnd.expovariate(rate)
 
+    if chaos_seed is not None:
+        crnd = random.Random(chaos_seed)
+        fault_plans = {
+            i: FaultPlan.from_seed(
+                random.Random(crnd.randrange(2 ** 31)), 64
+            )
+            for i in range(n_replicas)
+        }
+    fault_plans = fault_plans or {}
+    # self-healing matters once a replica can die more than once (flap /
+    # chaos); one-shot crash specs keep the historical no-restart shape
+    # so --fault records stay comparable to SERVE_r03
+    selfheal = chaos_seed is not None or any(
+        p.crash_every is not None for p in fault_plans.values()
+    )
     handles = []
     for i, eng in enumerate(make_engines()):
-        plan = (
-            FaultPlan(crash_at_tick=8) if (fault and i == 0) else None
+        handles.append(
+            ReplicaHandle(
+                i, eng, fault_plan=fault_plans.get(i),
+                engine_factory=(
+                    (lambda i=i: make_engine(i)) if selfheal else None
+                ),
+            )
         )
-        handles.append(ReplicaHandle(i, eng, fault_plan=plan))
-    fe = Frontend(handles, router=router, tracer=tracer)
+    config = FrontendConfig(
+        retry_limit=16 if selfheal else 3,
+        watchdog_ticks=5, watchdog_kill_ticks=20,
+        restart=RestartPolicy(
+            backoff_seconds=0.05, probation_ticks=4, probation_requests=2
+        ),
+    )
+    fe = Frontend(handles, router=router, tracer=tracer, config=config)
 
     t0 = time.perf_counter()
     outs, submitted = [], 0
@@ -288,7 +379,8 @@ def run_cluster_point(model, params, cfg, prompts, *, rate, n_replicas,
         "backend": jax.default_backend(),
         "router": s["router"],
         "replicas": n_replicas,
-        "fault": bool(fault),
+        "fault": bool(fault_plans),
+        "chaos_seed": chaos_seed,
         "n_requests": n_requests,
         "arrival_rate_per_sec": rate if rate > 0 else "all_at_once",
         "n_slots": n_slots,
@@ -305,6 +397,10 @@ def run_cluster_point(model, params, cfg, prompts, *, rate, n_replicas,
         "retries": s["retries"],
         "requeued": s["requeued"],
         "replica_deaths": s["replica_deaths"],
+        "watchdog_degraded": s["watchdog_degraded"],
+        "watchdog_kills": s["watchdog_kills"],
+        "restarts": s["restarts"],
+        "probation_promotions": s["probation_promotions"],
         "prefix_hit_rate": s["prefix_hit_rate"],
         "ttft_ms_p50": s["ttft_ms_p50"],
         "ttft_ms_p95": s["ttft_ms_p95"],
@@ -450,8 +546,18 @@ def main():
                     help="cluster routing policy or comma list to "
                          "compare: rr | least | prefix")
     ap.add_argument("--fault", action="store_true",
-                    help="cluster mode: crash replica 0 mid-run via a "
-                         "FaultPlan; records the failover cost")
+                    help="cluster mode: alias for --fault-spec 0:crash@8 "
+                         "(the historical crash-one-replica scenario)")
+    ap.add_argument("--fault-spec", type=str, default="",
+                    help="cluster mode: per-replica faults as a comma "
+                         "list of RID:KIND@ARG — crash@T | stall@T+N | "
+                         "flap@K | reject@T+N (e.g. "
+                         "'0:crash@8,1:stall@4+6,2:flap@10')")
+    ap.add_argument("--chaos", type=int, default=None, metavar="SEED",
+                    help="cluster mode: draw every replica's fault "
+                         "schedule from FaultPlan.from_seed(SEED) with "
+                         "self-healing armed; the record carries the "
+                         "fault-storm counters")
     ap.add_argument("--prefix-groups", type=int, default=4,
                     help="distinct shared system-headers in the "
                          "--prompt-dist workload (cluster mode: the "
@@ -553,6 +659,22 @@ def main():
                 "(compare router policies via --router rr,least,prefix)",
                 file=sys.stderr,
             )
+        fault_spec = args.fault_spec
+        if args.fault and not fault_spec:
+            fault_spec = "0:crash@8"  # the pre-PR-8 hardcoded scenario
+        if fault_spec and args.chaos is not None:
+            raise SystemExit(
+                "--chaos and --fault/--fault-spec are mutually exclusive "
+                "(chaos mode draws every replica's schedule from the seed)"
+            )
+        fault_plans = parse_fault_spec(fault_spec) if fault_spec else None
+        if fault_plans:
+            bad = [r for r in fault_plans if r >= args.replicas]
+            if bad:
+                raise SystemExit(
+                    f"--fault-spec names replicas {bad} but only "
+                    f"{args.replicas} exist"
+                )
         tracer = None
         if args.trace_out:
             from tpu_parallel.obs import Tracer
@@ -568,8 +690,11 @@ def main():
                     rate=rate, n_replicas=args.replicas, router=policy,
                     n_slots=args.slots, new_tokens=new_tokens,
                     seed=args.seed, engine_kwargs=dict(fast),
-                    fault=args.fault, warm=warm, tracer=tracer,
+                    fault_plans=fault_plans, chaos_seed=args.chaos,
+                    warm=warm, tracer=tracer,
                 )
+                if fault_spec:
+                    record["fault_spec"] = fault_spec
                 warm = False  # jits shared per model: warm once
                 logger.log_record(record)
         logger.close()

@@ -8,6 +8,13 @@ hashing), token-budget backpressure with typed rejections, priority
 classes with anti-starvation aging, per-request deadlines that cancel
 in-engine work, and replica-death retries that replay delivered tokens
 as a forced prefix so streamed output stays exactly consistent.
+
+The cluster SELF-HEALS: a progress watchdog detects stalled replicas
+from observed no-progress (degrade, then kill + replay), and dead
+replicas carrying an ``engine_factory`` are rebuilt under a
+:class:`RestartPolicy` circuit breaker — exponential backoff, half-open
+probation, promotion back to healthy.  ``scripts/chaos_bench.py`` soaks
+the whole story under seeded randomized fault storms.
 """
 
 from tpu_parallel.cluster.frontend import (
@@ -16,12 +23,15 @@ from tpu_parallel.cluster.frontend import (
     FrontendConfig,
 )
 from tpu_parallel.cluster.replica import (
+    BACKOFF,
     DEAD,
     DEGRADED,
     HEALTHY,
+    PROBATION,
     FaultPlan,
     ReplicaDead,
     ReplicaHandle,
+    RestartPolicy,
 )
 from tpu_parallel.cluster.router import (
     LeastLoadedRouter,
@@ -40,9 +50,12 @@ __all__ = [
     "ReplicaHandle",
     "ReplicaDead",
     "FaultPlan",
+    "RestartPolicy",
     "HEALTHY",
     "DEGRADED",
     "DEAD",
+    "BACKOFF",
+    "PROBATION",
     "Router",
     "RoundRobinRouter",
     "LeastLoadedRouter",

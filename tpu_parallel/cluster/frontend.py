@@ -30,8 +30,22 @@ replica, after however many failures:
   bitwise identical to a never-failed run — the stream just continues.
   Tokens are never re-streamed and never lost.  ``retry_limit`` bounds
   the replay of a request that keeps landing on dying replicas
-  (``failed``/``retry_limit``), and a cluster with no live replica fails
-  pending work loudly (``no_replica``) instead of queueing forever.
+  (``failed``/``retry_limit``), and a cluster dead BEYOND RECOVERY fails
+  pending work loudly (``no_replica``) instead of queueing forever —
+  while any restart is pending, pending work holds here instead, so a
+  full-fleet flap doesn't fail every request.
+- **Self-healing** (docs/12_cluster.md draws the state machine): a
+  progress WATCHDOG marks a replica that has work but delivers nothing
+  for ``watchdog_ticks`` cluster ticks DEGRADED, and after
+  ``watchdog_kill_ticks`` declares it DEAD with its work orphaned
+  through the normal forced-prefix replay — stalls are detected from
+  observed behavior, never from the injection side.  Dead replicas with
+  an ``engine_factory`` are rebuilt under a :class:`~tpu_parallel.
+  cluster.replica.RestartPolicy` circuit breaker: exponential backoff
+  on the injectable clock (BACKOFF), then a half-open PROBATION window
+  (bounded concurrent requests; ``probation_ticks`` clean ticks promote
+  to HEALTHY; a probation death trips the breaker and doubles the
+  backoff) until the budget (``max_restarts``) runs out.
 - **Graceful drain**: ``drain()`` closes the admission gate, pulls every
   replica's QUEUED remainder back and re-routes it across live replicas
   (the queue stuck behind one busy engine redistributes), then ticks
@@ -56,11 +70,14 @@ import time
 from typing import Callable, Dict, List, Optional, Sequence, Union
 
 from tpu_parallel.cluster.replica import (
+    BACKOFF,
     DEAD,
     DEGRADED,
     HEALTHY,
+    PROBATION,
     ReplicaDead,
     ReplicaHandle,
+    RestartPolicy,
 )
 from tpu_parallel.cluster.router import (
     PrefixAffinityRouter,
@@ -86,7 +103,22 @@ from tpu_parallel.serving.request import (
     StreamEvent,
 )
 
-_HEALTH_CODE = {HEALTHY: 0.0, DEGRADED: 1.0, DEAD: 2.0}
+_HEALTH_CODE = {
+    HEALTHY: 0.0,
+    DEGRADED: 1.0,
+    DEAD: 2.0,
+    BACKOFF: 3.0,
+    PROBATION: 4.0,
+}
+# circuit-breaker state per replica: 0 = closed (serving), 1 = half-open
+# (probation trickle), 2 = open (dead / waiting out backoff)
+_BREAKER_CODE = {
+    HEALTHY: 0.0,
+    DEGRADED: 0.0,
+    PROBATION: 1.0,
+    BACKOFF: 2.0,
+    DEAD: 2.0,
+}
 
 
 @dataclasses.dataclass(frozen=True)
@@ -111,6 +143,20 @@ class FrontendConfig:
       the frontend keeps just enough queued per replica to refill every
       slot and holds the rest HERE, where effective priority (with
       aging) re-sorts the backlog every tick.
+    - ``watchdog_ticks`` / ``watchdog_kill_ticks``: the progress
+      watchdog.  A replica that ``has_work()`` but makes NO observable
+      progress (no stream events, no prefill advance) for
+      ``watchdog_ticks`` consecutive cluster ticks is marked DEGRADED
+      (drained of new routing while anything healthy exists); at
+      ``watchdog_kill_ticks`` it is declared DEAD and its work replays
+      elsewhere through the forced-prefix path — stall DETECTION from
+      behavior alone, with zero help from the injection side.  None
+      disables that threshold.  Progress clears the counter and restores
+      a DEGRADED replica to HEALTHY.
+    - ``restart``: the :class:`~tpu_parallel.cluster.replica.
+      RestartPolicy` circuit breaker (None = dead replicas stay dead).
+      Only replicas carrying an ``engine_factory`` are ever restarted;
+      backoff timing flows through the frontend's injectable clock.
     """
 
     max_inflight_tokens: Optional[int] = None
@@ -118,6 +164,11 @@ class FrontendConfig:
     aging_seconds: float = 10.0
     retry_limit: int = 3
     dispatch_queue_depth: Optional[int] = None
+    watchdog_ticks: Optional[int] = 10
+    watchdog_kill_ticks: Optional[int] = 40
+    restart: Optional[RestartPolicy] = dataclasses.field(
+        default_factory=RestartPolicy
+    )
 
     def __post_init__(self):
         if self.aging_seconds <= 0:
@@ -130,6 +181,22 @@ class FrontendConfig:
             raise ValueError(
                 f"dispatch_queue_depth={self.dispatch_queue_depth} < 1"
             )
+        if self.watchdog_ticks is not None and self.watchdog_ticks < 1:
+            raise ValueError(f"watchdog_ticks={self.watchdog_ticks} < 1")
+        if self.watchdog_kill_ticks is not None:
+            if self.watchdog_kill_ticks < 1:
+                raise ValueError(
+                    f"watchdog_kill_ticks={self.watchdog_kill_ticks} < 1"
+                )
+            if (
+                self.watchdog_ticks is not None
+                and self.watchdog_kill_ticks <= self.watchdog_ticks
+            ):
+                raise ValueError(
+                    f"watchdog_kill_ticks={self.watchdog_kill_ticks} must "
+                    f"exceed watchdog_ticks={self.watchdog_ticks} — a "
+                    "replica must degrade before it is killed"
+                )
 
 
 @dataclasses.dataclass
@@ -139,6 +206,25 @@ class ClusterOutput(RequestOutput):
 
     replicas: List[int] = dataclasses.field(default_factory=list)
     retries: int = 0
+
+
+class _Recovery:
+    """Frontend-internal self-healing state for one replica: the
+    watchdog's stall counter, the circuit breaker's failure/attempt
+    tallies, the pending restart deadline, and probation progress."""
+
+    __slots__ = (
+        "stall_ticks", "failures", "attempts", "clean_ticks",
+        "restart_at", "probation",
+    )
+
+    def __init__(self):
+        self.stall_ticks = 0  # consecutive no-progress ticks with work
+        self.failures = 0  # consecutive deaths since the last promotion
+        self.attempts = 0  # lifetime restart attempts (breaker budget)
+        self.clean_ticks = 0  # exception-free ticks this probation
+        self.restart_at: Optional[float] = None  # frontend-clock deadline
+        self.probation = False  # currently half-open
 
 
 class _ClientState:
@@ -213,6 +299,17 @@ class Frontend:
         self._cancelled = r.counter("cluster_cancelled_total")
         self._failed = r.counter("cluster_failed_total")
         self._deaths = r.counter("cluster_replica_deaths_total")
+        self._watchdog_degraded = r.counter(
+            "cluster_watchdog_degraded_total"
+        )
+        self._watchdog_kills = r.counter("cluster_watchdog_kills_total")
+        self._restarts = r.counter("cluster_restarts_total")
+        self._restart_failures = r.counter("cluster_restart_failures_total")
+        self._promotions = r.counter("cluster_probation_promotions_total")
+        self._demotions = r.counter("cluster_probation_demotions_total")
+        self._recovery: Dict[int, _Recovery] = {
+            h.replica_id: _Recovery() for h in self.replicas
+        }
         self._imbalance = r.histogram("cluster_route_imbalance")
         self._ttft = r.histogram("cluster_ttft_seconds")
         self._e2e = r.histogram("cluster_e2e_seconds")
@@ -281,24 +378,42 @@ class Frontend:
     # -- the tick ----------------------------------------------------------
 
     def step(self) -> List[StreamEvent]:
-        """One cluster tick: enforce deadlines, dispatch pending work
-        through the router, tick every live replica (deaths collected and
-        their work re-routed THIS tick), publish per-replica telemetry.
-        Returns the tick's cluster-level StreamEvents (client request
-        ids, cluster token indices)."""
+        """One cluster tick: fire due restarts, enforce deadlines,
+        dispatch pending work through the router, tick every live
+        replica (deaths collected and their work re-routed THIS tick,
+        the progress watchdog fed from each replica's observed output),
+        publish per-replica telemetry.  Returns the tick's cluster-level
+        StreamEvents (client request ids, cluster token indices)."""
         now = self.clock()
         self._events = []
+        self._service_restarts(now)
         self._enforce_deadlines(now)
         self._dispatch(now)
         for handle in self.replicas:
-            if handle.health == DEAD:
+            if handle.health in (DEAD, BACKOFF):
                 continue
+            # progress is judged from OBSERVED behavior only: stream
+            # events out, or prefill work consumed (a mid-chunk tick
+            # delivers no token yet clearly advances)
+            had_work = handle.has_work()
+            prefill_before = handle.pending_prefill_tokens
             try:
-                handle.step()
+                events = handle.step()
             except ReplicaDead:
                 self._on_death(handle)
+                continue
+            progressed = bool(events) or (
+                handle.pending_prefill_tokens < prefill_before
+            )
+            if handle.health == PROBATION:
+                self._probation_tick(handle, had_work, progressed)
+            self._watchdog(handle, had_work, progressed)
         # re-place retries and bounced attempts without losing a tick
         self._dispatch(self.clock())
+        # loud failure ONLY with the whole fleet dead beyond recovery: a
+        # replica in backoff/probation (or rescheduled for restart) means
+        # capacity is coming back, so pending work HOLDS in the frontend
+        # queue instead of failing a full-fleet flap's every request
         if all(h.health == DEAD for h in self.replicas):
             for st in list(self._pending):
                 self._pending.remove(st)
@@ -308,6 +423,129 @@ class Frontend:
         self._publish()
         events, self._events = self._events, []
         return events
+
+    # -- self-healing ------------------------------------------------------
+
+    def _service_restarts(self, now: float) -> None:
+        """Fire every due restart: rebuild the engine through the
+        handle's factory and enter PROBATION.  A factory failure counts
+        against the breaker budget and doubles the backoff; an exhausted
+        budget leaves the replica DEAD (breaker open for good)."""
+        policy = self.config.restart
+        if policy is None:
+            return
+        for handle in self.replicas:
+            if handle.health != BACKOFF:
+                continue
+            rec = self._recovery[handle.replica_id]
+            if rec.restart_at is None or now < rec.restart_at:
+                continue
+            rec.restart_at = None
+            rec.attempts += 1
+            try:
+                handle.restart()
+            except Exception as exc:
+                self._restart_failures.inc()
+                rec.failures += 1
+                if self.tracer.enabled:
+                    self.tracer.instant(
+                        "restart_failed", track="router",
+                        replica=handle.replica_id, error=repr(exc),
+                    )
+                if rec.attempts < policy.max_restarts:
+                    rec.restart_at = now + policy.delay(rec.failures)
+                else:
+                    handle.health = DEAD  # breaker open for good
+                continue
+            rec.clean_ticks = 0
+            rec.stall_ticks = 0
+            rec.probation = True
+            self._restarts.inc()
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "restart", track="router", replica=handle.replica_id,
+                    attempt=rec.attempts,
+                )
+            # the fresh engine owes nothing to old exclusions: requests
+            # orphaned by the PREVIOUS incarnation may run here again
+            # (without this, a 1-replica cluster could never self-heal)
+            for st in self._open_states():
+                st.excluded.discard(handle.replica_id)
+
+    def _probation_tick(
+        self, handle: ReplicaHandle, had_work: bool, progressed: bool
+    ) -> None:
+        policy = self.config.restart
+        rec = self._recovery[handle.replica_id]
+        if had_work and not progressed:
+            # a stall-suspect tick proves nothing: freeze the clean
+            # count and let the watchdog judge the replica — a wedged
+            # restart must never be promoted (which would also reset
+            # the breaker's failure count and defeat backoff escalation)
+            return
+        rec.clean_ticks += 1
+        if policy is not None and rec.clean_ticks >= policy.probation_ticks:
+            handle.health = HEALTHY
+            rec.probation = False
+            rec.failures = 0  # proved itself: earn back fast restarts
+            self._promotions.inc()
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "probation_promote", track="router",
+                    replica=handle.replica_id,
+                    clean_ticks=rec.clean_ticks,
+                )
+
+    def _watchdog(
+        self, handle: ReplicaHandle, had_work: bool, progressed: bool
+    ) -> None:
+        """Observed-progress stall detection: a replica with work that
+        produced nothing this tick accrues stall ticks; enough of them
+        degrade it (drained of new routing) and then kill it (work
+        orphaned through the normal death path).  Any progress clears
+        the counter and restores a DEGRADED replica."""
+        cfg = self.config
+        if cfg.watchdog_ticks is None and cfg.watchdog_kill_ticks is None:
+            return
+        rec = self._recovery[handle.replica_id]
+        if progressed or not had_work:
+            rec.stall_ticks = 0
+            if handle.health == DEGRADED:
+                handle.health = HEALTHY
+                if self.tracer.enabled:
+                    self.tracer.instant(
+                        "watchdog_recovered", track="router",
+                        replica=handle.replica_id,
+                    )
+            return
+        rec.stall_ticks += 1
+        kill = cfg.watchdog_kill_ticks
+        warn = cfg.watchdog_ticks
+        if kill is not None and rec.stall_ticks >= kill:
+            self._watchdog_kills.inc()
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "watchdog_kill", track="router",
+                    replica=handle.replica_id,
+                    stalled_ticks=rec.stall_ticks,
+                )
+            handle.kill(
+                f"watchdog: no progress for {rec.stall_ticks} ticks"
+            )
+            self._on_death(handle)
+        elif (
+            warn is not None
+            and rec.stall_ticks >= warn
+            and handle.health == HEALTHY
+        ):
+            handle.health = DEGRADED
+            self._watchdog_degraded.inc()
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "watchdog_degraded", track="router",
+                    replica=handle.replica_id,
+                    stalled_ticks=rec.stall_ticks,
+                )
 
     def has_work(self) -> bool:
         return bool(self._pending) or bool(self._by_attempt)
@@ -334,11 +572,11 @@ class Frontend:
             else None
         )
         for handle in self.replicas:
-            if handle.health == DEAD:
+            if handle.health in (DEAD, BACKOFF):
                 continue
             handle.engine.begin_drain()
         for handle in self.replicas:
-            if handle.health == DEAD:
+            if handle.health in (DEAD, BACKOFF):
                 continue
             for eout in handle.take_queued():
                 st = self._by_attempt.pop(eout.request.request_id, None)
@@ -369,6 +607,17 @@ class Frontend:
         if self.config.dispatch_queue_depth is not None:
             return self.config.dispatch_queue_depth
         return handle.engine.pool.n_slots
+
+    def _probation_headroom(self, handle: ReplicaHandle) -> bool:
+        """A half-open replica takes at most ``probation_requests``
+        concurrent open requests — enough traffic to prove the rebuilt
+        engine, little enough that a relapse orphans almost nothing."""
+        if handle.health != PROBATION:
+            return True
+        policy = self.config.restart
+        if policy is None:
+            return True
+        return handle.open_requests < policy.probation_requests
 
     def _effective_priority(self, st: _ClientState, now: float) -> float:
         arrival = st.out.arrival_time
@@ -404,9 +653,15 @@ class Frontend:
                 and h.queue_depth < self._dispatch_depth(h)
                 and h.replica_id not in st.excluded
                 and h.replica_id not in tried
+                and self._probation_headroom(h)
             ]
-            healthy = [h for h in cands if h.health == HEALTHY]
-            cands = healthy or cands
+            # healthy first; a PROBATION replica takes its half-open
+            # trickle alongside them (that's how it proves itself);
+            # DEGRADED only when nothing else is placeable
+            preferred = [
+                h for h in cands if h.health in (HEALTHY, PROBATION)
+            ]
+            cands = preferred or cands
             pick = self.router.route(req.prompt, cands)
             if pick is None:
                 return False
@@ -535,9 +790,15 @@ class Frontend:
     # -- failure / cancellation -------------------------------------------
 
     def _on_death(self, handle: ReplicaHandle) -> None:
-        """A replica died mid-tick: exclude it for every orphaned request
-        and replay each (forced-prefix) elsewhere; requests out of
-        retries fail loudly."""
+        """A replica died mid-tick (engine exception, fault plan, or
+        watchdog kill — they all count against the same retry budget):
+        exclude it for every orphaned request and replay each
+        (forced-prefix) elsewhere; requests out of retries fail loudly.
+        Each orphan is also FORGOTTEN from the handle's ledger — the
+        replay is now the frontend's responsibility, and a later restart
+        of this replica must not find stale orphans to double-replay.
+        Finally the circuit breaker decides whether a restart is
+        scheduled (BACKOFF) or the replica stays DEAD."""
         now = self.clock()
         self._deaths.inc()
         if self.tracer.enabled:
@@ -546,6 +807,7 @@ class Frontend:
                 orphans=len(handle.orphans()),
             )
         for eout in handle.orphans():
+            handle.forget(eout.request.request_id)
             st = self._by_attempt.pop(eout.request.request_id, None)
             if st is None or st.out.done:
                 continue
@@ -567,6 +829,36 @@ class Frontend:
                     delivered=len(st.out.tokens),
                 )
             self._pending.append(st)
+        # circuit breaker: consecutive failures stretch the backoff; a
+        # death during probation is the classic breaker trip (the replica
+        # failed its audition) and doubles the next wait
+        rec = self._recovery[handle.replica_id]
+        rec.failures += 1
+        rec.clean_ticks = 0
+        rec.stall_ticks = 0
+        if rec.probation:
+            rec.probation = False
+            self._demotions.inc()
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "probation_demote", track="router",
+                    replica=handle.replica_id,
+                )
+        policy = self.config.restart
+        if (
+            policy is not None
+            and handle.engine_factory is not None
+            and rec.attempts < policy.max_restarts
+        ):
+            delay = policy.delay(rec.failures)
+            handle.health = BACKOFF
+            rec.restart_at = now + delay
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "restart_scheduled", track="router",
+                    replica=handle.replica_id, delay=delay,
+                    failures=rec.failures,
+                )
 
     def _enforce_deadlines(self, now: float) -> None:
         for st in self._open_states():
@@ -584,7 +876,7 @@ class Frontend:
         if st in self._pending:
             self._pending.remove(st)
         self._finalize(st, CANCELLED, reason, now)
-        if handle is not None and handle.health != DEAD:
+        if handle is not None and handle.health not in (DEAD, BACKOFF):
             handle.engine.cancel(engine_rid, reason=reason)
             handle.forget(engine_rid)
         self._cancelled.inc()
@@ -628,8 +920,12 @@ class Frontend:
             r.gauge("cluster_replica_health", **lab).set(
                 _HEALTH_CODE[h.health]
             )
+            r.gauge("cluster_breaker_state", **lab).set(
+                _BREAKER_CODE[h.health]
+            )
+            r.gauge("cluster_replica_restarts", **lab).set(h.restarts)
             r.gauge("cluster_replica_load", **lab).set(
-                0.0 if h.health == DEAD else h.load()
+                0.0 if h.health in (DEAD, BACKOFF) else h.load()
             )
             r.gauge("cluster_replica_queue_depth", **lab).set(h.queue_depth)
             r.gauge("cluster_replica_active_slots", **lab).set(h.active_slots)
@@ -637,6 +933,31 @@ class Frontend:
         r.gauge("cluster_pending_requests").set(len(self._pending))
         if isinstance(self.router, PrefixAffinityRouter):
             r.gauge("cluster_affinity_fallbacks").set(self.router.fallbacks)
+
+    def recovery_summary(self) -> Dict[int, dict]:
+        """Per-replica self-healing state for tooling and the chaos
+        harness: breaker attempts/budget, consecutive failures, whether a
+        restart is pending, and probation progress."""
+        policy = self.config.restart
+        out = {}
+        for h in self.replicas:
+            rec = self._recovery[h.replica_id]
+            out[h.replica_id] = {
+                "health": h.health,
+                "restarts": h.restarts,
+                "attempts": rec.attempts,
+                "budget_left": (
+                    0 if policy is None or h.engine_factory is None
+                    else max(0, policy.max_restarts - rec.attempts)
+                ),
+                "failures": rec.failures,
+                "restart_pending": rec.restart_at is not None,
+                "restart_at": rec.restart_at,
+                "probation": rec.probation,
+                "clean_ticks": rec.clean_ticks,
+                "stall_ticks": rec.stall_ticks,
+            }
+        return out
 
     def prefix_hit_rate(self) -> Optional[float]:
         """Aggregate prefix-cache hit rate across every replica whose
@@ -665,6 +986,12 @@ class Frontend:
             "cancelled": int(self._cancelled.value),
             "failed": int(self._failed.value),
             "replica_deaths": int(self._deaths.value),
+            "watchdog_degraded": int(self._watchdog_degraded.value),
+            "watchdog_kills": int(self._watchdog_kills.value),
+            "restarts": int(self._restarts.value),
+            "restart_failures": int(self._restart_failures.value),
+            "probation_promotions": int(self._promotions.value),
+            "probation_demotions": int(self._demotions.value),
             "inflight_tokens": self._reserved,
             "prefix_hit_rate": (
                 None if hit_rate is None else round(hit_rate, 4)
