@@ -1,0 +1,141 @@
+"""Static check: every block-table mutation goes through the allocator.
+
+The block-paged KV cache's single hard invariant is that the
+:class:`~tpu_parallel.serving.cache_pool.BlockAllocator`'s refcounts and
+the per-slot block tables never drift apart — a table entry pointing at a
+block the allocator thinks is free is a use-after-free (the next owner's
+writes scribble over live K/V), and a freed entry the allocator still
+counts is a leak that starves admission.  The whole mutation surface is
+therefore fenced inside ``tpu_parallel/serving/cache_pool.py``
+(:class:`PagedCachePool`'s ``ensure_writable`` / ``map_prefix`` /
+``release`` / ``snapshot_blocks`` / ``free_stored``); everyone else —
+engine, prefix cache, benches, tests — READS tables and calls those
+methods.
+
+This makes the fence a tier-1 test
+(``tests/test_paged_kv.py::test_block_table_mutations_fenced``) instead of
+prose, exactly like ``check_clock.py`` / ``check_host_sync.py``: any
+subscript STORE or in-place mutation whose target chain mentions a block
+table (``...block_table[...] = ``, ``bt_dev.at[...]`` excluded — jax
+functional updates return copies) outside ``cache_pool.py`` is flagged.
+Reads (``table[slot]``, ``np.asarray(pool.block_table)``) are fine.
+
+Usage: ``python scripts/check_blocks.py [paths...]`` — prints one
+``file:line: <expr> mutates a block table outside BlockAllocator`` per
+violation, exits nonzero on any.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List
+
+# attribute/name spellings that identify a block-table object at a
+# mutation site; matched against any link of the assignment target's
+# attribute chain
+TABLE_NAMES = frozenset({"block_table", "_block_table"})
+
+DEFAULT_PATHS = (
+    "tpu_parallel/serving",
+    "tpu_parallel/cluster",
+    "scripts",
+)
+
+# the single module allowed to mutate tables (the allocator's home)
+ALLOWED_FILES = frozenset({"cache_pool.py"})
+
+
+def _chain_mentions_table(node: ast.AST) -> bool:
+    """True when the expression chain under ``node`` names a block table
+    (``pool.block_table``, ``self._block_table``, bare ``block_table``)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in TABLE_NAMES:
+            return True
+        if isinstance(sub, ast.Name) and sub.id in TABLE_NAMES:
+            return True
+    return False
+
+
+def check_source(source: str, filename: str) -> List[str]:
+    """Return ``file:line: message`` strings for every block-table
+    subscript STORE (``table[...] = x``, ``table[...] += x``, ``del
+    table[...]``) outside the allocator module."""
+    if os.path.basename(filename) in ALLOWED_FILES:
+        return []
+    tree = ast.parse(source, filename=filename)
+    problems: List[str] = []
+
+    def flag(node: ast.AST, what: str) -> None:
+        problems.append(
+            f"{filename}:{node.lineno}: {what} mutates a block table "
+            "outside BlockAllocator (route it through PagedCachePool)"
+        )
+
+    for node in ast.walk(tree):
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        elif isinstance(node, ast.Delete):
+            targets = list(node.targets)
+        for tgt in targets:
+            # only SUBSCRIPT stores are table mutations; rebinding a
+            # local name (`table = pool.block_table[slot]`) is a read
+            if isinstance(tgt, ast.Subscript) and _chain_mentions_table(
+                tgt.value
+            ):
+                flag(tgt, ast.unparse(tgt))
+    return problems
+
+
+def check_paths(paths=DEFAULT_PATHS) -> List[str]:
+    problems: List[str] = []
+    walked = False
+    for path in paths:
+        if os.path.isfile(path):
+            files = [path]
+        elif os.path.isdir(path):
+            files = sorted(
+                os.path.join(root, f)
+                for root, _, names in os.walk(path)
+                for f in names
+                if f.endswith(".py")
+            )
+        else:
+            raise FileNotFoundError(
+                f"check_blocks: no such path {path!r} (a typo here would "
+                "silently check nothing and pass)"
+            )
+        for fname in files:
+            walked = True
+            with open(fname) as fh:
+                problems.extend(check_source(fh.read(), fname))
+    if not walked:
+        raise FileNotFoundError(
+            f"check_blocks: paths {paths!r} contained no Python files"
+        )
+    return problems
+
+
+def main(argv: List[str]) -> int:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    os.chdir(repo_root)
+    paths = argv[1:] or list(DEFAULT_PATHS)
+    problems = check_paths(paths)
+    for problem in problems:
+        print(problem, file=sys.stderr)
+    if problems:
+        print(
+            f"check_blocks: {len(problems)} raw block-table mutation(s)",
+            file=sys.stderr,
+        )
+        return 1
+    print("check_blocks: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -185,7 +185,10 @@ def run_point(model, params, cfg, prompts, *, rate, n_slots, new_tokens,
         "backend": jax.default_backend(),
         "prefill_mode": label,
         "n_requests": n_requests,
-        "arrival_rate_per_sec": rate if rate > 0 else "all_at_once",
+        "arrival_mode": "poisson" if rate > 0 else "burst",
+        # numeric or null ALWAYS (the burst sentinel used to be the
+        # string "all_at_once" in this float field — schema fix)
+        "arrival_rate_per_sec": rate if rate > 0 else None,
         "n_slots": n_slots,
         "prompt_len": [min(lengths), max(lengths)],
         "distinct_prompt_lens": len(set(lengths)),
@@ -193,6 +196,10 @@ def run_point(model, params, cfg, prompts, *, rate, n_slots, new_tokens,
         "kv_cache": cfg.kv_cache_dtype,
         "prefill_buckets": list(eng._buckets) if eng._buckets else None,
         "prefill_chunk_tokens": eng._chunk_tokens,
+        # block-paged KV cache (0 = fixed-slot layout); occupancy / COW /
+        # shared-block counters ride in via the metrics summary below
+        "kv_block_tokens": getattr(eng.pool, "block_tokens", 0),
+        "kv_pool_blocks": getattr(eng.pool, "n_blocks", None),
         "prefix_cache_size": (
             eng._prefix.max_entries if eng._prefix is not None else 0
         ),
@@ -382,7 +389,10 @@ def run_cluster_point(model, params, cfg, prompts, *, rate, n_replicas,
         "fault": bool(fault_plans),
         "chaos_seed": chaos_seed,
         "n_requests": n_requests,
-        "arrival_rate_per_sec": rate if rate > 0 else "all_at_once",
+        "arrival_mode": "poisson" if rate > 0 else "burst",
+        # numeric or null ALWAYS (the burst sentinel used to be the
+        # string "all_at_once" in this float field — schema fix)
+        "arrival_rate_per_sec": rate if rate > 0 else None,
         "n_slots": n_slots,
         "prompt_len": [min(lengths), max(lengths)],
         "new_tokens": new_tokens,
@@ -406,6 +416,117 @@ def run_cluster_point(model, params, cfg, prompts, *, rate, n_replicas,
         "ttft_ms_p95": s["ttft_ms_p95"],
         "e2e_ms_p95": s["e2e_ms_p95"],
     }
+
+
+def run_capacity_probe(model, params, cfg, *, seed, logger):
+    """The paged layout's capacity claim, measured at EQUAL pool bytes:
+    a fixed-slot pool of ``s_fixed`` rows vs a paged pool holding the
+    SAME K/V bytes as ``s_fixed * seq_len / block_tokens`` blocks.
+    Short requests (one block worst case) admit until the fixed pool
+    runs out of whole rows vs until the paged pool runs out of blocks —
+    plus a burst decode-throughput leg at batch 8 so the block-table
+    gather overhead is measured, not asserted."""
+    from tpu_parallel.serving import Request, SchedulerConfig, ServingEngine
+
+    seq_len = cfg.seq_len
+    bt = max(1, seq_len // 4)
+    s_fixed = 4
+    n_blocks = s_fixed * seq_len // bt  # EQUAL pool bytes
+    short_prompt = [5, 3, 7]
+    short_new = max(1, bt - len(short_prompt) - 1)  # 1 block worst case
+    n_short = 2 * n_blocks
+
+    def concurrent_short(paged):
+        kw = (
+            dict(
+                kv_block_tokens=bt, kv_pool_blocks=n_blocks,
+                n_slots=n_blocks,
+            )
+            if paged
+            else dict(n_slots=s_fixed)
+        )
+        eng = ServingEngine(
+            model, params, decode_steps_per_tick=1,
+            scheduler=SchedulerConfig(
+                max_prefills_per_tick=n_blocks, max_queue=4 * n_blocks
+            ),
+            rng=jax.random.PRNGKey(seed), **kw,
+        )
+        outs = [
+            eng.add_request(
+                Request(
+                    prompt=list(short_prompt), max_new_tokens=short_new
+                )
+            )
+            for _ in range(n_short)
+        ]
+        eng.step()
+        conc = eng.in_flight
+        eng.run(max_ticks=5000)
+        assert all(out.status == "finished" for out in outs)
+        if paged:
+            eng.pool.allocator.check()
+            assert eng.pool.blocks_free == n_blocks  # no leak
+        return conc
+
+    fixed_conc = concurrent_short(False)
+    paged_conc = concurrent_short(True)
+
+    rnd = random.Random(seed)
+    bench_prompts = [
+        [rnd.randrange(1, cfg.vocab_size) for _ in range(3)]
+        for _ in range(8)
+    ]
+    bench_new = min(16, seq_len - 4)
+
+    def burst_tok_s(paged):
+        kw = dict(kv_block_tokens=bt) if paged else {}
+        eng = ServingEngine(
+            model, params, n_slots=8,
+            scheduler=SchedulerConfig(max_prefills_per_tick=8),
+            rng=jax.random.PRNGKey(seed), **kw,
+        )
+        for p in bench_prompts:  # warm the compiles
+            eng.add_request(Request(prompt=list(p), max_new_tokens=2))
+        eng.run()
+        eng.reset_metrics()
+        t0 = time.perf_counter()
+        outs = [
+            eng.add_request(
+                Request(prompt=list(p), max_new_tokens=bench_new)
+            )
+            for p in bench_prompts
+        ]
+        eng.run()
+        wall = time.perf_counter() - t0
+        assert all(out.status == "finished" for out in outs)
+        return round(len(bench_prompts) * bench_new / wall, 1)
+
+    fixed_tps = burst_tok_s(False)
+    paged_tps = burst_tok_s(True)
+    record = {
+        "bench": "serve_paged_capacity",
+        "model": getattr(cfg, "_name", None) or (
+            "gpt2_125m" if jax.default_backend() == "tpu" else "tiny"
+        ),
+        "backend": jax.default_backend(),
+        "seq_len": seq_len,
+        "kv_block_tokens": bt,
+        "kv_pool_blocks": n_blocks,
+        "equal_pool_tokens": s_fixed * seq_len,
+        "fixed_slots": s_fixed,
+        "short_request_tokens": len(short_prompt) + short_new,
+        "fixed_concurrent_short": fixed_conc,
+        "paged_concurrent_short": paged_conc,
+        "concurrency_ratio": round(paged_conc / max(1, fixed_conc), 2),
+        "decode_batch": len(bench_prompts),
+        "decode_new_tokens": bench_new,
+        "fixed_decode_tok_s": fixed_tps,
+        "paged_decode_tok_s": paged_tps,
+        "paged_over_fixed_decode": round(paged_tps / fixed_tps, 3),
+    }
+    logger.log_record(record)
+    return record
 
 
 class _GarbageDrafter:
@@ -478,6 +599,12 @@ def smoke(model, params, cfg, prompts, new_tokens):
             draft_tokens=3,
             drafter=_GarbageDrafter(refs_by_prompt, cfg.vocab_size),
         ),
+        # block-paged KV pool: same gates over the paged layout (default
+        # fused tick; prefix sharing + COW; speculative verify) — paged
+        # greedy output must match static generate() bitwise too
+        "paged": dict(kv_block_tokens="auto"),
+        "paged_prefix": dict(kv_block_tokens="auto", prefix_cache_size=4),
+        "paged_spec": dict(kv_block_tokens="auto", draft_tokens=3),
     }
     failures = 0
     for name, kwargs in modes.items():
@@ -535,6 +662,18 @@ def main():
                     help="speculative decode draft tokens (0 = off); the "
                          "record then carries acceptance rate and "
                          "tokens_per_decode_tick")
+    ap.add_argument("--kv-block-tokens", type=str, default="0",
+                    help="block-paged KV cache: tokens per block, or "
+                         "'auto' for the bucket quantum (0 = fixed-slot "
+                         "layout)")
+    ap.add_argument("--kv-pool-blocks", type=int, default=0,
+                    help="paged pool capacity in blocks (0 = engine "
+                         "default n_slots * seq_len / block_tokens)")
+    ap.add_argument("--capacity-probe", action="store_true",
+                    help="emit a serve_paged_capacity record: concurrent "
+                         "short-request admissions and burst decode "
+                         "throughput, fixed-slot vs paged at EQUAL pool "
+                         "bytes")
     ap.add_argument("--fused-tick", type=int, default=0,
                     help="decode_steps_per_tick for the measured engines "
                          "(0 = engine default 'auto'; 1 = the per-step "
@@ -648,6 +787,15 @@ def main():
         fast["decode_steps_per_tick"] = args.fused_tick
         if args.fused_tick == 1:
             fast_label += "+per_step"
+    if args.kv_block_tokens not in ("0", ""):
+        fast["kv_block_tokens"] = (
+            "auto"
+            if args.kv_block_tokens == "auto"
+            else int(args.kv_block_tokens)
+        )
+        if args.kv_pool_blocks > 0:
+            fast["kv_pool_blocks"] = args.kv_pool_blocks
+        fast_label += "+paged"
 
     if args.replicas > 1:
         # cluster mode: one record per (rate, router policy) on the SAME
@@ -722,6 +870,9 @@ def main():
         tracer = Tracer()
 
     logger = MetricLogger(logdir=".", name=args.out)
+    if args.capacity_probe:
+        run_capacity_probe(model, params, cfg, seed=args.seed,
+                           logger=logger)
     eng = None
     for rate in (float(r) for r in args.rate.split(",")):
         for label, engine_kwargs in configs:

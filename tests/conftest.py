@@ -67,6 +67,7 @@ _SLOW_FILES = {
     "test_generate.py",
     "test_serving.py",
     "test_spec_decode.py",
+    "test_paged_kv.py",
     "test_cluster.py",
 }
 _SLOW_TESTS = {

@@ -120,6 +120,7 @@ def decode_step(
     tok: jax.Array,
     positions: jax.Array,
     write_index: Optional[jax.Array] = None,
+    block_table: Optional[jax.Array] = None,
 ):
     """One single-token decode tick — THE reusable core of every decode loop.
 
@@ -141,6 +142,7 @@ def decode_step(
         hidden_only=True,
         mutable=["cache"],
         write_index=write_index,
+        block_table=block_table,
     )
     return hidden, updated["cache"]
 
@@ -191,7 +193,8 @@ def prefill_step(model: GPTLM, params, tokens: jax.Array,
 
 
 def prefill_extend_step(model: GPTLM, params, cache, tokens: jax.Array,
-                        positions: jax.Array, write_start: jax.Array):
+                        positions: jax.Array, write_start: jax.Array,
+                        block_table: Optional[jax.Array] = None):
     """Continue a prefill INTO an existing cache: ``tokens`` [b, T] at
     global ``positions`` [b, T] (pads -1), K/V written at cache slots
     ``write_start + [0..T)`` per row (the multi-token ``write_index`` path
@@ -215,12 +218,14 @@ def prefill_extend_step(model: GPTLM, params, cache, tokens: jax.Array,
         hidden_only=True,
         mutable=["cache"],
         write_index=write_start,
+        block_table=block_table,
     )
     return hidden, updated["cache"]
 
 
 def verify_step(model: GPTLM, params, cache, tokens: jax.Array,
-                positions: jax.Array, write_index: jax.Array):
+                positions: jax.Array, write_index: jax.Array,
+                block_table: Optional[jax.Array] = None):
     """Score T tokens per row in ONE forward — the speculative-decoding
     verify core.  ``tokens`` [b, T] is each row's current token followed by
     its draft tokens, at global ``positions`` [b, T] (pads -1); K/V land at
@@ -252,6 +257,7 @@ def verify_step(model: GPTLM, params, cache, tokens: jax.Array,
         hidden_only=True,
         mutable=["cache"],
         write_index=write_index,
+        block_table=block_table,
     )
     return hidden, updated["cache"]
 

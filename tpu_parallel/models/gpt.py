@@ -148,11 +148,18 @@ class GPTLM(nn.Module):
         decode: bool = False,
         hidden_only: bool = False,
         write_index: Optional[jax.Array] = None,
+        block_table: Optional[jax.Array] = None,
     ) -> jax.Array:
         cfg = self.config
         if write_index is not None and not decode:
             raise ValueError(
                 "write_index (slot-indexed cache writes) requires decode=True"
+            )
+        if block_table is not None and cfg.kv_block_tokens < 1:
+            raise ValueError(
+                "block_table passed but kv_block_tokens == 0 — paged KV "
+                "serving requires a model built with kv_block_tokens/"
+                "kv_pool_blocks (the serving engine constructs one)"
             )
         if write_index is not None and cfg.positional == "relative":
             # the shared T5 bias table is computed from ROW 0's positions
@@ -209,7 +216,7 @@ class GPTLM(nn.Module):
                 "axis); on a pipe=1 mesh the knob would be silently ignored"
             )
         if cfg.pipe_size > 1:
-            if write_index is not None:
+            if write_index is not None or block_table is not None:
                 raise NotImplementedError(
                     "slot-indexed cache writes under pipeline parallelism "
                     "(the decode ring's per-stage caches would need the "
@@ -289,6 +296,7 @@ class GPTLM(nn.Module):
                 decode=decode,
                 attn_bias=attn_bias,
                 write_index=write_index,
+                block_table=block_table,
             )
 
         if cfg.prenorm:

@@ -144,6 +144,20 @@ class TransformerConfig:
     # dequantized cache copy), except the lazy-beam path which still
     # dequantizes transiently per layer per step
     kv_cache_dtype: str = "bf16"
+    # paged decode KV cache (the serving engine's block-table layout):
+    # kv_block_tokens > 0 stores decode K/V in a flat pool of
+    # ``kv_pool_blocks`` fixed-size blocks of ``kv_block_tokens`` positions
+    # each instead of per-row ``seq_len`` stripes.  Every decode call must
+    # then pass ``block_table`` [batch, seq_len // kv_block_tokens] mapping
+    # each row's logical block index to a physical pool block (-1 =
+    # unmapped: reads masked out, writes dropped) plus ``write_index`` —
+    # the engine owns the tables through
+    # :class:`~tpu_parallel.serving.cache_pool.BlockAllocator`.  0 = the
+    # classic contiguous per-row cache.  Set ONLY by the serving engine
+    # (it rebuilds its model with these fields); training and the static
+    # generate() paths never page.
+    kv_block_tokens: int = 0
+    kv_pool_blocks: int = 0
     # lazy beam-search decode: >1 switches the decode attention to the
     # cross-beam form (beam j of prompt i = row i*k+j) that follows beam
     # ancestry through a per-slot source-row table instead of physically
@@ -536,6 +550,7 @@ class Attention(nn.Module):
         cache_valid: Optional[jax.Array] = None,
         attn_bias: Optional[jax.Array] = None,
         write_index: Optional[jax.Array] = None,
+        block_table: Optional[jax.Array] = None,
     ) -> jax.Array:
         cfg = self.config
         tp_size = axis_size_or_none(cfg.model_axis) or 1
@@ -614,6 +629,41 @@ class Attention(nn.Module):
                 )
             quant_cache = cfg.kv_cache_dtype == "int8"
             cache_store_dtype = jnp.int8 if quant_cache else cfg.dtype
+            paged = cfg.kv_block_tokens > 0
+            if paged:
+                # block-paged layout: K/V live in a FLAT pool of
+                # kv_pool_blocks blocks of kv_block_tokens positions each,
+                # shared by every row; rows address it through their
+                # block_table entries.  The pool is row-count-free — slot
+                # capacity decouples from seq_len.
+                if cfg.kv_pool_blocks < 1:
+                    raise ValueError(
+                        f"kv_block_tokens={cfg.kv_block_tokens} needs "
+                        f"kv_pool_blocks >= 1 (got {cfg.kv_pool_blocks})"
+                    )
+                if block_table is None or write_index is None:
+                    raise ValueError(
+                        "paged KV cache (kv_block_tokens > 0) requires "
+                        "block_table AND write_index — the serving "
+                        "engine's block-allocator path is the only caller"
+                    )
+                if cfg.beam_width > 1:
+                    raise NotImplementedError(
+                        "paged KV cache under lazy beam search (beam_src "
+                        "bookkeeping assumes contiguous per-row caches)"
+                    )
+                kv_store = (
+                    cfg.kv_pool_blocks, cfg.kv_block_tokens, local_kv,
+                    cfg.head_dim,
+                )
+                scale_store = (
+                    cfg.kv_pool_blocks, cfg.kv_block_tokens, local_kv, 1
+                )
+                pos_store = (cfg.kv_pool_blocks, cfg.kv_block_tokens)
+            else:
+                kv_store = (b, cfg.seq_len, local_kv, cfg.head_dim)
+                scale_store = (b, cfg.seq_len, local_kv, 1)
+                pos_store = (b, cfg.seq_len)
             # cache at K/V-head width (local_kv): under GQA this is the whole
             # point — n_heads/n_kv less cache HBM; decode_attention contracts
             # grouped queries against it directly (no expansion)
@@ -621,14 +671,14 @@ class Attention(nn.Module):
                 "cache",
                 "cached_key",
                 jnp.zeros,
-                (b, cfg.seq_len, local_kv, cfg.head_dim),
+                kv_store,
                 cache_store_dtype,
             )
             cached_v = self.variable(
                 "cache",
                 "cached_value",
                 jnp.zeros,
-                (b, cfg.seq_len, local_kv, cfg.head_dim),
+                kv_store,
                 cache_store_dtype,
             )
             if quant_cache:
@@ -638,24 +688,26 @@ class Attention(nn.Module):
                     "cache",
                     "cached_key_scale",
                     jnp.zeros,
-                    (b, cfg.seq_len, local_kv, 1),
+                    scale_store,
                     jnp.float32,
                 )
                 cached_v_scale = self.variable(
                     "cache",
                     "cached_value_scale",
                     jnp.zeros,
-                    (b, cfg.seq_len, local_kv, 1),
+                    scale_store,
                     jnp.float32,
                 )
-            # per-slot global positions (int32 [b, seq_len]) — the decode
-            # mask keys off STORED positions, so ragged (left-padded)
-            # batches work: pad slots hold -1 and never attend.  Aligned
-            # batches write j at slot j, reproducing the classic layout.
+            # per-slot global positions (int32) — the decode mask keys off
+            # STORED positions, so ragged (left-padded) batches work: pad
+            # slots hold -1 and never attend.  Aligned batches write j at
+            # slot j, reproducing the classic layout.  Paged mode stores
+            # the table per (block, offset); freed blocks are re-invalidated
+            # to -1 by the allocator before reuse.
             cached_p = self.variable(
                 "cache",
                 "cached_pos",
-                lambda: jnp.full((b, cfg.seq_len), -1, jnp.int32),
+                lambda: jnp.full(pos_store, -1, jnp.int32),
             )
             cache_index = self.variable(
                 "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
@@ -696,19 +748,41 @@ class Attention(nn.Module):
                         "write_index under lazy beam search (beam_src slot "
                         "bookkeeping assumes the shared scalar cache_index)"
                     )
-                rows = jnp.arange(b)[:, None]
                 wi = (
                     write_index.astype(jnp.int32)[:, None]
                     + jnp.arange(x.shape[1])[None, :]
                 )
-                # out-of-range targets (a pool's free slots, a padded
-                # chunk's tail beyond seq_len) fall under JAX's default
-                # scatter semantics: the update is DROPPED, leaving the
-                # cache intact — deliberately not clamped, which would
-                # overwrite a valid boundary entry instead
-                upd = lambda buf, new: buf.at[rows, wi].set(
-                    new.astype(buf.dtype)
-                )
+                if paged:
+                    # logical column -> (physical block, offset) through the
+                    # row's block table: table[row, col // bt] * bt +
+                    # col % bt.  Unmapped (-1) table entries and logical
+                    # blocks beyond the table width redirect to pool index
+                    # kv_pool_blocks — out of range, DROPPED by scatter
+                    # semantics, the same discard the contiguous layout's
+                    # column-seq_len park relies on.
+                    bt = cfg.kv_block_tokens
+                    lblk = wi // bt
+                    ok = lblk < block_table.shape[1]
+                    phys = jnp.take_along_axis(
+                        block_table, jnp.where(ok, lblk, 0), axis=1
+                    )
+                    phys = jnp.where(
+                        ok & (phys >= 0), phys, cfg.kv_pool_blocks
+                    )
+                    off = wi % bt
+                    upd = lambda buf, new: buf.at[phys, off].set(
+                        new.astype(buf.dtype)
+                    )
+                else:
+                    rows = jnp.arange(b)[:, None]
+                    # out-of-range targets (a pool's free slots, a padded
+                    # chunk's tail beyond seq_len) fall under JAX's default
+                    # scatter semantics: the update is DROPPED, leaving the
+                    # cache intact — deliberately not clamped, which would
+                    # overwrite a valid boundary entry instead
+                    upd = lambda buf, new: buf.at[rows, wi].set(
+                        new.astype(buf.dtype)
+                    )
             else:
                 upd = lambda buf, new: lax.dynamic_update_slice_in_dim(
                     buf, new, idx, axis=1
@@ -774,11 +848,35 @@ class Attention(nn.Module):
                     window=cfg.attn_window, bias=attn_bias, k_positions=new_p,
                 )
             else:
+                k_pos = new_p
+                if paged:
+                    # assemble each row's LOGICAL K/V view by gathering its
+                    # blocks out of the flat pool (one gather per payload;
+                    # logical column c = pool[table[c // bt], c % bt]), so
+                    # the attention math below is untouched and paged greedy
+                    # output is bitwise identical to the contiguous layout
+                    bt = cfg.kv_block_tokens
+                    tbl = jnp.maximum(block_table, 0)
+
+                    def pages(buf):
+                        g = jnp.take(buf, tbl, axis=0)
+                        return g.reshape(
+                            b, tbl.shape[1] * bt, *buf.shape[2:]
+                        )
+
+                    k_all, v_all = pages(k_all), pages(v_all)
+                    if k_scale is not None:
+                        k_scale, v_scale = pages(k_scale), pages(v_scale)
+                    # unmapped (-1) table entries gathered block 0's
+                    # contents above — mask them out through the stored
+                    # positions (-1 never attends)
+                    mapped = jnp.repeat(block_table >= 0, bt, axis=1)
+                    k_pos = jnp.where(mapped, pages(new_p), -1)
                 # decode_attention contracts grouped queries against the
                 # kv-width cache directly — no K/V expansion
                 out = decode_attention(
                     q, k_all, v_all, positions, window=cfg.attn_window,
-                    bias=attn_bias, k_positions=new_p,
+                    bias=attn_bias, k_positions=k_pos,
                     k_scale=k_scale, v_scale=v_scale,
                 )
         else:
@@ -995,6 +1093,7 @@ class Block(nn.Module):
         cache_valid: Optional[jax.Array] = None,
         attn_bias: Optional[jax.Array] = None,
         write_index: Optional[jax.Array] = None,
+        block_table: Optional[jax.Array] = None,
     ) -> jax.Array:
         cfg = self.config
         if decode and cfg.moe_experts > 0 and cfg.moe_router == "expert_choice":
@@ -1023,6 +1122,7 @@ class Block(nn.Module):
             cache_valid=cache_valid,
             attn_bias=attn_bias,
             write_index=write_index,
+            block_table=block_table,
         )
         if cfg.prenorm:
             h = make_norm(cfg, "norm_attn")(x).astype(cfg.dtype)
@@ -1063,7 +1163,7 @@ class _ScanBlock(nn.Module):
     def __call__(self, carry, _):
         (
             x, positions, segment_ids, aux_scale, cache_valid, attn_bias,
-            write_index,
+            write_index, block_table,
         ) = carry
         for j in range(self.group):
             name = "block" if self.group == 1 else f"block{j}"
@@ -1077,11 +1177,12 @@ class _ScanBlock(nn.Module):
                 cache_valid=cache_valid,
                 attn_bias=attn_bias,
                 write_index=write_index,
+                block_table=block_table,
             )
         return (
             (
                 x, positions, segment_ids, aux_scale, cache_valid, attn_bias,
-                write_index,
+                write_index, block_table,
             ),
             None,
         )
@@ -1136,6 +1237,7 @@ class BlockStack(nn.Module):
         cache_valid: Optional[jax.Array] = None,
         attn_bias: Optional[jax.Array] = None,
         write_index: Optional[jax.Array] = None,
+        block_table: Optional[jax.Array] = None,
     ) -> jax.Array:
         cfg = self.config
         remat_kwargs = remat_kwargs_for(cfg)
@@ -1189,10 +1291,10 @@ class BlockStack(nn.Module):
                 _split_transpose=cfg.scan_split_transpose,
                 metadata_params={nn.PARTITION_NAME: None},
             )(cfg, train, decode, base_block, group, name="layers")
-            (x, _, _, _, _, _, _), _ = stacked(
+            (x, _, _, _, _, _, _, _), _ = stacked(
                 (
                     x, positions, segment_ids, aux_scale, cache_valid,
-                    attn_bias, write_index,
+                    attn_bias, write_index, block_table,
                 ),
                 None,
             )
@@ -1209,7 +1311,7 @@ class BlockStack(nn.Module):
             for i in range(self.n_layers):
                 x = block_cls(cfg, name=f"layer_{i}")(
                     x, positions, segment_ids, train, decode, aux_scale,
-                    cache_valid, attn_bias, write_index,
+                    cache_valid, attn_bias, write_index, block_table,
                 )
         return x
 

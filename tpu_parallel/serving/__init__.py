@@ -4,7 +4,9 @@ prefix reuse, and exact speculative (draft-verify) decoding
 (docs/10_serving_engine.md)."""
 
 from tpu_parallel.serving.cache_pool import (
+    BlockAllocator,
     CachePool,
+    PagedCachePool,
     clear_rows,
     copy_prefix_rows,
     extract_rows,
@@ -52,7 +54,9 @@ from tpu_parallel.serving.spec_decode import (
 )
 
 __all__ = [
+    "BlockAllocator",
     "CachePool",
+    "PagedCachePool",
     "insert_rows",
     "scatter_rows",
     "extract_rows",

@@ -11,11 +11,25 @@ just returns the slot index to the free list (the row is dead weight until
 the next insert overwrites all of it, including the position table whose
 ``-1`` entries keep unwritten slots out of every attention read).
 
-Memory model: pool bytes are fixed at construction —
-``n_slots x seq_len`` K/V entries per layer regardless of how many
-requests are in flight.  There is no paging/fragmentation (slots are
-whole-sequence rows, the simplest correct layout); ``kv_cache_dtype="int8"``
-halves the payload exactly as on the static path.
+Memory model — two layouts share this module:
+
+- **Fixed-slot** (:class:`CachePool`, ``kv_block_tokens == 0``): pool
+  bytes are fixed at construction — ``n_slots x seq_len`` K/V entries per
+  layer regardless of how many requests are in flight.  Slots are
+  whole-sequence rows: the simplest correct layout, but a 9-token request
+  pays for the full context window and every prefix-cache hit copies
+  O(prefix_len) rows.
+- **Block-paged** (:class:`PagedCachePool` + :class:`BlockAllocator`,
+  ``kv_block_tokens > 0``): K/V live in a flat pool of fixed-size blocks;
+  each slot owns a block-table row mapping logical block indices to
+  physical blocks, filled on demand as the sequence grows.  Slot count
+  decouples from ``seq_len`` (short requests hold only the blocks they
+  use), prefix-cache hits are O(1) refcounted table writes instead of row
+  copies, and the first write into a shared block copy-on-writes that ONE
+  block (docs/10_serving_engine.md has the full memory-model story).
+
+``kv_cache_dtype="int8"`` halves the payload exactly as on the static
+path under either layout.
 
 Donation invariant: every WRITE op on the pool (insert / scatter / clear
 / copy_prefix) and every engine decode tick — per-step, verify, and the
@@ -30,6 +44,7 @@ Read-side ops (``extract``, ``stack_prefix``) copy and may be held.
 
 from __future__ import annotations
 
+import heapq
 from typing import List, Optional
 
 import jax
@@ -162,6 +177,15 @@ def _pool_cache_shapes(model, params, n_slots: int):
     def probe():
         tok = jnp.zeros((n_slots, 1), jnp.int32)
         pos = jnp.zeros((n_slots, 1), jnp.int32)
+        kwargs = {}
+        bt = getattr(model.config, "kv_block_tokens", 0)
+        if bt > 0:
+            # paged models refuse decode without a table; the probe's dummy
+            # one never runs (eval_shape), it only shapes the cache tree
+            kwargs["block_table"] = jnp.zeros(
+                (n_slots, model.config.seq_len // bt), jnp.int32
+            )
+            kwargs["write_index"] = jnp.zeros((n_slots,), jnp.int32)
         _, variables = model.apply(
             {"params": params},
             tok,
@@ -170,6 +194,7 @@ def _pool_cache_shapes(model, params, n_slots: int):
             decode=True,
             hidden_only=True,
             mutable=["cache"],
+            **kwargs,
         )
         return variables["cache"]
 
@@ -395,3 +420,421 @@ def default_row_fns():
         jax.jit(copy_prefix_rows, donate_argnums=0),
         jax.jit(stack_prefix_rows),
     )
+
+
+# --- block-paged layout ------------------------------------------------------
+
+
+def free_block_pos(pool_cache, blocks):
+    """Invalidate physical ``blocks`` ([k] int32): every position entry to
+    -1, so a recycled block's stale positions can never re-enter attention
+    under its next owner's table (the paged analog of :func:`clear_rows`).
+    Pad ``blocks`` with the pool size — out-of-range scatters DROP, so one
+    compiled shape serves any free count.  K/V payloads stay as dead bytes
+    until overwritten, exactly as on the fixed-slot path."""
+
+    def clr(path, leaf):
+        if not _leaf_name(path).startswith(("cached_pos", "cross_mask")):
+            return leaf
+        ax = beam_cache_batch_axis(path, leaf)
+        if ax is None:
+            return leaf
+        idx = (slice(None),) * ax + (blocks,)
+        return leaf.at[idx].set(-1)
+
+    return jax.tree_util.tree_map_with_path(clr, pool_cache)
+
+
+def copy_block(pool_cache, src, dst):
+    """Copy physical block ``src`` onto ``dst`` across every cache leaf —
+    the device half of copy-on-write: a slot about to write into a SHARED
+    block gets its own copy of that ONE block (O(block_tokens), not
+    O(prefix_len) rows).  Positions copy verbatim: sharing maps the same
+    LOGICAL block index into every sharer's table, so the stored global
+    positions are already correct for the copy."""
+
+    def cp(path, leaf):
+        ax = beam_cache_batch_axis(path, leaf)
+        if ax is None:
+            return leaf
+        row = lax.dynamic_slice_in_dim(leaf, src, 1, axis=ax)
+        return lax.dynamic_update_slice_in_dim(leaf, row, dst, axis=ax)
+
+    return jax.tree_util.tree_map_with_path(cp, pool_cache)
+
+
+def default_block_fns():
+    """Jitted (free_block_pos, copy_block) with the pool operand donated —
+    both are WRITE ops under the module's donation contract."""
+    return (
+        jax.jit(free_block_pos, donate_argnums=0),
+        jax.jit(copy_block, donate_argnums=0),
+    )
+
+
+class BlockAllocator:
+    """Host-side free list + refcounts over the physical block pool.
+
+    THE single mutation authority for block ownership (the
+    ``scripts/check_blocks.py`` gate enforces that no code outside this
+    module writes a block table directly): ``alloc`` hands out the
+    lowest-numbered free block with refcount 1, ``share`` bumps a live
+    block's refcount (prefix-cache entries and every additional slot
+    mapping hold one reference each), ``free`` drops one reference and
+    returns the block to the free list when the count hits zero.
+    Refcounts can never go negative — freeing an unreferenced block
+    raises (the double-free guard), as does sharing one.
+    """
+
+    def __init__(self, n_blocks: int):
+        if n_blocks < 1:
+            raise ValueError(f"n_blocks={n_blocks} < 1")
+        import numpy as np
+
+        self.n_blocks = n_blocks
+        self._free: List[int] = list(range(n_blocks))
+        self._ref = np.zeros(n_blocks, np.int32)
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return self.n_blocks - len(self._free)
+
+    def refcount(self, block: int) -> int:
+        return int(self._ref[block])
+
+    def alloc(self) -> int:
+        """Claim the lowest free block (deterministic), refcount 1."""
+        if not self._free:
+            raise RuntimeError(
+                "block pool exhausted — admission control must reserve "
+                "blocks before the engine writes (estimated-blocks gate)"
+            )
+        block = heapq.heappop(self._free)
+        self._ref[block] = 1
+        return block
+
+    def share(self, block: int) -> None:
+        """One more reference to a LIVE block (a slot mapping or a
+        prefix-cache entry)."""
+        if not (0 <= block < self.n_blocks) or self._ref[block] < 1:
+            raise ValueError(
+                f"share of unallocated block {block} "
+                f"(refcount {self.refcount(block) if 0 <= block < self.n_blocks else 'n/a'})"
+            )
+        self._ref[block] += 1
+
+    def free(self, block: int) -> bool:
+        """Drop one reference; True when the block actually returned to
+        the free list (refcount hit zero — the caller must invalidate its
+        device positions via :func:`free_block_pos` before reuse)."""
+        if not (0 <= block < self.n_blocks) or self._ref[block] < 1:
+            raise ValueError(
+                f"double free of block {block} (refcount "
+                f"{self.refcount(block) if 0 <= block < self.n_blocks else 'n/a'})"
+            )
+        self._ref[block] -= 1
+        if self._ref[block] == 0:
+            heapq.heappush(self._free, block)
+            return True
+        return False
+
+    def check(self) -> None:
+        """Invariant audit (tests / debug): refcounts non-negative, the
+        free list holds exactly the zero-refcount blocks, no duplicates."""
+        import numpy as np
+
+        assert (self._ref >= 0).all(), "negative refcount"
+        free = sorted(self._free)
+        assert free == sorted(set(free)), "duplicate free-list entry"
+        zero = np.nonzero(self._ref == 0)[0].tolist()
+        assert free == zero, f"free list {free} != zero-ref blocks {zero}"
+        assert self.in_use + self.n_free == self.n_blocks
+
+
+class PagedCachePool:
+    """Block-paged pool: device cache tree + per-slot block tables +
+    host bookkeeping (slot free list, :class:`BlockAllocator`, per-slot
+    block targets for admission accounting).
+
+    The device tree at ``self.cache`` holds every layer's K/V in
+    ``kv_pool_blocks`` blocks of ``kv_block_tokens`` positions; the HOST
+    mirror ``self.block_table`` [n_slots, max_blocks] is authoritative
+    (device uploads are per-call copies), with -1 = unmapped.  All table
+    mutation goes through this class — allocation (``ensure_writable``),
+    refcounted prefix sharing (``map_prefix`` / ``snapshot_blocks``), and
+    release — so the allocator's refcounts can never drift from the
+    tables (``scripts/check_blocks.py`` gates raw writes).
+
+    Same donation-and-ownership contract as :class:`CachePool`: every
+    write op (extend/decode/verify ticks, COW copies, free-list
+    invalidation) DONATES the pool operand, so ``self.cache`` is the only
+    valid handle and stale references point at deleted buffers.
+    """
+
+    def __init__(self, model, params, n_slots: int, block_fns=None):
+        import numpy as np
+
+        cfg = model.config
+        bt = cfg.kv_block_tokens
+        if bt < 1:
+            raise ValueError(
+                "PagedCachePool needs a model built with kv_block_tokens "
+                f"> 0 (got {bt})"
+            )
+        if cfg.seq_len % bt != 0:
+            raise ValueError(
+                f"kv_block_tokens={bt} must divide seq_len={cfg.seq_len}"
+            )
+        if n_slots < 1:
+            raise ValueError(f"n_slots={n_slots} < 1")
+        self.n_slots = n_slots
+        self.block_tokens = bt
+        self.max_blocks = cfg.seq_len // bt
+        self.n_blocks = cfg.kv_pool_blocks
+        self.cache = empty_pool(model, params, n_slots)
+        self.allocator = BlockAllocator(self.n_blocks)
+        self.block_table = np.full(
+            (n_slots, self.max_blocks), -1, np.int32
+        )
+        # bumped on EVERY host-mirror mutation so the engine re-uploads
+        # the device copy lazily (the fused tick's table rides its inputs)
+        self.table_version = 0
+        self._free_slots: List[int] = list(range(n_slots))
+        # blocks each occupied slot is still entitled to allocate
+        # (admission reserved them); available = free - outstanding
+        self._target_blocks = np.zeros(n_slots, np.int32)
+        # cumulative tallies (ServingMetrics delta-syncs these)
+        self.cow_copies = 0
+        self.shared_block_maps = 0
+        if block_fns is None:
+            block_fns = default_block_fns()
+        self._free_pos, self._copy_block = block_fns
+        # bytes of ONE block across every payload leaf (all layers) — the
+        # capacity denominator behind kv_bytes_per_active_token
+        self.bytes_per_block = sum(
+            leaf.size * leaf.dtype.itemsize // self.n_blocks
+            for path, leaf in jax.tree_util.tree_flatten_with_path(
+                self.cache
+            )[0]
+            if beam_cache_batch_axis(path, leaf) is not None
+        )
+
+    # -- slot lifecycle ----------------------------------------------------
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free_slots)
+
+    @property
+    def occupancy(self) -> float:
+        return 1.0 - len(self._free_slots) / self.n_slots
+
+    @property
+    def blocks_in_use(self) -> int:
+        return self.allocator.in_use
+
+    @property
+    def blocks_free(self) -> int:
+        return self.allocator.n_free
+
+    def acquire(self) -> Optional[int]:
+        if not self._free_slots:
+            return None
+        return self._free_slots.pop(0)
+
+    def release(self, slot: int) -> None:
+        """Return ``slot`` to the free list AND drop its block references:
+        exclusively-owned blocks go back to the allocator (positions
+        device-invalidated so the next owner never attends stale entries);
+        shared blocks just decrement — prefix-cache entries and co-sharers
+        keep them alive."""
+        if slot in self._free_slots or not (0 <= slot < self.n_slots):
+            raise ValueError(f"bad release of slot {slot}")
+        freed = []
+        for j in range(self.max_blocks):
+            blk = int(self.block_table[slot, j])
+            if blk >= 0 and self.allocator.free(blk):
+                freed.append(blk)
+        self.block_table[slot, :] = -1
+        self.table_version += 1
+        self._target_blocks[slot] = 0
+        self._invalidate(freed)
+        self._free_slots.append(slot)
+        self._free_slots.sort()
+
+    def _invalidate(self, blocks) -> None:
+        """Device-side -1 of freed blocks' position entries, in padded
+        fixed-width calls (one compiled shape)."""
+        if not blocks:
+            return
+        import numpy as np
+
+        for i in range(0, len(blocks), self.max_blocks):
+            chunk = blocks[i : i + self.max_blocks]
+            idx = np.full(self.max_blocks, self.n_blocks, np.int32)
+            idx[: len(chunk)] = chunk
+            self.cache = self._free_pos(self.cache, jnp.asarray(idx))
+
+    # -- admission accounting ----------------------------------------------
+
+    def blocks_needed(self, total_tokens: int) -> int:
+        """Blocks a request of ``total_tokens`` (prompt + budget) needs,
+        ignoring prefix sharing (the conservative admission estimate)."""
+        return -(-int(total_tokens) // self.block_tokens)
+
+    def outstanding_blocks(self) -> int:
+        """Blocks occupied slots are still entitled to allocate.  One
+        vectorized pass — the admission gate calls this per queued
+        candidate per tick (idle slots have target 0, so they contribute
+        ``max(0, -mapped) == 0``)."""
+        import numpy as np
+
+        mapped = (self.block_table >= 0).sum(axis=1)
+        return int(np.maximum(self._target_blocks - mapped, 0).sum())
+
+    def blocks_available(self) -> int:
+        """Free blocks NOT spoken for by in-flight slots' entitlements —
+        the admission gate's budget."""
+        return self.allocator.n_free - self.outstanding_blocks()
+
+    def begin_slot(
+        self, slot: int, total_tokens: int, cow_reserve: int = 0
+    ) -> None:
+        """Record the slot's block entitlement at admission (ceil of its
+        worst-case token footprint) — lazy allocation draws against it.
+        ``cow_reserve`` is extra headroom for copy-on-write allocations
+        when prefix sharing can land MID-block (buckets not aligned to
+        ``block_tokens``): a COW keeps the original alive under its other
+        referents AND claims a fresh block, so it is real demand the
+        plain ceil cannot see — the engine reserves one block per
+        non-aligned bucket (plus one for a mid-block hit tail), which
+        upper-bounds the slot's possible COW events."""
+        self._target_blocks[slot] = (
+            min(self.max_blocks, self.blocks_needed(total_tokens))
+            + int(cow_reserve)
+        )
+
+    # -- the write path ----------------------------------------------------
+
+    def ensure_writable(self, slot: int, start_col: int, end_col: int) -> None:
+        """Make logical columns ``[start_col, end_col)`` of ``slot``
+        writable: allocate unmapped blocks in range, and COPY-ON-WRITE any
+        block in range whose refcount exceeds one (someone else — a
+        prefix-cache entry or a co-sharing slot — still reads the
+        original).  Runs before every engine write (prefill extend, decode
+        tick, verify tick), so shared blocks are never scribbled on."""
+        if end_col <= start_col:
+            return
+        first = start_col // self.block_tokens
+        last = min(self.max_blocks, self.blocks_needed(end_col))
+        dirty = False
+        for j in range(first, last):
+            blk = int(self.block_table[slot, j])
+            if blk < 0:
+                self.block_table[slot, j] = self.allocator.alloc()
+                dirty = True
+            elif self.allocator.refcount(blk) > 1:
+                new = self.allocator.alloc()
+                self.cache = self._copy_block(
+                    self.cache, jnp.int32(blk), jnp.int32(new)
+                )
+                self.allocator.free(blk)  # refcount was > 1: stays alive
+                self.block_table[slot, j] = new
+                self.cow_copies += 1
+                dirty = True
+        if dirty:
+            self.table_version += 1
+
+    # -- prefix sharing ----------------------------------------------------
+
+    def map_prefix(self, slot: int, blocks, length: int) -> None:
+        """Land a stored prefix into ``slot`` as TABLE POINTER WRITES — one
+        refcount bump per block, zero K/V copies (the fixed-slot layout's
+        ``copy_prefix`` was O(prefix_len) rows).  A later write into any
+        shared block copy-on-writes through :meth:`ensure_writable`.
+        Positions need no trimming: entries beyond ``length`` in the tail
+        block hold their own column index (the aligned-layout invariant),
+        which every query masks out until the slot overwrites them."""
+        need = self.blocks_needed(length)
+        if len(blocks) < need:
+            raise ValueError(
+                f"prefix of {length} tokens needs {need} blocks, got "
+                f"{len(blocks)}"
+            )
+        for j in range(need):
+            blk = int(blocks[j])
+            self.allocator.share(blk)
+            self.block_table[slot, j] = blk
+        self.shared_block_maps += need
+        self.table_version += 1
+
+    def snapshot_blocks(self, slot: int, length: int):
+        """Freeze the slot's first ``ceil(length / block_tokens)`` blocks
+        as a prefix-cache entry: one refcount bump each, NO copies.  The
+        owner's next write into a snapshotted block copy-on-writes away
+        from it, so the stored prefix is immutable from this moment."""
+        need = self.blocks_needed(length)
+        blocks = tuple(int(b) for b in self.block_table[slot, :need])
+        if any(b < 0 for b in blocks):
+            raise ValueError(
+                f"slot {slot} has only "
+                f"{int((self.block_table[slot] >= 0).sum())} mapped blocks; "
+                f"cannot snapshot {need}"
+            )
+        for b in blocks:
+            self.allocator.share(b)
+        return blocks
+
+    def pin_blocks(self, blocks) -> None:
+        """Take a temporary reference on a stored prefix entry's blocks so
+        the entry can outlive its LRU slot: a same-tick eviction (another
+        admission group's ``store_one`` overflowing the cache calls
+        :meth:`free_stored` on the entry) must not free blocks a later
+        group looked up but has not mapped yet.  Pair every pin with one
+        :meth:`free_stored` once the blocks are mapped."""
+        for b in blocks:
+            self.allocator.share(int(b))
+
+    def free_stored(self, blocks) -> None:
+        """Drop a prefix-cache entry's block references (LRU eviction or a
+        lost store race); blocks whose refcount hits zero return to the
+        free list and are device-invalidated."""
+        freed = [b for b in blocks if self.allocator.free(int(b))]
+        self._invalidate(freed)
+
+    # -- invariants --------------------------------------------------------
+
+    def assert_slot_aligned(self, slot: int) -> None:
+        """The block-paged generalization of
+        :meth:`CachePool.assert_slot_aligned`: gathered through the slot's
+        table, every valid LOGICAL position entry stores exactly its own
+        column (``pos[c] in {-1, c}``) — the no-rollback invariant that
+        keeps stale speculative columns and shared-tail surplus invisible.
+        """
+        import numpy as np
+
+        tbl = self.block_table[slot]
+        mapped = np.repeat(tbl >= 0, self.block_tokens)
+
+        def check(path, leaf):
+            if not _leaf_name(path).startswith("cached_pos"):
+                return leaf
+            ax = beam_cache_batch_axis(path, leaf)
+            arr = np.asarray(leaf)
+            pages = np.take(arr, np.maximum(tbl, 0), axis=ax)
+            flat = pages.reshape(*arr.shape[:ax], -1)
+            row = np.where(mapped, flat, -1).reshape(-1, flat.shape[-1])
+            cols = np.arange(flat.shape[-1])[None, :]
+            bad = (row != -1) & (row != cols)
+            assert not bad.any(), (
+                f"slot {slot} paged position table misaligned at "
+                f"(layer, col) {np.argwhere(bad)[:4].tolist()}: stale "
+                f"columns would enter attention (pos != col)"
+            )
+            return leaf
+
+        jax.tree_util.tree_map_with_path(check, self.cache)

@@ -58,6 +58,20 @@ outputs are token-identical to batch-1 exact-length prefill, pinned in
    model.  Hit/miss/eviction counters surface in
    :class:`~tpu_parallel.serving.metrics.ServingMetrics`.
 
+Block-paged KV cache (``kv_block_tokens`` > 0 or ``"auto"``): swaps the
+fixed ``n_slots x seq_len`` pool for a flat pool of fixed-size blocks
+addressed through per-slot block tables
+(:class:`~tpu_parallel.serving.cache_pool.PagedCachePool`) — slot count
+decouples from ``seq_len`` (admission reserves estimated blocks, with
+transient exhaustion queuing head-of-line and impossible requests
+rejecting with the typed ``capacity`` reason), and prefix reuse becomes
+refcounted block SHARING with copy-on-write instead of row copies.  All
+serving paths (per-step / fused / speculative / chunked / int8 /
+crash-replay) stay greedy-bitwise-identical to the fixed layout
+(``tests/test_paged_kv.py``; memory-model story in
+``docs/10_serving_engine.md``).  Not yet paged: mesh serving and lazy
+beam search.
+
 Greedy equivalence: for requests submitted together, per-request outputs
 are token-identical to static ``generate()`` on the same prompts (pinned
 in ``tests/test_serving.py``) — row-parallel ops make batch composition
@@ -76,7 +90,9 @@ meshes are refused — serve those through ``generate_sharded``.
 
 from __future__ import annotations
 
+import dataclasses
 import functools
+import math
 import time
 from typing import (
     Callable,
@@ -106,7 +122,9 @@ from tpu_parallel.obs.registry import MetricRegistry
 from tpu_parallel.obs.tracer import NULL_TRACER, Tracer
 from tpu_parallel.serving.cache_pool import (
     CachePool,
+    PagedCachePool,
     cache_partition_specs,
+    default_block_fns,
     default_row_fns,
     insert_rows,
 )
@@ -251,7 +269,7 @@ def _decode_core(
 
 def _fused_decode_core(
     model, params, steps, tok, pos, widx, live, budget, eos, temp, topk,
-    topp, cache, rng,
+    topp, cache, rng, table=None,
 ):
     """``steps`` masked single-token decode ticks in ONE jitted
     ``lax.scan`` — the fused engine tick's device body.  Per-slot serving
@@ -286,7 +304,8 @@ def _fused_decode_core(
         tok, pos, widx, live, budget, cache = carry
         widx_eff = jnp.where(live, widx, seq_len)
         hidden, cache = decode_step(
-            model, params, cache, tok, pos, write_index=widx_eff
+            model, params, cache, tok, pos, write_index=widx_eff,
+            block_table=table,
         )
         logits = _full_last_logits(cfg, params, hidden)
         nxt = sample_tokens(logits, step_rng, temp, topk, topp)
@@ -310,7 +329,7 @@ def _fused_decode_core(
 
 def _verify_core(
     model, params, tok, drafts, draft_len, pos, widx, temperature, top_k,
-    top_p, cache, rng,
+    top_p, cache, rng, table=None,
 ):
     """One SPECULATIVE engine tick over the slot pool: each row feeds its
     current token plus its (padded) draft block through one multi-token
@@ -334,12 +353,84 @@ def _verify_core(
     positions = jnp.where(
         offs <= draft_len[:, None], pos[:, None] + offs, -1
     )
-    hidden, cache = verify_step(model, params, cache, tokens, positions, widx)
+    hidden, cache = verify_step(
+        model, params, cache, tokens, positions, widx, block_table=table
+    )
     logits = _full_logits(model.config, params, hidden)
     out_tokens, accepted = verify_tokens(
         drafts, draft_len, logits, rng, temperature, top_k, top_p
     )
     return out_tokens, accepted, cache
+
+
+def _extend_core_paged(
+    model, params, tokens, positions, last_idx, write_start, table, cache,
+    rng,
+):
+    """The paged prefill/extend core: rows' K/V land DIRECTLY in the
+    shared block pool through their block-table rows (``write_start +
+    [0..T)`` translated per token to ``table[row, col // bt] * bt +
+    col % bt``) — there is no fresh per-request cache to insert/scatter,
+    which is the whole point of paging.  Dummy rows pass an all--1 table
+    (every write dropped)."""
+    del rng
+    hidden, cache = prefill_extend_step(
+        model, params, cache, tokens, positions, write_start,
+        block_table=table,
+    )
+    return _full_last_logits(model.config, params, hidden, last_idx), cache
+
+
+def _decode_core_paged(
+    model, params, tok, pos, widx, table, temperature, top_k, top_p, cache,
+    rng,
+):
+    """One paged engine tick: identical math to :func:`_decode_core`
+    (same ``decode_step`` / lm_head / sampler), with cache reads and
+    writes routed through the per-slot block tables — greedy output is
+    bitwise identical to the fixed-slot layout."""
+    hidden, cache = decode_step(
+        model, params, cache, tok, pos, write_index=widx, block_table=table
+    )
+    logits = _full_last_logits(model.config, params, hidden)
+    nxt = sample_tokens(logits, rng, temperature, top_k, top_p)
+    return nxt, cache
+
+
+@functools.lru_cache(maxsize=8)
+def _paged_engine_fns(model):
+    """Jitted engine steps for the BLOCK-PAGED pool, cached per (paged)
+    model.  The cache pool is DONATED on every call exactly as on the
+    fixed-slot path; block tables are NOT donated — they are small
+    per-call uploads of the host-authoritative mirror, so donation would
+    only buy an ownership hazard."""
+    extend = jax.jit(
+        lambda params, tokens, positions, last_idx, wstart, table, cache, \
+            rng: _extend_core_paged(
+                model, params, tokens, positions, last_idx, wstart, table,
+                cache, rng,
+            ),
+        donate_argnums=6,
+    )
+    decode = jax.jit(
+        lambda params, tok, pos, widx, table, temp, tk, tp, cache, rng: (
+            _decode_core_paged(
+                model, params, tok, pos, widx, table, temp, tk, tp, cache,
+                rng,
+            )
+        ),
+        donate_argnums=8,
+    )
+    verify = jax.jit(
+        lambda params, tok, drafts, dlen, pos, widx, table, temp, tk, tp, \
+            cache, rng: _verify_core(
+                model, params, tok, drafts, dlen, pos, widx, temp, tk, tp,
+                cache, rng, table=table,
+            ),
+        donate_argnums=10,
+    )
+    sample = jax.jit(sample_tokens)
+    return extend, decode, verify, sample, default_block_fns()
 
 
 @functools.lru_cache(maxsize=8)
@@ -422,6 +513,22 @@ def _fused_engine_fn(model, steps: int):
             model, params, steps, *state, *knobs, cache, rng
         ),
         donate_argnums=(1, 3),
+    )
+
+
+@functools.lru_cache(maxsize=8)
+def _paged_fused_engine_fn(model, steps: int):
+    """The fused decode tick over the block-paged pool: same donated
+    (state, cache) contract as :func:`_fused_engine_fn`; the block table
+    rides the tick's inputs un-donated (loop-invariant through the scan —
+    the host re-uploads only when the allocator moved a mapping, so
+    steady-state decode re-dispatches the same device table and the
+    compile count stays pinned per (model, steps))."""
+    return jax.jit(
+        lambda params, state, knobs, table, cache, rng: _fused_decode_core(
+            model, params, steps, *state, *knobs, cache, rng, table=table
+        ),
+        donate_argnums=(1, 4),
     )
 
 
@@ -580,6 +687,8 @@ class ServingEngine:
         prefill_batch: Optional[int] = None,
         prefill_chunk_tokens: Optional[int] = None,
         prefix_cache_size: int = 0,
+        kv_block_tokens: Union[int, str, None] = None,
+        kv_pool_blocks: Optional[int] = None,
         decode_steps_per_tick: Union[int, str] = "auto",
         draft_tokens: int = 0,
         drafter: Optional[Drafter] = None,
@@ -645,6 +754,57 @@ class ServingEngine:
             self._buckets = bs
         else:
             self._buckets = None
+        # block-paged KV cache: kv_block_tokens > 0 (or "auto") swaps the
+        # fixed n_slots x seq_len pool for a flat pool of kv_pool_blocks
+        # blocks addressed through per-slot block tables — slot count
+        # decouples from seq_len and prefix hits become O(1) refcounted
+        # pointer writes.  None/0 keeps the fixed-slot layout.
+        if kv_block_tokens in (None, 0):
+            self._paged = False
+            self._block_tokens = 0
+        else:
+            if mesh is not None:
+                raise NotImplementedError(
+                    "paged KV cache under a mesh (build_sharded_serving "
+                    "has no block-table plumbing) — mesh serving keeps "
+                    "the fixed-slot pool"
+                )
+            if kv_block_tokens == "auto":
+                # the bucket quantum: the largest size dividing seq_len,
+                # every prefill bucket, and 32 — so bucket-aligned prefix
+                # keys (the router/prefix-cache alignment) always land on
+                # block boundaries and shared blocks need no trimming
+                bt = math.gcd(cfg.seq_len, 32)
+                for b in self._buckets or ():
+                    bt = math.gcd(bt, int(b))
+            else:
+                bt = int(kv_block_tokens)
+                if bt < 1:
+                    raise ValueError(f"kv_block_tokens={bt} < 1")
+                if cfg.seq_len % bt != 0:
+                    raise ValueError(
+                        f"kv_block_tokens={bt} must divide "
+                        f"seq_len={cfg.seq_len}"
+                    )
+            n_blocks = (
+                int(kv_pool_blocks)
+                if kv_pool_blocks is not None
+                else n_slots * (cfg.seq_len // bt)
+            )
+            if n_blocks < 1:
+                raise ValueError(f"kv_pool_blocks={n_blocks} < 1")
+            self._paged = True
+            self._block_tokens = bt
+            # the engine's jitted fns and pool key off the PAGED model
+            # variant (cache shapes are config-driven); params are
+            # layout-agnostic and shared
+            model = type(model)(
+                dataclasses.replace(
+                    cfg, kv_block_tokens=bt, kv_pool_blocks=n_blocks
+                )
+            )
+            cfg = model.config
+            self.model = model
         if prefill_chunk_tokens is not None and prefill_chunk_tokens < 1:
             raise ValueError(
                 f"prefill_chunk_tokens={prefill_chunk_tokens} < 1"
@@ -656,8 +816,33 @@ class ServingEngine:
                 "keys are bucket-aligned)"
             )
         self._prefix = (
-            PrefixCache(prefix_cache_size) if prefix_cache_size > 0 else None
+            PrefixCache(
+                prefix_cache_size,
+                # paged entries hold refcounted block ids: eviction must
+                # hand the references back to the allocator
+                on_evict=(
+                    self._release_prefix_entry if self._paged else None
+                ),
+            )
+            if prefix_cache_size > 0
+            else None
         )
+        # copy-on-write admission headroom: with buckets NOT aligned to
+        # the block size, stored prefixes end mid-block, so sharing puts
+        # live write columns inside shared blocks and the sharers'
+        # writes COW — each COW claims a fresh block the plain
+        # ceil(total/bt) estimate cannot see (the original stays alive
+        # under its other referents).  Reserve one block per non-aligned
+        # bucket plus one for a mid-block hit tail — the upper bound on
+        # one slot's COW events — so the block gate can never admit a
+        # set whose COWs exhaust the pool mid-tick.  Zero under the
+        # "auto" quantum (aligned buckets never COW).
+        self._cow_reserve = 0
+        if self._paged and self._prefix is not None:
+            unaligned = sum(
+                1 for b in self._buckets if b % self._block_tokens != 0
+            )
+            self._cow_reserve = (1 + unaligned) if unaligned else 0
         self._prefill_batch = (
             prefill_batch
             if prefill_batch is not None
@@ -707,13 +892,24 @@ class ServingEngine:
                     "— mesh serving decodes per-step"
                 )
         self._fused_steps = fused
-        self._fused_fn = _fused_engine_fn(model, fused) if fused > 1 else None
+        if fused > 1:
+            self._fused_fn = (
+                _paged_fused_engine_fn(model, fused)
+                if self._paged
+                else _fused_engine_fn(model, fused)
+            )
+        else:
+            self._fused_fn = None
         # device-resident slot state (fused path): uploaded lazily after
         # host-side mutations, otherwise the previous tick's returned
         # arrays are re-donated — steady-state decode never re-uploads
         self._dev_state = None
         self._dev_knobs = None
         self._state_dirty = True
+        # device copy of the paged block-table mirror, re-uploaded only
+        # when the allocator bumped table_version
+        self._dev_table = None
+        self._table_version = -1
 
         pool_shardings = None
         if mesh is not None:
@@ -734,14 +930,24 @@ class ServingEngine:
                 model, mesh, _HashableTree.of(param_specs),
                 _HashableTree.of(cspecs),
             )
+        elif self._paged:
+            fns = None
         else:
             fns = _engine_fns(model)
-        (self._prefill_fn, self._extend_fn, self._decode_fn,
-         self._verify_fn, self._sample_fn, insert, row_fns) = fns
-        self.pool = CachePool(
-            model, params, n_slots, insert_fn=insert,
-            shardings=pool_shardings, row_fns=row_fns,
-        )
+        if self._paged:
+            (self._extend_fn, self._decode_fn, self._verify_fn,
+             self._sample_fn, block_fns) = _paged_engine_fns(model)
+            self._prefill_fn = None  # paged prefill IS the extend path
+            self.pool: Union[CachePool, PagedCachePool] = PagedCachePool(
+                model, params, n_slots, block_fns=block_fns
+            )
+        else:
+            (self._prefill_fn, self._extend_fn, self._decode_fn,
+             self._verify_fn, self._sample_fn, insert, row_fns) = fns
+            self.pool = CachePool(
+                model, params, n_slots, insert_fn=insert,
+                shardings=pool_shardings, row_fns=row_fns,
+            )
 
         n = n_slots
         self._tok = np.zeros(n, np.int32)
@@ -796,6 +1002,27 @@ class ServingEngine:
             )
             self.metrics.record_rejected()
             return out
+        if self._paged:
+            # a pool smaller than one request's worst case could never
+            # admit it — the typed reject the cluster frontend already
+            # understands (transient exhaustion instead queues: the
+            # per-tick block gate holds the head until blocks free up)
+            need = self.pool.blocks_needed(total) + self._cow_reserve
+            if need > self.pool.n_blocks:
+                out.status = REJECTED
+                out.finish_reason = REJECT_CAPACITY
+                out.detail = (
+                    f"request needs {need} KV blocks "
+                    f"({total} tokens at {self.pool.block_tokens}/block"
+                    + (
+                        f" + {self._cow_reserve} copy-on-write reserve"
+                        if self._cow_reserve
+                        else ""
+                    )
+                    + f") but the pool holds {self.pool.n_blocks}"
+                )
+                self.metrics.record_rejected()
+                return out
         verdict = self.scheduler.submit(out, requeue=requeue)
         if not verdict:
             out.status = REJECTED
@@ -946,15 +1173,27 @@ class ServingEngine:
             else None
         )
         admitted = self.scheduler.schedule(
-            self.pool.n_free, now, bucket_key=bucket_key
+            self.pool.n_free, now, bucket_key=bucket_key,
+            can_admit=self._block_gate() if self._paged else None,
         )
         events.extend(self._admit_batch(admitted))
+        # active tokens RESIDENT during this tick's decode = slots'
+        # written depths + in-flight chunked prefills' offsets, captured
+        # BEFORE delivery retires finished slots — the capacity
+        # denominator behind kv_bytes_per_active_token
+        active_tokens = int(self._pos[self._active].sum()) + sum(
+            st.offset for st in self._chunking.values()
+        )
         decoded = False
         if self._active.any():
             events.extend(self._decode_tick())
             decoded = True
         if self._prefix is not None:
             self.metrics.sync_prefix_cache(self._prefix)
+        if self._paged:
+            self.metrics.sync_block_pool(
+                self.pool, active_tokens=active_tokens
+            )
         # stall attribution, most-specific first: any prefill work this
         # tick stalled the pool's decode; a speculative tick spent its
         # decode slot verifying; an undecoded tick with nothing admitted
@@ -1023,6 +1262,10 @@ class ServingEngine:
         self.metrics = metrics
         self.registry = self.metrics.registry
         self.scheduler.registry = self.registry
+        if self._paged:
+            # the pool's COW/share tallies are cumulative; watermark them
+            # so the fresh record's delta-synced counters start at zero
+            self.metrics.seed_block_pool(self.pool)
         return self.metrics
 
     @property
@@ -1051,6 +1294,68 @@ class ServingEngine:
     def _next_rng(self) -> jax.Array:
         self._rng, sub = jax.random.split(self._rng)
         return sub
+
+    def _device_table(self) -> jax.Array:
+        """Device copy of the pool's host-authoritative block-table
+        mirror, re-uploaded ONLY when the allocator moved a mapping
+        (``table_version``) — steady-state decode re-dispatches the same
+        device array, so the fused tick's inputs are loop-invariant and
+        its compile count stays pinned."""
+        if (
+            self._dev_table is None
+            or self._table_version != self.pool.table_version
+        ):
+            self._dev_table = jnp.asarray(self.pool.block_table)
+            self._table_version = self.pool.table_version
+        return self._dev_table
+
+    def _block_gate(self) -> Callable[[RequestOutput], bool]:
+        """Admission gate closure for ONE scheduling pass: a candidate
+        must fit its WORST-CASE block footprint (``ceil((prompt +
+        max_new_tokens) / block_tokens)``, ignoring prefix sharing —
+        conservative, plus the copy-on-write reserve for non-aligned
+        buckets) inside the free blocks not already spoken for by
+        in-flight slots' entitlements.  The closure tracks its own
+        running reservation so one tick's multiple admissions cannot
+        jointly overcommit.  A refused candidate first EVICTS
+        least-recently-used prefix-cache entries (stored entries hold
+        refcounted blocks indefinitely — without the pressure valve a
+        head whose worst case exceeds the un-stored remainder would
+        starve forever); only then does it wait (head-of-line,
+        FIFO-fair) for running requests to retire blocks."""
+        pool = self.pool
+        reserved = 0
+
+        def gate(out: RequestOutput) -> bool:
+            nonlocal reserved
+            need = (
+                pool.blocks_needed(
+                    len(out.request.prompt) + out.request.max_new_tokens
+                )
+                + self._cow_reserve
+            )
+            avail = pool.blocks_available() - reserved
+            while (
+                need > avail
+                and self._prefix is not None
+                and self._prefix.pop_lru()
+            ):
+                # an evicted entry frees its blocks only if no live slot
+                # still maps them — recompute rather than assume
+                avail = pool.blocks_available() - reserved
+            if need > avail:
+                return False
+            reserved += need
+            return True
+
+        return gate
+
+    def _release_prefix_entry(self, entry) -> None:
+        """PrefixCache eviction hook (paged mode): hand the evicted
+        entry's block references back to the allocator; blocks nobody
+        else holds return to the free list device-invalidated."""
+        blocks, _length = entry
+        self.pool.free_stored(blocks)
 
     def _bucket_for(self, length: int) -> int:
         for b in self._buckets:
@@ -1087,6 +1392,8 @@ class ServingEngine:
                 span = self._queue_spans.pop(out.request.request_id, None)
                 if span is not None:
                     span.finish()
+        if self._paged:
+            return self._admit_batch_paged(admitted)
         for out in admitted:
             length = len(out.request.prompt)
             if self._chunk_tokens is not None and length > self._chunk_tokens:
@@ -1186,10 +1493,12 @@ class ServingEngine:
                 )
         events = []
         for i, out in enumerate(outs):
+            # store BEFORE activating (uniform with the paged path, where
+            # immediate retirement wipes the slot's block table)
+            self._maybe_store_prefix(out, int(slots[i]))
             events.append(
                 self._activate(int(slots[i]), out, firsts[i], int(lengths[i]))
             )
-            self._maybe_store_prefix(out, int(slots[i]))
         return events
 
     def _admit_prefix_batch(
@@ -1244,15 +1553,123 @@ class ServingEngine:
                 )
         events = []
         for i, out in enumerate(outs):
+            # a request hitting on a SHORT prefix may carry a longer
+            # bucket-aligned prefix that was LRU-evicted — re-seed it
+            # (no-op unless some key is actually new); store BEFORE
+            # activating (uniform with the paged path, where immediate
+            # retirement wipes the slot's block table)
+            self._maybe_store_prefix(out, int(slots[i]))
             events.append(
                 self._activate(
                     int(slots[i]), out, firsts[i], len(out.request.prompt)
                 )
             )
-            # a request hitting on a SHORT prefix may carry a longer
-            # bucket-aligned prefix that was LRU-evicted — re-seed it
-            # (no-op unless some key is actually new)
-            self._maybe_store_prefix(out, int(slots[i]))
+        return events
+
+    def _admit_batch_paged(
+        self, admitted: List[RequestOutput]
+    ) -> List[StreamEvent]:
+        """Paged admission routing: there is no whole-row prefill — every
+        prompt (cold or prefix hit) lands DIRECTLY in the shared block
+        pool through one batched extend call, grouped by (prefix length,
+        remainder width) so one compiled shape serves the group.  A
+        prefix hit costs a table pointer write plus refcount bump per
+        shared block — ZERO K/V row copies (the fixed-slot layout's
+        ``stack_prefix_rows``/``copy_prefix`` economy is gone)."""
+        events: List[StreamEvent] = []
+        groups: Dict[Tuple[int, int], list] = {}
+        for out in admitted:
+            length = len(out.request.prompt)
+            if self._chunk_tokens is not None and length > self._chunk_tokens:
+                events.extend(self._start_chunked(out))
+                continue
+            plen, blocks = 0, None
+            if self._prefix is not None:
+                hit = self._prefix.lookup(out.request.prompt, self._buckets)
+                if hit is not None:
+                    blocks, plen = hit
+                    # pin: an earlier-processed group's prefix store can
+                    # LRU-evict this entry (free_stored -> refcount 0 ->
+                    # block reused) before OUR group maps it; the pin is
+                    # dropped right after map_prefix
+                    self.pool.pin_blocks(blocks)
+            width = (
+                self._bucket_for(length - plen)
+                if self._buckets is not None
+                else length - plen  # legacy exact widths, compiled per len
+            )
+            groups.setdefault((plen, width), []).append((out, blocks))
+        for (plen, width), group in groups.items():
+            events.extend(self._admit_extend_paged(group, plen, width))
+        return events
+
+    def _admit_extend_paged(
+        self, group: List[tuple], plen: int, width: int
+    ) -> List[StreamEvent]:
+        """ONE batched extend for a same-(prefix, width) paged admission
+        group: each row maps its shared prefix blocks (refcount bumps, no
+        copies), allocates writable blocks for its remainder, and writes
+        its remainder K/V straight into the pool through its block-table
+        row.  Dummy batch rows pass an all--1 table — every write
+        dropped."""
+        t0 = self.tracer.now()
+        nb = max(self._prefill_batch, len(group))
+        tokens = np.zeros((nb, width), np.int32)
+        rems = np.ones(nb, np.int32)
+        table = np.full((nb, self.pool.max_blocks), -1, np.int32)
+        slots: List[int] = []
+        for i, (out, blocks) in enumerate(group):
+            req = out.request
+            rem = req.prompt[plen:]
+            tokens[i, : len(rem)] = rem
+            rems[i] = len(rem)
+            slot = self.pool.acquire()
+            assert slot is not None, "scheduler admitted beyond free slots"
+            slots.append(slot)
+            self.pool.begin_slot(
+                slot, len(req.prompt) + req.max_new_tokens,
+                cow_reserve=self._cow_reserve,
+            )
+            if blocks is not None:
+                self.pool.map_prefix(slot, blocks, plen)
+                self.pool.free_stored(blocks)  # drop the admission pin
+            self.pool.ensure_writable(slot, plen, len(req.prompt))
+            table[i] = self.pool.block_table[slot]
+        base, last_idx = padded_prefill_inputs(rems, width)
+        positions = jnp.where(base >= 0, base + plen, -1)
+        logits, self.pool.cache = self._extend_fn(
+            self.params, jnp.asarray(tokens), positions, last_idx,
+            jnp.full((nb,), plen, jnp.int32), jnp.asarray(table),
+            self.pool.cache, self._next_rng(),
+        )
+        self._prefill_shapes.add(("extend", nb, width))
+        self.metrics.record_prefill_call()
+        outs = [out for (out, _) in group]
+        firsts = self._sample_first(logits, outs)
+        if self.tracer.enabled:
+            t1 = self.tracer.now()
+            self.tracer.record(
+                "prefill_batch", "scheduler", t0, t1, bucket=width, rows=nb,
+                requests=len(outs), prefix_len=plen,
+            )
+            for i, out in enumerate(outs):
+                self.tracer.record(
+                    "prefill", f"slot {slots[i]}", t0, t1,
+                    request_id=out.request.request_id, slot=slots[i],
+                    bucket=width, cache_hit=plen > 0, prefix_len=plen,
+                )
+        events = []
+        for i, out in enumerate(outs):
+            # store BEFORE activating: a request finishing on its first
+            # token (max_new_tokens=1 / immediate EOS) releases its slot
+            # inside _activate's delivery, wiping the block table the
+            # snapshot needs
+            self._maybe_store_prefix(out, slots[i])
+            events.append(
+                self._activate(
+                    slots[i], out, firsts[i], len(out.request.prompt)
+                )
+            )
         return events
 
     def _extend_slot(
@@ -1266,6 +1683,18 @@ class ServingEngine:
         tokens[0, :take] = tokens_seq
         base, last_idx = padded_prefill_inputs([take], width)
         positions = jnp.where(base >= 0, base + offset, -1)
+        if self._paged:
+            # the chunk writes straight into the shared pool through the
+            # slot's table row — no extract/insert round-trip exists
+            self.pool.ensure_writable(slot, offset, offset + take)
+            logits, self.pool.cache = self._extend_fn(
+                self.params, jnp.asarray(tokens), positions, last_idx,
+                jnp.asarray([offset], jnp.int32),
+                jnp.asarray(self.pool.block_table[slot : slot + 1]),
+                self.pool.cache, self._next_rng(),
+            )
+            self._prefill_shapes.add(("extend", 1, width))
+            return logits
         row = self.pool.extract(slot)
         logits, row = self._extend_fn(
             self.params, jnp.asarray(tokens), positions, last_idx,
@@ -1282,14 +1711,28 @@ class ServingEngine:
         slot = self.pool.acquire()
         assert slot is not None, "scheduler admitted beyond free slots"
         offset = 0
+        if self._paged:
+            self.pool.begin_slot(
+                slot,
+                len(out.request.prompt) + out.request.max_new_tokens,
+                cow_reserve=self._cow_reserve,
+            )
         if self._prefix is not None:
             hit = self._prefix.lookup(out.request.prompt, self._buckets)
             if hit is not None:
                 row, offset = hit
-                self.pool.copy_prefix(row, slot, offset)
-        if offset == 0:
+                if self._paged:
+                    # O(1) pointer writes; the first chunk's writes into a
+                    # shared tail block copy-on-write through
+                    # ensure_writable — never O(prefix) row copies
+                    self.pool.map_prefix(slot, row, offset)
+                else:
+                    self.pool.copy_prefix(row, slot, offset)
+        if offset == 0 and not self._paged:
             # incremental writes only from here on: invalidate the slot's
-            # previous occupant NOW (a whole-row insert never happens)
+            # previous occupant NOW (a whole-row insert never happens);
+            # a paged slot needs no clear — release() already
+            # device-invalidated its freed blocks' positions
             self.pool.clear(slot)
         out.status = RUNNING
         self._slot_out[slot] = out
@@ -1322,9 +1765,10 @@ class ServingEngine:
             return []
         del self._chunking[slot]
         tok0 = self._sample_first(logits, [st.out])[0]
-        event = self._activate(slot, st.out, tok0, len(prompt))
+        # store BEFORE activating: immediate retirement inside _activate
+        # releases the slot (paged: wipes the table the snapshot needs)
         self._maybe_store_prefix(st.out, slot)
-        return [event]
+        return [self._activate(slot, st.out, tok0, len(prompt))]
 
     def _maybe_store_prefix(self, out: RequestOutput, slot: int) -> None:
         """Seed the prefix cache from a freshly prefilled slot row (every
@@ -1337,6 +1781,17 @@ class ServingEngine:
             b >= len(prompt) or prompt[:b] in self._prefix
             for b in self._buckets
         ):
+            return
+        if self._paged:
+            # per-key refcounted block snapshots — NO K/V copies: the
+            # owner's next write into a snapshotted block copy-on-writes
+            # away, so stored prefixes are immutable from this moment
+            for b in self._buckets:
+                if b >= len(prompt) or prompt[:b] in self._prefix:
+                    continue
+                blocks = self.pool.snapshot_blocks(slot, b)
+                if not self._prefix.store_one(prompt[:b], b, blocks):
+                    self.pool.free_stored(blocks)  # lost the store race
             return
         self._prefix.store(prompt, self._buckets, self.pool.extract(slot))
 
@@ -1396,17 +1851,36 @@ class ServingEngine:
         if self._fused_steps > 1:
             return self._fused_tick()
         t0 = self.tracer.now()
-        nxt, self.pool.cache = self._decode_fn(
-            self.params,
-            jnp.asarray(self._tok),
-            jnp.asarray(self._pos),
-            jnp.asarray(self._widx),
-            jnp.asarray(self._temp),
-            jnp.asarray(self._topk),
-            jnp.asarray(self._topp),
-            self.pool.cache,
-            self._next_rng(),
-        )
+        if self._paged:
+            seq_len = self.model.config.seq_len
+            for slot in np.nonzero(self._active)[0]:
+                w = int(self._widx[slot])
+                if w < seq_len:
+                    self.pool.ensure_writable(int(slot), w, w + 1)
+            nxt, self.pool.cache = self._decode_fn(
+                self.params,
+                jnp.asarray(self._tok),
+                jnp.asarray(self._pos),
+                jnp.asarray(self._widx),
+                self._device_table(),
+                jnp.asarray(self._temp),
+                jnp.asarray(self._topk),
+                jnp.asarray(self._topp),
+                self.pool.cache,
+                self._next_rng(),
+            )
+        else:
+            nxt, self.pool.cache = self._decode_fn(
+                self.params,
+                jnp.asarray(self._tok),
+                jnp.asarray(self._pos),
+                jnp.asarray(self._widx),
+                jnp.asarray(self._temp),
+                jnp.asarray(self._topk),
+                jnp.asarray(self._topp),
+                self.pool.cache,
+                self._next_rng(),
+            )
         nxt = np.asarray(nxt)  # forces the async dispatch; t1 is real time
         events = []
         trace = self.tracer.enabled
@@ -1473,10 +1947,28 @@ class ServingEngine:
         t0 = self.tracer.now()
         if self._state_dirty or self._dev_state is None:
             self._upload_slot_state()
-        block, counts, self._dev_state, self.pool.cache = self._fused_fn(
-            self.params, self._dev_state, self._dev_knobs,
-            self.pool.cache, self._next_rng(),
-        )
+        if self._paged:
+            # make every column this tick CAN write writable up front
+            # (budget-clamped so a finishing slot never draws blocks
+            # beyond its admission entitlement); the table then rides the
+            # scan's inputs loop-invariant — steady-state ticks re-upload
+            # nothing and the compile count stays pinned
+            seq_len = self.model.config.seq_len
+            for slot in np.nonzero(self._active)[0]:
+                out = self._slot_out[slot]
+                w = int(self._widx[slot])
+                rem = out.request.max_new_tokens - len(out.tokens)
+                end = min(w + min(self._fused_steps, max(rem, 0)), seq_len)
+                self.pool.ensure_writable(int(slot), w, end)
+            block, counts, self._dev_state, self.pool.cache = self._fused_fn(
+                self.params, self._dev_state, self._dev_knobs,
+                self._device_table(), self.pool.cache, self._next_rng(),
+            )
+        else:
+            block, counts, self._dev_state, self.pool.cache = self._fused_fn(
+                self.params, self._dev_state, self._dev_knobs,
+                self.pool.cache, self._next_rng(),
+            )
         # ONE device->host sync per T decode steps — the whole point
         block, counts = np.asarray(block), np.asarray(counts)
         stuck = [
@@ -1577,19 +2069,45 @@ class ServingEngine:
             dlen[slot] = len(d)
             drafts[slot, : len(d)] = d
         t0 = self.tracer.now()
-        block, accepted, self.pool.cache = self._verify_fn(
-            self.params,
-            jnp.asarray(self._tok),
-            jnp.asarray(drafts),
-            jnp.asarray(dlen),
-            jnp.asarray(self._pos),
-            jnp.asarray(self._widx),
-            jnp.asarray(self._temp),
-            jnp.asarray(self._topk),
-            jnp.asarray(self._topp),
-            self.pool.cache,
-            self._next_rng(),
-        )
+        if self._paged:
+            # the verify writes current token + dlen draft columns;
+            # draft_for_row already clamped dlen inside the budget, so
+            # the range never overdraws the slot's block entitlement
+            for slot in active:
+                w = int(self._widx[slot])
+                self.pool.ensure_writable(
+                    int(slot),
+                    w,
+                    min(w + int(dlen[slot]) + 1, cfg.seq_len),
+                )
+            block, accepted, self.pool.cache = self._verify_fn(
+                self.params,
+                jnp.asarray(self._tok),
+                jnp.asarray(drafts),
+                jnp.asarray(dlen),
+                jnp.asarray(self._pos),
+                jnp.asarray(self._widx),
+                self._device_table(),
+                jnp.asarray(self._temp),
+                jnp.asarray(self._topk),
+                jnp.asarray(self._topp),
+                self.pool.cache,
+                self._next_rng(),
+            )
+        else:
+            block, accepted, self.pool.cache = self._verify_fn(
+                self.params,
+                jnp.asarray(self._tok),
+                jnp.asarray(drafts),
+                jnp.asarray(dlen),
+                jnp.asarray(self._pos),
+                jnp.asarray(self._widx),
+                jnp.asarray(self._temp),
+                jnp.asarray(self._topk),
+                jnp.asarray(self._topp),
+                self.pool.cache,
+                self._next_rng(),
+            )
         block, accepted = np.asarray(block), np.asarray(accepted)
         events = []
         trace = self.tracer.enabled

@@ -104,6 +104,25 @@ class ServingMetrics:
         self._tokens_accepted = r.counter("serving_tokens_accepted_total")
         self._spec_wasted = r.counter("serving_spec_wasted_positions_total")
         self._spec_acceptance = r.histogram("serving_spec_acceptance_ratio")
+        # block-paged KV pool (kv_block_tokens > 0): live block occupancy
+        # gauges, copy-on-write and prefix-share tallies (delta-synced
+        # from the pool's cumulative counters so reset_metrics starts a
+        # fresh record at zero), and bytes of allocated KV per active
+        # token — the capacity win the paged layout exists for (a fixed
+        # pool pins this at seq_len's worth regardless of request length)
+        self._kv_blocks_in_use = r.gauge("serving_kv_blocks_in_use")
+        self._kv_blocks_free = r.gauge("serving_kv_blocks_free")
+        self._kv_cow_copies = r.counter(
+            "serving_kv_block_cow_copies_total"
+        )
+        self._prefix_shared_blocks = r.counter(
+            "serving_prefix_shared_blocks_total"
+        )
+        self._kv_bytes_per_token = r.gauge(
+            "serving_kv_bytes_per_active_token"
+        )
+        self._cow_seen = 0
+        self._shared_seen = 0
         # dispatch amortization: every jitted model-forward the engine
         # issues (prefill/extend/chunk/decode/verify/fused) counts one
         # host dispatch; decode-family dispatches additionally observe
@@ -209,6 +228,22 @@ class ServingMetrics:
     def host_dispatches(self) -> int:
         return int(self._host_dispatches.value)
 
+    @property
+    def kv_blocks_in_use(self) -> int:
+        return int(self._kv_blocks_in_use.value)
+
+    @property
+    def kv_blocks_free(self) -> int:
+        return int(self._kv_blocks_free.value)
+
+    @property
+    def kv_block_cow_copies(self) -> int:
+        return int(self._kv_cow_copies.value)
+
+    @property
+    def prefix_shared_blocks(self) -> int:
+        return int(self._prefix_shared_blocks.value)
+
     # -- recording ---------------------------------------------------------
 
     def record_tick(
@@ -305,6 +340,38 @@ class ServingMetrics:
         self._prefix_misses.set(prefix_cache.misses)
         self._prefix_evictions.set(prefix_cache.evictions)
 
+    def seed_block_pool(self, pool) -> None:
+        """Watermark a paged pool's CUMULATIVE COW/share tallies so this
+        record's delta-synced counters start at zero (``reset_metrics``
+        hands a long-lived engine a fresh record without resetting the
+        pool)."""
+        self._cow_seen = pool.cow_copies
+        self._shared_seen = pool.shared_block_maps
+
+    def sync_block_pool(self, pool, active_tokens: int = 0) -> None:
+        """Mirror a
+        :class:`~tpu_parallel.serving.cache_pool.PagedCachePool`'s
+        occupancy and copy tallies (the pool owns the counts; metrics
+        delta-syncs the cumulative ones past the :meth:`seed_block_pool`
+        watermark).  ``active_tokens`` — the in-flight requests' written
+        depths — is the denominator of the capacity gauge: allocated KV
+        bytes per token actually in use (fixed-slot layouts pin this at
+        seq_len's worth; paging's whole point is pulling it toward
+        ``bytes_per_block / block_tokens``)."""
+        self._kv_blocks_in_use.set(pool.blocks_in_use)
+        self._kv_blocks_free.set(pool.blocks_free)
+        cow, shared = pool.cow_copies, pool.shared_block_maps
+        if cow > self._cow_seen:
+            self._kv_cow_copies.inc(cow - self._cow_seen)
+        self._cow_seen = cow
+        if shared > self._shared_seen:
+            self._prefix_shared_blocks.inc(shared - self._shared_seen)
+        self._shared_seen = shared
+        if active_tokens > 0:
+            self._kv_bytes_per_token.set(
+                pool.blocks_in_use * pool.bytes_per_block / active_tokens
+            )
+
     def throughput(self) -> Optional[float]:
         """Generated tokens per wall-second over the ticks observed."""
         if self._t_start is None or self._t_last is None:
@@ -352,6 +419,15 @@ class ServingMetrics:
             "tokens_per_decode_tick": (
                 round(self.tokens_out / self.decode_ticks, 3)
                 if self.decode_ticks
+                else None
+            ),
+            "kv_blocks_in_use": self.kv_blocks_in_use,
+            "kv_blocks_free": self.kv_blocks_free,
+            "kv_block_cow_copies": self.kv_block_cow_copies,
+            "prefix_shared_blocks": self.prefix_shared_blocks,
+            "kv_bytes_per_active_token": (
+                round(float(self._kv_bytes_per_token.value), 1)
+                if self._kv_bytes_per_token.value
                 else None
             ),
             "host_dispatches": self.host_dispatches,

@@ -19,6 +19,14 @@ produce logits.)
 Entries are whole pool rows (seq_len-long K/V per layer) — real HBM — so
 the cache is small and LRU-evicted; ``max_entries`` bounds it.  Hit/miss/
 eviction counters feed :class:`~tpu_parallel.serving.metrics.ServingMetrics`.
+
+Under the BLOCK-PAGED pool the store is a different economy: entries hold
+refcounted physical block-id tuples instead of copied rows (a hit is a
+table pointer write + refcount bump — O(1), zero K/V copies), and the
+``on_evict`` callback lets the engine return an evicted entry's block
+references to the :class:`~tpu_parallel.serving.cache_pool.BlockAllocator`.
+The LRU/lookup machinery is identical either way — the cache never
+inspects its values.
 """
 
 from __future__ import annotations
@@ -37,7 +45,7 @@ class PrefixCache:
     stored as extracted — no rewrite on the store path).
     """
 
-    def __init__(self, max_entries: int = 8):
+    def __init__(self, max_entries: int = 8, on_evict=None):
         if max_entries < 1:
             raise ValueError(f"max_entries={max_entries} < 1")
         self.max_entries = max_entries
@@ -45,6 +53,9 @@ class PrefixCache:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        # called with each LRU-evicted (row_tree, length) entry — the
+        # paged pool's refcount-release hook (None = entries just drop)
+        self.on_evict = on_evict
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -96,7 +107,37 @@ class PrefixCache:
                 continue
             self._entries[key] = (row_tree, b)
             stored.append(b)
-        while len(self._entries) > self.max_entries:
-            self._entries.popitem(last=False)
-            self.evictions += 1
+        self._evict_overflow()
         return stored
+
+    def store_one(self, prefix, length: int, row_tree) -> bool:
+        """Store ONE entry under the exact ``prefix`` key (first writer
+        wins; a refused store returns False so the caller can release
+        whatever references ``row_tree`` carries).  The paged pool's store
+        path — each bucket-aligned key holds its OWN refcounted block
+        tuple, so eviction accounting stays per-key."""
+        key = tuple(int(t) for t in prefix)
+        if key in self._entries:
+            self._entries.move_to_end(key)
+            return False
+        self._entries[key] = (row_tree, int(length))
+        self._evict_overflow()
+        return True
+
+    def pop_lru(self) -> bool:
+        """Evict the least-recently-used entry NOW; False when empty.
+        The paged engine's block-pressure valve: stored entries hold
+        refcounted blocks indefinitely, so when the admission gate cannot
+        seat the queue head it trades cold cached prefixes for capacity
+        instead of starving the head forever."""
+        if not self._entries:
+            return False
+        _, entry = self._entries.popitem(last=False)
+        self.evictions += 1
+        if self.on_evict is not None:
+            self.on_evict(entry)
+        return True
+
+    def _evict_overflow(self) -> None:
+        while len(self._entries) > self.max_entries:
+            self.pop_lru()

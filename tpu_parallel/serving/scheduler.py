@@ -218,12 +218,18 @@ class FIFOScheduler:
         n_free: int,
         now: Optional[float] = None,
         bucket_key: Optional[Callable[[RequestOutput], object]] = None,
+        can_admit: Optional[Callable[[RequestOutput], bool]] = None,
     ) -> List[RequestOutput]:
         """Pop up to ``min(n_free, max_prefills_per_tick)`` admissions.
 
         ``now`` feeds the telemetry (queue-age gauge, admitted queue
         waits); FIFO ordering itself ignores it — priority policies
-        would not.  ``bucket_key`` (the engine's bucketed-prefill
+        would not.  ``can_admit`` (the paged engine's estimated-blocks
+        gate) vetoes individual admissions beyond the free-slot count:
+        a vetoed HEAD blocks the whole tick (head-of-line, FIFO-fair —
+        blocks free up as running requests retire), a vetoed non-head
+        candidate is kept in place while later same-bucket entries may
+        still admit.  ``bucket_key`` (the engine's bucketed-prefill
         grouping) constrains
         the tick's admissions to ONE batchable group: the FIFO head always
         admits, and the rest of the budget fills with later queued entries
@@ -242,15 +248,24 @@ class FIFOScheduler:
         if bucket_key is None:
             admitted = []
             while n > 0 and self._queue:
+                if can_admit is not None and not can_admit(self._queue[0]):
+                    break  # head-of-line: wait for blocks to free up
                 admitted.append(self._queue.popleft())
                 n -= 1
             self._observe(now, admitted)
             return admitted
+        if can_admit is not None and not can_admit(self._queue[0]):
+            self._observe(now, [])
+            return []
         head = self._queue.popleft()
         admitted, key = [head], bucket_key(head)
         kept = deque()
         for out in self._queue:
-            if len(admitted) < n and bucket_key(out) == key:
+            if (
+                len(admitted) < n
+                and bucket_key(out) == key
+                and (can_admit is None or can_admit(out))
+            ):
                 admitted.append(out)
             else:
                 kept.append(out)
