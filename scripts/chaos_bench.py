@@ -78,12 +78,16 @@ def baseline_tokens(model, params, prompts, new_tokens, n_slots):
     return [list(o.tokens) for o in outs]
 
 
-def build_fault_plans(seed, n_replicas, horizon):
+def build_fault_plans(seed, n_replicas, horizon, swap=False):
     """One seeded :class:`FaultPlan` per replica.  The required kinds
     (crash / stall / flap) spread round-robin across the fleet so every
     storm exercises all three shapes even at 2 replicas; extra reject
-    windows land by coin flip.  Child rngs derive from the master seed,
-    so plans are a pure function of (seed, n_replicas, horizon)."""
+    windows land by coin flip.  ``swap`` adds the ``swap@T`` OPERATOR
+    event to one seeded replica's plan — the harness (not the plan)
+    triggers a fleet-wide rolling weight swap when the cluster reaches
+    that tick, so the rollout collides with the storm.  Child rngs
+    derive from the master seed, so plans are a pure function of
+    (seed, n_replicas, horizon, swap)."""
     from tpu_parallel.cluster import FaultPlan
 
     master = random.Random(seed)
@@ -93,6 +97,8 @@ def build_fault_plans(seed, n_replicas, horizon):
     for i in range(n_replicas):
         if master.random() < 0.3:
             kinds[i].add("reject")
+    if swap:
+        kinds[master.randrange(n_replicas)].add("swap")
     plans = []
     for i in range(n_replicas):
         child = random.Random(master.randrange(2 ** 31))
@@ -113,9 +119,17 @@ def run_soak(model, params, cfg, prompts, refs, *, seed, n_replicas,
              n_slots, new_tokens, router="least", horizon=64, dt=0.05,
              max_ticks=4000, watchdog_ticks=3, watchdog_kill_ticks=8,
              max_restarts=3, backoff_seconds=0.4, probation_ticks=4,
-             probation_requests=2, retry_limit=16):
+             probation_requests=2, retry_limit=16, swap=False):
     """Drive one seeded storm to completion.  Returns ``(record,
-    violations)`` — an empty violations list is a passing soak."""
+    violations)`` — an empty violations list is a passing soak.
+
+    ``swap=True`` arms the ``swap@T`` operator event: at the seeded
+    tick the harness begins a NULL-VALUE rolling weight swap (same
+    numbers under a new version id, so the bitwise invariant stays
+    meaningful) that must resolve — completed with every live replica
+    on the new version, or rolled back with every live replica on the
+    old one — without wedging, while replicas crash, stall and flap
+    around (and under) it."""
     from tpu_parallel.cluster import (
         BACKOFF,
         DEAD,
@@ -124,6 +138,7 @@ def run_soak(model, params, cfg, prompts, refs, *, seed, n_replicas,
         FrontendConfig,
         ReplicaHandle,
         RestartPolicy,
+        SwapPolicy,
     )
     from tpu_parallel.serving import Request, SchedulerConfig, ServingEngine
 
@@ -141,7 +156,11 @@ def run_soak(model, params, cfg, prompts, refs, *, seed, n_replicas,
             clock=clock, decode_steps_per_tick=1,
         )
 
-    plans = build_fault_plans(seed, n_replicas, horizon)
+    plans = build_fault_plans(seed, n_replicas, horizon, swap=swap)
+    swap_tick = min(
+        (p.swap_at_tick for p in plans if p.swap_at_tick is not None),
+        default=None,
+    )
     handles = [
         ReplicaHandle(i, factory(), fault_plan=plans[i],
                       engine_factory=factory)
@@ -182,11 +201,26 @@ def run_soak(model, params, cfg, prompts, refs, *, seed, n_replicas,
     tick = 0
     submitted = 0
 
+    swap_begin_state = None
+
     def tick_once():
         """Advance the fake clock one dt, step the cluster, fold this
         tick's death/served-after-restart observations into the tallies
-        the healing invariants are judged on."""
-        nonlocal tick
+        the healing invariants are judged on.  The seeded swap@T event
+        fires here too — an OPERATOR action colliding with the storm."""
+        nonlocal tick, swap_begin_state
+        if (
+            swap_tick is not None
+            and swap_begin_state is None
+            and tick >= swap_tick
+        ):
+            swap_begin_state = fe.begin_swap(
+                params=params, version="storm-v2",
+                policy=SwapPolicy(
+                    drain_ticks=12, canary_ticks=3,
+                    canary_seconds=2 * dt, canary_requests=1,
+                ),
+            )["state"]
         t[0] += dt
         fe.step()
         for h in handles:
@@ -229,10 +263,16 @@ def run_soak(model, params, cfg, prompts, refs, *, seed, n_replicas,
 
     # drive to quiescence: the storm may kill a replica on the very last
     # serving tick; the fleet must be allowed to converge (pending
-    # restarts fire, probation resolves, flap budgets burn out) before
-    # the healing invariant is judged
-    while tick < max_ticks and any(
-        h.health in (BACKOFF, PROBATION) for h in handles
+    # restarts fire, probation resolves, flap budgets burn out, a
+    # mid-storm rollout completes or rolls back) before the healing and
+    # swap invariants are judged
+    while tick < max_ticks and (
+        any(h.health in (BACKOFF, PROBATION) for h in handles)
+        or fe.swap_status()["state"] in ("rolling", "rolling_back")
+        # a storm that resolves before the seeded swap@T tick still
+        # ticks on until the operator event FIRES (an idle-fleet swap
+        # is legal; silently skipping it would misreport a refusal)
+        or (swap_tick is not None and swap_begin_state is None)
     ):
         tick_once()
 
@@ -296,6 +336,36 @@ def run_soak(model, params, cfg, prompts, refs, *, seed, n_replicas,
         )
     if s["restarts"] >= 1 and s["probation_promotions"] < 1:
         violations.append("no restarted replica ever passed probation")
+    swap_status = fe.swap_status()
+    if swap_tick is not None:
+        # the mid-storm rollout must RESOLVE (crashes defer or skip
+        # targets, never wedge it) and leave zero version mix among the
+        # live fleet
+        if swap_begin_state != "rolling":
+            violations.append(
+                f"swap@{swap_tick} refused: {swap_begin_state}"
+            )
+        if swap_status["state"] == "completed":
+            want = "storm-v2"
+        elif swap_status["state"] == "rolled_back":
+            want = "initial"
+        else:
+            want = None
+            violations.append(
+                f"swap never resolved: {swap_status['state']}"
+            )
+        if want is not None:
+            mixed = {
+                h.replica_id: h.weights_version
+                for h in handles
+                if h.health not in (DEAD, BACKOFF)
+                and h.weights_version != want
+            }
+            if mixed:
+                violations.append(
+                    f"live replicas off the {want} version after "
+                    f"{swap_status['state']}: {mixed}"
+                )
 
     record = {
         "bench": "chaos_soak",
@@ -321,6 +391,11 @@ def run_soak(model, params, cfg, prompts, refs, *, seed, n_replicas,
             "probation_ticks": probation_ticks,
             "probation_requests": probation_requests,
         },
+        "swap": swap,
+        "swap_at_tick": swap_tick,
+        "swap_state": swap_status["state"],
+        "swap_verdict": swap_status.get("verdict"),
+        "swap_rollbacks": s["swap_rollbacks"],
         "finished": s["finished"],
         "retries": s["retries"],
         "replica_deaths": s["replica_deaths"],
@@ -356,6 +431,10 @@ def main():
     ap.add_argument("--horizon", type=int, default=64,
                     help="fault-schedule tick horizon")
     ap.add_argument("--max-ticks", type=int, default=4000)
+    ap.add_argument("--swap", action="store_true",
+                    help="arm the seeded swap@T operator event: a "
+                         "null-value rolling weight swap collides with "
+                         "the storm and must resolve without wedging")
     ap.add_argument("--record", type=str, default="",
                     help="write the soak record to this JSON file")
     args = ap.parse_args()
@@ -383,7 +462,7 @@ def main():
         model, params, cfg, prompts, refs, seed=args.seed,
         n_replicas=args.replicas, n_slots=args.slots,
         new_tokens=new_tokens, router=args.router, horizon=args.horizon,
-        max_ticks=args.max_ticks,
+        max_ticks=args.max_ticks, swap=args.swap,
     )
     print(json.dumps(record, indent=2))
     if args.record:

@@ -1,0 +1,101 @@
+"""One runner for every AST contract gate.
+
+The repo grew four static checkers, one per PR, each wired into tier-1
+through its own copy of the same plumbing (import-from-scripts, run
+``check_paths``, assert empty, self-test the catch path):
+
+- ``check_clock``  — serving/cluster code never reads wall time directly
+  (the injectable-clock contract).
+- ``check_scopes`` — every collective in parallel/ + ops/ sits inside a
+  ``jax.named_scope`` (labelable accelerator traces).
+- ``check_host_sync`` — no per-slot device sync inside a host loop under
+  serving/ (the dispatch tax the fused tick exists to kill).
+- ``check_blocks`` — block-table mutation stays inside ``cache_pool.py``
+  (the single table-mutation authority).
+
+This module is the registry: each checker contributes its module name
+(they all expose ``check_paths(paths=DEFAULT_PATHS) -> [problems]`` and
+a ``main(argv)``), and both CI surfaces — ``python scripts/check_all.py``
+and the single tier-1 test ``tests/test_checkers.py::test_all_ast_gates``
+— iterate it.  Adding the next checker is ONE line here plus its module,
+not a fifth copy of the wiring.
+
+Usage: ``python scripts/check_all.py [names...]`` — runs every gate (or
+just the named ones) over its own default paths, prints each problem,
+exits nonzero on any.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from typing import Dict, List, Sequence
+
+# the registry: module name -> one-line contract (the order is the
+# historical order the gates landed in; output follows it)
+CHECKERS: Dict[str, str] = {
+    "check_clock": "serving/cluster time flows through the injectable clock",
+    "check_scopes": "collectives sit inside jax.named_scope",
+    "check_host_sync": "no per-slot device sync in serving host loops",
+    "check_blocks": "block-table mutation stays inside cache_pool.py",
+}
+
+SCRIPTS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def load_checker(name: str):
+    """Import one checker module from the scripts directory by path (no
+    sys.path mutation — safe from tests and other tools)."""
+    if name not in CHECKERS:
+        raise ValueError(
+            f"unknown checker {name!r} (registered: {sorted(CHECKERS)})"
+        )
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(SCRIPTS_DIR, f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def run_all(names: Sequence[str] = ()) -> Dict[str, List[str]]:
+    """Run every registered gate (or just ``names``) over its own
+    DEFAULT_PATHS, from the repo root.  Returns name -> problem list;
+    an all-empty dict of lists is a passing tree."""
+    repo_root = os.path.dirname(SCRIPTS_DIR)
+    cwd = os.getcwd()
+    os.chdir(repo_root)  # every checker's DEFAULT_PATHS are repo-relative
+    try:
+        results: Dict[str, List[str]] = {}
+        for name in names or CHECKERS:
+            results[name] = load_checker(name).check_paths()
+        return results
+    finally:
+        os.chdir(cwd)
+
+
+def main(argv: List[str]) -> int:
+    results = run_all(argv[1:])
+    failed = 0
+    for name, problems in results.items():
+        for problem in problems:
+            print(problem, file=sys.stderr)
+        if problems:
+            failed += 1
+            print(
+                f"{name}: {len(problems)} violation(s) — "
+                f"{CHECKERS[name]}",
+                file=sys.stderr,
+            )
+        else:
+            print(f"{name}: OK")
+    if failed:
+        print(f"check_all: {failed} gate(s) failed", file=sys.stderr)
+        return 1
+    print(f"check_all: {len(results)} gates OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

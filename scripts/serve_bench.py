@@ -15,7 +15,24 @@ Usage:
       [--chunk C] [--prefix-cache N] [--spec K] [--compare] [--smoke]
       [--replicas N] [--router rr|least|prefix[,...]] [--fault]
       [--prefix-groups G] [--trace-out FILE] [--metrics-out FILE]
+      [--trace-record FILE] [--trace-replay FILE --time-compress X]
+      [--swap-bench --swap-at T --swap-record FILE]
       [--seed K] [--out FILE]
+
+Workload record/replay: ``--trace-record PATH`` dumps the generated
+request schedule (arrival, prompt, prefix group, priority, deadline)
+as JSONL; ``--trace-replay PATH`` re-feeds a recorded schedule through
+the same runners — single-engine or cluster — with ``--time-compress
+X`` dividing every arrival gap (a day-in-the-life at 10-100x).
+
+``--swap-bench`` is the rolling weight hot-swap acceptance bench
+(docs/12): three deterministic fake-clock legs over one schedule —
+baseline, a real rolling swap at tick ``--swap-at`` (zero failed
+requests, in-flight-at-swap streams bitwise identical to baseline,
+fleet ends 100% on the new version), and an injected regression whose
+stalled canary must trigger automatic rollback (fleet ends 100% on the
+OLD version).  Exits nonzero on any invariant violation;
+``--swap-record`` writes the ``SERVE_r05.json``-style record.
 
 ``--replicas N`` (N > 1) switches to CLUSTER mode: N engine replicas
 behind the ``tpu_parallel.cluster`` Frontend, one record per (rate,
@@ -86,46 +103,127 @@ def make_prompts(cfg, *, n_requests, prompt_min, prompt_max, prefix_len,
     """Random prompts; with ``prefix_len`` > 0 every prompt opens with one
     of ``prefix_groups`` random system-headers (assigned randomly, so
     routing policy — not submission order — decides placement) and
-    [prompt_min, prompt_max] sizes the SUFFIX."""
+    [prompt_min, prompt_max] sizes the SUFFIX.  Returns ``(prompts,
+    group_indices)`` — the group index feeds the trace recorder's
+    ``prefix_group`` field (0 when prefixes are off)."""
     rnd = random.Random(seed)
     headers = [
         [rnd.randrange(1, cfg.vocab_size) for _ in range(prefix_len)]
         for _ in range(max(1, prefix_groups))
     ]
-    prompts = []
+    prompts, groups = [], []
     for _ in range(n_requests):
         n = rnd.randint(prompt_min, prompt_max)
         # single-group draws NO group index, preserving the exact RNG
         # stream (and therefore the workload) of pre-cluster SERVE_r01/
         # r02 records at the same --seed
-        header = (
-            headers[0]
-            if len(headers) == 1
-            else headers[rnd.randrange(len(headers))]
-        )
+        g = 0 if len(headers) == 1 else rnd.randrange(len(headers))
         prompts.append(
-            header + [rnd.randrange(1, cfg.vocab_size) for _ in range(n)]
+            headers[g]
+            + [rnd.randrange(1, cfg.vocab_size) for _ in range(n)]
         )
-    return prompts
+        groups.append(g)
+    return prompts, groups
+
+
+def build_schedule(prompts, groups, rate, seed, new_tokens):
+    """The bench's request schedule as data: one dict per request with
+    arrival (seconds from t0, same Poisson draw the runners always
+    made), the prompt itself, and the workload-shape fields the cluster
+    frontend consumes (priority, deadline).  This is the unit
+    ``--trace-record`` dumps and ``--trace-replay`` re-feeds."""
+    rnd = random.Random(seed)
+    arrivals, t = [], 0.0
+    for _ in prompts:
+        arrivals.append(t)
+        if rate > 0:
+            t += rnd.expovariate(rate)
+    return [
+        {
+            "arrival": round(a, 6),
+            "prompt": list(p),
+            "prompt_len": len(p),
+            "prefix_group": g,
+            "priority": 0,
+            "deadline": None,
+            "max_new_tokens": new_tokens,
+        }
+        for a, p, g in zip(arrivals, prompts, groups)
+    ]
+
+
+def write_trace(path, schedule, meta=None):
+    """Dump a schedule as JSONL: a ``trace_meta`` header line then one
+    request per line — the workload-replay harness's exchange format."""
+    import json
+
+    with open(path, "w") as fh:
+        fh.write(json.dumps({"record": "trace_meta", **(meta or {})}))
+        fh.write("\n")
+        for entry in schedule:
+            fh.write(json.dumps(entry))
+            fh.write("\n")
+    return path
+
+
+def load_trace(path, time_compress=1.0):
+    """Load a recorded schedule; ``time_compress`` divides every arrival
+    (10 = a day-in-the-life replayed in 1/10th the time — same order,
+    same prompts, compressed gaps)."""
+    import json
+
+    if time_compress <= 0:
+        raise SystemExit(f"--time-compress {time_compress} must be > 0")
+    schedule = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            if rec.get("record") == "trace_meta":
+                continue
+            rec["arrival"] = float(rec["arrival"]) / time_compress
+            schedule.append(rec)
+    if not schedule:
+        raise SystemExit(f"trace {path} holds no requests")
+    return sorted(schedule, key=lambda r: r["arrival"])
+
+
+def _schedule_request(entry, on_token=None):
+    from tpu_parallel.serving import Request
+
+    return Request(
+        prompt=list(entry["prompt"]),
+        max_new_tokens=int(entry["max_new_tokens"]),
+        priority=int(entry.get("priority") or 0),
+        deadline=entry.get("deadline"),
+        on_token=on_token,
+    )
 
 
 def run_point(model, params, cfg, prompts, *, rate, n_slots, new_tokens,
-              seed, engine_kwargs, label, tracer=None):
+              seed, engine_kwargs, label, tracer=None, schedule=None):
     from tpu_parallel.serving import (
         Request,
         SchedulerConfig,
         ServingEngine,
     )
 
-    rnd = random.Random(seed)
-    n_requests = len(prompts)
     # Poisson process: exponential inter-arrival gaps at `rate` req/s
-    # (rate <= 0 or huge => everything arrives at t=0)
-    arrivals, t = [], 0.0
-    for _ in range(n_requests):
-        arrivals.append(t)
-        if rate > 0:
-            t += rnd.expovariate(rate)
+    # (rate <= 0 or huge => everything arrives at t=0); a replayed trace
+    # supplies the whole schedule instead
+    if schedule is None:
+        schedule = build_schedule(
+            prompts, [0] * len(prompts), rate, seed, new_tokens
+        )
+    prompts = [e["prompt"] for e in schedule]
+    arrivals = [e["arrival"] for e in schedule]
+    n_requests = len(schedule)
+    # a replayed trace's budgets win over the CLI default — the record
+    # and the throughput denominator must describe what actually ran
+    new_tokens = max(int(e["max_new_tokens"]) for e in schedule)
+    total_new = sum(int(e["max_new_tokens"]) for e in schedule)
 
     eng = ServingEngine(
         model, params, n_slots=n_slots,
@@ -156,14 +254,9 @@ def run_point(model, params, cfg, prompts, *, rate, n_slots, new_tokens,
     while submitted < n_requests or eng.has_work():
         now = time.perf_counter() - t0
         while submitted < n_requests and arrivals[submitted] <= now:
-            outs.append(
-                eng.add_request(
-                    Request(
-                        prompt=prompts[submitted],
-                        max_new_tokens=new_tokens,
-                    )
-                )
-            )
+            outs.append(eng.add_request(_schedule_request(
+                schedule[submitted]
+            )))
             submitted += 1
         if eng.has_work():
             eng.step()
@@ -213,9 +306,7 @@ def run_point(model, params, cfg, prompts, *, rate, n_slots, new_tokens,
         # bounded by the bucket set)
         "prefill_compiles": eng.prefill_compiles,
         "wall_s": round(wall, 3),
-        "request_tokens_per_sec": round(
-            n_requests * new_tokens / wall, 1
-        ),
+        "request_tokens_per_sec": round(total_new / wall, 1),
         **summary,
     }
 
@@ -268,7 +359,7 @@ def parse_fault_spec(spec: str):
 def run_cluster_point(model, params, cfg, prompts, *, rate, n_replicas,
                       router, n_slots, new_tokens, seed, engine_kwargs,
                       fault_plans=None, chaos_seed=None, warm=True,
-                      tracer=None):
+                      tracer=None, schedule=None):
     """One cluster-mode measurement: ``n_replicas`` engines behind the
     Frontend under the given router policy, same Poisson arrival stream
     as :func:`run_point`.  ``fault_plans`` (replica id -> FaultPlan, see
@@ -300,18 +391,21 @@ def run_cluster_point(model, params, cfg, prompts, *, rate, n_replicas,
     def make_engines():
         return [make_engine(i) for i in range(n_replicas)]
 
+    if schedule is None:
+        schedule = build_schedule(
+            prompts, [0] * len(prompts), rate, seed, new_tokens
+        )
+    prompts = [e["prompt"] for e in schedule]
+    arrivals = [e["arrival"] for e in schedule]
+    # a replayed trace's budgets win over the CLI default (see run_point)
+    new_tokens = max(int(e["max_new_tokens"]) for e in schedule)
+    total_new = sum(int(e["max_new_tokens"]) for e in schedule)
+
     if warm:
         fe = Frontend(make_engines(), router=router)
         for p in prompts:
             fe.submit(Request(prompt=p, max_new_tokens=2))
         fe.run()
-
-    rnd = random.Random(seed)
-    arrivals, t = [], 0.0
-    for _ in range(len(prompts)):
-        arrivals.append(t)
-        if rate > 0:
-            t += rnd.expovariate(rate)
 
     if chaos_seed is not None:
         crnd = random.Random(chaos_seed)
@@ -353,14 +447,7 @@ def run_cluster_point(model, params, cfg, prompts, *, rate, n_replicas,
     while submitted < n_requests or fe.has_work():
         now = time.perf_counter() - t0
         while submitted < n_requests and arrivals[submitted] <= now:
-            outs.append(
-                fe.submit(
-                    Request(
-                        prompt=prompts[submitted],
-                        max_new_tokens=new_tokens,
-                    )
-                )
-            )
+            outs.append(fe.submit(_schedule_request(schedule[submitted])))
             submitted += 1
         if fe.has_work():
             fe.step()
@@ -400,9 +487,7 @@ def run_cluster_point(model, params, cfg, prompts, *, rate, n_replicas,
         "draft_tokens": engine_kwargs.get("draft_tokens", 0),
         "wall_s": round(wall, 3),
         "tokens_out": tokens_out,
-        "request_tokens_per_sec": round(
-            n_requests * new_tokens / wall, 1
-        ),
+        "request_tokens_per_sec": round(total_new / wall, 1),
         "finished": s["finished"],
         "retries": s["retries"],
         "requeued": s["requeued"],
@@ -416,6 +501,269 @@ def run_cluster_point(model, params, cfg, prompts, *, rate, n_replicas,
         "ttft_ms_p95": s["ttft_ms_p95"],
         "e2e_ms_p95": s["e2e_ms_p95"],
     }
+
+
+def run_swap_bench(model, params, cfg, schedule, *, n_replicas, n_slots,
+                   router, seed, dt, swap_at_tick, logger):
+    """The rolling weight hot-swap acceptance bench (SERVE_r05): three
+    legs over ONE replayed schedule on a FAKE clock (dt per cluster
+    tick), so every trajectory is a pure function of (schedule, seed).
+
+    1. ``baseline`` — no swap; per-request greedy tokens recorded.
+    2. ``swap`` — a REAL new weight set (different init) rolls across
+       the fleet at tick ``swap_at_tick``.  Invariants: the rollout
+       completes, the fleet ends 100% on the new version, ZERO failed
+       requests, and every request that was mid-stream at the trigger
+       finishes bitwise identical to the baseline (it completes on the
+       old weights).
+    3. ``regression`` — a null-value weight set (same numbers, new
+       version id, so bitwise comparisons stay valid) whose canary is
+       stalled by a FaultPlan: the watchdog kills it, the SwapPolicy
+       rolls back automatically, and the fleet ends 100% on the OLD
+       version with — again — zero failed requests.
+
+    Returns ``(record, violations)``; an empty violations list is the
+    acceptance criterion.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_parallel.cluster import (
+        FaultPlan,
+        Frontend,
+        FrontendConfig,
+        ReplicaHandle,
+        RestartPolicy,
+        SwapPolicy,
+    )
+    from tpu_parallel.models.generate import generate
+    from tpu_parallel.serving import SchedulerConfig, ServingEngine
+
+    probe_len = max(e["prompt_len"] for e in schedule)
+    probe = jax.numpy.zeros((1, probe_len), jax.numpy.int32)
+    params_v2 = type(model)(model.config).init(
+        {"params": jax.random.PRNGKey(seed + 7)}, probe, train=False
+    )["params"]
+
+    t = [0.0]
+    clock = lambda: t[0]  # noqa: E731 — the bench's injectable time axis
+
+    def make_engine():
+        return ServingEngine(
+            model, params, n_slots=n_slots,
+            scheduler=SchedulerConfig(max_prefills_per_tick=2),
+            clock=clock, decode_steps_per_tick=1,
+        )
+
+    policy = SwapPolicy(
+        drain_ticks=60, canary_ticks=4, canary_seconds=2 * dt,
+        canary_requests=1,
+    )
+
+    def run_leg(swap_params=None, version=None, fault_plans=None,
+                max_ticks=8000):
+        t[0] = 0.0
+        fault_plans = fault_plans or {}
+        handles = [
+            ReplicaHandle(
+                i, make_engine(), fault_plan=fault_plans.get(i),
+                engine_factory=make_engine,
+            )
+            for i in range(n_replicas)
+        ]
+        fe = Frontend(
+            handles, router=router, clock=clock,
+            config=FrontendConfig(
+                retry_limit=16, watchdog_ticks=3, watchdog_kill_ticks=8,
+                restart=RestartPolicy(
+                    backoff_seconds=4 * dt, probation_ticks=3,
+                    probation_requests=2,
+                ),
+            ),
+        )
+        outs, submitted, ticks = [], 0, 0
+        midstream_at_swap = None
+        canary_tick = {}  # replica id -> fleet tick its FIRST canary began
+        while ticks < max_ticks:
+            now = ticks * dt
+            while (
+                submitted < len(schedule)
+                and schedule[submitted]["arrival"] <= now
+            ):
+                outs.append(
+                    fe.submit(_schedule_request(schedule[submitted]))
+                )
+                submitted += 1
+            if swap_params is not None and ticks == swap_at_tick:
+                midstream_at_swap = [
+                    i for i, o in enumerate(outs)
+                    if not o.done and o.tokens
+                ]
+                st = fe.begin_swap(
+                    params=swap_params, version=version, policy=policy
+                )
+                assert st["state"] == "rolling", st
+            t[0] += dt
+            fe.step()
+            ticks += 1
+            canary = fe.swap_status().get("canary")
+            if canary is not None:
+                canary_tick.setdefault(canary, ticks)
+            if (
+                submitted >= len(schedule)
+                and not fe.has_work()
+                and fe.swap_status()["state"]
+                not in ("rolling", "rolling_back")
+                # a leg that drains before swap_at_tick still ticks on
+                # until the swap fires and resolves (an idle-fleet swap
+                # is legal; a silently-skipped one would KeyError the
+                # record build below)
+                and (swap_params is None or ticks > swap_at_tick)
+            ):
+                break
+        return fe, outs, midstream_at_swap, ticks, canary_tick
+
+    violations = []
+
+    def check(cond, msg):
+        if not cond:
+            violations.append(msg)
+
+    # leg 1: baseline
+    fe0, outs0, _, ticks0, _ = run_leg()
+    check(
+        all(o.status == "finished" for o in outs0),
+        "baseline: not every request finished",
+    )
+    base_tokens = [list(o.tokens) for o in outs0]
+    # anchor the baseline itself against static generate (greedy truth)
+    for i in (0, len(schedule) - 1):
+        ref = np.asarray(generate(
+            model, params,
+            jnp.asarray(schedule[i]["prompt"], jnp.int32)[None, :],
+            max_new_tokens=schedule[i]["max_new_tokens"],
+        ))[0]
+        check(
+            base_tokens[i] == [int(x) for x in ref],
+            f"baseline request {i} diverged from static generate",
+        )
+
+    # leg 2: the real rolling swap under load
+    fe1, outs1, midstream, ticks1, canary_ticks = run_leg(
+        swap_params=params_v2, version="v2"
+    )
+    s1 = fe1.swap_status()
+    check(s1["state"] == "completed", f"swap leg did not complete: {s1}")
+    check(
+        all(v == "v2" for v in s1["replica_versions"].values()),
+        f"fleet not 100% on v2 after swap: {s1['replica_versions']}",
+    )
+    check(
+        all(o.status == "finished" for o in outs1),
+        "swap leg: failed/lost requests: "
+        + str([
+            (i, o.status, o.finish_reason)
+            for i, o in enumerate(outs1) if o.status != "finished"
+        ]),
+    )
+    check(bool(midstream), "choreography: nothing was mid-stream at swap")
+    for i in midstream or []:
+        check(
+            list(outs1[i].tokens) == base_tokens[i],
+            f"in-flight-at-swap request {i} diverged from the no-swap "
+            "baseline",
+        )
+
+    # leg 3: injected regression -> automatic rollback.  Null-value
+    # weights keep every comparison bitwise; the stalled CANARY is the
+    # regression (the watchdog observes it, the policy rolls back).
+    # Tick flow is weight-independent (no EOS in the random workload),
+    # so leg 2's observed canary-entry tick for the first target IS leg
+    # 3's — the stall is aimed exactly at the audition window.
+    first_target = fe1.replicas[0].replica_id
+    check(
+        first_target in canary_ticks,
+        "choreography: the first target never reached canary in leg 2",
+    )
+    null_v2 = jax.tree_util.tree_map(lambda x: x, params)
+    fe2, outs2, _, ticks2, _ = run_leg(
+        swap_params=null_v2, version="v2-regression",
+        fault_plans={first_target: FaultPlan(
+            stall_at_tick=canary_ticks.get(first_target, swap_at_tick) + 1,
+            stall_ticks=400,
+        )},
+    )
+    s2 = fe2.swap_status()
+    check(
+        s2["state"] == "rolled_back",
+        f"regression leg did not roll back: {s2}",
+    )
+    check(
+        s2["verdict"] in ("canary_death", "slo_ttft", "slo_e2e"),
+        f"untyped rollback verdict: {s2['verdict']}",
+    )
+    live = [h for h in fe2.replicas if h.health not in ("dead", "backoff")]
+    check(
+        bool(live) and all(h.weights_version == "initial" for h in live),
+        "fleet not 100% on the old version after rollback: "
+        + str({h.replica_id: h.weights_version for h in fe2.replicas}),
+    )
+    check(
+        all(o.status == "finished" for o in outs2),
+        "regression leg: failed/lost requests",
+    )
+    check(
+        [list(o.tokens) for o in outs2] == base_tokens,
+        "regression leg diverged from baseline (null-value swap must be "
+        "bitwise invisible)",
+    )
+
+    record = {
+        "bench": "serve_swap",
+        "model": getattr(cfg, "_name", None) or (
+            "gpt2_125m" if jax.default_backend() == "tpu" else "tiny"
+        ),
+        "backend": jax.default_backend(),
+        "seed": seed,
+        "replicas": n_replicas,
+        "router": router,
+        "n_requests": len(schedule),
+        "n_slots": n_slots,
+        "dt": dt,
+        "swap_at_tick": swap_at_tick,
+        "swap_policy": {
+            "drain_ticks": policy.drain_ticks,
+            "canary_ticks": policy.canary_ticks,
+            "canary_seconds": policy.canary_seconds,
+            "canary_requests": policy.canary_requests,
+        },
+        "baseline_ticks": ticks0,
+        "swap_ticks": ticks1,
+        "regression_ticks": ticks2,
+        "midstream_at_swap": len(midstream or []),
+        "swap_state": s1["state"],
+        "swap_relocations": int(fe1.registry.counter(
+            "cluster_swap_relocations_total"
+        ).value),
+        "swap_canary_finished": s1.get("canary_finished", 0),
+        "rollback_state": s2["state"],
+        "rollback_verdict": s2["verdict"],
+        "rollback_deaths": fe2.summary()["replica_deaths"],
+        "zero_failed_requests": all(
+            o.status == "finished" for o in outs1 + outs2
+        ),
+        "inflight_bitwise_exact": all(
+            list(outs1[i].tokens) == base_tokens[i]
+            for i in (midstream or [])
+        ),
+        "regression_bitwise_exact": (
+            [list(o.tokens) for o in outs2] == base_tokens
+        ),
+        "invariants_ok": not violations,
+        "violations": violations,
+    }
+    logger.log_record(record)
+    return record, violations
 
 
 def run_capacity_probe(model, params, cfg, *, seed, logger):
@@ -697,6 +1045,28 @@ def main():
                          "schedule from FaultPlan.from_seed(SEED) with "
                          "self-healing armed; the record carries the "
                          "fault-storm counters")
+    ap.add_argument("--trace-record", type=str, default="",
+                    help="dump the generated request schedule (arrival, "
+                         "prompt, prefix-group, priority, deadline) as "
+                         "JSONL — the workload-replay exchange format")
+    ap.add_argument("--trace-replay", type=str, default="",
+                    help="re-feed a recorded schedule instead of "
+                         "generating one (overrides --requests/--rate "
+                         "workload shape)")
+    ap.add_argument("--time-compress", type=float, default=1.0,
+                    help="divide every replayed arrival time by this "
+                         "factor (10 = day-in-the-life at 10x speed)")
+    ap.add_argument("--swap-bench", action="store_true",
+                    help="deterministic rolling weight hot-swap bench "
+                         "on a fake clock: baseline / swap / "
+                         "injected-regression legs over one schedule; "
+                         "nonzero exit on any invariant violation")
+    ap.add_argument("--swap-at", type=int, default=12,
+                    help="swap-bench: cluster tick the rollout starts at")
+    ap.add_argument("--swap-dt", type=float, default=0.05,
+                    help="swap-bench: fake-clock seconds per tick")
+    ap.add_argument("--swap-record", type=str, default="",
+                    help="swap-bench: write the record to this JSON file")
     ap.add_argument("--prefix-groups", type=int, default=4,
                     help="distinct shared system-headers in the "
                          "--prompt-dist workload (cluster mode: the "
@@ -748,11 +1118,71 @@ def main():
     params = model.init(
         {"params": jax.random.PRNGKey(1)}, probe, train=False
     )["params"]
-    prompts = make_prompts(
+    prompts, groups = make_prompts(
         cfg, n_requests=args.requests, prompt_min=prompt_min,
         prompt_max=prompt_max, prefix_len=prefix_len, seed=args.seed,
         prefix_groups=(args.prefix_groups if args.prompt_dist else 1),
     )
+    rates = [float(r) for r in args.rate.split(",")]
+
+    # workload-replay harness: --trace-record dumps the first rate
+    # point's schedule; --trace-replay swaps the generated workload for
+    # a recorded one (time-compressed), feeding the SAME runners
+    replay = None
+    if args.trace_replay:
+        replay = load_trace(args.trace_replay, args.time_compress)
+        rates = rates[:1]  # the trace IS the arrival process
+    if args.trace_record:
+        recorded = write_trace(
+            args.trace_record,
+            build_schedule(prompts, groups, rates[0], args.seed,
+                           new_tokens),
+            meta=dict(
+                seed=args.seed, rate=rates[0],
+                n_requests=args.requests, new_tokens=new_tokens,
+                prefix_groups=(
+                    args.prefix_groups if args.prompt_dist else 1
+                ),
+            ),
+        )
+        print(f"trace recorded: {recorded}")
+
+    if args.swap_bench:
+        import json
+
+        from tpu_parallel.utils.logging_utils import MetricLogger
+
+        schedule = replay if replay is not None else build_schedule(
+            prompts, groups, rates[0], args.seed, new_tokens
+        )
+        logger = MetricLogger(logdir=".", name=args.out)
+        record, violations = run_swap_bench(
+            model, params, cfg, schedule,
+            n_replicas=max(2, args.replicas), n_slots=args.slots,
+            router=args.router.split(",")[0], seed=args.seed,
+            dt=args.swap_dt, swap_at_tick=args.swap_at, logger=logger,
+        )
+        record["workload"] = (
+            {"trace_replay": args.trace_replay,
+             "time_compress": args.time_compress}
+            if replay is not None
+            else "generated"
+        )
+        logger.close()
+        print(json.dumps(record, indent=2))
+        if args.swap_record:
+            with open(args.swap_record, "w") as fh:
+                json.dump(record, fh, indent=2)
+                fh.write("\n")
+            print(f"record: {args.swap_record}")
+        if violations:
+            print(
+                f"swap_bench: {len(violations)} INVARIANT VIOLATION(S)",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        print("swap_bench: all invariants held")
+        return
 
     if args.smoke:
         failures = smoke(model, params, cfg, prompts[:6], new_tokens)
@@ -831,7 +1261,7 @@ def main():
         logger = MetricLogger(logdir=".", name=args.out)
         warm = True
         fe = None
-        for rate in (float(r) for r in args.rate.split(",")):
+        for rate in rates:
             for policy in args.router.split(","):
                 fe, record = run_cluster_point(
                     model, params, cfg, prompts,
@@ -839,10 +1269,13 @@ def main():
                     n_slots=args.slots, new_tokens=new_tokens,
                     seed=args.seed, engine_kwargs=dict(fast),
                     fault_plans=fault_plans, chaos_seed=args.chaos,
-                    warm=warm, tracer=tracer,
+                    warm=warm, tracer=tracer, schedule=replay,
                 )
                 if fault_spec:
                     record["fault_spec"] = fault_spec
+                if replay is not None:
+                    record["trace_replay"] = args.trace_replay
+                    record["time_compress"] = args.time_compress
                 warm = False  # jits shared per model: warm once
                 logger.log_record(record)
         logger.close()
@@ -874,14 +1307,17 @@ def main():
         run_capacity_probe(model, params, cfg, seed=args.seed,
                            logger=logger)
     eng = None
-    for rate in (float(r) for r in args.rate.split(",")):
+    for rate in rates:
         for label, engine_kwargs in configs:
             eng, record = run_point(
                 model, params, cfg, prompts,
                 rate=rate, n_slots=args.slots, new_tokens=new_tokens,
                 seed=args.seed, engine_kwargs=engine_kwargs, label=label,
-                tracer=tracer,
+                tracer=tracer, schedule=replay,
             )
+            if replay is not None:
+                record["trace_replay"] = args.trace_replay
+                record["time_compress"] = args.time_compress
             logger.log_record(record)
     logger.close()
 

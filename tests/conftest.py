@@ -69,6 +69,7 @@ _SLOW_FILES = {
     "test_spec_decode.py",
     "test_paged_kv.py",
     "test_cluster.py",
+    "test_swap.py",
 }
 _SLOW_TESTS = {
     "test_pp_aux_gradient_invariance",

@@ -1,0 +1,159 @@
+"""The repo's AST contract gates, behind ONE tier-1 entry point.
+
+``scripts/check_all.py`` registers every static checker (injectable
+clock, named-scope collectives, host-sync-free serving loops, fenced
+block-table mutation); ``test_all_ast_gates`` runs the whole registry
+over the live tree exactly as CI would — adding the next checker is one
+registry line plus its module, and it is gated here automatically.  The
+per-checker self-tests (the proof each gate still CATCHES violations and
+honors its exemptions) live alongside, consolidated from the four files
+that used to wire them individually.
+"""
+
+import os
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+try:
+    import check_all
+finally:
+    sys.path.pop(0)
+
+
+def _load(name):
+    return check_all.load_checker(name)
+
+
+# -- the single tier-1 entry point -----------------------------------------
+
+
+def test_all_ast_gates():
+    """Every registered gate passes over the live tree.  One assertion
+    per gate so a violation names its contract, not just a count."""
+    results = check_all.run_all()
+    assert set(results) == set(check_all.CHECKERS)
+    for name, problems in results.items():
+        assert problems == [], (
+            f"{name} ({check_all.CHECKERS[name]}):\n" + "\n".join(problems)
+        )
+
+
+def test_check_all_rejects_unknown_checker():
+    with pytest.raises(ValueError):
+        check_all.run_all(["no_such_gate"])
+
+
+# -- per-checker self-tests (the catch paths) ------------------------------
+
+
+def test_check_clock_semantics():
+    """The clock gate catches attribute calls, from-imports and sleep —
+    while a clock DEFAULT (dependency injection) stays legal."""
+    cc = _load("check_clock")
+    bad = (
+        "import time\n"
+        "from time import monotonic as mono\n"
+        "def f():\n"
+        "    a = time.time()\n"
+        "    b = mono()\n"
+        "    time.sleep(1)\n"
+        "def ok(clock=time.monotonic):\n"
+        "    return clock()\n"
+    )
+    found = cc.check_source(bad, "x.py")
+    assert len(found) == 3
+    assert any("time.time()" in p for p in found)
+    assert any("mono()" in p for p in found)
+    assert any("time.sleep()" in p for p in found)
+
+
+def test_check_scopes_semantics():
+    """The collective gate flags an unscoped psum and honors with-block
+    scopes, decorator scopes (nested-def scan bodies) and the axis-size
+    probe exemption."""
+    cs = _load("check_scopes")
+    flagged = cs.check_source(
+        "def f(x):\n    return lax.psum(x, 'data')\n", "f.py"
+    )
+    assert len(flagged) == 1 and "psum" in flagged[0]
+    for ok_src in (
+        "def f(x):\n"
+        "    with jax.named_scope('s'):\n"
+        "        return lax.psum(x, 'data')\n",
+        "@jax.named_scope('s')\n"
+        "def f(x):\n"
+        "    def body(c, _):\n"
+        "        return lax.ppermute(c, 'pipe', perm=[(0, 1)]), None\n"
+        "    return body(x, None)\n",
+        "def f():\n    return lax.psum(1, 'data')\n",
+    ):
+        assert cs.check_source(ok_src, "ok.py") == [], ok_src
+
+
+def test_check_host_sync_semantics():
+    """The host-sync gate flags loop-body and per-iteration comprehension
+    syncs, honors the ``# host-sync:`` whitelist (anywhere in a wrapped
+    call's span), leaves loop-free syncs legal, and fails loudly on a
+    typo'd path."""
+    chs = _load("check_host_sync")
+    bad = (
+        "import numpy as np\n"
+        "def f(slots, fetch):\n"
+        "    for s in slots:\n"
+        "        a = np.asarray(fetch(s))\n"
+        "        fetch(s).block_until_ready()\n"
+        "    while slots:\n"
+        "        b = np.asarray(slots.pop())  # host-sync: tick-boundary\n"
+        "    c = np.asarray(fetch(0))\n"
+        "def g(xs, fetch):\n"
+        "    return [np.asarray(fetch(x)) for x in xs]\n"
+        "def h(dev_batch):\n"
+        "    return [int(t) for t in np.asarray(dev_batch)]\n"
+    )
+    found = chs.check_source(bad, "x.py")
+    assert len(found) == 3, found
+    assert any("np.asarray" in p and ":4:" in p for p in found)
+    assert any("block_until_ready" in p for p in found)
+    assert any(":10:" in p for p in found)
+    wrapped = (
+        "import numpy as np\n"
+        "def f(slots, fetch):\n"
+        "    while slots:\n"
+        "        b = np.asarray(\n"
+        "            fetch(slots.pop())\n"
+        "        )  # host-sync: tick-boundary\n"
+    )
+    assert chs.check_source(wrapped, "x.py") == []
+    with pytest.raises(FileNotFoundError):
+        chs.check_paths((os.path.join(REPO_ROOT, "no_such_dir"),))
+
+
+def test_check_blocks_semantics():
+    """The block-table gate catches subscript stores, augmented stores
+    and deletes; reads, copies and local rebinds stay legal, and the
+    allocator's own module is the one legal mutation site."""
+    cb = _load("check_blocks")
+    bad = (
+        "def f(pool, t):\n"
+        "    pool.block_table[0, 1] = 3\n"
+        "    pool.block_table[0] += 1\n"
+        "    self._block_table[s][j] = 9\n"
+        "    del pool.block_table[0]\n"
+    )
+    found = cb.check_source(bad, "x.py")
+    assert len(found) == 4, found
+    ok = (
+        "def g(pool, np, jnp):\n"
+        "    row = pool.block_table[0]\n"
+        "    table = np.asarray(pool.block_table)\n"
+        "    block_table = jnp.zeros(4)\n"
+        "    other[0] = pool.block_table[1]\n"
+        "    return row, table, block_table\n"
+    )
+    assert cb.check_source(ok, "x.py") == []
+    assert cb.check_source(bad, "cache_pool.py") == []
+    with pytest.raises(FileNotFoundError):
+        cb.check_paths((os.path.join(REPO_ROOT, "no_such_dir"),))

@@ -1279,107 +1279,6 @@ def test_cluster_metrics_and_router_track(env):
     assert imb and imb[0]["count"] > 0
 
 
-# -- clock discipline (satellite) ------------------------------------------
-
-
-def test_serving_time_flows_through_clock():
-    """Tier-1 wiring of scripts/check_clock.py: no module under
-    tpu_parallel/serving/ or tpu_parallel/cluster/ reads wall time
-    directly — plus a self-test that the checker actually catches
-    violations."""
-    import os
-    import sys
-
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    sys.path.insert(0, os.path.join(repo, "scripts"))
-    try:
-        import check_clock
-    finally:
-        sys.path.pop(0)
-    problems = check_clock.check_paths(
-        (
-            os.path.join(repo, "tpu_parallel", "serving"),
-            os.path.join(repo, "tpu_parallel", "cluster"),
-        )
-    )
-    assert problems == [], "\n".join(problems)
-    # the checker catches attribute calls, from-imports, and sleep —
-    # while a clock DEFAULT (dependency injection) stays legal
-    bad = (
-        "import time\n"
-        "from time import monotonic as mono\n"
-        "def f():\n"
-        "    a = time.time()\n"
-        "    b = mono()\n"
-        "    time.sleep(1)\n"
-        "def ok(clock=time.monotonic):\n"
-        "    return clock()\n"
-    )
-    found = check_clock.check_source(bad, "x.py")
-    assert len(found) == 3
-    assert any("time.time()" in p for p in found)
-    assert any("mono()" in p for p in found)
-    assert any("time.sleep()" in p for p in found)
-
-
-def test_serving_no_per_slot_host_sync():
-    """Tier-1 wiring of scripts/check_host_sync.py: no module under
-    tpu_parallel/serving/ syncs the device inside a host loop (per-slot
-    syncs are the dispatch tax the fused tick exists to kill; the one
-    tick-boundary sync in the speculative host loop carries the
-    ``# host-sync:`` annotation) — plus a self-test that the checker
-    catches violations and honors the whitelist."""
-    import os
-    import sys
-
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    sys.path.insert(0, os.path.join(repo, "scripts"))
-    try:
-        import check_host_sync
-    finally:
-        sys.path.pop(0)
-    problems = check_host_sync.check_paths(
-        (os.path.join(repo, "tpu_parallel", "serving"),)
-    )
-    assert problems == [], "\n".join(problems)
-    bad = (
-        "import numpy as np\n"
-        "def f(slots, fetch):\n"
-        "    for s in slots:\n"
-        "        a = np.asarray(fetch(s))\n"
-        "        fetch(s).block_until_ready()\n"
-        "    while slots:\n"
-        "        b = np.asarray(slots.pop())  # host-sync: tick-boundary\n"
-        "    c = np.asarray(fetch(0))\n"
-        "def g(xs, fetch):\n"
-        "    return [np.asarray(fetch(x)) for x in xs]\n"
-        "def h(dev_batch):\n"
-        "    return [int(t) for t in np.asarray(dev_batch)]\n"
-    )
-    found = check_host_sync.check_source(bad, "x.py")
-    # the two for-body calls AND the per-iteration comprehension call
-    # flag; the annotated while-body call, the loop-free call, and the
-    # iterate-ONCE comprehension iterable stay legal
-    assert len(found) == 3, found
-    assert any("np.asarray" in p and ":4:" in p for p in found)
-    assert any("block_until_ready" in p for p in found)
-    assert any(":10:" in p for p in found)
-    # the whitelist annotation counts anywhere in a wrapped call's span
-    # (black parks the trailing comment on the closing-paren line)
-    wrapped = (
-        "import numpy as np\n"
-        "def f(slots, fetch):\n"
-        "    while slots:\n"
-        "        b = np.asarray(\n"
-        "            fetch(slots.pop())\n"
-        "        )  # host-sync: tick-boundary\n"
-    )
-    assert check_host_sync.check_source(wrapped, "x.py") == []
-    # a typo'd path must fail loudly, never walk zero files and pass
-    with pytest.raises(FileNotFoundError):
-        check_host_sync.check_paths((os.path.join(repo, "no_such_dir"),))
-
-
 # -- prefix affinity wins (slow) -------------------------------------------
 
 

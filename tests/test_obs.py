@@ -1,15 +1,14 @@
 """Unified-telemetry tests: metric registry (log-bucketed histograms vs
 numpy ground truth), span tracer (null-tracer cost contract), exporters
 (Chrome trace round-trip + Perfetto field contract, Prometheus text
-parse), the rebased JSONL sink, the scheduler's queue-age gauge, and the
-collective-scope static check (``scripts/check_scopes.py``)."""
+parse), the rebased JSONL sink, and the scheduler's queue-age gauge.
+(The collective-scope static check moved to ``tests/test_checkers.py``,
+the single entry point over the ``scripts/check_all.py`` registry.)"""
 
 import json
 import math
 import os
 import re
-import subprocess
-import sys
 import time
 
 import numpy as np
@@ -84,6 +83,31 @@ def test_histogram_empty_is_none():
     h = Histogram()
     assert h.percentile(50) is None and h.mean() is None
     assert h.min is None and h.max is None
+
+
+def test_histogram_window_base_and_delta():
+    """HistogramWindow splits a monotone histogram at its capture point:
+    base_* reads the before side, delta_* the since side — the swap
+    controller's baseline-vs-canary mechanism."""
+    from tpu_parallel.obs import HistogramWindow
+
+    h = Histogram()
+    for v in (1.0, 2.0, 3.0):
+        h.observe(v)
+    w = HistogramWindow(h)
+    assert w.base_count() == 3
+    assert w.base_mean() == pytest.approx(2.0)
+    assert w.delta_count() == 0 and w.delta_mean() is None
+    for v in (10.0, 20.0):
+        h.observe(v)
+    assert w.base_mean() == pytest.approx(2.0)  # capture is immutable
+    assert w.delta_count() == 2
+    assert w.delta_mean() == pytest.approx(15.0)
+    # a fresh window re-captures the same instrument
+    w2 = HistogramWindow(h)
+    assert w2.base_count() == 5 and w2.delta_count() == 0
+    empty = HistogramWindow(Histogram())
+    assert empty.base_mean() is None and empty.delta_mean() is None
 
 
 def test_histogram_percentile_within_one_bucket_width():
@@ -415,54 +439,9 @@ def test_generate_speculative_registry_acceptance_histogram():
     assert h.max == 1.0 and h.min == 0.25
 
 
-# -- collective-scope static check (satellite) -----------------------------
-
-
-def _load_check_scopes():
-    import importlib.util
-
-    spec = importlib.util.spec_from_file_location(
-        "check_scopes", os.path.join(REPO_ROOT, "scripts", "check_scopes.py")
-    )
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
-
-
-def test_check_scopes_unit_semantics():
-    cs = _load_check_scopes()
-    flagged = cs.check_source(
-        "def f(x):\n    return lax.psum(x, 'data')\n", "f.py"
-    )
-    assert len(flagged) == 1 and "psum" in flagged[0]
-    for ok_src in (
-        # with-block scope
-        "def f(x):\n"
-        "    with jax.named_scope('s'):\n"
-        "        return lax.psum(x, 'data')\n",
-        # decorator scope, collective in a NESTED def (scan body idiom)
-        "@jax.named_scope('s')\n"
-        "def f(x):\n"
-        "    def body(c, _):\n"
-        "        return lax.ppermute(c, 'pipe', perm=[(0, 1)]), None\n"
-        "    return body(x, None)\n",
-        # axis-size query exemption
-        "def f():\n    return lax.psum(1, 'data')\n",
-    ):
-        assert cs.check_source(ok_src, "ok.py") == [], ok_src
-
-
-def test_collectives_named_scoped():
-    """Tier-1 gate: every real collective call in tpu_parallel/parallel
-    and tpu_parallel/ops sits inside a jax.named_scope (so accelerator
-    traces stay labelable) — run exactly as CI would."""
-    proc = subprocess.run(
-        [sys.executable, os.path.join(REPO_ROOT, "scripts", "check_scopes.py")],
-        capture_output=True,
-        text=True,
-        cwd=REPO_ROOT,
-    )
-    assert proc.returncode == 0, proc.stderr
+# (The collective-scope gate — and every other AST contract gate — is
+# wired tier-1 through the single scripts/check_all.py registry entry
+# point in tests/test_checkers.py.)
 
 
 # -- disabled-tracer overhead (acceptance, slow) ---------------------------

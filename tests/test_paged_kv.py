@@ -3,11 +3,11 @@ double-free, fragmentation round-trip), copy-on-write under concurrent
 sharers, greedy bitwise parity with the fixed-slot engine across every
 serving path (per-step / fused / speculative / chunked / int8 / cluster
 crash-replay), zero-copy prefix sharing, admission-by-blocks, donation
-and compile-count pins, and the ``scripts/check_blocks.py`` mutation
-fence."""
+and compile-count pins.  (The ``check_blocks`` mutation fence moved to
+``tests/test_checkers.py``, the single entry point over the
+``scripts/check_all.py`` registry.)"""
 
 import os
-import sys
 
 import jax
 import jax.numpy as jnp
@@ -573,47 +573,6 @@ def test_paged_fused_compile_count_pin(env):
     )
 
 
-# -- the mutation fence -------------------------------------------------------
-
-
-def test_block_table_mutations_fenced():
-    """Tier-1 wiring of scripts/check_blocks.py: no module under
-    serving/, cluster/ or scripts/ writes a block table directly — plus
-    a self-test that the checker catches subscript stores, augmented
-    stores and deletes while leaving reads and local rebinding legal."""
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    sys.path.insert(0, os.path.join(repo, "scripts"))
-    try:
-        import check_blocks
-    finally:
-        sys.path.pop(0)
-    problems = check_blocks.check_paths(
-        (
-            os.path.join(repo, "tpu_parallel", "serving"),
-            os.path.join(repo, "tpu_parallel", "cluster"),
-            os.path.join(repo, "scripts"),
-        )
-    )
-    assert problems == [], "\n".join(problems)
-    bad = (
-        "def f(pool, t):\n"
-        "    pool.block_table[0, 1] = 3\n"
-        "    pool.block_table[0] += 1\n"
-        "    self._block_table[s][j] = 9\n"
-        "    del pool.block_table[0]\n"
-    )
-    found = check_blocks.check_source(bad, "x.py")
-    assert len(found) == 4, found
-    ok = (
-        "def g(pool, np, jnp):\n"
-        "    row = pool.block_table[0]\n"  # read
-        "    table = np.asarray(pool.block_table)\n"  # copy
-        "    block_table = jnp.zeros(4)\n"  # local rebind, not a store
-        "    other[0] = pool.block_table[1]\n"  # store into NON-table
-        "    return row, table, block_table\n"
-    )
-    assert check_blocks.check_source(ok, "x.py") == []
-    # the allocator's own module is the one legal mutation site
-    assert check_blocks.check_source(bad, "cache_pool.py") == []
-    with pytest.raises(FileNotFoundError):
-        check_blocks.check_paths((os.path.join(repo, "no_such_dir"),))
+# (The block-table mutation fence — and every other AST contract gate —
+# is wired tier-1 through the single scripts/check_all.py registry entry
+# point in tests/test_checkers.py.)

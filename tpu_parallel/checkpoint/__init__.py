@@ -1,3 +1,21 @@
-from tpu_parallel.checkpoint.io import Checkpointer, abstract_state_of
+from tpu_parallel.checkpoint.io import (
+    Checkpointer,
+    WeightManifest,
+    WeightsCorrupt,
+    abstract_state_of,
+    latest_weights_step,
+    load_serving_weights,
+    params_fingerprint,
+    save_serving_weights,
+)
 
-__all__ = ["Checkpointer", "abstract_state_of"]
+__all__ = [
+    "Checkpointer",
+    "WeightManifest",
+    "WeightsCorrupt",
+    "abstract_state_of",
+    "latest_weights_step",
+    "load_serving_weights",
+    "params_fingerprint",
+    "save_serving_weights",
+]

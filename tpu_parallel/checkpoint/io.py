@@ -11,10 +11,14 @@ NamedSharding, so restore never materializes a full replica on one host.
 
 from __future__ import annotations
 
+import dataclasses
+import hashlib
+import json
 import os
 from typing import Any, Callable, Optional
 
 import jax
+import numpy as np
 import orbax.checkpoint as ocp
 
 Pytree = Any
@@ -138,6 +142,153 @@ class Checkpointer:
 
     def close(self) -> None:
         self.manager.close()
+
+
+# -- serving weight sets (the hot-swap unit) --------------------------------
+#
+# A training checkpoint is a TrainState (params + optimizer + step); the
+# serving fleet hot-swaps PARAMS ONLY, and it needs two things a bare
+# orbax tree does not give it: a VERSION identity (what the fleet's
+# `cluster_swap_version` gauge and `swap_status()` report) and a content
+# FINGERPRINT (so a corrupted or wrong-file load is refused BEFORE a
+# replica starts serving garbage — the cheap half of the canary's
+# logit-fingerprint spot check).  `save_serving_weights` writes the param
+# pytree via orbax plus a small JSON manifest next to it;
+# `load_serving_weights` restores and verifies the fingerprint, raising
+# :class:`WeightsCorrupt` on any mismatch.
+
+
+class WeightsCorrupt(ValueError):
+    """Loaded weights do not match their manifest fingerprint — the file
+    set was truncated, tampered with, or mixed from two saves.  Serving
+    such weights would be silent garbage; refuse loudly instead."""
+
+
+@dataclasses.dataclass(frozen=True)
+class WeightManifest:
+    """Sidecar identity record of one saved serving weight set."""
+
+    version: str
+    step: int
+    fingerprint: str
+    n_leaves: int
+    n_params: int
+
+    def to_json(self) -> str:
+        return json.dumps(dataclasses.asdict(self), indent=2, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, text: str) -> "WeightManifest":
+        return cls(**json.loads(text))
+
+
+def params_fingerprint(params: Pytree) -> str:
+    """Deterministic content hash of a param pytree: sha256 over every
+    leaf's path, shape, dtype and raw bytes (host transfer — call at save
+    / load / audit points, not per tick).  Identical trees hash
+    identically across processes; any flipped bit, reshaped leaf, or
+    renamed module changes the digest."""
+    h = hashlib.sha256()
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    for path, leaf in leaves:
+        arr = np.asarray(leaf)
+        h.update(jax.tree_util.keystr(path).encode())
+        h.update(str(arr.shape).encode())
+        h.update(str(arr.dtype).encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()
+
+
+def _manifest_path(directory: str, step: int) -> str:
+    return os.path.join(
+        os.path.abspath(directory), f"weights_manifest_{step}.json"
+    )
+
+
+def _weights_dir(directory: str, step: int) -> str:
+    return os.path.join(os.path.abspath(directory), f"weights_{step}")
+
+
+def save_serving_weights(
+    directory: str, step: int, params: Pytree, version: Optional[str] = None
+) -> WeightManifest:
+    """Write a hot-swappable weight set: the param pytree (orbax) plus its
+    :class:`WeightManifest` sidecar.  ``version`` defaults to
+    ``"step-<step>"`` — the identity the cluster's rolling swap reports
+    per replica."""
+    leaves = jax.tree_util.tree_leaves(params)
+    manifest = WeightManifest(
+        version=version if version is not None else f"step-{step}",
+        step=step,
+        fingerprint=params_fingerprint(params),
+        n_leaves=len(leaves),
+        n_params=int(sum(np.asarray(x).size for x in leaves)),
+    )
+    path = _weights_dir(directory, step)
+    with ocp.PyTreeCheckpointer() as ptc:
+        ptc.save(path, args=ocp.args.PyTreeSave(params), force=True)
+    with open(_manifest_path(directory, step), "w") as fh:
+        fh.write(manifest.to_json())
+        fh.write("\n")
+    return manifest
+
+
+def latest_weights_step(directory: str) -> Optional[int]:
+    """Largest step with a manifest in ``directory`` (None when empty)."""
+    steps = []
+    if os.path.isdir(directory):
+        for name in os.listdir(directory):
+            if name.startswith("weights_manifest_") and name.endswith(".json"):
+                steps.append(int(name[len("weights_manifest_"):-len(".json")]))
+    return max(steps) if steps else None
+
+
+def load_serving_weights(
+    directory: str,
+    step: Optional[int] = None,
+    like: Optional[Pytree] = None,
+) -> tuple:
+    """Restore a weight set saved by :func:`save_serving_weights` and
+    VERIFY it against its manifest.  ``like`` (the live params the loaded
+    set will replace) restores each leaf with its template's dtype/
+    sharding; without it leaves come back as saved.  Returns ``(params,
+    manifest)``; raises FileNotFoundError when nothing is saved and
+    :class:`WeightsCorrupt` when the content hash disagrees with the
+    manifest — never hand unverified weights to a serving fleet."""
+    if step is None:
+        step = latest_weights_step(directory)
+        if step is None:
+            raise FileNotFoundError(
+                f"no serving weights saved under {directory}"
+            )
+    mpath = _manifest_path(directory, step)
+    if not os.path.exists(mpath):
+        raise FileNotFoundError(f"no weight manifest at {mpath}")
+    with open(mpath) as fh:
+        manifest = WeightManifest.from_json(fh.read())
+    path = _weights_dir(directory, step)
+    with ocp.PyTreeCheckpointer() as ptc:
+        if like is not None:
+            restored = ptc.restore(
+                path,
+                args=ocp.args.PyTreeRestore(
+                    item=like,
+                    restore_args=(
+                        ocp.checkpoint_utils.construct_restore_args(like)
+                    ),
+                ),
+            )
+        else:
+            restored = ptc.restore(path)
+    digest = params_fingerprint(restored)
+    if digest != manifest.fingerprint:
+        raise WeightsCorrupt(
+            f"weights at {path} hash {digest[:12]}… but the manifest "
+            f"records {manifest.fingerprint[:12]}… (version "
+            f"{manifest.version!r}, step {step}) — refusing to serve a "
+            "corrupted or mismatched weight set"
+        )
+    return restored, manifest
 
 
 def abstract_state_of(init_fn: Callable, *example_args) -> Pytree:

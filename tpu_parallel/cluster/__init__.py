@@ -15,6 +15,13 @@ replicas carrying an ``engine_factory`` are rebuilt under a
 :class:`RestartPolicy` circuit breaker — exponential backoff, half-open
 probation, promotion back to healthy.  ``scripts/chaos_bench.py`` soaks
 the whole story under seeded randomized fault storms.
+
+The cluster also ships NEW WEIGHTS under load: ``Frontend.begin_swap``
+rolls a versioned weight set across the fleet one replica at a time
+(``cluster/swap.py`` — exclusion, drain-or-relocate, recompile-free
+rebind, canary probation), with a :class:`SwapPolicy` watching the
+canary against a pre-swap latency baseline and a logit-fingerprint spot
+check, rolling the whole fleet back automatically on regression.
 """
 
 from tpu_parallel.cluster.frontend import (
@@ -42,6 +49,26 @@ from tpu_parallel.cluster.router import (
     make_router,
     prefix_route_key,
 )
+from tpu_parallel.cluster.swap import (
+    ROLLBACK_CANARY_DEATH,
+    ROLLBACK_SLO_E2E,
+    ROLLBACK_SLO_TTFT,
+    ROLLBACK_SPOT_CHECK,
+    SWAP_CANARY,
+    SWAP_COMPLETED,
+    SWAP_EXCLUDED,
+    SWAP_PROMOTED,
+    SWAP_REFUSED_DRAINING,
+    SWAP_REFUSED_FINGERPRINT,
+    SWAP_REFUSED_IN_PROGRESS,
+    SWAP_REFUSED_SHAPE,
+    SWAP_REFUSED_VERSION,
+    SWAP_ROLLED_BACK,
+    SWAP_ROLLING,
+    SWAP_ROLLING_BACK,
+    SwapController,
+    SwapPolicy,
+)
 
 __all__ = [
     "Frontend",
@@ -63,4 +90,22 @@ __all__ = [
     "least_loaded",
     "make_router",
     "prefix_route_key",
+    "SwapController",
+    "SwapPolicy",
+    "SWAP_CANARY",
+    "SWAP_COMPLETED",
+    "SWAP_EXCLUDED",
+    "SWAP_PROMOTED",
+    "SWAP_ROLLED_BACK",
+    "SWAP_ROLLING",
+    "SWAP_ROLLING_BACK",
+    "SWAP_REFUSED_DRAINING",
+    "SWAP_REFUSED_FINGERPRINT",
+    "SWAP_REFUSED_IN_PROGRESS",
+    "SWAP_REFUSED_SHAPE",
+    "SWAP_REFUSED_VERSION",
+    "ROLLBACK_CANARY_DEATH",
+    "ROLLBACK_SLO_E2E",
+    "ROLLBACK_SLO_TTFT",
+    "ROLLBACK_SPOT_CHECK",
 ]

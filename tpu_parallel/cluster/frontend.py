@@ -84,9 +84,19 @@ from tpu_parallel.cluster.router import (
     Router,
     make_router,
 )
+from tpu_parallel.cluster.swap import (
+    SWAP_REFUSED_DRAINING,
+    SWAP_REFUSED_FINGERPRINT,
+    SWAP_REFUSED_IN_PROGRESS,
+    SWAP_REFUSED_SHAPE,
+    SWAP_REFUSED_VERSION,
+    SWAP_TRACK,
+    SwapController,
+    SwapPolicy,
+)
 from tpu_parallel.obs.registry import MetricRegistry
 from tpu_parallel.obs.tracer import NULL_TRACER, Tracer
-from tpu_parallel.serving.engine import ServingEngine
+from tpu_parallel.serving.engine import ServingEngine, validate_same_shapes
 from tpu_parallel.serving.request import (
     CANCELLED,
     EXPIRED,
@@ -232,6 +242,7 @@ class _ClientState:
 
     __slots__ = (
         "out", "seq", "budget", "excluded", "handle", "engine_rid", "base",
+        "pinned_version",
     )
 
     def __init__(self, out: ClusterOutput, seq: int, budget: int):
@@ -242,6 +253,10 @@ class _ClientState:
         self.handle: Optional[ReplicaHandle] = None  # current attempt
         self.engine_rid: Optional[str] = None
         self.base = 0  # tokens delivered before the current attempt
+        # the weight version that produced this request's FIRST token: a
+        # stream must not straddle weight versions, so replays prefer
+        # same-version replicas while any exist (rolling-swap hygiene)
+        self.pinned_version: Optional[str] = None
 
 
 class Frontend:
@@ -313,6 +328,17 @@ class Frontend:
         self._imbalance = r.histogram("cluster_route_imbalance")
         self._ttft = r.histogram("cluster_ttft_seconds")
         self._e2e = r.histogram("cluster_e2e_seconds")
+        self._by_id: Dict[int, ReplicaHandle] = {
+            h.replica_id: h for h in self.replicas
+        }
+        # rolling weight hot-swap (cluster/swap.py): the in-flight (or
+        # last finished) rollout, the fleet's post-swap standard weights
+        # (restarting replicas rebind to them), and version ordinals for
+        # the per-replica cluster_swap_version gauge
+        self._swap: Optional[SwapController] = None
+        self._fleet_weights: Optional[tuple] = None
+        self._version_ordinals: Dict[str, int] = {"initial": 0}
+        self._swap_seq = itertools.count(1)
 
     # -- admission ---------------------------------------------------------
 
@@ -387,6 +413,10 @@ class Frontend:
         now = self.clock()
         self._events = []
         self._service_restarts(now)
+        if self._swap is not None and self._swap.active:
+            # the rolling swap advances BEFORE dispatch so exclusions,
+            # rebinds and canary promotions shape this tick's placement
+            self._swap.tick(now)
         self._enforce_deadlines(now)
         self._dispatch(now)
         for handle in self.replicas:
@@ -426,6 +456,21 @@ class Frontend:
 
     # -- self-healing ------------------------------------------------------
 
+    def _handle(self, replica_id: int) -> ReplicaHandle:
+        return self._by_id[replica_id]
+
+    def _restartable(self, handle: ReplicaHandle) -> bool:
+        """Whether the circuit breaker could ever revive this replica —
+        a restart policy exists, the handle carries a factory, and the
+        lifetime attempt budget is not exhausted."""
+        policy = self.config.restart
+        return (
+            policy is not None
+            and handle.engine_factory is not None
+            and self._recovery[handle.replica_id].attempts
+            < policy.max_restarts
+        )
+
     def _service_restarts(self, now: float) -> None:
         """Fire every due restart: rebuild the engine through the
         handle's factory and enter PROBATION.  A factory failure counts
@@ -457,6 +502,20 @@ class Frontend:
                 else:
                     handle.health = DEAD  # breaker open for good
                 continue
+            # version reconciliation: the factory rebuilds with the
+            # weights the cluster was BORN with, but a completed hot
+            # swap made a newer set the fleet standard — rebind the
+            # fresh (idle) engine before it takes probation traffic, so
+            # a post-swap restart can never resurrect the old version
+            if self._fleet_weights is not None:
+                ver, params = self._fleet_weights
+                if handle.weights_version != ver:
+                    handle.engine.rebind_params(params, version=ver)
+                    if self.tracer.enabled:
+                        self.tracer.instant(
+                            "swap_rebind_on_restart", track=SWAP_TRACK,
+                            replica=handle.replica_id, version=ver,
+                        )
             rec.clean_ticks = 0
             rec.stall_ticks = 0
             rec.probation = True
@@ -484,6 +543,11 @@ class Frontend:
             # the breaker's failure count and defeat backoff escalation)
             return
         rec.clean_ticks += 1
+        if self._swap is not None and self._swap.gates_probation(handle):
+            # the swap canary (and any replica awaiting rollback) is
+            # promoted by the SwapPolicy, not the generic probation
+            # clock — clean ticks still accrue for the canary gate
+            return
         if policy is not None and rec.clean_ticks >= policy.probation_ticks:
             handle.health = HEALTHY
             rec.probation = False
@@ -578,18 +642,132 @@ class Frontend:
         for handle in self.replicas:
             if handle.health in (DEAD, BACKOFF):
                 continue
-            for eout in handle.take_queued():
-                st = self._by_attempt.pop(eout.request.request_id, None)
-                if st is None or st.out.done:
-                    continue
-                st.handle = None
-                st.engine_rid = None
-                self._requeued.inc()
-                self._pending.append(st)
+            self._pull_back_queued(handle)
         events = self.run(max_ticks)
         if span is not None:
             span.finish(requeued=int(self._requeued.value))
         return events
+
+    # -- rolling weight hot-swap -------------------------------------------
+
+    def begin_swap(
+        self,
+        checkpoint_dir: Optional[str] = None,
+        step: Optional[int] = None,
+        *,
+        params=None,
+        version: Optional[str] = None,
+        policy: Optional[SwapPolicy] = None,
+    ) -> dict:
+        """Start a zero-downtime rolling weight swap (cluster/swap.py —
+        the module docstring and docs/12 describe the state machine).
+
+        Pass either a ``checkpoint_dir`` (+ optional ``step``) written by
+        :func:`~tpu_parallel.checkpoint.io.save_serving_weights` — the
+        manifest supplies the version and the load is fingerprint-
+        verified — or an in-memory ``params`` tree with a ``version``
+        string.  Returns the swap status dict (see :meth:`swap_status`);
+        a REFUSED swap carries the typed reason in ``verdict``:
+        ``draining`` (mid-drain fleets don't take new weights),
+        ``swap_in_progress`` (one rollout at a time),
+        ``fingerprint_mismatch`` (checkpoint failed its manifest audit),
+        ``shape_mismatch`` (not a same-shape weight set) or
+        ``version_in_service`` (the version id is already live — a
+        rollback could never tell old from new).
+        """
+
+        def refuse(reason: str, detail: Optional[str] = None) -> dict:
+            self.registry.counter(
+                "cluster_swap_refused_total", reason=reason
+            ).inc()
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "swap_refused", track=SWAP_TRACK, reason=reason,
+                )
+            return {"state": "refused", "verdict": reason, "detail": detail}
+
+        if self.draining:
+            return refuse(SWAP_REFUSED_DRAINING)
+        if self._swap is not None and self._swap.active:
+            return refuse(SWAP_REFUSED_IN_PROGRESS)
+        if checkpoint_dir is not None:
+            from tpu_parallel.checkpoint.io import (
+                WeightsCorrupt,
+                load_serving_weights,
+            )
+
+            try:
+                params, manifest = load_serving_weights(
+                    checkpoint_dir, step=step,
+                    like=self.replicas[0].engine.params,
+                )
+            except WeightsCorrupt as exc:
+                return refuse(SWAP_REFUSED_FINGERPRINT, detail=str(exc))
+            if version is None:
+                version = manifest.version
+        if params is None:
+            raise ValueError(
+                "begin_swap needs params=... or a checkpoint_dir"
+            )
+        if version is None:
+            version = f"swap-{next(self._swap_seq)}"
+        if any(h.weights_version == version for h in self.replicas):
+            return refuse(
+                SWAP_REFUSED_VERSION,
+                detail=f"version {version!r} is already serving",
+            )
+        try:
+            validate_same_shapes(self.replicas[0].engine.params, params)
+        except ValueError as exc:
+            return refuse(SWAP_REFUSED_SHAPE, detail=str(exc))
+        self._version_ordinals.setdefault(
+            version, len(self._version_ordinals)
+        )
+        self._swap = SwapController(
+            self, params, version, policy or SwapPolicy()
+        )
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "swap_begin", track=SWAP_TRACK, version=version,
+                replicas=len(self.replicas),
+            )
+        return self._swap.status_dict()
+
+    def swap_status(self) -> dict:
+        """The current (or last finished) rollout's typed status:
+        ``state`` (``idle`` / ``rolling`` / ``rolling_back`` /
+        ``completed`` / ``rolled_back``), the typed ``verdict``
+        (``completed``, or the rollback reason — ``canary_death`` /
+        ``slo_ttft`` / ``slo_e2e`` / ``logit_fingerprint``), per-replica
+        phases and weight versions, and the canary-vs-baseline latency
+        window means."""
+        if self._swap is None:
+            return {
+                "state": "idle",
+                "verdict": None,
+                "replica_versions": {
+                    h.replica_id: h.weights_version for h in self.replicas
+                },
+            }
+        return self._swap.status_dict()
+
+    def _pull_back_queued(self, handle: ReplicaHandle) -> int:
+        """Pull ``handle``'s engine-queued remainder back into the
+        frontend backlog — the ONE relocation-of-queued-work move drain
+        and the swap rollout's exclusion/revert phases all share (queued
+        work has no replica or weight-version stake yet).  Returns how
+        many requests moved."""
+        moved = 0
+        for eout in handle.take_queued():
+            st = self._by_attempt.pop(eout.request.request_id, None)
+            if st is None or st.out.done:
+                continue
+            st.handle = None
+            st.engine_rid = None
+            self._requeued.inc()
+            self._pending.append(st)
+            moved += 1
+        return moved
 
     def cancel(self, request_id: str, reason: str = "cancelled") -> bool:
         """Client-initiated cancellation by CLUSTER request id — pending,
@@ -645,6 +823,7 @@ class Frontend:
         False leaves the request pending for the next tick."""
         req = st.out.request
         tried: set = set()
+        swap = self._swap
         while True:
             cands = [
                 h
@@ -654,7 +833,23 @@ class Frontend:
                 and h.replica_id not in st.excluded
                 and h.replica_id not in tried
                 and self._probation_headroom(h)
+                # rolling swap: the current target is drained of NEW
+                # placement; during a rollback every replica still on
+                # the abandoned version is off limits
+                and not h.swap_excluded
+                and (swap is None or not swap.blocked(h))
             ]
+            if st.pinned_version is not None:
+                # a stream must finish on the weight version that
+                # started it: prefer same-version replicas, fall back
+                # only when none exist anywhere (counted at the actual
+                # dispatch below, once per placement, not per pass)
+                same = [
+                    h for h in cands
+                    if h.weights_version == st.pinned_version
+                ]
+                if same:
+                    cands = same
             # healthy first; a PROBATION replica takes its half-open
             # trickle alongside them (that's how it proves itself);
             # DEGRADED only when nothing else is placeable
@@ -700,6 +895,17 @@ class Frontend:
                     c.replica_id for c in cands
                 }:
                     self.router.fallbacks += 1
+            if (
+                st.pinned_version is not None
+                and st.out.tokens
+                and pick.weights_version != st.pinned_version
+            ):
+                # the one case a stream crosses weight versions: a
+                # mid-stream replay found NO replica on its pinned
+                # version — counted per actual placement
+                self.registry.counter(
+                    "cluster_swap_version_fallbacks_total"
+                ).inc()
             st.handle = pick
             st.engine_rid = ereq.request_id
             st.out.replicas.append(pick.replica_id)
@@ -765,6 +971,10 @@ class Frontend:
             index = st.base + ev.index
             if st.out.first_token_time is None:
                 st.out.first_token_time = now
+            if st.pinned_version is None and st.handle is not None:
+                # first token: the stream is now committed to this
+                # weight version (replays prefer same-version replicas)
+                st.pinned_version = st.handle.weights_version
             st.out.status = RUNNING
             st.out.tokens.append(ev.token)
             st.out.token_times.append(now)
@@ -776,6 +986,10 @@ class Frontend:
                 finish_reason=ev.finish_reason,
             )
             if ev.finished:
+                if self._swap is not None and self._swap.active:
+                    # canary-window accounting + spot-check candidate
+                    # capture (needs st.handle, so before _finalize)
+                    self._swap.note_finish(st, now)
                 self._finalize(st, FINISHED, ev.finish_reason, now)
                 self._finished.inc()
                 if st.out.ttft is not None:
@@ -845,11 +1059,7 @@ class Frontend:
                     replica=handle.replica_id,
                 )
         policy = self.config.restart
-        if (
-            policy is not None
-            and handle.engine_factory is not None
-            and rec.attempts < policy.max_restarts
-        ):
+        if self._restartable(handle):
             delay = policy.delay(rec.failures)
             handle.health = BACKOFF
             rec.restart_at = now + delay
@@ -859,6 +1069,12 @@ class Frontend:
                     replica=handle.replica_id, delay=delay,
                     failures=rec.failures,
                 )
+        if self._swap is not None and self._swap.active:
+            # the rollout reacts AFTER the breaker decided: a dead
+            # canary triggers rollback, a dead target defers, a dead
+            # promoted replica re-queues (its restart resurrects the
+            # old weights and must be swapped again)
+            self._swap.on_death(handle.replica_id)
 
     def _enforce_deadlines(self, now: float) -> None:
         for st in self._open_states():
@@ -924,6 +1140,11 @@ class Frontend:
                 _BREAKER_CODE[h.health]
             )
             r.gauge("cluster_replica_restarts", **lab).set(h.restarts)
+            r.gauge("cluster_swap_version", **lab).set(
+                self._version_ordinals.setdefault(
+                    h.weights_version, len(self._version_ordinals)
+                )
+            )
             r.gauge("cluster_replica_load", **lab).set(
                 0.0 if h.health in (DEAD, BACKOFF) else h.load()
             )
@@ -992,6 +1213,15 @@ class Frontend:
             "restart_failures": int(self._restart_failures.value),
             "probation_promotions": int(self._promotions.value),
             "probation_demotions": int(self._demotions.value),
+            "swap_state": self.swap_status()["state"],
+            "swaps": int(
+                self.registry.counter("cluster_swaps_total").value
+            ),
+            "swap_rollbacks": int(
+                self.registry.counter(
+                    "cluster_swap_rollbacks_total"
+                ).value
+            ),
             "inflight_tokens": self._reserved,
             "prefix_hit_rate": (
                 None if hit_rate is None else round(hit_rate, 4)

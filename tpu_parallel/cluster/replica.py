@@ -108,6 +108,12 @@ class FaultPlan:
     - ``reject_at_tick`` + ``reject_ticks``: during that tick window the
       replica refuses NEW admissions (``accepting`` is False) while
       in-flight work proceeds — the overload-shedding shape.
+    - ``swap_at_tick``: an OPERATOR event, not a fault: the chaos/bench
+      harness reading the plan calls ``Frontend.begin_swap`` when the
+      fleet reaches this tick (``swap@T``), so seeded storms exercise a
+      rolling weight swap colliding with crashes and stalls.  The plan
+      itself never triggers it — like every other entry it only
+      describes the schedule; the harness owns the behavior.
     """
 
     crash_at_tick: Optional[int] = None
@@ -116,6 +122,7 @@ class FaultPlan:
     reject_at_tick: Optional[int] = None
     reject_ticks: int = 0
     crash_every: Optional[int] = None
+    swap_at_tick: Optional[int] = None
     exception_factory: Optional[Callable[[int], Exception]] = (
         dataclasses.field(default=None, compare=False)
     )
@@ -170,10 +177,14 @@ class FaultPlan:
         if kinds is None:
             pool = ("crash", "stall", "flap", "reject")
             kinds = tuple(k for k in pool if rnd.random() < 0.5)
-        unknown = set(kinds) - {"crash", "stall", "flap", "reject"}
+        unknown = set(kinds) - {"crash", "stall", "flap", "reject", "swap"}
         if unknown:
             raise ValueError(f"unknown fault kinds {sorted(unknown)}")
         kw: dict = {}
+        if "swap" in kinds:
+            # early enough that the rollout collides with the storm, late
+            # enough that traffic and faults are already in motion
+            kw["swap_at_tick"] = rnd.randrange(3, max(4, ticks // 2))
         if "stall" in kinds:
             kw["stall_at_tick"] = rnd.randrange(2, max(3, ticks // 3))
             kw["stall_ticks"] = rnd.randrange(2, 6)
@@ -289,6 +300,11 @@ class ReplicaHandle:
         self.fault_plan = fault_plan
         self.engine_factory = engine_factory
         self.health = HEALTHY
+        # rolling weight swap: True while this replica is the rollout's
+        # current target being drained of traffic — the frontend's
+        # dispatch filter skips it for NEW placement while in-flight work
+        # finishes on the old weights (cluster/swap.py owns the flag)
+        self.swap_excluded = False
         self.ticks = 0  # lifetime step() calls, NEVER reset
         self.incarnation_ticks = 0  # step() calls since the last restart
         self.restarts = 0  # successful restarts served so far
@@ -311,6 +327,13 @@ class ReplicaHandle:
     @property
     def pending_prefill_tokens(self) -> int:
         return self.engine.pending_prefill_tokens
+
+    @property
+    def weights_version(self) -> str:
+        """The served weight set's identity (``"initial"`` until a hot
+        swap rebinds it) — what the rolling-swap status and the
+        ``cluster_swap_version`` gauge report."""
+        return getattr(self.engine, "weights_version", "initial")
 
     @property
     def open_requests(self) -> int:
@@ -449,6 +472,10 @@ class ReplicaHandle:
         self.incarnation_ticks = 0
         self.restarts += 1
         self.health = PROBATION
+        # a rebuilt engine is a fresh traffic target — any stale swap
+        # exclusion died with the old incarnation (the swap controller
+        # re-queues the replica as a target if its rollout still runs)
+        self.swap_excluded = False
 
     def has_work(self) -> bool:
         return self.health not in (DEAD, BACKOFF) and self.engine.has_work()
@@ -485,6 +512,7 @@ class ReplicaHandle:
         return {
             "replica": self.replica_id,
             "health": self.health,
+            "weights_version": self.weights_version,
             "ticks": self.ticks,
             "restarts": self.restarts,
             "queue_depth": self.queue_depth,

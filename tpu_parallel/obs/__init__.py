@@ -19,6 +19,7 @@ from tpu_parallel.obs.registry import (
     Counter,
     Gauge,
     Histogram,
+    HistogramWindow,
     MetricRegistry,
     validate_snapshot,
 )
@@ -34,6 +35,7 @@ __all__ = [
     "Counter",
     "Gauge",
     "Histogram",
+    "HistogramWindow",
     "MetricRegistry",
     "validate_snapshot",
     "Span",

@@ -149,6 +149,49 @@ class Histogram:
         return out
 
 
+class HistogramWindow:
+    """Point-in-time capture of a :class:`Histogram` for WINDOWED reads.
+
+    Registry histograms are monotone — they can never be reset without
+    lying to their other writers — so any consumer that needs "what
+    happened since T" (the swap controller's pre-swap latency baseline
+    and per-canary windows, a bench's measure-after-warmup read) captures
+    a window at T and reads deltas against the live instrument:
+
+    - :meth:`base_count` / :meth:`base_mean`: the distribution AT capture
+      (the swap controller's "before" side).
+    - :meth:`delta_count` / :meth:`delta_mean`: observations landed SINCE
+      capture (the "after" side).  Exact, like the histogram's own
+      count/sum.
+
+    The window holds only two floats — capturing is free and windows can
+    be re-captured per phase (one monotone canary histogram serves every
+    rollout step through a fresh window each time).
+    """
+
+    __slots__ = ("hist", "count0", "sum0")
+
+    def __init__(self, hist: Histogram):
+        self.hist = hist
+        self.count0 = hist.count
+        self.sum0 = hist.sum
+
+    def base_count(self) -> int:
+        return self.count0
+
+    def base_mean(self) -> Optional[float]:
+        """Mean of everything observed BEFORE capture; None when empty."""
+        return (self.sum0 / self.count0) if self.count0 else None
+
+    def delta_count(self) -> int:
+        return self.hist.count - self.count0
+
+    def delta_mean(self) -> Optional[float]:
+        """Mean of everything observed SINCE capture; None when empty."""
+        dc = self.delta_count()
+        return ((self.hist.sum - self.sum0) / dc) if dc else None
+
+
 class MetricRegistry:
     """Get-or-create store of labeled instruments.
 

@@ -157,6 +157,32 @@ from tpu_parallel.serving.spec_decode import (
 )
 
 
+def validate_same_shapes(old, new) -> None:
+    """Raise ``ValueError`` unless ``new`` matches ``old`` leaf for leaf
+    in structure, shape and dtype — the precondition for a
+    recompile-free weight rebind.  THE one check both
+    :meth:`ServingEngine.rebind_params` and the cluster's
+    ``begin_swap`` typed up-front refusal run, so they can never drift
+    apart."""
+    old_leaves, old_def = jax.tree_util.tree_flatten_with_path(old)
+    new_leaves, new_def = jax.tree_util.tree_flatten_with_path(new)
+    if old_def != new_def:
+        raise ValueError(
+            f"weight tree structure differs: {new_def} != {old_def}"
+        )
+    for (path, a), (_, b) in zip(old_leaves, new_leaves):
+        if (
+            getattr(a, "shape", None) != getattr(b, "shape", None)
+            or getattr(a, "dtype", None) != getattr(b, "dtype", None)
+        ):
+            raise ValueError(
+                f"leaf {jax.tree_util.keystr(path)}: "
+                f"{getattr(b, 'shape', None)}/{getattr(b, 'dtype', None)}"
+                f" != served {getattr(a, 'shape', None)}/"
+                f"{getattr(a, 'dtype', None)}"
+            )
+
+
 def sample_tokens(
     logits: jax.Array,
     rng: jax.Array,
@@ -715,6 +741,9 @@ class ServingEngine:
             )
         self.model = model
         self.params = params
+        # the served weight set's identity — rebind_params() updates it;
+        # the cluster's rolling hot-swap reports it per replica
+        self.weights_version = "initial"
         self.clock = clock
         # telemetry: the tracer records lifecycle spans (one track per
         # slot + a scheduler track; NULL_TRACER = disabled, near-zero
@@ -1267,6 +1296,40 @@ class ServingEngine:
             # so the fresh record's delta-synced counters start at zero
             self.metrics.seed_block_pool(self.pool)
         return self.metrics
+
+    def rebind_params(self, params, version: Optional[str] = None) -> None:
+        """Swap the engine's served weights IN PLACE — the zero-downtime
+        hot-swap entry point (``cluster/swap.py`` drives it per replica).
+
+        The new tree must match the current one exactly in structure,
+        leaf shapes and dtypes (:func:`validate_same_shapes` — the ONE
+        check ``begin_swap``'s typed up-front refusal also uses): every
+        jitted engine fn takes ``params`` as a plain traced operand, so
+        a same-shape rebind reuses every compiled program (no retrace,
+        no recompile — the swap tests pin the jit cache sizes).  A
+        mismatched tree raises ``ValueError`` with the first offending
+        leaf path; an engine with work in flight raises ``RuntimeError``
+        — callers must drain or relocate first, because requests
+        mid-generation would silently continue under different weights
+        (the cluster controller guarantees the idle window; direct users
+        get the loud error).
+        """
+        if self.has_work():
+            raise RuntimeError(
+                f"rebind_params with work in flight ({self.in_flight} "
+                f"slots, {self.scheduler.depth} queued) — drain or "
+                "relocate before swapping weights"
+            )
+        try:
+            validate_same_shapes(self.params, params)
+        except ValueError as exc:
+            raise ValueError(
+                f"rebind_params: {exc} — same-shape swaps only (a "
+                "reshape needs a new engine)"
+            ) from None
+        self.params = params
+        if version is not None:
+            self.weights_version = version
 
     @property
     def decode_steps_per_tick(self) -> int:
