@@ -119,7 +119,8 @@ def run_soak(model, params, cfg, prompts, refs, *, seed, n_replicas,
              n_slots, new_tokens, router="least", horizon=64, dt=0.05,
              max_ticks=4000, watchdog_ticks=3, watchdog_kill_ticks=8,
              max_restarts=3, backoff_seconds=0.4, probation_ticks=4,
-             probation_requests=2, retry_limit=16, swap=False):
+             probation_requests=2, retry_limit=16, swap=False,
+             autopilot=False, autopilot_queue_age_target=None):
     """Drive one seeded storm to completion.  Returns ``(record,
     violations)`` — an empty violations list is a passing soak.
 
@@ -129,11 +130,20 @@ def run_soak(model, params, cfg, prompts, refs, *, seed, n_replicas,
     meaningful) that must resolve — completed with every live replica
     on the new version, or rolled back with every live replica on the
     old one — without wedging, while replicas crash, stall and flap
-    around (and under) it."""
+    around (and under) it.
+
+    ``autopilot=True`` arms the SLO autopilot in SCALE-ONLY trim
+    (``max_shed_fraction=0``: a storm may not lose a single request, so
+    shedding is pinned off while scale-up through the probation gate
+    collides with the crashes and stalls — and, under ``swap=True``,
+    with the mid-storm rollout, where any due scale action must be
+    typed-refused rather than interleave).  Every healing invariant
+    must hold unchanged; the record carries the controller tallies."""
     from tpu_parallel.cluster import (
         BACKOFF,
         DEAD,
         PROBATION,
+        AutopilotPolicy,
         Frontend,
         FrontendConfig,
         ReplicaHandle,
@@ -178,6 +188,25 @@ def run_soak(model, params, cfg, prompts, refs, *, seed, n_replicas,
             watchdog_kill_ticks=watchdog_kill_ticks, restart=policy,
         ),
     )
+    ap = None
+    if autopilot:
+        ap = fe.enable_autopilot(
+            AutopilotPolicy(
+                queue_age_target=(
+                    autopilot_queue_age_target
+                    if autopilot_queue_age_target is not None
+                    else 8 * dt
+                ),
+                window_ticks=4, breach_ticks=2, clear_ticks=8,
+                max_shed_fraction=0.0,  # a storm must lose NO request
+                max_replicas=n_replicas + 2, min_replicas=n_replicas,
+                scale_cooldown_ticks=8,
+                # scale-down stays off: retiring a replica before its
+                # seeded faults fire would tame the storm under test
+                scale_down_idle_ticks=None,
+            ),
+            factory,
+        )
 
     # arrivals spread over the fault horizon, so traffic keeps flowing
     # while replicas crash, stall and come back — plus an AFTERMATH
@@ -267,7 +296,9 @@ def run_soak(model, params, cfg, prompts, refs, *, seed, n_replicas,
     # mid-storm rollout completes or rolls back) before the healing and
     # swap invariants are judged
     while tick < max_ticks and (
-        any(h.health in (BACKOFF, PROBATION) for h in handles)
+        # fe.replicas covers the original fleet AND any autopilot
+        # scale-ups still auditioning in probation
+        any(h.health in (BACKOFF, PROBATION) for h in fe.replicas)
         or fe.swap_status()["state"] in ("rolling", "rolling_back")
         # a storm that resolves before the seeded swap@T tick still
         # ticks on until the operator event FIRES (an idle-fleet swap
@@ -301,7 +332,7 @@ def run_soak(model, params, cfg, prompts, refs, *, seed, n_replicas,
         violations.append(
             f"leaked token-budget reservations: {s['inflight_tokens']}"
         )
-    for h in handles:
+    for h in fe.replicas:  # original fleet + autopilot scale-ups
         if h.health in (DEAD, BACKOFF):
             continue  # abandoned engines owe nothing
         pool = h.engine.pool
@@ -391,6 +422,24 @@ def run_soak(model, params, cfg, prompts, refs, *, seed, n_replicas,
             "probation_ticks": probation_ticks,
             "probation_requests": probation_requests,
         },
+        "autopilot": autopilot,
+        "autopilot_scale_ups": (
+            None if ap is None else s["scale_ups"]
+        ),
+        "autopilot_refusals": (
+            None if ap is None else int(fe.registry.counter(
+                "cluster_autopilot_refusals_total",
+                reason="swap_in_progress",
+            ).value)
+        ),
+        "autopilot_actions": (
+            None if ap is None
+            else [
+                {"tick": a.tick, "kind": a.kind, "reason": a.reason}
+                for a in ap.actions
+            ]
+        ),
+        "fleet_size_final": len(fe.replicas),
         "swap": swap,
         "swap_at_tick": swap_tick,
         "swap_state": swap_status["state"],
@@ -435,6 +484,16 @@ def main():
                     help="arm the seeded swap@T operator event: a "
                          "null-value rolling weight swap collides with "
                          "the storm and must resolve without wedging")
+    ap.add_argument("--autopilot", action="store_true",
+                    help="arm the SLO autopilot in scale-only trim "
+                         "(shedding pinned off): autoscaling collides "
+                         "with the storm — and any mid-swap scale is "
+                         "typed-refused — under the same invariants")
+    ap.add_argument("--autopilot-queue-age-target", type=float,
+                    default=None,
+                    help="autopilot breach target in seconds (default "
+                         "8 x dt); lower it to force scale activity "
+                         "in small storms")
     ap.add_argument("--record", type=str, default="",
                     help="write the soak record to this JSON file")
     args = ap.parse_args()
@@ -463,6 +522,8 @@ def main():
         n_replicas=args.replicas, n_slots=args.slots,
         new_tokens=new_tokens, router=args.router, horizon=args.horizon,
         max_ticks=args.max_ticks, swap=args.swap,
+        autopilot=args.autopilot,
+        autopilot_queue_age_target=args.autopilot_queue_age_target,
     )
     print(json.dumps(record, indent=2))
     if args.record:

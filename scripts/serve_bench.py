@@ -17,6 +17,8 @@ Usage:
       [--prefix-groups G] [--trace-out FILE] [--metrics-out FILE]
       [--trace-record FILE] [--trace-replay FILE --time-compress X]
       [--swap-bench --swap-at T --swap-record FILE]
+      [--autopilot --autopilot-record FILE]
+      [--priority-dist SPEC] [--deadline-dist SPEC]
       [--seed K] [--out FILE]
 
 Workload record/replay: ``--trace-record PATH`` dumps the generated
@@ -24,6 +26,23 @@ request schedule (arrival, prompt, prefix group, priority, deadline)
 as JSONL; ``--trace-replay PATH`` re-feeds a recorded schedule through
 the same runners — single-engine or cluster — with ``--time-compress
 X`` dividing every arrival gap (a day-in-the-life at 10-100x).
+``--priority-dist`` / ``--deadline-dist`` (``VALUE:WEIGHT,...``;
+deadlines accept ``none``) shape the generated schedule's priority
+classes and per-request deadlines from weighted draws on a child rng —
+recorded traces carry the drawn values, so a replayed overload trace
+exercises priority shedding exactly as recorded.
+
+``--autopilot`` is the SLO-autopilot acceptance bench (SERVE_r06,
+docs/12): deterministic fake-clock legs over one seeded 2x-overload
+schedule — a no-autopilot leg whose queue age diverges and deadlines
+miss en masse, then the same schedule with the autopilot shedding a
+bounded lowest-priority slice and scaling the fleet through the
+probation gate.  Exits nonzero unless non-shed deadline misses stay
+under 5%, queue-age p95 stays bounded, the shed fraction respects the
+policy bound, every finished request is bitwise identical to the
+single-engine baseline, and the typed action log replays bit-for-bit;
+``--autopilot-record`` writes the record.  The same gate runs (without
+the determinism re-run) as part of ``--smoke``.
 
 ``--swap-bench`` is the rolling weight hot-swap acceptance bench
 (docs/12): three deterministic fake-clock legs over one schedule —
@@ -126,26 +145,66 @@ def make_prompts(cfg, *, n_requests, prompt_min, prompt_max, prefix_len,
     return prompts, groups
 
 
-def build_schedule(prompts, groups, rate, seed, new_tokens):
+def parse_dist(spec):
+    """``VALUE:WEIGHT,...`` -> ``[(value, weight), ...]`` — the
+    ``--priority-dist`` / ``--deadline-dist`` exchange format.  Values
+    parse as numbers; ``none`` (deadlines: no deadline) stays None.
+    Weights are relative (they need not sum to 1)."""
+    out = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            val_s, _, w_s = part.partition(":")
+            value = None if val_s.lower() == "none" else float(val_s)
+            weight = float(w_s) if w_s else 1.0
+        except ValueError:
+            raise SystemExit(f"bad dist entry {part!r} (want VALUE:WEIGHT)")
+        if weight <= 0:
+            raise SystemExit(f"dist entry {part!r}: weight must be > 0")
+        out.append((value, weight))
+    if not out:
+        raise SystemExit(f"empty dist spec {spec!r}")
+    return out
+
+
+def build_schedule(prompts, groups, rate, seed, new_tokens,
+                   priority_dist=None, deadline_dist=None):
     """The bench's request schedule as data: one dict per request with
     arrival (seconds from t0, same Poisson draw the runners always
     made), the prompt itself, and the workload-shape fields the cluster
     frontend consumes (priority, deadline).  This is the unit
-    ``--trace-record`` dumps and ``--trace-replay`` re-feeds."""
+    ``--trace-record`` dumps and ``--trace-replay`` re-feeds.
+
+    ``priority_dist`` / ``deadline_dist`` (:func:`parse_dist` output)
+    draw each request's priority class and deadline from weighted
+    distributions on a CHILD rng, so the arrival stream — and therefore
+    every pre-existing record at the same seed — is bit-identical with
+    the knobs off, and the shaped schedule is still a pure function of
+    (seed, dists)."""
     rnd = random.Random(seed)
     arrivals, t = [], 0.0
     for _ in prompts:
         arrivals.append(t)
         if rate > 0:
             t += rnd.expovariate(rate)
+    shape = random.Random(seed ^ 0x5EED0D15)
+    def draw(dist, cast):
+        if dist is None:
+            return None
+        vals = [v for v, _ in dist]
+        weights = [w for _, w in dist]
+        v = shape.choices(vals, weights=weights)[0]
+        return None if v is None else cast(v)
     return [
         {
             "arrival": round(a, 6),
             "prompt": list(p),
             "prompt_len": len(p),
             "prefix_group": g,
-            "priority": 0,
-            "deadline": None,
+            "priority": draw(priority_dist, int) or 0,
+            "deadline": draw(deadline_dist, float),
             "max_new_tokens": new_tokens,
         }
         for a, p, g in zip(arrivals, prompts, groups)
@@ -203,7 +262,8 @@ def _schedule_request(entry, on_token=None):
 
 
 def run_point(model, params, cfg, prompts, *, rate, n_slots, new_tokens,
-              seed, engine_kwargs, label, tracer=None, schedule=None):
+              seed, engine_kwargs, label, tracer=None, schedule=None,
+              priority_dist=None, deadline_dist=None):
     from tpu_parallel.serving import (
         Request,
         SchedulerConfig,
@@ -215,7 +275,8 @@ def run_point(model, params, cfg, prompts, *, rate, n_slots, new_tokens,
     # supplies the whole schedule instead
     if schedule is None:
         schedule = build_schedule(
-            prompts, [0] * len(prompts), rate, seed, new_tokens
+            prompts, [0] * len(prompts), rate, seed, new_tokens,
+            priority_dist=priority_dist, deadline_dist=deadline_dist,
         )
     prompts = [e["prompt"] for e in schedule]
     arrivals = [e["arrival"] for e in schedule]
@@ -359,7 +420,8 @@ def parse_fault_spec(spec: str):
 def run_cluster_point(model, params, cfg, prompts, *, rate, n_replicas,
                       router, n_slots, new_tokens, seed, engine_kwargs,
                       fault_plans=None, chaos_seed=None, warm=True,
-                      tracer=None, schedule=None):
+                      tracer=None, schedule=None, priority_dist=None,
+                      deadline_dist=None):
     """One cluster-mode measurement: ``n_replicas`` engines behind the
     Frontend under the given router policy, same Poisson arrival stream
     as :func:`run_point`.  ``fault_plans`` (replica id -> FaultPlan, see
@@ -393,7 +455,8 @@ def run_cluster_point(model, params, cfg, prompts, *, rate, n_replicas,
 
     if schedule is None:
         schedule = build_schedule(
-            prompts, [0] * len(prompts), rate, seed, new_tokens
+            prompts, [0] * len(prompts), rate, seed, new_tokens,
+            priority_dist=priority_dist, deadline_dist=deadline_dist,
         )
     prompts = [e["prompt"] for e in schedule]
     arrivals = [e["arrival"] for e in schedule]
@@ -766,6 +829,297 @@ def run_swap_bench(model, params, cfg, schedule, *, n_replicas, n_slots,
     return record, violations
 
 
+def run_autopilot_bench(model, params, cfg, *, n_replicas=2, max_replicas=4,
+                        n_slots=2, router="least", seed=0, dt=0.05,
+                        n_requests=96, new_tokens=8, overload=2.0,
+                        logger=None, determinism_check=False):
+    """The SLO-autopilot acceptance bench (SERVE_r06): deterministic
+    fake-clock legs over ONE seeded overload schedule — offered load
+    ``overload`` x the starting fleet's service capacity, mixed priority
+    classes, per-request deadlines.
+
+    1. ``baseline`` — a single no-fault engine serves every prompt with
+       no deadlines: the greedy reference tokens.
+    2. ``no_autopilot`` — the fixed fleet under the overload: the
+       backlog grows without bound, queue-age p95 diverges, and late
+       arrivals blow their deadlines en masse.
+    3. ``autopilot`` — same schedule, autopilot armed: shed a bounded
+       lowest-priority slice early (typed ``shed``), scale to
+       ``max_replicas`` through the probation gate, retune admission.
+
+    Invariants (the returned violations list is empty on pass):
+    deadline-miss rate of NON-SHED requests < 5% while the no-autopilot
+    leg misses worse; the autopilot leg's peak windowed queue-age p95
+    stays bounded while the no-autopilot leg's diverges past it; shed
+    fraction <= the policy's ``max_shed_fraction``; and every FINISHED
+    request's greedy tokens are bitwise identical to the single-engine
+    baseline.  ``determinism_check=True`` re-runs the autopilot leg and
+    requires an identical typed action log.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_parallel.cluster import (
+        AutopilotPolicy,
+        Frontend,
+        FrontendConfig,
+        ReplicaHandle,
+        RestartPolicy,
+    )
+    from tpu_parallel.models.generate import generate
+    from tpu_parallel.serving import SchedulerConfig, ServingEngine
+
+    rnd = random.Random(seed)
+    prompts = [
+        [rnd.randrange(1, cfg.vocab_size)
+         for _ in range(rnd.randint(3, min(12, cfg.seq_len - new_tokens - 2)))]
+        for _ in range(n_requests)
+    ]
+    # per-step decode at one tick per token: the starting fleet retires
+    # about n_replicas * n_slots / (new_tokens + 1) requests per tick,
+    # so this arrival rate is `overload` x sustainable capacity
+    capacity = n_replicas * n_slots / ((new_tokens + 1) * dt)
+    rate = overload * capacity
+    # deadlines sized to be comfortable at fleet capacity and hopeless
+    # in an unbounded backlog; low priority is the sheddable slice
+    deadline = 3.0 * (new_tokens + 1) * dt
+    schedule = build_schedule(
+        prompts, [0] * len(prompts), rate, seed, new_tokens,
+        priority_dist=[(0, 6), (1, 3), (2, 1)],
+        deadline_dist=[(deadline, 3), (2 * deadline, 1)],
+    )
+
+    refs = [
+        [int(x) for x in np.asarray(generate(
+            model, params, jnp.asarray(p, jnp.int32)[None, :],
+            max_new_tokens=new_tokens,
+        ))[0]]
+        for p in prompts
+    ]
+
+    t = [0.0]
+    clock = lambda: t[0]  # noqa: E731 — the bench's injectable time axis
+
+    def factory():
+        return ServingEngine(
+            model, params, n_slots=n_slots,
+            scheduler=SchedulerConfig(max_prefills_per_tick=2),
+            clock=clock, decode_steps_per_tick=1,
+        )
+
+    policy = AutopilotPolicy(
+        queue_age_target=(new_tokens + 1) * dt,
+        window_ticks=8, breach_ticks=2, clear_ticks=8,
+        max_shed_fraction=0.4,
+        # provably-unmeetable estimate: a queued request needs at least
+        # one prefill tick + one decode tick per remaining token
+        min_service_seconds=dt,
+        service_seconds_per_token=dt,
+        max_replicas=max_replicas, min_replicas=n_replicas,
+        scale_cooldown_ticks=8, scale_down_idle_ticks=32,
+        prefill_surge_share=n_slots,
+    )
+
+    def run_leg(autopilot, max_ticks=6000):
+        t[0] = 0.0
+        handles = [
+            ReplicaHandle(i, factory(), engine_factory=factory)
+            for i in range(n_replicas)
+        ]
+        fe = Frontend(
+            handles, router=router, clock=clock,
+            config=FrontendConfig(
+                retry_limit=8, watchdog_ticks=6, watchdog_kill_ticks=24,
+                restart=RestartPolicy(
+                    backoff_seconds=4 * dt, probation_ticks=3,
+                    probation_requests=2,
+                ),
+            ),
+        )
+        ap = fe.enable_autopilot(policy, factory) if autopilot else None
+        outs, submitted, ticks = [], 0, 0
+        peak_qage95 = 0.0
+        while ticks < max_ticks:
+            now = ticks * dt
+            while (
+                submitted < len(schedule)
+                and schedule[submitted]["arrival"] <= now
+            ):
+                outs.append(
+                    fe.submit(_schedule_request(schedule[submitted]))
+                )
+                submitted += 1
+            t[0] += dt
+            fe.step()
+            ticks += 1
+            if ap is not None:
+                peak_qage95 = max(peak_qage95, ap._qage_p95())
+            else:
+                # the IDENTICAL sense function the autopilot reads
+                # (cluster_queue_age), so both legs report a comparable
+                # trajectory.  The raw per-tick value upper-bounds the
+                # windowed p95, which only makes the divergence gate
+                # harder to fake.
+                from tpu_parallel.cluster.autopilot import (
+                    cluster_queue_age,
+                )
+
+                peak_qage95 = max(
+                    peak_qage95, cluster_queue_age(fe, t[0])
+                )
+            if submitted >= len(schedule) and not fe.has_work():
+                break
+        return fe, ap, outs, ticks, peak_qage95
+
+    violations = []
+
+    def check(cond, msg):
+        if not cond:
+            violations.append(msg)
+
+    def leg_stats(outs):
+        shed = [o for o in outs if o.finish_reason == "shed"]
+        nonshed = [o for o in outs if o.finish_reason != "shed"]
+        missed = [o for o in nonshed if o.finish_reason == "deadline"]
+        finished = [o for o in nonshed if o.status == "finished"]
+        return shed, nonshed, missed, finished
+
+    fe0, _, outs0, ticks0, peak0 = run_leg(autopilot=False)
+    shed0, nonshed0, missed0, finished0 = leg_stats(outs0)
+    miss_rate0 = len(missed0) / max(1, len(nonshed0))
+
+    fe1, ap1, outs1, ticks1, peak1 = run_leg(autopilot=True)
+    shed1, nonshed1, missed1, finished1 = leg_stats(outs1)
+    miss_rate1 = len(missed1) / max(1, len(nonshed1))
+    shed_fraction = len(shed1) / max(1, len(outs1))
+
+    check(
+        all(o.done for o in outs0) and all(o.done for o in outs1),
+        "non-termination: open requests at the end of a leg",
+    )
+    check(
+        miss_rate1 < 0.05,
+        f"autopilot leg non-shed deadline-miss rate {miss_rate1:.3f} "
+        ">= 5%",
+    )
+    check(
+        miss_rate0 > miss_rate1,
+        f"no-autopilot leg should miss worse ({miss_rate0:.3f} vs "
+        f"{miss_rate1:.3f}) — overload too tame to prove anything",
+    )
+    check(
+        shed_fraction <= policy.max_shed_fraction,
+        f"shed fraction {shed_fraction:.3f} > policy bound "
+        f"{policy.max_shed_fraction}",
+    )
+    qage_bound = 4.0 * policy.queue_age_target
+    check(
+        peak1 <= qage_bound,
+        f"autopilot queue-age p95 peak {peak1:.3f}s not bounded "
+        f"(> {qage_bound:.3f}s)",
+    )
+    # the no-autopilot backlog age is structurally capped by deadline
+    # enforcement (a pending request is cancelled once past its
+    # deadline), so "diverges" = well past the SLO target AND at least
+    # twice the controlled leg's peak
+    check(
+        peak0 > max(policy.queue_age_target, 2.0 * peak1),
+        f"no-autopilot queue age {peak0:.3f}s never diverged "
+        f"(target {policy.queue_age_target:.3f}s, autopilot peak "
+        f"{peak1:.3f}s) — overload too tame",
+    )
+    for i, out in enumerate(outs1):
+        if out.status == "finished":
+            check(
+                list(out.tokens) == refs[i],
+                f"autopilot leg request {i} diverged from the "
+                "single-engine baseline",
+            )
+    check(
+        fe1.summary()["scale_ups"] >= 1,
+        "autopilot never scaled up under 2x overload",
+    )
+
+    action_log = [
+        (a.tick, a.kind, a.reason, a.detail) for a in ap1.actions
+    ]
+    if determinism_check:
+        _, ap2, outs2, _, _ = run_leg(autopilot=True)
+        log2 = [(a.tick, a.kind, a.reason, a.detail) for a in ap2.actions]
+        check(
+            action_log == log2,
+            "autopilot action log not deterministic across identical runs",
+        )
+        check(
+            [(o.status, o.finish_reason, list(o.tokens)) for o in outs1]
+            == [(o.status, o.finish_reason, list(o.tokens)) for o in outs2],
+            "autopilot leg outcomes not deterministic across identical "
+            "runs",
+        )
+
+    s1 = fe1.summary()
+    record = {
+        "bench": "serve_autopilot",
+        "model": getattr(cfg, "_name", None) or (
+            "gpt2_125m" if jax.default_backend() == "tpu" else "tiny"
+        ),
+        "backend": jax.default_backend(),
+        "seed": seed,
+        "replicas": n_replicas,
+        "max_replicas": max_replicas,
+        "router": router,
+        "n_requests": n_requests,
+        "n_slots": n_slots,
+        "new_tokens": new_tokens,
+        "dt": dt,
+        "overload_factor": overload,
+        "arrival_rate_per_sec": round(rate, 3),
+        "deadline_seconds": deadline,
+        "policy": {
+            "queue_age_target": policy.queue_age_target,
+            "window_ticks": policy.window_ticks,
+            "breach_ticks": policy.breach_ticks,
+            "clear_ticks": policy.clear_ticks,
+            "max_shed_fraction": policy.max_shed_fraction,
+            "scale_cooldown_ticks": policy.scale_cooldown_ticks,
+            "scale_down_idle_ticks": policy.scale_down_idle_ticks,
+        },
+        "no_autopilot": {
+            "ticks": ticks0,
+            "peak_queue_age_p95_s": round(peak0, 4),
+            "deadline_miss_rate": round(miss_rate0, 4),
+            "finished": len(finished0),
+            "deadline_missed": len(missed0),
+        },
+        "autopilot": {
+            "ticks": ticks1,
+            "peak_queue_age_p95_s": round(peak1, 4),
+            "deadline_miss_rate": round(miss_rate1, 4),
+            "finished": len(finished1),
+            "deadline_missed": len(missed1),
+            "shed": len(shed1),
+            "shed_fraction": round(shed_fraction, 4),
+            "scale_ups": s1["scale_ups"],
+            "scale_downs": s1["scale_downs"],
+            "final_replicas": len(fe1.replicas),
+            "actions": [
+                {"tick": a.tick, "kind": a.kind, "reason": a.reason}
+                for a in ap1.actions
+            ],
+        },
+        "bitwise_exact_finished": all(
+            list(out.tokens) == refs[i]
+            for i, out in enumerate(outs1)
+            if out.status == "finished"
+        ),
+        "invariants_ok": not violations,
+        "violations": violations,
+    }
+    if logger is not None:
+        logger.log_record(record)
+    return record, violations
+
+
 def run_capacity_probe(model, params, cfg, *, seed, logger):
     """The paged layout's capacity claim, measured at EQUAL pool bytes:
     a fixed-slot pool of ``s_fixed`` rows vs a paged pool holding the
@@ -980,6 +1334,16 @@ def smoke(model, params, cfg, prompts, new_tokens):
                 file=sys.stderr,
             )
             failures += 1
+    # SLO-autopilot invariant gate: a compact deterministic fake-clock
+    # overload run — the controller must keep non-shed deadline misses
+    # under 5%, bound queue-age p95, respect the shed-fraction bound,
+    # and keep every finished request bitwise identical to the
+    # single-engine baseline (the standalone --autopilot bench adds the
+    # action-log determinism re-run on top)
+    _, ap_problems = run_autopilot_bench(model, params, cfg, seed=0)
+    for problem in ap_problems:
+        print(f"SMOKE FAIL [autopilot] {problem}", file=sys.stderr)
+        failures += 1
     print(
         "smoke: PASS" if failures == 0 else f"smoke: {failures} FAILURES"
     )
@@ -1067,6 +1431,21 @@ def main():
                     help="swap-bench: fake-clock seconds per tick")
     ap.add_argument("--swap-record", type=str, default="",
                     help="swap-bench: write the record to this JSON file")
+    ap.add_argument("--autopilot", action="store_true",
+                    help="SLO-autopilot overload bench on a fake clock: "
+                         "no-autopilot vs autopilot legs over one seeded "
+                         "2x-overload schedule + action-log determinism "
+                         "re-run; nonzero exit on any invariant violation")
+    ap.add_argument("--autopilot-record", type=str, default="",
+                    help="autopilot bench: write the record to this JSON "
+                         "file (SERVE_r06.json)")
+    ap.add_argument("--priority-dist", type=str, default="",
+                    help="weighted priority classes for the generated "
+                         "schedule, VALUE:WEIGHT,... (e.g. '0:6,1:3,2:1')")
+    ap.add_argument("--deadline-dist", type=str, default="",
+                    help="weighted per-request deadlines (seconds) for "
+                         "the generated schedule, VALUE:WEIGHT,... with "
+                         "'none' for no deadline (e.g. '2.0:3,none:1')")
     ap.add_argument("--prefix-groups", type=int, default=4,
                     help="distinct shared system-headers in the "
                          "--prompt-dist workload (cluster mode: the "
@@ -1129,6 +1508,12 @@ def main():
     # point's schedule; --trace-replay swaps the generated workload for
     # a recorded one (time-compressed), feeding the SAME runners
     replay = None
+    priority_dist = (
+        parse_dist(args.priority_dist) if args.priority_dist else None
+    )
+    deadline_dist = (
+        parse_dist(args.deadline_dist) if args.deadline_dist else None
+    )
     if args.trace_replay:
         replay = load_trace(args.trace_replay, args.time_compress)
         rates = rates[:1]  # the trace IS the arrival process
@@ -1136,16 +1521,44 @@ def main():
         recorded = write_trace(
             args.trace_record,
             build_schedule(prompts, groups, rates[0], args.seed,
-                           new_tokens),
+                           new_tokens, priority_dist=priority_dist,
+                           deadline_dist=deadline_dist),
             meta=dict(
                 seed=args.seed, rate=rates[0],
                 n_requests=args.requests, new_tokens=new_tokens,
                 prefix_groups=(
                     args.prefix_groups if args.prompt_dist else 1
                 ),
+                priority_dist=args.priority_dist or None,
+                deadline_dist=args.deadline_dist or None,
             ),
         )
         print(f"trace recorded: {recorded}")
+
+    if args.autopilot:
+        import json
+
+        logger = MetricLogger(logdir=".", name=args.out)
+        record, violations = run_autopilot_bench(
+            model, params, cfg, router=args.router.split(",")[0],
+            seed=args.seed, determinism_check=True, logger=logger,
+        )
+        logger.close()
+        print(json.dumps(record, indent=2))
+        if args.autopilot_record:
+            with open(args.autopilot_record, "w") as fh:
+                json.dump(record, fh, indent=2)
+                fh.write("\n")
+            print(f"record: {args.autopilot_record}")
+        if violations:
+            print(
+                f"autopilot_bench: {len(violations)} INVARIANT "
+                "VIOLATION(S)",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        print("autopilot_bench: all invariants held")
+        return
 
     if args.swap_bench:
         import json
@@ -1270,6 +1683,8 @@ def main():
                     seed=args.seed, engine_kwargs=dict(fast),
                     fault_plans=fault_plans, chaos_seed=args.chaos,
                     warm=warm, tracer=tracer, schedule=replay,
+                    priority_dist=priority_dist,
+                    deadline_dist=deadline_dist,
                 )
                 if fault_spec:
                     record["fault_spec"] = fault_spec
@@ -1314,6 +1729,7 @@ def main():
                 rate=rate, n_slots=args.slots, new_tokens=new_tokens,
                 seed=args.seed, engine_kwargs=engine_kwargs, label=label,
                 tracer=tracer, schedule=replay,
+                priority_dist=priority_dist, deadline_dist=deadline_dist,
             )
             if replay is not None:
                 record["trace_replay"] = args.trace_replay

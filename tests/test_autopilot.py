@@ -1,0 +1,881 @@
+"""SLO-autopilot tests: closed-loop overload control over the cluster
+frontend — hysteresis-gated shedding (typed ``shed``, lowest effective
+priority first, bounded by ``max_shed_fraction``), replica autoscaling
+through the probation gate (typed-refused mid-swap), admission retuning
+within bounds, prefix-ring rebalancing, the pre-dispatch deadline shed
+bugfix, and the headline reproducibility guarantee: same trace + same
+policy => identical typed action log."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_parallel.cluster import (
+    AP_REFUSED,
+    AP_REFUSED_SWAP,
+    AP_SCALE_DOWN,
+    AP_SCALE_UP,
+    AP_SHED_CANCEL,
+    AP_SHED_OFF,
+    AP_SHED_ON,
+    HEALTHY,
+    PROBATION,
+    RETIRED,
+    AutopilotPolicy,
+    Frontend,
+    FrontendConfig,
+    PrefixAffinityRouter,
+    ReplicaHandle,
+    RestartPolicy,
+)
+from tpu_parallel.models import GPTLM, tiny_test
+from tpu_parallel.models.generate import generate
+from tpu_parallel.serving import (
+    CANCELLED,
+    REJECT_SHED,
+    REJECTED,
+    FIFOScheduler,
+    Request,
+    SchedulerConfig,
+    ServingEngine,
+)
+
+
+@pytest.fixture(scope="module")
+def env():
+    cfg = tiny_test(dtype=jnp.float32, remat=False)
+    model = GPTLM(cfg)
+    rng = jax.random.PRNGKey(11)
+    lens = [3, 5, 7, 4, 6, 8]
+    prompts = [
+        [int(t) for t in np.asarray(
+            jax.random.randint(
+                jax.random.fold_in(rng, i), (L,), 1, cfg.vocab_size
+            )
+        )]
+        for i, L in enumerate(lens)
+    ]
+    probe = jax.random.randint(rng, (1, max(lens)), 1, cfg.vocab_size)
+    params = model.init(
+        {"params": jax.random.PRNGKey(1)}, probe, train=False
+    )["params"]
+    refs = [
+        np.asarray(generate(
+            model, params, jnp.asarray(p, jnp.int32)[None, :],
+            max_new_tokens=6,
+        ))[0]
+        for p in prompts
+    ]
+    return cfg, model, params, prompts, refs
+
+
+def _fleet(env, clock, n=2, slots=2, **fe_kw):
+    cfg, model, params, _, _ = env
+
+    def factory():
+        return ServingEngine(
+            model, params, n_slots=slots,
+            scheduler=SchedulerConfig(max_prefills_per_tick=2),
+            clock=clock, decode_steps_per_tick=1,
+        )
+
+    handles = [
+        ReplicaHandle(i, factory(), engine_factory=factory)
+        for i in range(n)
+    ]
+    kw = dict(
+        router="least", clock=clock,
+        config=FrontendConfig(
+            watchdog_ticks=4, watchdog_kill_ticks=16,
+            restart=RestartPolicy(
+                backoff_seconds=0.1, probation_ticks=2,
+                probation_requests=2,
+            ),
+        ),
+    )
+    kw.update(fe_kw)
+    return Frontend(handles, **kw), factory
+
+
+# -- policy validation -------------------------------------------------------
+
+
+def test_policy_validation():
+    AutopilotPolicy(max_replicas=4)  # defaults are coherent
+    with pytest.raises(ValueError):
+        AutopilotPolicy(queue_age_target=0.0)
+    with pytest.raises(ValueError):
+        AutopilotPolicy(max_shed_fraction=1.5)
+    with pytest.raises(ValueError):
+        AutopilotPolicy(max_replicas=1, min_replicas=2)
+    with pytest.raises(ValueError):
+        AutopilotPolicy(token_budget_bounds=(8, 4))
+    with pytest.raises(ValueError):
+        AutopilotPolicy(imbalance_factor=1.0)
+    with pytest.raises(ValueError):
+        AutopilotPolicy(breach_ticks=0)
+
+
+def test_scheduler_retune_hook():
+    """The autopilot's scheduler hook: live max_prefills_per_tick /
+    max_queue changes, validated, leaving queued entries alone."""
+    sched = FIFOScheduler(SchedulerConfig(max_prefills_per_tick=1))
+    cfg = sched.retune(max_prefills_per_tick=4)
+    assert cfg.max_prefills_per_tick == 4
+    assert sched.config.max_prefills_per_tick == 4
+    sched.retune(max_queue=2)
+    assert sched.config.max_queue == 2
+    assert sched.config.max_prefills_per_tick == 4  # untouched
+    with pytest.raises(ValueError):
+        sched.retune(max_prefills_per_tick=0)
+
+
+# -- pre-dispatch deadline shed (satellite bugfix) ---------------------------
+
+
+def test_expired_in_queue_never_dispatched(env):
+    """Regression: a request whose deadline expired while pending must
+    be dropped AT DISPATCH (typed ``deadline``), never handed to a
+    replica for a wasted prefill — and the cancel counters observe it
+    exactly once."""
+    _, _, _, prompts, _ = env
+    t = [0.0]
+    fe, _ = _fleet(env, lambda: t[0], n=1, slots=1)
+    # occupy the only slot so the victim waits in the frontend backlog
+    blocker = fe.submit(Request(prompt=prompts[0], max_new_tokens=6))
+    fe.step()
+    victim = fe.submit(
+        Request(prompt=prompts[1], max_new_tokens=6, deadline=0.5)
+    )
+    # the deadline expires while the request is still pending; the next
+    # dispatch pass must cancel instead of place
+    t[0] = 1.0
+    fe.step()
+    assert victim.status == CANCELLED
+    assert victim.finish_reason == "deadline"
+    assert victim.replicas == []  # never reached a replica
+    assert fe.registry.counter("cluster_cancelled_total").value == 1.0
+    assert (
+        fe.registry.counter(
+            "cluster_dispatched_total", replica=0
+        ).value == 1.0  # only the blocker was ever dispatched
+    )
+    fe.run(max_ticks=60)
+    assert blocker.status == "finished"
+
+
+def test_dispatch_deadline_checked_on_mid_tick_clock(env):
+    """The tick's SECOND dispatch pass reads a fresh clock: a deadline
+    expiring mid-tick (engine work advanced the clock) is still caught
+    before placement."""
+    _, _, _, prompts, _ = env
+    t = [0.0]
+
+    def clock():  # advances on every read — the adversarial clock
+        t[0] += 0.2
+        return t[0]
+
+    fe, _ = _fleet(env, clock, n=1, slots=1)
+    out = fe.submit(Request(prompt=prompts[0], max_new_tokens=4,
+                            deadline=0.6))
+    for _ in range(30):
+        if out.done:
+            break
+        fe.step()
+    assert out.done
+    # wherever it died, it must never have been dispatched after expiry:
+    # a cancelled-by-deadline request with zero replica attempts proves
+    # the dispatch-time check fired (placement would have recorded one)
+    if out.status == CANCELLED:
+        assert out.finish_reason == "deadline"
+
+
+# -- shedding ----------------------------------------------------------------
+
+
+def _overload(fe, prompts, t, n=12, priority=0, deadline=None):
+    outs = []
+    for i in range(n):
+        outs.append(fe.submit(Request(
+            prompt=list(prompts[i % len(prompts)]), max_new_tokens=6,
+            priority=priority, deadline=deadline,
+        )))
+    return outs
+
+
+def test_shed_hysteresis_and_typed_reject(env):
+    """Backlog age past target for breach_ticks => shedding engages; a
+    NEW lowest-priority submission rejects typed ``shed``; a clear
+    window disengages (asymmetric hysteresis, both transitions logged)."""
+    _, _, _, prompts, _ = env
+    t = [0.0]
+    fe, _ = _fleet(env, lambda: t[0], n=1, slots=1)
+    ap = fe.enable_autopilot(AutopilotPolicy(
+        queue_age_target=0.5, window_ticks=4, breach_ticks=2,
+        clear_ticks=3, max_shed_fraction=1.0, max_replicas=1,
+    ))
+    _overload(fe, prompts, t, n=8)
+    # age the backlog past the target without letting it drain
+    for _ in range(3):
+        t[0] += 0.4
+        fe.step()
+    assert ap.shedding
+    assert [a.kind for a in ap.actions][:1] == [AP_SHED_ON]
+    low = fe.submit(Request(prompt=prompts[0], max_new_tokens=4,
+                            priority=0))
+    assert low.status == REJECTED and low.finish_reason == REJECT_SHED
+    # a HIGHER class than everything pending sails through the shed gate
+    high = fe.submit(Request(prompt=prompts[1], max_new_tokens=4,
+                             priority=99))
+    assert high.status != REJECTED
+    fe.run(max_ticks=400)
+    for _ in range(6):  # idle clear window disengages
+        t[0] += 0.05
+        fe.step()
+    assert not ap.shedding
+    kinds = [a.kind for a in ap.actions]
+    assert AP_SHED_OFF in kinds
+    assert fe.registry.counter(
+        "cluster_rejected_total", reason="shed"
+    ).value == 1.0
+
+
+def test_shed_fraction_bound(env):
+    """The shed budget is hard: at max_shed_fraction=0.25 at most a
+    quarter of a window's submissions shed, the rest admit."""
+    _, _, _, prompts, _ = env
+    t = [0.0]
+    fe, _ = _fleet(env, lambda: t[0], n=1, slots=1)
+    ap = fe.enable_autopilot(AutopilotPolicy(
+        queue_age_target=0.3, window_ticks=100, breach_ticks=2,
+        clear_ticks=50, max_shed_fraction=0.25, max_replicas=1,
+    ))
+    _overload(fe, prompts, t, n=6)
+    for _ in range(3):
+        t[0] += 0.4
+        fe.step()
+    assert ap.shedding
+    outs = _overload(fe, prompts, t, n=20)
+    shed = [o for o in outs if o.finish_reason == REJECT_SHED]
+    admitted = [o for o in outs if not o.done]
+    total_submitted = fe._submitted.value - ap._win_sub0
+    assert shed, "nothing shed under sustained overload"
+    assert len(shed) <= 0.25 * total_submitted
+    assert admitted, "shedding must stay a bounded slice, not a rout"
+    # zero max_shed_fraction pins shedding fully off (chaos-soak trim)
+    assert AutopilotPolicy(max_shed_fraction=0.0, max_replicas=1)
+
+
+def test_shed_cancels_provably_unmeetable(env):
+    """While shedding, a QUEUED request whose deadline cannot be met
+    (waited + estimate > deadline) is cancelled typed ``shed`` before
+    wasting a prefill; meetable neighbors survive."""
+    _, _, _, prompts, _ = env
+    t = [0.0]
+    fe, _ = _fleet(env, lambda: t[0], n=1, slots=1)
+    fe.enable_autopilot(AutopilotPolicy(
+        queue_age_target=0.3, window_ticks=8, breach_ticks=2,
+        clear_ticks=8, max_shed_fraction=1.0,
+        min_service_seconds=0.1, service_seconds_per_token=0.1,
+        max_replicas=1,
+    ))
+    blocker = fe.submit(Request(prompt=prompts[0], max_new_tokens=6))
+    fe.step()
+    # 6 tokens * 0.1s + 0.1s floor = 0.7s estimate: at t=0.8 a 1.4s
+    # deadline is provably gone while a 10s deadline is comfortable
+    doomed = fe.submit(Request(prompt=prompts[1], max_new_tokens=6,
+                               deadline=1.4))
+    fine = fe.submit(Request(prompt=prompts[2], max_new_tokens=6,
+                             deadline=10.0))
+    for _ in range(3):
+        t[0] += 0.4
+        fe.step()
+    assert doomed.status == CANCELLED
+    assert doomed.finish_reason == REJECT_SHED
+    assert not fine.done or fine.status == "finished"
+    assert fe.registry.counter(
+        "cluster_autopilot_shed_total", kind="cancel"
+    ).value == 1.0
+    fe.run(max_ticks=400)
+    assert blocker.status == "finished" and fine.status == "finished"
+
+
+def test_shed_floor_covers_engine_queued_backlog(env):
+    """Review regression: when the backlog lives in ENGINE queues (the
+    frontend backlog is empty), the shed floor still ranks against the
+    queued work — a higher class sails through, and with nothing
+    waiting anywhere no arrival is shed at all."""
+    _, _, _, prompts, _ = env
+    t = [0.0]
+    fe, _ = _fleet(env, lambda: t[0], n=1, slots=1)
+    ap = fe.enable_autopilot(AutopilotPolicy(
+        queue_age_target=0.3, window_ticks=8, breach_ticks=2,
+        clear_ticks=50, max_shed_fraction=1.0, max_replicas=1,
+    ))
+    # r0 runs, r1 sits in the ENGINE queue; frontend backlog drains empty
+    fe.submit(Request(prompt=prompts[0], max_new_tokens=20, priority=0))
+    fe.submit(Request(prompt=prompts[1], max_new_tokens=20, priority=0))
+    t[0] += 0.4
+    fe.step()
+    t[0] += 0.4
+    fe.step()
+    assert ap.shedding
+    assert not fe._pending  # the waiting work is all engine-queued
+    assert fe.replicas[0].engine.scheduler.depth >= 1
+    high = fe.submit(Request(prompt=prompts[2], max_new_tokens=4,
+                             priority=5))
+    assert high.status != REJECTED  # ranks above the queued floor
+    low = fe.submit(Request(prompt=prompts[3], max_new_tokens=4,
+                            priority=0))
+    assert low.status == REJECTED and low.finish_reason == REJECT_SHED
+    fe.run(max_ticks=600)
+    # shedding may still be engaged, but with NOTHING waiting anywhere
+    # an arrival of any class admits (floor is None => no shed)
+    if ap.shedding:
+        out = fe.submit(Request(prompt=prompts[4], max_new_tokens=4,
+                                priority=0))
+        assert out.status != REJECTED
+        fe.run(max_ticks=200)
+
+
+# -- scaling -----------------------------------------------------------------
+
+
+def test_scale_up_enters_probation_and_serves(env):
+    """Sustained breach grows the fleet: the new replica appears under
+    the next free id, starts in PROBATION (half-open, must prove
+    itself), is promoted by clean ticks, and serves work."""
+    _, _, _, prompts, refs = env
+    t = [0.0]
+    fe, _ = _fleet(env, lambda: t[0], n=1, slots=1)
+    fe.enable_autopilot(AutopilotPolicy(
+        queue_age_target=0.3, window_ticks=4, breach_ticks=2,
+        clear_ticks=4, max_shed_fraction=0.0, max_replicas=2,
+        scale_cooldown_ticks=2,
+    ))
+    outs = _overload(fe, prompts, t, n=8)
+    t[0] += 0.4
+    fe.step()
+    t[0] += 0.4
+    fe.step()
+    assert len(fe.replicas) == 2
+    fresh = fe._by_id[1]
+    assert fresh.health == PROBATION
+    assert fe.summary()["scale_ups"] == 1
+    while fe.has_work():
+        t[0] += 0.05
+        fe.step()
+    assert fresh.health == HEALTHY  # promoted through the normal gate
+    assert fresh.engine.metrics.finished >= 1  # it actually served
+    assert all(o.status == "finished" for o in outs)
+    for o, p in zip(outs[: len(prompts)], prompts):
+        ref = np.asarray(generate(
+            env[1], env[2], jnp.asarray(p, jnp.int32)[None, :],
+            max_new_tokens=6,
+        ))[0]
+        np.testing.assert_array_equal(np.asarray(o.tokens), ref)
+
+
+def test_scale_down_retires_idle_replica(env):
+    """An idle replica past scale_down_idle_ticks retires through the
+    drain path: fleet shrinks to min_replicas, the handle leaves for
+    the retired list with a released pool, never below the floor."""
+    _, _, _, prompts, _ = env
+    t = [0.0]
+    fe, _ = _fleet(env, lambda: t[0], n=3, slots=2)
+    fe.enable_autopilot(AutopilotPolicy(
+        queue_age_target=5.0, window_ticks=4, breach_ticks=2,
+        clear_ticks=4, max_shed_fraction=0.0, max_replicas=3,
+        min_replicas=2, scale_cooldown_ticks=2, scale_down_idle_ticks=4,
+    ))
+    for _ in range(20):
+        t[0] += 0.05
+        fe.step()
+    assert len(fe.replicas) == 2  # exactly one retired: the floor holds
+    assert len(fe.retired) == 1
+    gone = fe.retired[0]
+    assert gone.health == RETIRED
+    assert gone.engine.pool.n_free == gone.engine.pool.n_slots
+    assert gone.engine.draining
+    assert fe.summary()["scale_downs"] == 1
+    # the survivors still serve
+    out = fe.submit(Request(prompt=prompts[0], max_new_tokens=4))
+    fe.run(max_ticks=100)
+    assert out.status == "finished"
+
+
+def test_scale_up_never_reuses_a_retired_id(env):
+    """Review regression: replica ids are monotone — a scale-up after a
+    scale-down must NOT reuse the retiree's id (its terminal gauge row
+    and trace history belong to a different engine)."""
+    _, _, _, prompts, _ = env
+    t = [0.0]
+    fe, _ = _fleet(env, lambda: t[0], n=2, slots=1)
+    ap = fe.enable_autopilot(AutopilotPolicy(
+        queue_age_target=0.3, window_ticks=4, breach_ticks=2,
+        clear_ticks=2, max_shed_fraction=0.0, max_replicas=3,
+        min_replicas=2, scale_cooldown_ticks=2, scale_down_idle_ticks=3,
+    ))
+    _overload(fe, prompts, t, n=10)
+    t[0] += 0.4
+    fe.step()
+    t[0] += 0.4
+    fe.step()
+    assert 2 in fe._by_id  # scaled up to replica 2
+    fe.run(max_ticks=600)
+    for _ in range(10):  # idle: replica 2 retires
+        t[0] += 0.05
+        fe.step()
+    assert len(fe.retired) == 1  # the LONGEST-idle replica retired
+    retired_id = fe.retired[0].replica_id
+    _overload(fe, prompts, t, n=10)
+    for _ in range(4):
+        t[0] += 0.4
+        fe.step()
+        if len(fe.replicas) == 3:
+            break
+    assert len(fe.replicas) == 3
+    # fresh monotone id — never the retiree's, never a reuse of 2
+    assert 3 in fe._by_id and retired_id not in fe._by_id
+    assert [a.kind for a in ap.actions].count(AP_SCALE_UP) == 2
+    fe.run(max_ticks=600)
+
+
+def test_scale_refused_typed_during_swap(env):
+    """Acceptance pin: a due scale action NEVER interleaves with an
+    in-progress rolling swap — it is refused with the typed
+    ``swap_in_progress`` reason (action log + counter)."""
+    _, _, params, prompts, _ = env
+    t = [0.0]
+    fe, _ = _fleet(env, lambda: t[0], n=2, slots=1)
+    ap = fe.enable_autopilot(AutopilotPolicy(
+        queue_age_target=0.3, window_ticks=4, breach_ticks=2,
+        clear_ticks=4, max_shed_fraction=0.0, max_replicas=4,
+        scale_cooldown_ticks=2,
+    ))
+    st = fe.begin_swap(params=params, version="v2")
+    assert st["state"] == "rolling"
+    _overload(fe, prompts, t, n=10)
+    for _ in range(4):
+        t[0] += 0.4
+        fe.step()
+        if any(a.kind == AP_REFUSED for a in ap.actions):
+            break
+    refusals = [a for a in ap.actions if a.kind == AP_REFUSED]
+    assert refusals and refusals[0].reason == AP_REFUSED_SWAP
+    assert all(a.kind != AP_SCALE_UP for a in ap.actions)
+    assert len(fe.replicas) == 2  # the fleet did not move mid-rollout
+    assert fe.registry.counter(
+        "cluster_autopilot_refusals_total", reason=AP_REFUSED_SWAP
+    ).value >= 1.0
+    fe.run(max_ticks=600)
+
+
+def test_scale_up_rebinds_to_fleet_standard_weights(env):
+    """A scale-up after a completed swap must serve the NEW fleet
+    standard, not the factory's pre-swap weights."""
+    cfg, model, params, prompts, _ = env
+    t = [0.0]
+    fe, _ = _fleet(env, lambda: t[0], n=2, slots=1)
+    fe.enable_autopilot(AutopilotPolicy(
+        queue_age_target=0.3, window_ticks=4, breach_ticks=2,
+        clear_ticks=4, max_shed_fraction=0.0, max_replicas=3,
+        scale_cooldown_ticks=2,
+    ))
+    st = fe.begin_swap(params=params, version="v2")
+    assert st["state"] == "rolling"
+    while fe.swap_status()["state"] == "rolling":
+        t[0] += 0.1
+        fe.step()
+    assert fe.swap_status()["state"] == "completed"
+    _overload(fe, prompts, t, n=10)
+    for _ in range(3):
+        t[0] += 0.4
+        fe.step()
+    assert len(fe.replicas) == 3
+    assert fe._by_id[2].weights_version == "v2"
+    fe.run(max_ticks=600)
+
+
+# -- rebalance ---------------------------------------------------------------
+
+
+def test_ring_weight_rebalance_and_membership():
+    """Weighted-ring mechanics: set_weight shifts only the hot
+    replica's keys; add/remove move only the joiner/leaver's keys;
+    weights restore losslessly (placement is a pure function of the
+    weight map)."""
+    r = PrefixAffinityRouter([0, 1, 2], vnodes=32)
+    keys = [[i, i + 1, i + 2] for i in range(300)]
+    before = [r.owner(k) for k in keys]
+    r.set_weight(0, 0.5)
+    after = [r.owner(k) for k in keys]
+    moved = [i for i, (a, b) in enumerate(zip(before, after)) if a != b]
+    # only keys leaving the depressed replica move, and only AWAY from it
+    assert moved
+    assert all(before[i] == 0 for i in moved)
+    assert sum(1 for o in after if o == 0) < sum(
+        1 for o in before if o == 0
+    )
+    r.set_weight(0, 1.0)
+    assert [r.owner(k) for k in keys] == before  # lossless restore
+    r.add_replica(3)
+    grown = [r.owner(k) for k in keys]
+    assert all(b == g or g == 3 for b, g in zip(before, grown))
+    r.remove_replica(3)
+    assert [r.owner(k) for k in keys] == before
+    with pytest.raises(ValueError):
+        r.set_weight(0, 0.0)
+    with pytest.raises(ValueError):
+        r.set_weight(99, 0.5)
+    solo = PrefixAffinityRouter([0])
+    with pytest.raises(ValueError):
+        solo.remove_replica(0)
+
+
+def test_autopilot_rebalances_hot_ring_owner(env):
+    """A replica loaded past imbalance_factor x the fleet mean gets its
+    ring weight halved (typed rebalance action), shifting future
+    placement off it."""
+    _, _, _, prompts, _ = env
+    t = [0.0]
+    fe, _ = _fleet(env, lambda: t[0], n=2, slots=4, router="prefix")
+    ap = fe.enable_autopilot(AutopilotPolicy(
+        queue_age_target=100.0,  # never shed: isolate the rebalancer
+        max_shed_fraction=0.0, max_replicas=2,
+        # with one idle peer, max load == 2 x mean exactly — a factor
+        # below 2 makes the skew detectable in a 2-replica fleet
+        imbalance_factor=1.5, rebalance_cooldown_ticks=1,
+    ))
+    hot = fe.replicas[0]
+    # pile queued work on one replica directly (past the frontend) so
+    # its load() runs far beyond the fleet mean
+    for i in range(8):
+        hot.submit(Request(prompt=list(prompts[i % len(prompts)]),
+                           max_new_tokens=4))
+    t[0] += 0.05
+    fe.step()
+    rebalances = [a for a in ap.actions if a.kind == "rebalance"]
+    assert rebalances and rebalances[0].reason == "imbalance"
+    assert fe.router.weights[hot.replica_id] == 0.5
+    fe.run(max_ticks=300)
+
+
+# -- retune ------------------------------------------------------------------
+
+
+def test_retune_budget_and_prefill_share_within_bounds(env):
+    """Sustained breach tightens the token budget and surges the
+    prefill share to the ceiling; a clear stretch relaxes both back to
+    the OPERATOR's pre-autopilot settings — never past them, never
+    outside the configured bounds."""
+    _, _, _, prompts, _ = env
+    t = [0.0]
+    fe, _ = _fleet(env, lambda: t[0], n=1, slots=2)
+    fe.enable_autopilot(AutopilotPolicy(
+        queue_age_target=0.3, window_ticks=2, breach_ticks=2,
+        clear_ticks=2, max_shed_fraction=0.0, max_replicas=1,
+        token_budget_bounds=(64, 512), token_budget_step=0.5,
+        prefill_surge_share=4,
+    ))
+    _overload(fe, prompts, t, n=8)
+    for _ in range(6):
+        t[0] += 0.4
+        fe.step()
+    assert fe.config.max_inflight_tokens is not None
+    assert 64 <= fe.config.max_inflight_tokens < 512
+    sched = fe.replicas[0].engine.scheduler
+    assert sched.config.max_prefills_per_tick == 4  # surge bound
+    fe.run(max_ticks=400)
+    for _ in range(10):  # clear stretch relaxes back
+        t[0] += 0.05
+        fe.step()
+    # restored to the operator's own configured share (2), NOT forced
+    # down to the policy floor (1) — and the operator's UNBOUNDED token
+    # budget comes back as exactly that (None), not a lingering cap
+    assert sched.config.max_prefills_per_tick == 2
+    assert fe.config.max_inflight_tokens is None
+
+
+# -- determinism (acceptance) ------------------------------------------------
+
+
+def test_action_log_deterministic(env):
+    """Same trace + same policy + same clock => byte-identical typed
+    action logs and identical outcomes, twice."""
+    _, _, _, prompts, _ = env
+
+    def run():
+        t = [0.0]
+        fe, _ = _fleet(env, lambda: t[0], n=2, slots=1)
+        ap = fe.enable_autopilot(AutopilotPolicy(
+            queue_age_target=0.3, window_ticks=4, breach_ticks=2,
+            clear_ticks=4, max_shed_fraction=0.5, max_replicas=4,
+            scale_cooldown_ticks=3, scale_down_idle_ticks=6,
+            min_service_seconds=0.05, service_seconds_per_token=0.05,
+        ))
+        outs = []
+        for i in range(24):
+            outs.append(fe.submit(Request(
+                prompt=list(prompts[i % len(prompts)]),
+                max_new_tokens=6, priority=i % 3,
+                deadline=2.0 if i % 2 else None,
+            )))
+            t[0] += 0.1
+            fe.step()
+        for _ in range(120):
+            t[0] += 0.1
+            fe.step()
+            if not fe.has_work():
+                break
+        log = [dataclasses.astuple(a) for a in ap.actions]
+        outcomes = [
+            (o.status, o.finish_reason, list(o.tokens)) for o in outs
+        ]
+        return log, outcomes
+
+    log1, outcomes1 = run()
+    log2, outcomes2 = run()
+    assert log1 == log2
+    assert outcomes1 == outcomes2
+    assert log1  # the scenario actually exercised the controller
+
+
+# -- status / telemetry ------------------------------------------------------
+
+
+def test_autopilot_status_and_metrics(env):
+    """autopilot_status() / summary() exposure and the
+    cluster_autopilot_* series appear end to end (tracer track
+    included)."""
+    from tpu_parallel.obs import Tracer
+
+    _, _, _, prompts, _ = env
+    t = [0.0]
+    tracer = Tracer()
+    fe, _ = _fleet(env, lambda: t[0], n=1, slots=1, tracer=tracer)
+    assert fe.autopilot_status() == {"enabled": False}
+    assert fe.summary()["autopilot"] is None
+    ap = fe.enable_autopilot(AutopilotPolicy(
+        queue_age_target=0.3, window_ticks=4, breach_ticks=2,
+        clear_ticks=4, max_shed_fraction=1.0, max_replicas=1,
+    ))
+    with pytest.raises(RuntimeError):
+        fe.enable_autopilot(AutopilotPolicy(max_replicas=1))
+    _overload(fe, prompts, t, n=6)
+    for _ in range(3):
+        t[0] += 0.4
+        fe.step()
+    fe.submit(Request(prompt=prompts[0], max_new_tokens=4))
+    status = fe.autopilot_status()
+    assert status["enabled"] and status["shedding"]
+    assert status["queue_age_p95"] > 0.3
+    assert status["shed_rejects"] == 1
+    s = fe.summary()
+    assert s["autopilot"]["shedding"] and s["autopilot"]["shed_rejects"] == 1
+    names = {
+        (c["name"], tuple(sorted(c["labels"].items())))
+        for c in fe.registry.snapshot()["counters"]
+    }
+    assert ("cluster_autopilot_actions_total", (("kind", "shed_on"),)) \
+        in names
+    assert ("cluster_autopilot_shed_total", (("kind", "reject"),)) in names
+    gauges = {g["name"] for g in fe.registry.snapshot()["gauges"]}
+    assert "cluster_autopilot_shedding" in gauges
+    assert "cluster_autopilot_queue_age_p95_seconds" in gauges
+    assert "autopilot" in tracer.tracks()
+    assert ap.actions
+    fe.run(max_ticks=400)
+
+
+# -- chaos collision (acceptance) --------------------------------------------
+
+
+def test_chaos_storm_with_autoscaling_keeps_invariants(env):
+    """Acceptance pin: the autopilot's autoscaling armed DURING a seeded
+    crash/stall storm — scale-ups really fire mid-storm, and every PR 8
+    healing invariant holds unchanged (termination, bitwise exactness
+    vs the no-fault baseline, no leaked slots/reservations, dead
+    replicas healed).  Deterministic: same seed, same storm, same
+    action log."""
+    import os
+    import random
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "scripts"))
+    try:
+        import chaos_bench
+    finally:
+        sys.path.pop(0)
+
+    cfg, model, params, _, _ = env
+    rnd = random.Random(0)
+    prompts = chaos_bench.make_prompts(cfg, rnd, 12, 3, 12)
+    refs = chaos_bench.baseline_tokens(model, params, prompts, 6, 2)
+
+    def soak():
+        return chaos_bench.run_soak(
+            model, params, cfg, prompts, refs, seed=0, n_replicas=2,
+            n_slots=2, new_tokens=6, horizon=48, max_ticks=2500,
+            autopilot=True, autopilot_queue_age_target=0.1,
+        )
+
+    record, violations = soak()
+    assert violations == [], violations
+    assert record["all_terminal"] and record["bitwise_exact"]
+    assert record["autopilot_scale_ups"] >= 1  # scaling really collided
+    assert record["fleet_size_final"] > 2
+    assert record["replica_deaths"] >= 1 and record["restarts"] >= 1
+    record2, violations2 = soak()
+    assert violations2 == []
+    assert record["autopilot_actions"] == record2["autopilot_actions"]
+    assert record["fleet_size_final"] == record2["fleet_size_final"]
+
+
+# -- production soak (satellite CI gate) -------------------------------------
+
+
+@pytest.mark.slow
+def test_production_soak_trace_swap_storm_autopilot(env):
+    """One-command production soak: a production-shaped recorded trace
+    (mixed priorities + deadlines) drives the fleet through a seeded
+    fault storm, a mid-run rolling weight swap AND the autopilot's
+    scale/shed loop in one run.  The swap must resolve, every non-shed
+    request must finish bitwise identical to the single-engine
+    baseline, and the shed count stays under the policy bound."""
+    import os
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "scripts"))
+    try:
+        import serve_bench
+    finally:
+        sys.path.pop(0)
+
+    cfg, model, params, _, _ = env
+    import random
+
+    rnd = random.Random(3)
+    prompts = [
+        [rnd.randrange(1, cfg.vocab_size)
+         for _ in range(rnd.randint(3, 10))]
+        for _ in range(36)
+    ]
+    dt = 0.05
+    schedule = serve_bench.build_schedule(
+        prompts, [0] * len(prompts), rate=6.0, seed=3, new_tokens=6,
+        priority_dist=[(0, 6), (1, 3), (2, 1)],
+        deadline_dist=[(4.0, 3), (None, 1)],
+    )
+    refs = [
+        [int(x) for x in np.asarray(generate(
+            model, params, jnp.asarray(p, jnp.int32)[None, :],
+            max_new_tokens=6,
+        ))[0]]
+        for p in prompts
+    ]
+
+    from tpu_parallel.cluster import (
+        AutopilotPolicy,
+        FaultPlan,
+        SwapPolicy,
+    )
+
+    t = [0.0]
+    clock = lambda: t[0]  # noqa: E731
+
+    def factory():
+        return ServingEngine(
+            model, params, n_slots=2,
+            scheduler=SchedulerConfig(max_prefills_per_tick=2),
+            clock=clock, decode_steps_per_tick=1,
+        )
+
+    handles = [
+        ReplicaHandle(
+            i, factory(),
+            fault_plan=[
+                FaultPlan(crash_at_tick=20),
+                FaultPlan(stall_at_tick=10, stall_ticks=6),
+                None,
+            ][i],
+            engine_factory=factory,
+        )
+        for i in range(3)
+    ]
+    fe = Frontend(
+        handles, router="least", clock=clock,
+        config=FrontendConfig(
+            retry_limit=16, watchdog_ticks=3, watchdog_kill_ticks=10,
+            restart=RestartPolicy(
+                backoff_seconds=4 * dt, probation_ticks=3,
+                probation_requests=2,
+            ),
+        ),
+    )
+    policy = AutopilotPolicy(
+        queue_age_target=1.0, window_ticks=8, breach_ticks=2,
+        clear_ticks=8, max_shed_fraction=0.3, max_replicas=4,
+        min_replicas=3, scale_cooldown_ticks=8,
+        scale_down_idle_ticks=None,
+    )
+    fe.enable_autopilot(policy, factory)
+
+    outs, submitted, ticks, swap_started = [], 0, 0, False
+    while ticks < 4000:
+        now = ticks * dt
+        while (
+            submitted < len(schedule)
+            and schedule[submitted]["arrival"] <= now
+        ):
+            outs.append(fe.submit(
+                serve_bench._schedule_request(schedule[submitted])
+            ))
+            submitted += 1
+        if ticks == 30 and not swap_started:
+            st = fe.begin_swap(params=params, version="soak-v2",
+                               policy=SwapPolicy(
+                                   drain_ticks=12, canary_ticks=3,
+                                   canary_seconds=2 * dt,
+                               ))
+            assert st["state"] == "rolling", st
+            swap_started = True
+        t[0] += dt
+        fe.step()
+        ticks += 1
+        if (
+            submitted >= len(schedule)
+            and not fe.has_work()
+            and fe.swap_status()["state"] not in (
+                "rolling", "rolling_back"
+            )
+            and not any(
+                h.health in ("backoff", "probation")
+                for h in fe.replicas
+            )
+        ):
+            break
+
+    assert fe.swap_status()["state"] in ("completed", "rolled_back")
+    assert all(o.done for o in outs), "soak must terminate"
+    shed = [
+        o for o in outs if o.finish_reason == REJECT_SHED
+    ]
+    assert len(shed) <= policy.max_shed_fraction * len(outs)
+    for i, out in enumerate(outs):
+        if out.finish_reason in (REJECT_SHED, "deadline"):
+            continue
+        assert out.status == "finished", (i, out.status, out.finish_reason)
+        assert list(out.tokens) == refs[i], (
+            f"request {i} diverged from the single-engine baseline"
+        )
+    # the storm was real and the healing machinery ran under the
+    # autopilot (deaths from the crash plan, restart through probation)
+    s = fe.summary()
+    assert s["replica_deaths"] >= 1
+    assert s["restarts"] >= 1

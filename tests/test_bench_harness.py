@@ -1,4 +1,6 @@
-"""bench.py's wedge-resilience contract, exercised for real in subprocesses.
+"""bench.py's wedge-resilience contract, exercised for real in
+subprocesses — plus the serve_bench workload-schedule helpers (trace
+record/replay exchange format, priority/deadline distribution knobs).
 
 The round-3 lesson: BENCH_r03.json was a bare watchdog zero.  The parent
 must (a) never import jax itself, (b) report WHICH phase died, and (c)
@@ -15,6 +17,73 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BENCH = os.path.join(REPO, "bench.py")
+
+
+def _serve_bench():
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import serve_bench
+    finally:
+        sys.path.pop(0)
+    return serve_bench
+
+
+def test_parse_dist():
+    sb = _serve_bench()
+    assert sb.parse_dist("0:6,1:3,2:1") == [
+        (0.0, 6.0), (1.0, 3.0), (2.0, 1.0)
+    ]
+    assert sb.parse_dist("2.0:3,none:1") == [(2.0, 3.0), (None, 1.0)]
+    assert sb.parse_dist("5") == [(5.0, 1.0)]  # weight defaults to 1
+    for bad in ("", "x:y", "1:-2"):
+        with pytest.raises(SystemExit):
+            sb.parse_dist(bad)
+
+
+def test_schedule_dists_deterministic_and_replayable(tmp_path):
+    """--priority-dist / --deadline-dist satellite: the shaped schedule
+    (a) leaves the arrival stream bit-identical to the unshaped one at
+    the same seed (pre-existing records stay comparable), (b) is a pure
+    function of (seed, dists), and (c) round-trips through the trace
+    record/replay exchange format with every drawn field intact — a
+    replayed overload trace exercises priority shedding as recorded."""
+    sb = _serve_bench()
+    prompts = [[1, 2, 3]] * 40
+    groups = [0] * 40
+    pdist = sb.parse_dist("0:6,1:3,2:1")
+    ddist = sb.parse_dist("2.0:3,none:1")
+    plain = sb.build_schedule(prompts, groups, 8.0, 5, 4)
+    shaped = sb.build_schedule(
+        prompts, groups, 8.0, 5, 4,
+        priority_dist=pdist, deadline_dist=ddist,
+    )
+    assert [e["arrival"] for e in plain] == [e["arrival"] for e in shaped]
+    assert all(
+        e["priority"] == 0 and e["deadline"] is None for e in plain
+    )
+    assert {e["priority"] for e in shaped} == {0, 1, 2}
+    assert any(e["deadline"] is None for e in shaped)
+    assert any(e["deadline"] == 2.0 for e in shaped)
+    again = sb.build_schedule(
+        prompts, groups, 8.0, 5, 4,
+        priority_dist=pdist, deadline_dist=ddist,
+    )
+    assert shaped == again
+    path = str(tmp_path / "trace.jsonl")
+    sb.write_trace(
+        path, shaped,
+        meta={"priority_dist": "0:6,1:3,2:1", "deadline_dist": "2.0:3,none:1"},
+    )
+    with open(path) as fh:
+        header = json.loads(fh.readline())
+    assert header["record"] == "trace_meta"
+    assert header["priority_dist"] == "0:6,1:3,2:1"
+    replayed = sb.load_trace(path)
+    assert [
+        (e["priority"], e["deadline"], e["prompt"]) for e in replayed
+    ] == [
+        (e["priority"], e["deadline"], e["prompt"]) for e in shaped
+    ]
 
 
 def _run_bench(extra_env):

@@ -70,6 +70,28 @@ def test_check_clock_semantics():
     assert any("time.sleep()" in p for p in found)
 
 
+def test_check_clock_walk_covers_autopilot():
+    """New-module pickup: the clock gate's default paths are DIRECTORY
+    walks, so the SLO autopilot (cluster/autopilot.py) is covered with
+    zero registry changes — the walk finds it, and a wall-time call in
+    it would be flagged."""
+    cc = _load("check_clock")
+    cluster_dir = os.path.join(REPO_ROOT, "tpu_parallel", "cluster")
+    walked = [
+        os.path.join(root, f)
+        for root, _, names in os.walk(cluster_dir)
+        for f in names
+        if f.endswith(".py")
+    ]
+    assert any(f.endswith("autopilot.py") for f in walked)
+    assert "tpu_parallel/cluster" in cc.DEFAULT_PATHS
+    planted = "import time\ndef f():\n    return time.monotonic()\n"
+    flagged = cc.check_source(
+        planted, "tpu_parallel/cluster/autopilot.py"
+    )
+    assert len(flagged) == 1 and "monotonic" in flagged[0]
+
+
 def test_check_scopes_semantics():
     """The collective gate flags an unscoped psum and honors with-block
     scopes, decorator scopes (nested-def scan bodies) and the axis-size

@@ -1232,6 +1232,43 @@ def test_chaos_soak_multi_seed(env):
         assert violations == [], (seed, violations)
 
 
+def test_queue_age_cumulative_across_requeue_after_death(env):
+    """serving_queue_age_seconds — the autopilot's queue-age feed —
+    stays CUMULATIVE through a frontend requeue: work orphaned by a
+    replica death carries its ORIGINAL arrival into the surviving
+    engine's scheduler, so that replica's queue-age gauge reports the
+    full client wait, not the seconds since failover."""
+    _, _, _, prompts, _ = env
+    t = [0.0]
+    clock = lambda: t[0]  # noqa: E731
+    h0 = ReplicaHandle(
+        0, _engine(env, clock=clock, n_slots=1),
+        fault_plan=FaultPlan(crash_at_tick=1),
+    )
+    h1 = ReplicaHandle(1, _engine(env, clock=clock, n_slots=1))
+    fe = Frontend(
+        [h0, h1], router="least", clock=clock,
+        config=FrontendConfig(restart=None),
+    )
+    outs = [
+        fe.submit(Request(prompt=prompts[i], max_new_tokens=8))
+        for i in range(3)
+    ]
+    for _ in range(2):  # r0 runs on h0, r1 on h1, r2 queues on h0; crash
+        t[0] += 0.1
+        fe.step()
+    assert h0.health == DEAD
+    for _ in range(3):  # orphans requeue; one waits in h1's queue
+        t[0] += 0.1
+        fe.step()
+    assert h1.engine.scheduler.depth >= 1
+    gauge = h1.engine.registry.gauge("serving_queue_age_seconds")
+    # cumulative: now - ORIGINAL arrival (t=0), not now - failover time
+    assert gauge.value == pytest.approx(t[0])
+    fe.run(max_ticks=200)
+    assert all(o.status == FINISHED for o in outs)
+
+
 # -- telemetry wiring -------------------------------------------------------
 
 

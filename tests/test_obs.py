@@ -17,7 +17,9 @@ import pytest
 from tpu_parallel.obs import (
     NULL_SPAN,
     NULL_TRACER,
+    HistogramWindow,
     MetricRegistry,
+    PercentileWindow,
     Tracer,
     chrome_trace_events,
     prometheus_lines,
@@ -108,6 +110,82 @@ def test_histogram_window_base_and_delta():
     assert w2.base_count() == 5 and w2.delta_count() == 0
     empty = HistogramWindow(Histogram())
     assert empty.base_mean() is None and empty.delta_mean() is None
+
+
+def test_percentile_window_delta_percentile():
+    """PercentileWindow adds WINDOWED percentiles (the autopilot's p95
+    sense): the delta percentile tracks only post-capture observations,
+    agreeing with numpy on the delta set within bucket tolerance, and
+    the base-side stats stay those of the cheap two-float window."""
+    h = Histogram()
+    for _ in range(50):
+        h.observe(0.001)  # pre-capture noise the window must ignore
+    w = PercentileWindow(h)
+    assert w.delta_percentile(95) is None  # empty window
+    # plateaus sized so the probed percentiles sit INSIDE them (numpy's
+    # linear interpolation between plateaus is not the bucket estimate)
+    delta_vals = [0.1] * 80 + [1.0] * 10 + [10.0] * 10
+    for v in delta_vals:
+        h.observe(v)
+    for p in (50, 85, 95, 99):
+        est = w.delta_percentile(p)
+        true = float(np.percentile(delta_vals, p))
+        assert est == pytest.approx(true, rel=0.11), (p, est, true)
+    # cumulative reads are poisoned by the pre-capture mass (its p25 is
+    # the old noise; the window's p25 is squarely in the new traffic) ...
+    assert h.percentile(25) == pytest.approx(0.001, rel=0.11)
+    assert w.delta_percentile(25) == pytest.approx(0.1, rel=0.11)
+    # ... and the base side is exactly the capture point
+    assert w.base_count() == 50
+    assert w.delta_count() == len(delta_vals)
+    # zero-bucket observations land in the delta's rank walk too
+    w2 = PercentileWindow(h)
+    h.observe(0.0)
+    h.observe(5.0)
+    assert w2.delta_percentile(25) == 0.0
+    assert w2.delta_percentile(99) == pytest.approx(5.0, rel=0.11)
+
+
+def test_histogram_window_freezes_across_reset_metrics():
+    """Counter-reset hygiene (autopilot + swap both depend on it): an
+    ``engine.reset_metrics()`` mid-window installs a FRESH registry and
+    fresh instruments, but a window holds the old histogram OBJECT — so
+    its deltas freeze at their pre-reset value and can never go
+    negative, while a window captured on the new registry sees only the
+    new traffic."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_parallel.models import GPTLM, tiny_test
+    from tpu_parallel.serving import Request, ServingEngine
+
+    cfg = tiny_test(dtype=jnp.float32, remat=False)
+    model = GPTLM(cfg)
+    probe = jnp.zeros((1, 4), jnp.int32)
+    params = model.init(
+        {"params": jax.random.PRNGKey(1)}, probe, train=False
+    )["params"]
+    eng = ServingEngine(model, params, n_slots=2)
+    eng.add_request(Request(prompt=[1, 2, 3], max_new_tokens=2))
+    eng.run()
+    old_hist = eng.registry.histogram("serving_queue_wait_seconds")
+    assert old_hist.count >= 1
+    mid = HistogramWindow(old_hist)
+    mid_p = PercentileWindow(old_hist)
+    eng.reset_metrics()
+    fresh_hist = eng.registry.histogram("serving_queue_wait_seconds")
+    assert fresh_hist is not old_hist  # reset = new instruments
+    fresh = HistogramWindow(fresh_hist)
+    eng.add_request(Request(prompt=[1, 2, 3], max_new_tokens=2))
+    eng.run()
+    # the mid-reset window froze: nothing negative, nothing phantom
+    assert mid.delta_count() == 0
+    assert mid.delta_mean() is None
+    assert mid_p.delta_percentile(95) is None
+    assert mid.base_count() == mid.count0 >= 1
+    # the post-reset window saw exactly the new traffic
+    assert fresh.delta_count() >= 1
+    assert fresh.base_count() == 0
 
 
 def test_histogram_percentile_within_one_bucket_width():

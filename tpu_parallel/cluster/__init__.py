@@ -16,6 +16,15 @@ replicas carrying an ``engine_factory`` are rebuilt under a
 probation, promotion back to healthy.  ``scripts/chaos_bench.py`` soaks
 the whole story under seeded randomized fault storms.
 
+The cluster DEFENDS ITSELF against sustained overload: the SLO
+autopilot (``cluster/autopilot.py``, armed via
+``Frontend.enable_autopilot``) watches the queue-age and TTFT windows
+and — with explicit hysteresis — sheds a bounded lowest-priority slice
+(typed ``shed``), resizes the fleet through the probation gate, retunes
+the admission token budget and prefill tick share, and rebalances the
+prefix-affinity ring, logging every decision as a typed
+:class:`AutopilotAction`.
+
 The cluster also ships NEW WEIGHTS under load: ``Frontend.begin_swap``
 rolls a versioned weight set across the fleet one replica at a time
 (``cluster/swap.py`` — exclusion, drain-or-relocate, recompile-free
@@ -24,6 +33,24 @@ canary against a pre-swap latency baseline and a logit-fingerprint spot
 check, rolling the whole fleet back automatically on regression.
 """
 
+from tpu_parallel.cluster.autopilot import (
+    AP_REBALANCE,
+    AP_REFUSED,
+    AP_REFUSED_MAX_REPLICAS,
+    AP_REFUSED_NO_FACTORY,
+    AP_REFUSED_SWAP,
+    AP_RETUNE_BUDGET,
+    AP_RETUNE_PREFILL,
+    AP_SCALE_DOWN,
+    AP_SCALE_UP,
+    AP_SHED_CANCEL,
+    AP_SHED_OFF,
+    AP_SHED_ON,
+    AUTOPILOT_TRACK,
+    Autopilot,
+    AutopilotAction,
+    AutopilotPolicy,
+)
 from tpu_parallel.cluster.frontend import (
     ClusterOutput,
     Frontend,
@@ -35,6 +62,7 @@ from tpu_parallel.cluster.replica import (
     DEGRADED,
     HEALTHY,
     PROBATION,
+    RETIRED,
     FaultPlan,
     ReplicaDead,
     ReplicaHandle,
@@ -71,6 +99,23 @@ from tpu_parallel.cluster.swap import (
 )
 
 __all__ = [
+    "Autopilot",
+    "AutopilotAction",
+    "AutopilotPolicy",
+    "AP_SHED_ON",
+    "AP_SHED_OFF",
+    "AP_SHED_CANCEL",
+    "AP_SCALE_UP",
+    "AP_SCALE_DOWN",
+    "AP_RETUNE_BUDGET",
+    "AP_RETUNE_PREFILL",
+    "AP_REBALANCE",
+    "AP_REFUSED",
+    "AP_REFUSED_SWAP",
+    "AP_REFUSED_MAX_REPLICAS",
+    "AP_REFUSED_NO_FACTORY",
+    "AUTOPILOT_TRACK",
+    "RETIRED",
     "Frontend",
     "FrontendConfig",
     "ClusterOutput",

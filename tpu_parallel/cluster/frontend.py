@@ -69,12 +69,17 @@ import itertools
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Union
 
+from tpu_parallel.cluster.autopilot import (
+    Autopilot,
+    AutopilotPolicy,
+)
 from tpu_parallel.cluster.replica import (
     BACKOFF,
     DEAD,
     DEGRADED,
     HEALTHY,
     PROBATION,
+    RETIRED,
     ReplicaDead,
     ReplicaHandle,
     RestartPolicy,
@@ -105,6 +110,7 @@ from tpu_parallel.serving.request import (
     REJECT_CAPACITY,
     REJECT_CLIENT_LIMIT,
     REJECT_DRAINING,
+    REJECT_SHED,
     REJECT_TOKEN_BUDGET,
     REJECTED,
     RUNNING,
@@ -119,15 +125,19 @@ _HEALTH_CODE = {
     DEAD: 2.0,
     BACKOFF: 3.0,
     PROBATION: 4.0,
+    RETIRED: 5.0,
 }
 # circuit-breaker state per replica: 0 = closed (serving), 1 = half-open
-# (probation trickle), 2 = open (dead / waiting out backoff)
+# (probation trickle), 2 = open (no traffic flows — dead / waiting out
+# backoff / retired by the autopilot, which is benign but equally closed
+# to traffic)
 _BREAKER_CODE = {
     HEALTHY: 0.0,
     DEGRADED: 0.0,
     PROBATION: 1.0,
     BACKOFF: 2.0,
     DEAD: 2.0,
+    RETIRED: 2.0,
 }
 
 
@@ -339,6 +349,15 @@ class Frontend:
         self._fleet_weights: Optional[tuple] = None
         self._version_ordinals: Dict[str, int] = {"initial": 0}
         self._swap_seq = itertools.count(1)
+        # SLO autopilot (cluster/autopilot.py): the closed overload-
+        # control loop, plus the replicas it has scaled down (kept for
+        # observability — a retired handle owns no work and never ticks)
+        self._autopilot: Optional[Autopilot] = None
+        self.retired: List[ReplicaHandle] = []
+        # monotone id source for scale-ups: never reuse an id — not even
+        # a retiree's, whose terminal gauge row and trace history a new
+        # engine must not inherit
+        self._next_replica_id = max(self._by_id) + 1
 
     # -- admission ---------------------------------------------------------
 
@@ -397,6 +416,13 @@ class Frontend:
             and self._reserved + need > cfg.max_inflight_tokens
         ):
             return reject(REJECT_TOKEN_BUDGET)
+        if self._autopilot is not None:
+            # overload shedding: while the autopilot is past its SLO
+            # targets, NEW lowest-effective-priority submissions are
+            # refused typed (bounded by the policy's shed fraction)
+            veto = self._autopilot.admission_veto(request, now)
+            if veto is not None:
+                return reject(REJECT_SHED)
         self._reserved += need
         self._pending.append(_ClientState(out, next(self._seq), need))
         return out
@@ -417,6 +443,10 @@ class Frontend:
             # the rolling swap advances BEFORE dispatch so exclusions,
             # rebinds and canary promotions shape this tick's placement
             self._swap.tick(now)
+        if self._autopilot is not None:
+            # the autopilot senses and actuates before dispatch too, so
+            # shed state, fleet size and retuned budgets shape this tick
+            self._autopilot.tick(now)
         self._enforce_deadlines(now)
         self._dispatch(now)
         for handle in self.replicas:
@@ -751,6 +781,116 @@ class Frontend:
             }
         return self._swap.status_dict()
 
+    # -- SLO autopilot (cluster/autopilot.py) -------------------------------
+
+    def enable_autopilot(
+        self,
+        policy: Optional[AutopilotPolicy] = None,
+        engine_factory=None,
+    ) -> Autopilot:
+        """Arm the closed-loop overload controller: once per ``step()``
+        it senses the queue-age/TTFT windows and actuates bounded shed /
+        scale / retune moves (the module docstring of ``cluster/
+        autopilot.py`` is the full story).  ``engine_factory`` builds
+        the engines scale-up adds (default: the first replica's own
+        factory).  Returns the controller; ``autopilot_status()`` and
+        ``summary()`` expose its state.
+
+        The default policy (``policy=None``) is SHED-ONLY, anchored to
+        the current fleet: ``max_replicas == min_replicas == len(
+        replicas)`` and scale-down disabled — arming the controller for
+        graceful degradation must never quietly resize a fleet the
+        operator sized by hand.  Scaling is opt-in via an explicit
+        policy."""
+        if self._autopilot is not None:
+            raise RuntimeError("autopilot already enabled")
+        if policy is None:
+            policy = AutopilotPolicy(
+                max_replicas=len(self.replicas),
+                min_replicas=len(self.replicas),
+                scale_down_idle_ticks=None,
+            )
+        self._autopilot = Autopilot(self, policy, engine_factory)
+        return self._autopilot
+
+    def autopilot_status(self) -> dict:
+        """The controller's typed state (``{"enabled": False}`` when no
+        autopilot is armed)."""
+        if self._autopilot is None:
+            return {"enabled": False}
+        return self._autopilot.status()
+
+    def _add_replica(self, engine_factory) -> ReplicaHandle:
+        """Scale-up actuator: build a fresh engine, wrap it under the
+        next free replica id, and enter it through the SAME half-open
+        probation gate a restarted replica uses — a new replica proves
+        itself on a bounded trickle before taking full traffic.  After
+        a completed swap the newcomer is rebound to the fleet-standard
+        weights first, so scale-up can never resurrect an old version."""
+        rid = self._next_replica_id
+        self._next_replica_id += 1
+        handle = ReplicaHandle(
+            rid, engine_factory(), engine_factory=engine_factory
+        )
+        if self._fleet_weights is not None:
+            ver, params = self._fleet_weights
+            if handle.weights_version != ver:
+                handle.engine.rebind_params(params, version=ver)
+        rec = _Recovery()
+        if self.config.restart is not None:
+            handle.health = PROBATION
+            rec.probation = True
+        else:
+            # no RestartPolicy = no probation machinery to promote out
+            # of — enter HEALTHY rather than strand the newcomer
+            # half-open forever (it could then never idle-retire either)
+            handle.health = HEALTHY
+        self.replicas.append(handle)
+        self.replicas.sort(key=lambda h: h.replica_id)
+        self._by_id[rid] = handle
+        self._recovery[rid] = rec
+        if isinstance(self.router, PrefixAffinityRouter):
+            self.router.add_replica(rid)
+        self.registry.counter("cluster_scale_ups_total").inc()
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "scale_up", track="router", replica=rid,
+                replicas=len(self.replicas),
+            )
+        return handle
+
+    def _retire_replica(self, handle: ReplicaHandle) -> None:
+        """Scale-down actuator: retire one IDLE replica through the
+        existing drain machinery — the engine's gate closes, the (empty)
+        queued remainder relocates, and the handle leaves the fleet for
+        the ``retired`` list.  Nothing orphans and nothing replays: the
+        idle precondition is the whole point of ``scale_down_idle_ticks``."""
+        self._pull_back_queued(handle)  # belt and braces: idle = empty
+        handle.retire()
+        rid = handle.replica_id
+        self.replicas = [h for h in self.replicas if h.replica_id != rid]
+        self._by_id.pop(rid, None)
+        self._recovery.pop(rid, None)
+        self.retired.append(handle)
+        if isinstance(self.router, PrefixAffinityRouter):
+            self.router.remove_replica(rid)
+        # final gauge row: the retired replica stops publishing, so pin
+        # its last health/load values to the terminal state
+        lab = {"replica": rid}
+        self.registry.gauge("cluster_replica_health", **lab).set(
+            _HEALTH_CODE[RETIRED]
+        )
+        self.registry.gauge("cluster_breaker_state", **lab).set(
+            _BREAKER_CODE[RETIRED]
+        )
+        self.registry.gauge("cluster_replica_load", **lab).set(0.0)
+        self.registry.counter("cluster_scale_downs_total").inc()
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "scale_down", track="router", replica=rid,
+                replicas=len(self.replicas),
+            )
+
     def _pull_back_queued(self, handle: ReplicaHandle) -> int:
         """Pull ``handle``'s engine-queued remainder back into the
         frontend backlog — the ONE relocation-of-queued-work move drain
@@ -811,6 +951,21 @@ class Frontend:
         )
         leftover = []
         for st in order:
+            # pre-dispatch deadline shed: a request whose deadline
+            # expired while it waited here must not be handed to an
+            # engine — the prefill would be pure waste, and the engine
+            # would only hand it back for the in-flight cancel next
+            # tick.  (The tick-top _enforce_deadlines pass runs on the
+            # tick's FIRST clock read; the post-step re-dispatch reads a
+            # fresh clock, so a deadline can expire between the two.)
+            deadline = st.out.request.deadline
+            if (
+                deadline is not None
+                and st.out.arrival_time is not None
+                and now - st.out.arrival_time > deadline
+            ):
+                self._cancel_state(st, "deadline", now)
+                continue
             if not self._try_place(st, now):
                 leftover.append(st)
         self._pending = leftover
@@ -1221,6 +1376,25 @@ class Frontend:
                 self.registry.counter(
                     "cluster_swap_rollbacks_total"
                 ).value
+            ),
+            "autopilot": (
+                None if self._autopilot is None
+                else {
+                    "shedding": self._autopilot.shedding,
+                    "shed_rejects": int(
+                        self._autopilot._shed_rejects.value
+                    ),
+                    "shed_cancels": int(
+                        self._autopilot._shed_cancels.value
+                    ),
+                    "actions": len(self._autopilot.actions),
+                }
+            ),
+            "scale_ups": int(
+                self.registry.counter("cluster_scale_ups_total").value
+            ),
+            "scale_downs": int(
+                self.registry.counter("cluster_scale_downs_total").value
             ),
             "inflight_tokens": self._reserved,
             "prefix_hit_rate": (

@@ -55,6 +55,7 @@ DEGRADED = "degraded"  # stalled/slow: routable only when nothing healthy is
 DEAD = "dead"  # never routable; in-flight work must be replayed elsewhere
 BACKOFF = "backoff"  # dead with a restart scheduled; never routable
 PROBATION = "probation"  # restarted, half-open: routable under a request cap
+RETIRED = "retired"  # scaled down (autopilot): removed from the fleet, idle
 
 # ``load()`` weight of one pending prefill token relative to one queued
 # request / one active slot: a slot decodes one token per tick while a
@@ -348,7 +349,7 @@ class ReplicaHandle:
         A dead or backing-off replica reports infinite load so any
         ranking consumer that forgets to filter by health still never
         picks it."""
-        if self.health in (DEAD, BACKOFF):
+        if self.health in (DEAD, BACKOFF, RETIRED):
             return float("inf")
         return (
             self.queue_depth
@@ -366,7 +367,7 @@ class ReplicaHandle:
         able to land its re-routed queue remainders.  The probation
         request cap is the FRONTEND's filter (it owns the policy), not
         this property's."""
-        if self.health in (DEAD, BACKOFF):
+        if self.health in (DEAD, BACKOFF, RETIRED):
             return False
         if self.fault_plan is not None and self.fault_plan.rejecting(
             self.ticks
@@ -444,6 +445,23 @@ class ReplicaHandle:
         self._prune()
         return events
 
+    def retire(self) -> None:
+        """Scale-down retirement (the autopilot's shrink actuator): the
+        engine's drain gate closes and health becomes RETIRED — a
+        terminal state distinct from DEAD (nothing failed; no orphans,
+        no restart, no breaker involvement).  The caller (the frontend)
+        guarantees the idle precondition: a retiring replica holds no
+        queued or in-flight work, so its cache pool is already fully
+        released."""
+        if self.has_work():
+            raise RuntimeError(
+                f"retire replica {self.replica_id} with work in flight "
+                f"({self.engine.in_flight} slots, "
+                f"{self.queue_depth} queued) — only idle replicas retire"
+            )
+        self.engine.begin_drain()
+        self.health = RETIRED
+
     def kill(self, cause: str) -> None:
         """Declare this replica dead WITHOUT an exception — the watchdog
         path: the engine may even be fine (a false positive), but from
@@ -478,7 +496,10 @@ class ReplicaHandle:
         self.swap_excluded = False
 
     def has_work(self) -> bool:
-        return self.health not in (DEAD, BACKOFF) and self.engine.has_work()
+        return (
+            self.health not in (DEAD, BACKOFF, RETIRED)
+            and self.engine.has_work()
+        )
 
     def _prune(self) -> None:
         done = [rid for rid, out in self._ledger.items() if out.done]
@@ -508,7 +529,7 @@ class ReplicaHandle:
         return taken
 
     def summary(self) -> dict:
-        dark = self.health in (DEAD, BACKOFF)
+        dark = self.health in (DEAD, BACKOFF, RETIRED)
         return {
             "replica": self.replica_id,
             "health": self.health,

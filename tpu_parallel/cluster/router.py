@@ -121,11 +121,20 @@ class PrefixAffinityRouter(Router):
     """Consistent-hash placement on the bucket-aligned prompt prefix,
     least-loaded fallback on overload (see the module docstring).
 
-    ``replica_ids`` fixes the ring membership up front (every replica the
-    cluster was built with, dead or alive — the ring never changes, only
-    which owners are currently routable).  ``vnodes`` virtual nodes per
-    replica smooth the key distribution; 64 keeps per-replica share
-    within a few percent of fair for any realistic replica count.
+    ``replica_ids`` fixes the INITIAL ring membership (every replica the
+    cluster was built with, dead or alive — health never changes the
+    ring, only which owners are currently routable).  ``vnodes`` virtual
+    nodes per replica smooth the key distribution; 64 keeps per-replica
+    share within a few percent of fair for any realistic replica count.
+
+    The ring is additionally WEIGHTED and membership-mutable — the
+    cluster autopilot's rebalance/scale actuators: ``set_weight(rid, w)``
+    shrinks a hot replica's vnode count to ``round(vnodes * w)`` (its
+    HIGHEST-index vnodes are dropped, so every key still owned by a
+    surviving vnode keeps its home — the consistent-hashing property the
+    ring exists for), and ``add_replica`` / ``remove_replica`` grow and
+    shrink membership when the autopilot resizes the fleet (again only
+    the joining/leaving replica's keys move).
     """
 
     name = "prefix"
@@ -143,14 +152,56 @@ class PrefixAffinityRouter(Router):
             raise ValueError(f"vnodes={vnodes} < 1")
         self.buckets = tuple(buckets) if buckets else None
         self.overload_queue_depth = overload_queue_depth
+        self.vnodes = vnodes
         self.fallbacks = 0  # affinity target overloaded -> least-loaded
+        self._weights = {int(rid): 1.0 for rid in replica_ids}
+        self._rebuild()
+
+    def _rebuild(self) -> None:
         ring = []
-        for rid in replica_ids:
-            for v in range(vnodes):
+        for rid in sorted(self._weights):
+            # a weighted replica keeps its LOWEST vnode indices, so
+            # raising the weight back restores exactly the keys that
+            # left (placement stays a pure function of the weight map)
+            n = max(1, int(round(self.vnodes * self._weights[rid])))
+            for v in range(n):
                 ring.append((_stable_hash(f"{rid}:{v}".encode()), rid))
         ring.sort()
         self._ring_points = [p for p, _ in ring]
         self._ring_ids = [rid for _, rid in ring]
+
+    @property
+    def weights(self) -> dict:
+        """Current per-replica ring weights (1.0 = full vnode share)."""
+        return dict(self._weights)
+
+    def set_weight(self, replica_id: int, weight: float) -> None:
+        """Rebalance: scale one replica's share of the ring (0 < w <= 1).
+        The autopilot halves a hot replica's weight when its load runs
+        past ``imbalance_factor`` x the fleet mean, and restores it once
+        the fleet is balanced again."""
+        if not 0.0 < weight <= 1.0:
+            raise ValueError(f"ring weight {weight} outside (0, 1]")
+        if replica_id not in self._weights:
+            raise ValueError(f"replica {replica_id} not on the ring")
+        self._weights[replica_id] = weight
+        self._rebuild()
+
+    def add_replica(self, replica_id: int, weight: float = 1.0) -> None:
+        """Scale-up: join the ring (no-op when already a member) — only
+        keys whose nearest point is one of the NEW vnodes move."""
+        if not 0.0 < weight <= 1.0:
+            raise ValueError(f"ring weight {weight} outside (0, 1]")
+        self._weights.setdefault(int(replica_id), weight)
+        self._rebuild()
+
+    def remove_replica(self, replica_id: int) -> None:
+        """Scale-down: leave the ring; the retiree's keys slide to their
+        ring successors, everyone else keeps a warm cache."""
+        if len(self._weights) <= 1:
+            raise ValueError("cannot remove the last ring member")
+        self._weights.pop(int(replica_id), None)
+        self._rebuild()
 
     def owner(self, prompt: Sequence[int]) -> int:
         """The ring owner of this prompt's prefix key, ignoring health —

@@ -21,6 +21,7 @@ from tpu_parallel.obs.registry import (
     Histogram,
     HistogramWindow,
     MetricRegistry,
+    PercentileWindow,
     validate_snapshot,
 )
 from tpu_parallel.obs.tracer import (
@@ -36,6 +37,7 @@ __all__ = [
     "Gauge",
     "Histogram",
     "HistogramWindow",
+    "PercentileWindow",
     "MetricRegistry",
     "validate_snapshot",
     "Span",

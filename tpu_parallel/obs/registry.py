@@ -192,6 +192,54 @@ class HistogramWindow:
         return ((self.hist.sum - self.sum0) / dc) if dc else None
 
 
+class PercentileWindow(HistogramWindow):
+    """A :class:`HistogramWindow` that also snapshots the BUCKET counts,
+    so windowed PERCENTILES — not just means — read as deltas.
+
+    The base window's two-float capture answers "what is the mean since
+    T"; an SLO controller needs "what is the p95 since T" (a mean hides
+    exactly the tail an overload fattens).  Capturing the sparse bucket
+    dict costs O(hit buckets) — fine for a consumer that re-captures once
+    per decision window (the cluster autopilot), wasteful for one that
+    captures per observation.  The swap controller keeps the cheap base
+    class; the autopilot uses this one.
+
+    Counter-reset hygiene matters here too: a window holds a reference to
+    the HISTOGRAM OBJECT, not to a registry name, so an
+    ``engine.reset_metrics()`` mid-window (which installs a fresh
+    registry and fresh instruments) leaves the window reading the old,
+    now-unwritten instrument — deltas freeze at their last value and can
+    never go negative (pinned in ``tests/test_obs.py``).
+    """
+
+    __slots__ = ("buckets0", "zero0")
+
+    def __init__(self, hist: Histogram):
+        super().__init__(hist)
+        self.buckets0 = dict(hist.buckets)
+        self.zero0 = hist.zero_count
+
+    def delta_percentile(self, p: float) -> Optional[float]:
+        """Percentile over observations landed SINCE capture — the same
+        bucket-midpoint estimate as :meth:`Histogram.percentile`, on the
+        bucket-count deltas; None when the window is empty."""
+        h = self.hist
+        dc = self.delta_count()
+        if dc <= 0:
+            return None
+        p = min(max(p, 0.0), 100.0)
+        rank = min(dc, max(1, math.ceil(p / 100.0 * dc)))
+        seen = h.zero_count - self.zero0
+        if rank <= seen:
+            return 0.0
+        for idx in sorted(h.buckets):
+            seen += h.buckets[idx] - self.buckets0.get(idx, 0)
+            if rank <= seen:
+                lo, hi = h.bucket_bounds(idx)
+                return math.sqrt(lo * hi)
+        return h.max  # unreachable unless float drift; max is safe
+
+
 class MetricRegistry:
     """Get-or-create store of labeled instruments.
 

@@ -30,6 +30,7 @@ from tpu_parallel.serving.request import (
     REJECT_CLIENT_LIMIT,
     REJECT_DRAINING,
     REJECT_QUEUE_FULL,
+    REJECT_SHED,
     REJECT_TOKEN_BUDGET,
     REJECTED,
     RUNNING,
@@ -84,6 +85,7 @@ __all__ = [
     "REJECT_CAPACITY",
     "REJECT_TOKEN_BUDGET",
     "REJECT_CLIENT_LIMIT",
+    "REJECT_SHED",
     "FIFOScheduler",
     "SchedulerConfig",
     "SubmitResult",

@@ -56,6 +56,11 @@ REJECT_DRAINING = "draining"  # drain gate: no new work accepted
 REJECT_CAPACITY = "capacity"  # prompt + budget exceed seq_len
 REJECT_TOKEN_BUDGET = "token_budget"  # cluster-wide token backpressure
 REJECT_CLIENT_LIMIT = "client_limit"  # per-client concurrency cap
+# overload shedding (cluster autopilot): a NEW lowest-effective-priority
+# submission rejected — or a queued request whose deadline is provably
+# unmeetable cancelled — while the fleet is past its SLO targets.  Shed
+# early and loudly beats missing every deadline silently.
+REJECT_SHED = "shed"
 
 
 @dataclasses.dataclass

@@ -172,6 +172,34 @@ class FIFOScheduler:
         self._queue.append(out)
         return SubmitResult.ACCEPTED
 
+    def retune(
+        self,
+        max_prefills_per_tick: Optional[int] = None,
+        max_queue: Optional[int] = None,
+    ) -> SchedulerConfig:
+        """Adjust admission policy knobs on a LIVE scheduler — the
+        cluster autopilot's retuning hook.  Only the named knobs change
+        (``max_queue`` cannot be retuned back to unbounded: None means
+        "leave it alone"); the same validation as construction applies.
+        Queued entries are untouched — a tightened ``max_queue`` below
+        the current depth simply refuses new work until the queue drains
+        under it.  Returns the new (frozen) config."""
+        cfg = self.config
+        if max_prefills_per_tick is not None:
+            if max_prefills_per_tick < 1:
+                raise ValueError(
+                    f"max_prefills_per_tick={max_prefills_per_tick} < 1"
+                )
+            cfg = dataclasses.replace(
+                cfg, max_prefills_per_tick=max_prefills_per_tick
+            )
+        if max_queue is not None:
+            if max_queue < 0:
+                raise ValueError(f"max_queue={max_queue} < 0")
+            cfg = dataclasses.replace(cfg, max_queue=max_queue)
+        self.config = cfg
+        return cfg
+
     def begin_drain(self) -> None:
         """Close the admission gate: subsequent ``submit()`` calls reject
         with ``REJECT_DRAINING``; queued entries still schedule."""
