@@ -38,7 +38,11 @@ CHECKERS: Dict[str, str] = {
     "check_clock": "serving/cluster time flows through the injectable clock",
     "check_scopes": "collectives sit inside jax.named_scope",
     "check_host_sync": "no per-slot device sync in serving host loops",
-    "check_blocks": "block-table mutation stays inside cache_pool.py",
+    "check_blocks": (
+        "block-table mutation AND allocator reference minting stay "
+        "inside cache_pool.py (radix/offload/migration layers only "
+        "hold references)"
+    ),
 }
 
 SCRIPTS_DIR = os.path.dirname(os.path.abspath(__file__))

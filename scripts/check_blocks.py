@@ -20,9 +20,20 @@ table (``...block_table[...] = ``, ``bt_dev.at[...]`` excluded — jax
 functional updates return copies) outside ``cache_pool.py`` is flagged.
 Reads (``table[slot]``, ``np.asarray(pool.block_table)``) are fine.
 
+The KV hierarchy (``serving/kv_hierarchy.py`` — radix prefix tree +
+host offload tier) and the cluster migration shim widened the fence:
+those layers HOLD block references but must never mint or drop them
+directly, so direct calls to the allocator's mutation methods
+(``*.allocator.alloc()`` / ``.free()`` / ``.share()``) outside
+``cache_pool.py`` are flagged too — references flow through the pool's
+``pin_blocks`` / ``free_stored`` / ``snapshot_blocks`` /
+``import_stored`` surface, which is what keeps refcount conservation
+(Σ held refs == allocator refcounts) auditable in one module.  Reads
+(``allocator.check()``, ``.refcount()``, ``.n_free``) stay legal
+everywhere.
+
 Usage: ``python scripts/check_blocks.py [paths...]`` — prints one
-``file:line: <expr> mutates a block table outside BlockAllocator`` per
-violation, exits nonzero on any.
+``file:line: <expr> ...`` per violation, exits nonzero on any.
 """
 
 from __future__ import annotations
@@ -46,6 +57,10 @@ DEFAULT_PATHS = (
 # the single module allowed to mutate tables (the allocator's home)
 ALLOWED_FILES = frozenset({"cache_pool.py"})
 
+# allocator methods that mint/drop block references — callable only from
+# the allowed module; everything else goes through the pool surface
+ALLOCATOR_MUTATORS = frozenset({"alloc", "free", "share"})
+
 
 def _chain_mentions_table(node: ast.AST) -> bool:
     """True when the expression chain under ``node`` names a block table
@@ -61,7 +76,9 @@ def _chain_mentions_table(node: ast.AST) -> bool:
 def check_source(source: str, filename: str) -> List[str]:
     """Return ``file:line: message`` strings for every block-table
     subscript STORE (``table[...] = x``, ``table[...] += x``, ``del
-    table[...]``) outside the allocator module."""
+    table[...]``) and every direct allocator-reference mutation
+    (``*.allocator.alloc/free/share(...)``) outside the allocator
+    module."""
     if os.path.basename(filename) in ALLOWED_FILES:
         return []
     tree = ast.parse(source, filename=filename)
@@ -88,6 +105,23 @@ def check_source(source: str, filename: str) -> List[str]:
                 tgt.value
             ):
                 flag(tgt, ast.unparse(tgt))
+        # reference minting/dropping: `<expr>.allocator.alloc()` etc. —
+        # the radix/offload/migration layers HOLD references, only the
+        # pool takes and releases them.  Reads (check / refcount /
+        # n_free) are not in the mutator set and stay legal.
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ALLOCATOR_MUTATORS
+            and isinstance(node.func.value, ast.Attribute)
+            and node.func.value.attr == "allocator"
+        ):
+            problems.append(
+                f"{filename}:{node.lineno}: {ast.unparse(node.func)}() "
+                "takes/drops a block reference outside the pool (use "
+                "pin_blocks / free_stored / snapshot_blocks / "
+                "import_stored)"
+            )
     return problems
 
 

@@ -179,3 +179,42 @@ def test_check_blocks_semantics():
     assert cb.check_source(bad, "cache_pool.py") == []
     with pytest.raises(FileNotFoundError):
         cb.check_paths((os.path.join(REPO_ROOT, "no_such_dir"),))
+
+
+def test_check_blocks_allocator_reference_fence():
+    """The widened gate (KV hierarchy PR): direct allocator reference
+    mutation — ``*.allocator.alloc/free/share(...)`` — is fenced outside
+    ``cache_pool.py`` exactly like raw table stores, because the radix
+    tree, the host offload tier and the migration shim HOLD references
+    but must take and drop them through the pool's surface.  Reads
+    (``check`` / ``refcount`` / properties) stay legal everywhere, and
+    the pool's own module keeps its authority."""
+    cb = _load("check_blocks")
+    bad = (
+        "def f(pool, radix):\n"
+        "    b = pool.allocator.alloc()\n"
+        "    pool.allocator.share(b)\n"
+        "    radix.pool.allocator.free(b)\n"
+    )
+    found = cb.check_source(bad, "kv_hierarchy.py")
+    assert len(found) == 3, found
+    assert all("block reference" in p for p in found)
+    ok = (
+        "def g(pool):\n"
+        "    pool.allocator.check()\n"
+        "    r = pool.allocator.refcount(0)\n"
+        "    n = pool.allocator.n_free\n"
+        "    blocks = pool.snapshot_blocks(0, 8)\n"
+        "    pool.pin_blocks(blocks)\n"
+        "    pool.free_stored(blocks)\n"
+        "    return r, n\n"
+        "def h(alloc):\n"
+        "    return alloc()  # a bare callable is not the allocator\n"
+    )
+    assert cb.check_source(ok, "x.py") == []
+    assert cb.check_source(bad, "cache_pool.py") == []
+    # the walk covers the new module: a planted violation IN
+    # kv_hierarchy.py would be flagged by the default paths
+    assert any(
+        "tpu_parallel/serving" in p for p in cb.DEFAULT_PATHS
+    )

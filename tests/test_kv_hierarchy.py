@@ -1,0 +1,566 @@
+"""Hierarchical KV memory tests: the radix prefix tree against a
+brute-force longest-common-prefix oracle (with refcount conservation
+audited against the allocator after every op), engine-level greedy
+parity of the radix path with the fixed-slot engine, host offload tier
+spill/restore round-trips, and cross-replica KV migration on the swap
+drain-timeout relocation path (bitwise continuation, typed fallbacks).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_parallel.cluster import (
+    Frontend,
+    FrontendConfig,
+    ReplicaHandle,
+    RestartPolicy,
+    SwapPolicy,
+)
+from tpu_parallel.models import GPTLM, tiny_test
+from tpu_parallel.serving import (
+    FINISHED,
+    BlockAllocator,
+    Request,
+    SchedulerConfig,
+    ServingEngine,
+)
+from tpu_parallel.serving.kv_hierarchy import (
+    MIGRATE_IMPORTED,
+    MIGRATE_WEIGHTS_VERSION,
+    RadixPrefixCache,
+)
+
+BT = 4  # block_tokens for the property suite (engine tests pick their own)
+
+
+class _FakePool:
+    """The narrow pool surface RadixPrefixCache consumes, over a real
+    :class:`BlockAllocator` and host-side block CONTENT (one payload row
+    per block), so the property suite can audit refcount conservation
+    and byte-exact spill/restore round-trips without touching a device.
+    Content rows are written at import/alloc time and compared on every
+    hit — a tree that ever returned the wrong block fails loudly."""
+
+    def __init__(self, n_blocks, block_tokens=BT):
+        self.allocator = BlockAllocator(n_blocks)
+        self.block_tokens = block_tokens
+        self.bytes_per_block = 64
+        self.content = {}  # block id -> np payload row [1, 8]
+
+    # admission entitlements don't exist here: available == free
+    def blocks_available(self):
+        return self.allocator.n_free
+
+    def pin_blocks(self, blocks):
+        for b in blocks:
+            self.allocator.share(int(b))
+
+    def free_stored(self, blocks):
+        for b in blocks:
+            if self.allocator.free(int(b)):
+                self.content.pop(int(b), None)
+
+    def export_blocks(self, blocks):
+        return [
+            np.concatenate([self.content[int(b)] for b in blocks], axis=0)
+        ]
+
+    def import_stored(self, rows, count):
+        if count < 1:
+            return ()
+        if self.blocks_available() < count:
+            return None
+        blocks = tuple(self.allocator.alloc() for _ in range(count))
+        for i, b in enumerate(blocks):
+            self.content[b] = rows[0][i : i + 1].copy()
+        return blocks
+
+    # test-side helpers ---------------------------------------------------
+    def seed_block(self, payload_row):
+        """Allocate a block holding ``payload_row`` (the 'slot prefill'
+        write) — refcount 1 owned by the caller."""
+        b = self.allocator.alloc()
+        self.content[b] = payload_row
+        return b
+
+
+def _payload(run):
+    """Canonical per-block payload: a pure function of the token run, so
+    any block returned for a prefix can be content-verified."""
+    return np.asarray(run, np.int64).reshape(1, -1) * 7 + 3
+
+
+def _tree_refs(cache):
+    """Device references the tree holds (one per resident node)."""
+    return sum(1 for n in cache._walk() if n.block is not None)
+
+
+def _conservation(pool, cache, held):
+    """Σ allocator refcounts == tree-held refs + test-held refs — the
+    load-bearing invariant after ANY op sequence."""
+    total = int(pool.allocator._ref.sum())
+    assert total == _tree_refs(cache) + held, (
+        f"refcount conservation broken: allocator {total} != "
+        f"tree {_tree_refs(cache)} + held {held}"
+    )
+    pool.allocator.check()
+
+
+def _insert(pool, cache, tokens):
+    """Mimic the engine's store path: 'prefill' blocks (alloc + content),
+    snapshot-style handoff (pin), insert, release dupes and the slot's
+    own refs.  Leaves exactly one tree-held ref per new node."""
+    n = len(tokens) // pool.block_tokens
+    runs = [
+        tuple(tokens[j * BT : (j + 1) * BT]) for j in range(n)
+    ]
+    slot_blocks = [pool.seed_block(_payload(r)) for r in runs]
+    pool.pin_blocks(slot_blocks)  # the handoff refs the tree may keep
+    dupes = cache.insert(tokens[: n * BT], slot_blocks)
+    pool.free_stored(dupes)
+    pool.free_stored(slot_blocks)  # the 'slot' retires
+
+
+def _oracle_lcp(stored, prompt):
+    """Brute force: longest block-aligned common prefix of ``prompt``
+    with any stored full-block prefix, capped strictly below the prompt
+    length."""
+    best = 0
+    cap = (len(prompt) - 1) // BT
+    for s in stored:
+        k = 0
+        while (
+            k < cap
+            and k < len(s) // BT
+            and tuple(s[k * BT : (k + 1) * BT])
+            == tuple(prompt[k * BT : (k + 1) * BT])
+        ):
+            k += 1
+        best = max(best, k)
+    return best * BT
+
+
+def test_radix_matches_lcp_oracle_without_eviction():
+    """With capacity ample enough that nothing evicts, lookup must equal
+    the brute-force longest-common-prefix oracle on every probe — and
+    every returned block's content must be the canonical payload for its
+    token run (never someone else's K/V)."""
+    rnd = np.random.RandomState(0)
+    pool = _FakePool(512)
+    cache = RadixPrefixCache(pool, max_device_blocks=512)
+    stored = []
+    vocab = 5  # tiny vocab: collisions and shared prefixes are common
+    for step in range(300):
+        toks = [int(t) for t in rnd.randint(1, vocab, rnd.randint(1, 20))]
+        if rnd.rand() < 0.5:
+            full = (len(toks) // BT) * BT
+            if full:
+                _insert(pool, cache, toks[:full])
+                stored.append(tuple(toks[:full]))
+        else:
+            want = _oracle_lcp(stored, toks)
+            hit = cache.lookup(toks)
+            got = 0 if hit is None else hit[1]
+            assert got == want, (
+                f"step {step}: radix matched {got}, oracle says {want} "
+                f"for {toks}"
+            )
+            if hit is not None:
+                blocks, length = hit
+                for j, b in enumerate(blocks):
+                    np.testing.assert_array_equal(
+                        pool.content[int(b)],
+                        _payload(toks[j * BT : (j + 1) * BT]),
+                        err_msg=f"step {step}: wrong block content",
+                    )
+        _conservation(pool, cache, held=0)
+    assert cache.hits > 20 and cache.misses > 5  # both paths exercised
+
+
+def test_radix_conservation_under_eviction_and_offload():
+    """The evict/spill/restore storm: tight device budget, small host
+    tier, random inserts and lookups.  After EVERY op: refcount
+    conservation, budget bounds, and content-correct hits (a hit may
+    cover less than the oracle under eviction — never more, and never
+    wrong bytes).  The storm must actually exercise spill, restore,
+    host eviction and the restore-fallback path."""
+    rnd = np.random.RandomState(7)
+    pool = _FakePool(24)
+    cache = RadixPrefixCache(
+        pool, max_device_blocks=6, host_capacity_blocks=4
+    )
+    stored = []
+    vocab = 4
+    held = 0
+    pinned = []  # simulated live-slot refs on looked-up blocks
+    for step in range(400):
+        op = rnd.randint(4)
+        if op == 0:
+            toks = [
+                int(t) for t in rnd.randint(1, vocab, rnd.randint(4, 16))
+            ]
+            full = (len(toks) // BT) * BT
+            if full and pool.blocks_available() >= full // BT:
+                _insert(pool, cache, toks[:full])
+                stored.append(tuple(toks[:full]))
+        elif op == 1 and stored:
+            base = list(stored[rnd.randint(len(stored))])
+            probe = base + [int(rnd.randint(1, vocab))]
+            hit = cache.lookup(probe)
+            if hit is not None:
+                blocks, length = hit
+                assert length <= _oracle_lcp(stored, probe) + 0, (
+                    "matched beyond anything ever stored"
+                )
+                for j, b in enumerate(blocks):
+                    np.testing.assert_array_equal(
+                        pool.content[int(b)],
+                        _payload(probe[j * BT : (j + 1) * BT]),
+                    )
+                # a live slot maps the hit (engine: map_prefix share)
+                pool.pin_blocks(blocks)
+                pinned.append(tuple(blocks))
+                held += len(blocks)
+        elif op == 2 and pinned:
+            blocks = pinned.pop(rnd.randint(len(pinned)))
+            pool.free_stored(blocks)  # the slot retires
+            held -= len(blocks)
+        else:
+            cache.pop_lru()
+        _conservation(pool, cache, held=held)
+        assert cache.host_blocks_in_use <= cache.host_capacity
+    assert cache.evictions > 0, "storm never evicted"
+    assert cache.offloads > 0, "storm never spilled to host"
+    assert cache.restored_blocks > 0, "storm never restored from host"
+    assert cache.host_evictions > 0, "host tier never evicted"
+    # full teardown: drop every pin, then evict the tree dry — the
+    # allocator must come back to zero live blocks (no leak)
+    for blocks in pinned:
+        pool.free_stored(blocks)
+    while cache.pop_lru():
+        pass
+    assert _tree_refs(cache) == 0
+    pool.allocator.check()
+    assert pool.allocator.in_use == 0
+
+
+def test_radix_frequency_beats_pure_recency():
+    """Frequency-aware eviction: a header hit many times survives
+    pressure from a stream of newer one-shot inserts that pure LRU would
+    have let evict it."""
+    pool = _FakePool(64)
+    cache = RadixPrefixCache(
+        pool, max_device_blocks=3, hit_recency_bonus=8
+    )
+    hot = [1, 2, 3, 4]
+    _insert(pool, cache, hot)
+    for _ in range(6):
+        assert cache.lookup(hot + [9]) is not None  # heat it up
+    for i in range(5):  # colder one-shot inserts force evictions
+        _insert(pool, cache, [5 + i, 6, 7, 8])
+    hit = cache.lookup(hot + [9])
+    assert hit is not None and hit[1] == BT, (
+        "the frequently-hit header was evicted under one-shot pressure"
+    )
+
+
+# -- engine-level: parity, offload round-trip, migration ---------------------
+
+
+@pytest.fixture(scope="module")
+def env():
+    cfg = tiny_test(dtype=jnp.float32, remat=False)
+    model = GPTLM(cfg)
+    rng = jax.random.PRNGKey(11)
+    shared = [
+        int(t)
+        for t in np.asarray(
+            jax.random.randint(rng, (20,), 1, cfg.vocab_size)
+        )
+    ]
+    prompts = [
+        shared[:9],
+        shared[:17] + [3, 1, 4],
+        shared[:17] + [5, 9],
+        [5, 3, 2, 9, 1, 4],
+    ]
+    probe = jax.random.randint(rng, (1, 20), 1, cfg.vocab_size)
+    params = model.init(
+        {"params": jax.random.PRNGKey(1)}, probe, train=False
+    )["params"]
+    return cfg, model, params, prompts
+
+
+def _run(env, n_new=8, **kw):
+    cfg, model, params, prompts = env
+    kwargs = dict(
+        n_slots=4,
+        scheduler=SchedulerConfig(max_prefills_per_tick=4),
+        decode_steps_per_tick=1,
+    )
+    kwargs.update(kw)
+    eng = ServingEngine(model, params, **kwargs)
+    outs = []
+    for i, p in enumerate(prompts):
+        outs.append(
+            eng.add_request(
+                Request(request_id=f"r{i}", prompt=p, max_new_tokens=n_new)
+            )
+        )
+        eng.step()
+    eng.run(max_ticks=500)
+    assert all(o.status == FINISHED for o in outs)
+    return [list(o.tokens) for o in outs], eng
+
+
+@pytest.mark.parametrize("mode", ["per_step", "fused", "chunked"])
+def test_radix_engine_greedy_parity(env, mode):
+    """Acceptance: the radix hierarchy is a pure cache layout — greedy
+    output bitwise identical to the fixed-slot engine across tick
+    types, with real block-granular hits and zero copy-on-writes
+    (full-block sharing never puts a write inside a shared block)."""
+    kw = dict(
+        per_step=dict(),
+        fused=dict(decode_steps_per_tick=4),
+        chunked=dict(prefill_chunk_tokens=8),
+    )[mode]
+    fixed, _ = _run(env, **kw)
+    radix, eng = _run(
+        env, kv_block_tokens=4, prefix_cache_size=16,
+        kv_radix_cache=True, **kw,
+    )
+    assert fixed == radix, f"radix {mode} diverged from fixed-slot"
+    assert eng._radix.hits > 0  # the shared header actually matched
+    assert eng.pool.cow_copies == 0  # full-block sharing never COWs
+    assert eng._cow_reserve == 0
+    eng.pool.allocator.check()
+
+
+def test_radix_host_offload_restore_bitwise(env):
+    """Warm-tier round trip: a device budget of 2 blocks forces every
+    earlier tenant to spill; re-requesting it restores via batched
+    device_put and the continuation is bitwise identical — zero
+    recompute observed as zero restore failures and a counted hit.
+    Each header is HIT once before the pressure arrives: only
+    evicted-but-WARM blocks spill (a never-hit block drops outright, so
+    the host tier is not churned by one-off bytes)."""
+    cfg, model, params, _ = env
+    rnd = np.random.RandomState(3)
+    headers = [
+        [int(t) for t in rnd.randint(1, cfg.vocab_size, 8)]
+        for _ in range(4)
+    ]
+    eng = ServingEngine(
+        model, params, n_slots=2, decode_steps_per_tick=1,
+        scheduler=SchedulerConfig(max_prefills_per_tick=2),
+        kv_block_tokens=4, prefix_cache_size=2, kv_host_blocks=16,
+        kv_radix_cache=True,
+    )
+
+    def go(h, tag):
+        out = eng.add_request(
+            Request(request_id=tag, prompt=h + [7, 9], max_new_tokens=4)
+        )
+        eng.run(max_ticks=200)
+        assert out.status == FINISHED
+        return list(out.tokens)
+
+    first = []
+    for i, h in enumerate(headers):
+        first.append(go(h, f"a{i}"))
+        go(h, f"w{i}")  # hit it once: warm blocks spill, cold ones drop
+    assert eng._radix.offloads > 0, "no spill under a 2-block budget"
+    hits0 = eng._radix.hits
+    again = go(headers[0], "b0")
+    assert eng._radix.restored_blocks > 0, "revisit never restored"
+    assert eng._radix.restore_failures == 0
+    assert eng._radix.hits > hits0
+    assert again == first[0], "restored continuation diverged"
+    eng.pool.allocator.check()
+    s = eng.metrics.summary()
+    assert s["kv_host_restored_blocks"] > 0
+    assert s["kv_host_restore_failures"] == 0
+    assert s["prefix_hit_rate"] > 0
+
+
+def test_export_import_prefix_roundtrip(env):
+    """The migration primitive alone: export a mid-flight request's
+    blocks from engine A, import into engine B, and B's forced-prefix
+    continuation HITS (no recompute of the shipped blocks) and matches
+    A's own continuation bitwise.  A cross-version import refuses
+    typed."""
+    cfg, model, params, prompts = env
+
+    def mk():
+        return ServingEngine(
+            model, params, n_slots=2, decode_steps_per_tick=1,
+            scheduler=SchedulerConfig(max_prefills_per_tick=2),
+            kv_block_tokens=4, prefix_cache_size=16, kv_radix_cache=True,
+        )
+
+    # baseline: the full uninterrupted generation
+    base_eng = mk()
+    base = base_eng.add_request(
+        Request(request_id="base", prompt=prompts[1], max_new_tokens=10)
+    )
+    base_eng.run(max_ticks=200)
+    ref = list(base.tokens)
+
+    a = mk()
+    out = a.add_request(
+        Request(request_id="mid", prompt=prompts[1], max_new_tokens=10)
+    )
+    for _ in range(5):  # partway through decode
+        a.step()
+    assert out.status == "running" and len(out.tokens) >= 2
+    export = a.export_prefix("mid")
+    assert export is not None and export.n_blocks >= 2
+    delivered = list(out.tokens)
+
+    b = mk()
+    assert b.import_prefix(export) == MIGRATE_IMPORTED
+    hits0 = b._radix.hits
+    cont = b.add_request(
+        Request(
+            request_id="cont",
+            prompt=list(prompts[1]) + delivered,
+            max_new_tokens=10 - len(delivered),
+        )
+    )
+    b.run(max_ticks=200)
+    assert cont.status == FINISHED
+    assert delivered + list(cont.tokens) == ref, (
+        "migrated continuation diverged from the uninterrupted baseline"
+    )
+    assert b._radix.hits > hits0, "the import never produced a hit"
+    b.pool.allocator.check()
+
+    # version hygiene: KV is a function of the params — a target on a
+    # different weights version must refuse, typed
+    c = mk()
+    c.weights_version = "v2"
+    assert c.import_prefix(export) == MIGRATE_WEIGHTS_VERSION
+
+
+def test_swap_relocation_migrates_kv_bitwise(env):
+    """Acceptance: the swap drain-timeout relocation path ships KV
+    blocks replica-to-replica — greedy output bitwise identical to the
+    no-fault single-engine baseline, with ≥ 1 relocation continuing
+    from migrated blocks and any recompute visible only as a typed,
+    counted fallback status."""
+    cfg, model, params, _ = env
+    rnd = np.random.RandomState(5)
+    prompts = [
+        [int(x) for x in rnd.randint(1, cfg.vocab_size, 10)]
+        for _ in range(6)
+    ]
+    t = [0.0]
+    clock = lambda: t[0]  # noqa: E731
+    dt = 0.05
+
+    def mk():
+        return ServingEngine(
+            model, params, n_slots=2, decode_steps_per_tick=1,
+            scheduler=SchedulerConfig(max_prefills_per_tick=2),
+            clock=clock, kv_block_tokens=4, kv_pool_blocks=32,
+            prefix_cache_size=16, kv_radix_cache=True,
+        )
+
+    t[0] = 0.0
+    base_eng = mk()
+    bouts = [
+        base_eng.add_request(Request(prompt=p, max_new_tokens=12))
+        for p in prompts
+    ]
+    base_eng.run(max_ticks=1000)
+    assert all(o.status == FINISHED for o in bouts)
+    base = [list(o.tokens) for o in bouts]
+
+    t[0] = 0.0
+    handles = [
+        ReplicaHandle(i, mk(), engine_factory=mk) for i in range(2)
+    ]
+    fe = Frontend(
+        handles, router="rr", clock=clock,
+        config=FrontendConfig(
+            retry_limit=8, dispatch_queue_depth=8,
+            restart=RestartPolicy(
+                backoff_seconds=4 * dt, probation_ticks=3,
+                probation_requests=4,
+            ),
+        ),
+    )
+    outs = [
+        fe.submit(Request(prompt=p, max_new_tokens=12)) for p in prompts
+    ]
+    for _ in range(4):
+        t[0] += dt
+        fe.step()
+    # a null-value version roll: numerically identical weights under a
+    # new version id keep every request bitwise-comparable while the
+    # rollout's drain_ticks=1 forces in-flight relocation
+    null_v2 = jax.tree_util.tree_map(lambda x: x, params)
+    st = fe.begin_swap(
+        params=null_v2, version="v2",
+        policy=SwapPolicy(
+            drain_ticks=1, canary_ticks=2, canary_seconds=dt,
+            canary_requests=1,
+        ),
+    )
+    assert st["state"] == "rolling", st
+    ticks = 0
+    while (
+        fe.has_work()
+        or fe.swap_status()["state"] in ("rolling", "rolling_back")
+    ) and ticks < 3000:
+        t[0] += dt
+        fe.step()
+        ticks += 1
+    s = fe.summary()
+    assert fe.swap_status()["state"] == "completed"
+    assert all(o.status == FINISHED for o in outs)
+    assert [list(o.tokens) for o in outs] == base, (
+        "relocated streams diverged from the no-fault baseline"
+    )
+    assert s["kv_exports"] > 0, "relocation never exported KV"
+    assert s["kv_migrations"][MIGRATE_IMPORTED] > 0, (
+        f"no relocation continued from migrated blocks: "
+        f"{s['kv_migrations']}"
+    )
+    # every migration attempt resolved to a TYPED status — the sum over
+    # the vocabulary equals the exports (no silent recompute)
+    assert sum(s["kv_migrations"].values()) == s["kv_exports"]
+    for h in fe.replicas:
+        h.engine.pool.allocator.check()
+
+
+def test_warm_start_seeds_scale_up_replica(env):
+    """Autopilot-reused primitive: a scale-up newcomer's prefix cache
+    pre-seeds from the hottest radix chains of a live donor, so the
+    first request for a hot header HITS on the cold replica."""
+    cfg, model, params, prompts = env
+
+    def mk():
+        return ServingEngine(
+            model, params, n_slots=2, decode_steps_per_tick=1,
+            scheduler=SchedulerConfig(max_prefills_per_tick=2),
+            kv_block_tokens=4, prefix_cache_size=16, kv_radix_cache=True,
+        )
+
+    fe = Frontend(
+        [ReplicaHandle(0, mk(), engine_factory=mk)], router="least",
+        config=FrontendConfig(warm_start_blocks=16),
+    )
+    out = fe.submit(Request(prompt=prompts[1], max_new_tokens=6))
+    fe.run(max_ticks=200)
+    assert out.status == FINISHED
+    donor_blocks = fe.replicas[0].engine._radix.device_blocks
+    assert donor_blocks > 0
+    newcomer = fe._add_replica(mk)
+    assert newcomer.kv_warm_blocks > 0
+    assert newcomer.engine._radix.device_blocks > 0
+    hit = newcomer.engine._radix.lookup(list(prompts[1]) + [7])
+    assert hit is not None, "warm-started replica missed the hot header"
+    newcomer.engine.pool.allocator.check()

@@ -25,6 +25,15 @@ the admission token budget and prefill tick share, and rebalances the
 prefix-affinity ring, logging every decision as a typed
 :class:`AutopilotAction`.
 
+The cluster MIGRATES KV instead of recomputing it: relocation paths
+with a live source (the swap rollout's drain-timeout relocation) export
+a moved request's written KV blocks and import them into the target
+replica's prefix cache (``cluster/migration.py`` over the
+``serving/kv_hierarchy.py`` export format), so the forced-prefix replay
+hits instead of re-prefilling — bitwise-identical continuation, with
+recompute surviving only as a typed, counted fallback; autopilot
+scale-ups reuse the same primitive to warm-start newcomers' caches.
+
 The cluster also ships NEW WEIGHTS under load: ``Frontend.begin_swap``
 rolls a versioned weight set across the fleet one replica at a time
 (``cluster/swap.py`` — exclusion, drain-or-relocate, recompile-free
@@ -67,6 +76,12 @@ from tpu_parallel.cluster.replica import (
     ReplicaDead,
     ReplicaHandle,
     RestartPolicy,
+)
+from tpu_parallel.cluster.migration import (
+    MIGRATION_STATUSES,
+    capture_kv,
+    install_kv,
+    warm_start,
 )
 from tpu_parallel.cluster.router import (
     LeastLoadedRouter,
@@ -135,6 +150,10 @@ __all__ = [
     "least_loaded",
     "make_router",
     "prefix_route_key",
+    "MIGRATION_STATUSES",
+    "capture_kv",
+    "install_kv",
+    "warm_start",
     "SwapController",
     "SwapPolicy",
     "SWAP_CANARY",

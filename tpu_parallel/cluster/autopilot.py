@@ -601,6 +601,10 @@ class Autopilot:
                         now, AP_SCALE_UP, self._breach_reason,
                         replica=handle.replica_id,
                         replicas=len(fe.replicas),
+                        # KV blocks migrated into the newcomer's prefix
+                        # cache at birth (cluster/migration.py warm
+                        # start; 0 = cold or no radix hierarchy)
+                        kv_warm_blocks=handle.kv_warm_blocks,
                     )
         # -- down: replicas idle long enough, fleet above the floor
         if pol.scale_down_idle_ticks is None:

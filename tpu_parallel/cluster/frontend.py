@@ -84,6 +84,13 @@ from tpu_parallel.cluster.replica import (
     ReplicaHandle,
     RestartPolicy,
 )
+from tpu_parallel.cluster.migration import (
+    MIGRATE_IMPORTED,
+    MIGRATION_STATUSES,
+    capture_kv,
+    install_kv,
+    warm_start,
+)
 from tpu_parallel.cluster.router import (
     PrefixAffinityRouter,
     Router,
@@ -177,6 +184,11 @@ class FrontendConfig:
       RestartPolicy` circuit breaker (None = dead replicas stay dead).
       Only replicas carrying an ``engine_factory`` are ever restarted;
       backoff timing flows through the frontend's injectable clock.
+    - ``warm_start_blocks``: KV blocks to pre-seed into a scale-up
+      newcomer's prefix cache from the hottest radix chains of a live
+      donor (``cluster/migration.py``; 0 disables).  A no-op unless the
+      engines run the radix KV hierarchy — a cold cache is slow, not
+      wrong, so warm start is always best-effort.
     """
 
     max_inflight_tokens: Optional[int] = None
@@ -189,6 +201,7 @@ class FrontendConfig:
     restart: Optional[RestartPolicy] = dataclasses.field(
         default_factory=RestartPolicy
     )
+    warm_start_blocks: int = 16
 
     def __post_init__(self):
         if self.aging_seconds <= 0:
@@ -252,7 +265,7 @@ class _ClientState:
 
     __slots__ = (
         "out", "seq", "budget", "excluded", "handle", "engine_rid", "base",
-        "pinned_version",
+        "pinned_version", "kv_export",
     )
 
     def __init__(self, out: ClusterOutput, seq: int, budget: int):
@@ -267,6 +280,11 @@ class _ClientState:
         # stream must not straddle weight versions, so replays prefer
         # same-version replicas while any exist (rolling-swap hygiene)
         self.pinned_version: Optional[str] = None
+        # KV blocks captured from the last relocation's source replica
+        # (cluster/migration.py): installed into the next placement's
+        # engine so the forced-prefix replay HITS instead of recomputing;
+        # one-shot, cleared at the install attempt
+        self.kv_export = None
 
 
 class Frontend:
@@ -845,6 +863,32 @@ class Frontend:
             # of — enter HEALTHY rather than strand the newcomer
             # half-open forever (it could then never idle-retire either)
             handle.health = HEALTHY
+        if self.config.warm_start_blocks > 0:
+            # pre-seed the newcomer's prefix cache from the hottest
+            # radix chains of the busiest live donor: rebalanced traffic
+            # then hits immediately instead of re-prefilling every hot
+            # tenant header (no-op without the radix hierarchy)
+            donor, best = None, 0
+            for h in self.replicas:
+                if h.health in (DEAD, BACKOFF):
+                    continue
+                radix = getattr(h.engine, "_radix", None)
+                if radix is not None and radix.device_blocks > best:
+                    donor, best = h, radix.device_blocks
+            if donor is not None:
+                handle.kv_warm_blocks = warm_start(
+                    donor, handle, self.config.warm_start_blocks
+                )
+                if handle.kv_warm_blocks:
+                    self.registry.counter(
+                        "cluster_kv_warm_start_blocks_total"
+                    ).inc(handle.kv_warm_blocks)
+                    if self.tracer.enabled:
+                        self.tracer.instant(
+                            "kv_warm_start", track="router", replica=rid,
+                            donor=donor.replica_id,
+                            blocks=handle.kv_warm_blocks,
+                        )
         self.replicas.append(handle)
         self.replicas.sort(key=lambda h: h.replica_id)
         self._by_id[rid] = handle
@@ -889,6 +933,27 @@ class Frontend:
             self.tracer.instant(
                 "scale_down", track="router", replica=rid,
                 replicas=len(self.replicas),
+            )
+
+    def _capture_relocation_kv(
+        self, st: "_ClientState", handle: ReplicaHandle, engine_rid: str
+    ) -> None:
+        """Capture an attempt's written KV blocks from a LIVE source
+        replica before a relocation cancels its slot (the cancel frees
+        the blocks) — the export half of cross-replica KV migration.
+        Best effort: None leaves the replay on the proven recompute
+        path.  Crash replay never reaches here by design — a dead
+        engine's state must not be read."""
+        export = capture_kv(handle, engine_rid)
+        if export is None:
+            return
+        st.kv_export = export
+        self.registry.counter("cluster_kv_exports_total").inc()
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "kv_export", track="router",
+                request_id=st.out.request.request_id,
+                replica=handle.replica_id, blocks=export.n_blocks,
             )
 
     def _pull_back_queued(self, handle: ReplicaHandle) -> int:
@@ -1065,6 +1130,28 @@ class Frontend:
             st.engine_rid = ereq.request_id
             st.out.replicas.append(pick.replica_id)
             self._by_attempt[ereq.request_id] = st
+            if st.kv_export is not None:
+                # relocated KV rides along: land the captured blocks in
+                # the target's prefix cache BEFORE the engine's admission
+                # tick, so the forced-prefix replay hits and ships blocks
+                # instead of recomputing; every verdict is typed and
+                # counted — recompute survives only as observable fallback
+                verdict = install_kv(pick, st.kv_export)
+                self.registry.counter(
+                    "cluster_kv_migrations_total", status=verdict
+                ).inc()
+                if verdict == MIGRATE_IMPORTED:
+                    self.registry.counter(
+                        "cluster_kv_migrated_blocks_total"
+                    ).inc(st.kv_export.n_blocks)
+                if self.tracer.enabled:
+                    self.tracer.instant(
+                        "kv_migrate", track="router",
+                        request_id=req.request_id,
+                        replica=pick.replica_id, status=verdict,
+                        blocks=st.kv_export.n_blocks,
+                    )
+                st.kv_export = None
             self.registry.counter(
                 "cluster_dispatched_total", replica=pick.replica_id
             ).inc()
@@ -1397,6 +1484,27 @@ class Frontend:
                 self.registry.counter("cluster_scale_downs_total").value
             ),
             "inflight_tokens": self._reserved,
+            "kv_exports": int(
+                self.registry.counter("cluster_kv_exports_total").value
+            ),
+            "kv_migrations": {
+                status: int(
+                    self.registry.counter(
+                        "cluster_kv_migrations_total", status=status
+                    ).value
+                )
+                for status in MIGRATION_STATUSES
+            },
+            "kv_migrated_blocks": int(
+                self.registry.counter(
+                    "cluster_kv_migrated_blocks_total"
+                ).value
+            ),
+            "kv_warm_start_blocks": int(
+                self.registry.counter(
+                    "cluster_kv_warm_start_blocks_total"
+                ).value
+            ),
             "prefix_hit_rate": (
                 None if hit_rate is None else round(hit_rate, 4)
             ),

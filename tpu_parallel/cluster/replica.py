@@ -311,6 +311,9 @@ class ReplicaHandle:
         self.restarts = 0  # successful restarts served so far
         self._crash_fired = False  # one-shot crash_at_tick bookkeeping
         self.cause_of_death: Optional[str] = None  # set by kill()
+        # KV blocks warm-started into this replica's prefix cache at
+        # scale-up (cluster/migration.py; 0 = cold or no radix cache)
+        self.kv_warm_blocks = 0
         # engine request_id -> live engine RequestOutput; pruned as
         # requests reach a terminal state
         self._ledger: Dict[str, RequestOutput] = {}
@@ -519,6 +522,20 @@ class ReplicaHandle:
         """Drop one request from the ledger (the frontend pulled it back
         for re-routing — e.g. a drain's queued remainder)."""
         self._ledger.pop(request_id, None)
+
+    def export_kv(self, engine_rid: str):
+        """Best-effort KV export of a live attempt's written prefix (the
+        cross-replica migration capture — ``cluster/migration.py``).
+        None whenever nothing can or should be read: a dead/backing-off
+        replica's engine is in an unknown state, a fixed-slot engine has
+        no block pool, and any exception during the export degrades to
+        the proven recompute path rather than failing the relocation."""
+        if self.health in (DEAD, BACKOFF):
+            return None
+        try:
+            return self.engine.export_prefix(engine_rid)
+        except Exception:
+            return None  # capture is an optimization, never a new fault
 
     def take_queued(self) -> List[RequestOutput]:
         """Pull the engine's queued remainder (FIFO order) out of this

@@ -17,7 +17,10 @@ a time through a five-phase state machine (docs/12_cluster.md draws it):
     ``SwapPolicy.drain_ticks`` the stragglers are RELOCATED through the
     existing forced-prefix replay path (prompt + delivered tokens onto a
     same-version peer), so greedy output stays bitwise identical to a
-    never-swapped run.
+    never-swapped run.  Each straggler's written KV blocks are exported
+    from the still-live source first (``cluster/migration.py``), so the
+    replay's prefill imports blocks instead of recomputing them —
+    recompute survives only as a typed, counted fallback.
 ``SWAPPING``
     The idle engine rebinds to the new params
     (:meth:`~tpu_parallel.serving.engine.ServingEngine.rebind_params`).
@@ -434,7 +437,11 @@ class SwapController:
         and requeued at the frontend, whose next dispatch replays it
         with ``prompt + delivered`` onto a peer — greedy output bitwise
         identical, nothing re-streamed, and NO retry counted (a swap is
-        an operator action, not a fault)."""
+        an operator action, not a fault).  The source engine is ALIVE
+        here (unlike a crash), so each relocated request's written KV
+        blocks are captured first (``cluster/migration.py``) and the
+        replay's prefill becomes a block import instead of a recompute —
+        the continuation stays bitwise identical either way."""
         fe = self.fe
         for eout in h.orphans():
             erid = eout.request.request_id
@@ -442,6 +449,9 @@ class SwapController:
             st = fe._by_attempt.pop(erid, None)
             if st is None or st.out.done:
                 continue
+            # export BEFORE the cancel: the cancel releases the slot and
+            # frees its blocks
+            fe._capture_relocation_kv(st, h, erid)
             # detach BEFORE the engine cancel: the attempt's terminal
             # notification then no-ops in the frontend callback
             st.handle = None
@@ -568,15 +578,30 @@ class SwapController:
                 )
 
     def _run_spot_check(self, h: ReplicaHandle) -> bool:
+        import dataclasses as _dc
+
         import jax.numpy as jnp
         import numpy as np
 
         from tpu_parallel.models.generate import generate
 
         prompt, continuation = self._spot_candidate
+        model = h.engine.model
+        if getattr(model.config, "kv_block_tokens", 0):
+            # a paged engine's model is the block-paged config variant,
+            # which static generate() cannot drive (no block tables) —
+            # the offline replay runs the layout-free twin; params are
+            # layout-agnostic, and paged-vs-fixed greedy parity is
+            # pinned by tests/test_paged_kv.py, so the audit is as
+            # binding as on a fixed-slot fleet
+            model = type(model)(
+                _dc.replace(
+                    model.config, kv_block_tokens=0, kv_pool_blocks=0
+                )
+            )
         ref = np.asarray(
             generate(
-                h.engine.model, self.to_params,
+                model, self.to_params,
                 jnp.asarray(prompt, jnp.int32)[None, :],
                 max_new_tokens=len(continuation),
             )

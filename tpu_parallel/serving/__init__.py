@@ -18,6 +18,11 @@ from tpu_parallel.serving.engine import (
     default_prefill_buckets,
     sample_tokens,
 )
+from tpu_parallel.serving.kv_hierarchy import (
+    MIGRATION_STATUSES,
+    KVPrefixExport,
+    RadixPrefixCache,
+)
 from tpu_parallel.serving.metrics import ServingMetrics, percentile
 from tpu_parallel.serving.prefix_cache import PrefixCache
 from tpu_parallel.serving.request import (
@@ -69,6 +74,9 @@ __all__ = [
     "ServingMetrics",
     "percentile",
     "PrefixCache",
+    "RadixPrefixCache",
+    "KVPrefixExport",
+    "MIGRATION_STATUSES",
     "Request",
     "RequestOutput",
     "SamplingParams",

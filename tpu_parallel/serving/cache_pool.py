@@ -463,12 +463,54 @@ def copy_block(pool_cache, src, dst):
     return jax.tree_util.tree_map_with_path(cp, pool_cache)
 
 
+def gather_block_rows(pool_cache, blocks):
+    """Gather physical ``blocks`` ([k] int32) out of every block-axis
+    cache leaf, the block dim moved to axis 0 — a READ op (no donation;
+    the pool stays live).  The device half of :meth:`PagedCachePool.
+    export_blocks`: one gathered tree fetches to the host in a single
+    ``device_get``, so spilling a warm prefix to the host tier or
+    shipping it to another replica is one batched transfer, not one
+    round-trip per leaf per block."""
+    out = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(pool_cache)[0]:
+        ax = beam_cache_batch_axis(path, leaf)
+        if ax is None:
+            continue
+        rows = jnp.take(leaf, blocks, axis=ax)
+        out.append(jnp.moveaxis(rows, ax, 0))
+    return out
+
+
+def scatter_block_rows(pool_cache, rows, blocks):
+    """Write gathered block rows (the :func:`gather_block_rows` layout —
+    block dim at axis 0, one array per block-axis leaf in flatten order)
+    into physical ``blocks`` across every leaf — a WRITE op (pool
+    donated).  Out-of-range indices DROP (pad with the pool size), so one
+    compiled shape serves any restore/import count.  Positions copy
+    verbatim: block payloads always land at the same LOGICAL index they
+    were exported from, so the stored global positions stay correct."""
+    it = iter(rows)
+
+    def scat(path, leaf):
+        ax = beam_cache_batch_axis(path, leaf)
+        if ax is None:
+            return leaf
+        row = jnp.moveaxis(next(it), 0, ax).astype(leaf.dtype)
+        idx = (slice(None),) * ax + (blocks,)
+        return leaf.at[idx].set(row)
+
+    return jax.tree_util.tree_map_with_path(scat, pool_cache)
+
+
 def default_block_fns():
-    """Jitted (free_block_pos, copy_block) with the pool operand donated —
-    both are WRITE ops under the module's donation contract."""
+    """Jitted (free_block_pos, copy_block, gather_block_rows,
+    scatter_block_rows) — the write ops donate the pool operand under the
+    module's donation contract; the gather is a read and never does."""
     return (
         jax.jit(free_block_pos, donate_argnums=0),
         jax.jit(copy_block, donate_argnums=0),
+        jax.jit(gather_block_rows),
+        jax.jit(scatter_block_rows, donate_argnums=0),
     )
 
 
@@ -612,7 +654,8 @@ class PagedCachePool:
         self.shared_block_maps = 0
         if block_fns is None:
             block_fns = default_block_fns()
-        self._free_pos, self._copy_block = block_fns
+        (self._free_pos, self._copy_block, self._gather_rows,
+         self._scatter_rows) = block_fns
         # bytes of ONE block across every payload leaf (all layers) — the
         # capacity denominator behind kv_bytes_per_active_token
         self.bytes_per_block = sum(
@@ -805,6 +848,98 @@ class PagedCachePool:
         free list and are device-invalidated."""
         freed = [b for b in blocks if self.allocator.free(int(b))]
         self._invalidate(freed)
+
+    # -- block export / import (host offload tier + cross-replica migration)
+
+    @property
+    def export_meta(self):
+        """Shape signature of one exported block: ``(leaf name, per-block
+        shape, dtype)`` per block-axis cache leaf in flatten order — what
+        :meth:`import_stored` callers compare before landing foreign
+        payloads (a different model config must refuse, not scribble)."""
+        out = []
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+            self.cache
+        )[0]:
+            ax = beam_cache_batch_axis(path, leaf)
+            if ax is None:
+                continue
+            shape = leaf.shape[:ax] + leaf.shape[ax + 1:]
+            out.append((_leaf_name(path), shape, str(leaf.dtype)))
+        return tuple(out)
+
+    def export_blocks(self, blocks):
+        """Copy physical ``blocks``' K/V (payloads, positions, int8
+        scales) to HOST memory: one jitted gather + one batched
+        ``device_get`` per fixed-width chunk.  Returns one numpy array
+        per block-axis leaf (flatten order, block dim at axis 0, length
+        ``len(blocks)``) — the exchange payload the host offload tier
+        spills and cross-replica migration ships.  A read op: the blocks
+        stay live under their existing references, and refcounted
+        immutability (any sharer's write copy-on-writes away) means the
+        exported bytes can never be scribbled mid-copy."""
+        import numpy as np
+
+        if not blocks:
+            return []
+        chunks = []
+        for i in range(0, len(blocks), self.max_blocks):
+            chunk = blocks[i : i + self.max_blocks]
+            idx = np.zeros(self.max_blocks, np.int32)  # pad: block 0 rows
+            idx[: len(chunk)] = chunk
+            gathered = self._gather_rows(self.cache, jnp.asarray(idx))
+            host = jax.device_get(gathered)  # host-sync: offload/migration cold path, one batched fetch per chunk
+            chunks.append([leaf[: len(chunk)] for leaf in host])
+        if len(chunks) == 1:
+            return list(chunks[0])
+        return [
+            np.concatenate([c[i] for c in chunks], axis=0)
+            for i in range(len(chunks[0]))
+        ]
+
+    def _write_blocks(self, rows, blocks) -> None:
+        """Scatter host block rows (the :meth:`export_blocks` layout)
+        into physical ``blocks`` — padded fixed-width jitted calls, the
+        pool donated per the module contract."""
+        import numpy as np
+
+        for i in range(0, len(blocks), self.max_blocks):
+            chunk = blocks[i : i + self.max_blocks]
+            idx = np.full(self.max_blocks, self.n_blocks, np.int32)
+            idx[: len(chunk)] = chunk
+            pad = self.max_blocks - len(chunk)
+            payload = [
+                np.concatenate(
+                    [leaf[i : i + len(chunk)]]
+                    + ([np.zeros((pad,) + leaf.shape[1:], leaf.dtype)]
+                       if pad else []),
+                    axis=0,
+                )
+                for leaf in rows
+            ]
+            self.cache = self._scatter_rows(
+                self.cache,
+                [jnp.asarray(p) for p in payload],
+                jnp.asarray(idx),
+            )
+
+    def import_stored(self, rows, count: int):
+        """Allocate ``count`` fresh blocks — each with refcount 1, the
+        STORE's reference, exactly like :meth:`snapshot_blocks`'s bumps —
+        and land exported host rows in them via one batched upload +
+        scatter.  Returns the block-id tuple, or None when fewer than
+        ``count`` blocks are available beyond in-flight slots'
+        entitlements (the caller counts a typed restore/migration
+        fallback instead of stealing blocks admission already promised).
+        The imported entry participates in normal sharing from here:
+        ``map_prefix`` bumps it per hit, ``free_stored`` releases it."""
+        if count < 1:
+            return ()
+        if self.blocks_available() < count:
+            return None
+        blocks = tuple(self.allocator.alloc() for _ in range(count))
+        self._write_blocks(rows, blocks)
+        return blocks
 
     # -- invariants --------------------------------------------------------
 

@@ -72,6 +72,17 @@ crash-replay) stay greedy-bitwise-identical to the fixed layout
 ``docs/10_serving_engine.md``).  Not yet paged: mesh serving and lazy
 beam search.
 
+Hierarchical KV memory (``kv_radix_cache`` / ``kv_host_blocks``, paged
+only — ``serving/kv_hierarchy.py``): the aligned-LRU prefix cache swaps
+for a token-level RADIX TREE over the block pool (any shared prefix
+hits at block granularity, frequency-aware eviction) with a host-RAM
+offload tier below it (evicted-but-warm blocks spill via batched
+``device_get`` and restore via batched ``device_put`` instead of a
+re-prefill), plus ``export_prefix``/``import_prefix`` — the
+cross-replica KV migration primitive the cluster's forced-prefix
+relocation paths use to ship a moved request's blocks instead of
+recomputing them.
+
 Greedy equivalence: for requests submitted together, per-request outputs
 are token-identical to static ``generate()`` on the same prompts (pinned
 in ``tests/test_serving.py``) — row-parallel ops make batch composition
@@ -134,6 +145,18 @@ from tpu_parallel.serving.metrics import (
     STALL_QUEUE_EMPTY,
     STALL_SPEC_VERIFY,
     ServingMetrics,
+)
+from tpu_parallel.serving.kv_hierarchy import (
+    MIGRATE_ALREADY_CACHED,
+    MIGRATE_IMPORTED,
+    MIGRATE_INCOMPATIBLE,
+    MIGRATE_NO_BLOCKS,
+    MIGRATE_NO_KEY,
+    MIGRATE_NO_PREFIX_CACHE,
+    MIGRATE_NOT_PAGED,
+    MIGRATE_WEIGHTS_VERSION,
+    KVPrefixExport,
+    RadixPrefixCache,
 )
 from tpu_parallel.serving.prefix_cache import PrefixCache
 from tpu_parallel.serving.request import (
@@ -644,7 +667,18 @@ class ServingEngine:
       chunks interleaving with decode ticks (None = monolithic prefill).
     - ``prefix_cache_size``: LRU entries of bucket-aligned prefix K/V
       rows (0 = off; each entry is a full seq_len row of HBM).  Requires
-      bucketing.
+      bucketing.  Under ``kv_radix_cache`` the same knob bounds the
+      radix tree's resident DEVICE BLOCKS instead of whole entries.
+    - ``kv_radix_cache`` (paged only): replace the aligned-LRU prefix
+      cache with the token-level radix hierarchy
+      (:class:`~tpu_parallel.serving.kv_hierarchy.RadixPrefixCache`) —
+      ANY shared prefix hits at block granularity with frequency-aware
+      eviction, and hits are always block-aligned so the copy-on-write
+      admission reserve drops to zero.
+    - ``kv_host_blocks`` (implies ``kv_radix_cache``): host-RAM offload
+      tier capacity in blocks — evicted-but-warm prefix blocks spill to
+      host arrays via one batched ``device_get`` and restore via one
+      batched ``device_put`` instead of a re-prefill.
 
     Fused decode tick (exact — greedy output bitwise identical to the
     per-step engine, pinned in ``tests/test_serving.py``):
@@ -715,6 +749,8 @@ class ServingEngine:
         prefix_cache_size: int = 0,
         kv_block_tokens: Union[int, str, None] = None,
         kv_pool_blocks: Optional[int] = None,
+        kv_radix_cache: bool = False,
+        kv_host_blocks: int = 0,
         decode_steps_per_tick: Union[int, str] = "auto",
         draft_tokens: int = 0,
         drafter: Optional[Drafter] = None,
@@ -844,6 +880,27 @@ class ServingEngine:
                 "prefix_cache_size > 0 requires prefill bucketing (prefix "
                 "keys are bucket-aligned)"
             )
+        # radix hierarchy (kv_hierarchy.py): swaps the aligned-LRU cache
+        # for the token-level radix tree + optional host offload tier on
+        # the paged path; built AFTER the pool below (it holds pool
+        # references), so only validate here
+        if kv_host_blocks < 0:
+            raise ValueError(f"kv_host_blocks={kv_host_blocks} < 0")
+        self._radix_requested = bool(kv_radix_cache) or kv_host_blocks > 0
+        self._kv_host_blocks = int(kv_host_blocks)
+        self._radix: Optional[RadixPrefixCache] = None
+        if self._radix_requested:
+            if not self._paged:
+                raise ValueError(
+                    "kv_radix_cache / kv_host_blocks need the block-paged "
+                    "pool (kv_block_tokens > 0) — the radix tree indexes "
+                    "physical blocks"
+                )
+            if prefix_cache_size < 1:
+                raise ValueError(
+                    "kv_radix_cache needs prefix_cache_size > 0 (the "
+                    "radix tree's resident device-block budget)"
+                )
         self._prefix = (
             PrefixCache(
                 prefix_cache_size,
@@ -872,6 +929,12 @@ class ServingEngine:
                 1 for b in self._buckets if b % self._block_tokens != 0
             )
             self._cow_reserve = (1 + unaligned) if unaligned else 0
+        if self._radix_requested:
+            # radix matches and stores are FULL blocks: every hit's
+            # remainder starts on a block boundary, so prefix sharing can
+            # never put a live write column inside a shared block and the
+            # unaligned-bucket COW reserve is provably unnecessary
+            self._cow_reserve = 0
         self._prefill_batch = (
             prefill_batch
             if prefill_batch is not None
@@ -970,6 +1033,18 @@ class ServingEngine:
             self.pool: Union[CachePool, PagedCachePool] = PagedCachePool(
                 model, params, n_slots, block_fns=block_fns
             )
+            if self._radix_requested:
+                # the radix hierarchy replaces the aligned-LRU cache: the
+                # same self._prefix slot serves both (identical lookup/
+                # store/evict/counter surface), so every downstream
+                # consumer — admission, block-pressure valve, metrics,
+                # the cluster's hit-rate aggregation — is layout-blind
+                self._radix = RadixPrefixCache(
+                    self.pool,
+                    max_device_blocks=prefix_cache_size,
+                    host_capacity_blocks=self._kv_host_blocks,
+                )
+                self._prefix = self._radix
         else:
             (self._prefill_fn, self._extend_fn, self._decode_fn,
              self._verify_fn, self._sample_fn, insert, row_fns) = fns
@@ -1218,7 +1293,18 @@ class ServingEngine:
             events.extend(self._decode_tick())
             decoded = True
         if self._prefix is not None:
-            self.metrics.sync_prefix_cache(self._prefix)
+            entry_bytes = None
+            if self._radix is not None:
+                entry_bytes = self._radix.device_bytes
+            elif self._paged:
+                entry_bytes = self.pool.bytes_per_block * sum(
+                    len(blocks) for blocks, _ in self._prefix.values()
+                )
+            self.metrics.sync_prefix_cache(
+                self._prefix, entry_bytes=entry_bytes
+            )
+            if self._radix is not None:
+                self.metrics.sync_host_tier(self._radix)
         if self._paged:
             self.metrics.sync_block_pool(
                 self.pool, active_tokens=active_tokens
@@ -1330,6 +1416,142 @@ class ServingEngine:
         self.params = params
         if version is not None:
             self.weights_version = version
+
+    # -- KV block export / import (cross-replica migration) ----------------
+
+    def export_prefix(self, request_id: str) -> Optional[KVPrefixExport]:
+        """Export a live request's written KV prefix as host bytes — the
+        source half of cross-replica migration (``cluster/migration.py``
+        calls this right before a relocation cancels the slot, so the
+        forced-prefix replay can ship blocks instead of recomputing).
+
+        Covers the FULL blocks of the columns actually written so far: a
+        decoding slot has written ``prompt + delivered[:-1]`` (the
+        current token lands next tick), a mid-chunked-prefill slot its
+        chunk offset — both strictly shorter than the replay's
+        forced-prefix prompt, so the import is always a usable lookup
+        key.  Stale speculative columns sit at/beyond the accepted
+        frontier and therefore outside every exported block.  None when
+        there is nothing exportable (fixed-slot engine, unknown request,
+        less than one full block written)."""
+        if not self._paged:
+            return None
+        slot = None
+        for i, out in enumerate(self._slot_out):
+            if (
+                out is not None
+                and out.request.request_id == request_id
+            ):
+                slot = i
+                break
+        if slot is None:
+            return None
+        out = self._slot_out[slot]
+        if slot in self._chunking:
+            written = self._chunking[slot].offset
+            ctx = tuple(int(t) for t in out.request.prompt)
+        elif self._active[slot]:
+            written = int(self._pos[slot])
+            ctx = tuple(int(t) for t in out.request.prompt) + tuple(
+                int(t) for t in out.tokens
+            )
+        else:
+            return None
+        bt = self.pool.block_tokens
+        n = min(written, len(ctx)) // bt
+        if n <= 0:
+            return None
+        blocks = [int(self.pool.block_table[slot, j]) for j in range(n)]
+        if any(b < 0 for b in blocks):
+            return None  # belt and braces: written columns are mapped
+        return KVPrefixExport(
+            tokens=ctx[: n * bt],
+            length=n * bt,
+            block_tokens=bt,
+            weights_version=self.weights_version,
+            meta=self.pool.export_meta,
+            leaves=tuple(self.pool.export_blocks(blocks)),
+        )
+
+    def export_hot_prefixes(
+        self, max_blocks: int = 16
+    ) -> List[KVPrefixExport]:
+        """Export the radix tree's hottest resident chains (up to
+        ``max_blocks`` blocks total) — the donor half of the autopilot
+        scale-up warm start.  Empty without a radix cache."""
+        if self._radix is None:
+            return []
+        out = []
+        meta = self.pool.export_meta
+        for tokens, blocks in self._radix.hottest_chains(max_blocks):
+            out.append(
+                KVPrefixExport(
+                    tokens=tokens,
+                    length=len(tokens),
+                    block_tokens=self.pool.block_tokens,
+                    weights_version=self.weights_version,
+                    meta=meta,
+                    leaves=tuple(self.pool.export_blocks(list(blocks))),
+                )
+            )
+        return out
+
+    def import_prefix(self, export: KVPrefixExport) -> str:
+        """Land an exported KV prefix in THIS engine's prefix cache so
+        the next admission of a matching prompt HITS instead of
+        re-prefilling — the target half of migration.  Returns a typed
+        verdict (``kv_hierarchy.MIGRATION_STATUSES``): everything except
+        ``imported`` / ``already_cached`` is a counted fallback and the
+        caller's forced-prefix replay recomputes exactly as before.
+        Refuses typed on block-size/shape mismatch and — critically — on
+        a ``weights_version`` mismatch: cached K/V is a function of the
+        params, and importing across versions would continue the stream
+        with silently wrong attention reads."""
+        if not self._paged:
+            return MIGRATE_NOT_PAGED
+        if self._prefix is None:
+            return MIGRATE_NO_PREFIX_CACHE
+        if export.weights_version != self.weights_version:
+            return MIGRATE_WEIGHTS_VERSION
+        if (
+            export.block_tokens != self.pool.block_tokens
+            or export.meta != self.pool.export_meta
+        ):
+            return MIGRATE_INCOMPATIBLE
+        tokens = tuple(int(t) for t in export.tokens)
+        if self._radix is not None:
+            if self._radix.covers(tokens, export.length):
+                return MIGRATE_ALREADY_CACHED
+            blocks = self.pool.import_stored(
+                list(export.leaves), export.n_blocks
+            )
+            if blocks is None:
+                return MIGRATE_NO_BLOCKS
+            dupes = self._radix.insert(tokens, blocks)
+            if dupes:
+                self.pool.free_stored(dupes)
+            return MIGRATE_IMPORTED
+        # aligned-LRU target: store under the largest bucket key the
+        # export covers (lookups probe bucket-aligned keys only)
+        width = max(
+            (b for b in self._buckets or () if b <= export.length),
+            default=0,
+        )
+        if width <= 0:
+            return MIGRATE_NO_KEY
+        key = tokens[:width]
+        if key in self._prefix:
+            return MIGRATE_ALREADY_CACHED
+        need = self.pool.blocks_needed(width)
+        blocks = self.pool.import_stored(
+            [leaf[:need] for leaf in export.leaves], need
+        )
+        if blocks is None:
+            return MIGRATE_NO_BLOCKS
+        if not self._prefix.store_one(key, width, blocks):
+            self.pool.free_stored(blocks)  # lost the store race
+            return MIGRATE_ALREADY_CACHED
+        return MIGRATE_IMPORTED
 
     @property
     def decode_steps_per_tick(self) -> int:
@@ -1641,14 +1863,37 @@ class ServingEngine:
         ``stack_prefix_rows``/``copy_prefix`` economy is gone)."""
         events: List[StreamEvent] = []
         groups: Dict[Tuple[int, int], list] = {}
+        # free blocks this tick's admissions are owed (worst case + COW
+        # reserve, what the block gate reserved): a radix lookup
+        # restoring warm host blocks must leave this much headroom, or a
+        # restore could exhaust the pool mid-tick under the seats the
+        # gate already promised.  The reserve shrinks as requests are
+        # SEATED (begin_slot moves their need into the pool's own
+        # entitlement accounting, which blocks_available() already
+        # subtracts) — keeping a seated request's need here would
+        # double-count it and refuse restores with real headroom.
+        def _need(o):
+            return (
+                self.pool.blocks_needed(
+                    len(o.request.prompt) + o.request.max_new_tokens
+                )
+                + self._cow_reserve
+            )
+
+        reserve = sum(_need(o) for o in admitted)
         for out in admitted:
             length = len(out.request.prompt)
             if self._chunk_tokens is not None and length > self._chunk_tokens:
-                events.extend(self._start_chunked(out))
+                events.extend(self._start_chunked(out, reserve=reserve))
+                # seated inside _start_chunked: its entitlement is now
+                # the pool's to account
+                reserve -= _need(out)
                 continue
             plen, blocks = 0, None
             if self._prefix is not None:
-                hit = self._prefix.lookup(out.request.prompt, self._buckets)
+                hit = self._lookup_prefix(
+                    out.request.prompt, reserve=reserve
+                )
                 if hit is not None:
                     blocks, plen = hit
                     # pin: an earlier-processed group's prefix store can
@@ -1767,7 +2012,19 @@ class ServingEngine:
         self.pool.insert(row, slot)
         return logits
 
-    def _start_chunked(self, out: RequestOutput) -> List[StreamEvent]:
+    def _lookup_prefix(self, prompt, reserve: int = 0):
+        """Hierarchy-aware prefix probe: the radix tree matches at block
+        granularity (restoring warm host-tier blocks only within the
+        ``reserve`` headroom the admission gate has not promised away);
+        the aligned-LRU cache probes its bucket keys.  Same counted
+        hit/miss contract either way."""
+        if self._radix is not None:
+            return self._radix.lookup(prompt, reserve=reserve)
+        return self._prefix.lookup(prompt, self._buckets)
+
+    def _start_chunked(
+        self, out: RequestOutput, reserve: int = 0
+    ) -> List[StreamEvent]:
         """Claim a slot for a long prompt and run its first chunk (the
         remaining chunks advance one per tick).  A prefix-cache hit seeds
         the slot and the chunking starts at the prefix boundary."""
@@ -1780,8 +2037,19 @@ class ServingEngine:
                 len(out.request.prompt) + out.request.max_new_tokens,
                 cow_reserve=self._cow_reserve,
             )
+            # begin_slot just moved THIS request's need into the pool's
+            # entitlement accounting — drop it from the caller's reserve
+            # or the lookup's restore headroom double-counts it
+            reserve = max(
+                0,
+                reserve
+                - self.pool.blocks_needed(
+                    len(out.request.prompt) + out.request.max_new_tokens
+                )
+                - self._cow_reserve,
+            )
         if self._prefix is not None:
-            hit = self._prefix.lookup(out.request.prompt, self._buckets)
+            hit = self._lookup_prefix(out.request.prompt, reserve=reserve)
             if hit is not None:
                 row, offset = hit
                 if self._paged:
@@ -1840,6 +2108,22 @@ class ServingEngine:
         if self._prefix is None:
             return
         prompt = tuple(int(t) for t in out.request.prompt)
+        if self._radix is not None:
+            # radix store: index the prompt's FULL blocks — every block
+            # boundary becomes a shareable match point (any-prefix hits),
+            # and full-blocks-only keeps sharers' writes off shared
+            # blocks entirely (no COW reserve).  snapshot_blocks hands
+            # one reference per block; the tree keeps refs for NEW nodes
+            # and returns the duplicates for release.
+            bt = self.pool.block_tokens
+            full = (len(prompt) // bt) * bt
+            if full <= 0 or self._radix.covers(prompt, full):
+                return
+            blocks = self.pool.snapshot_blocks(slot, full)
+            dupes = self._radix.insert(prompt[:full], blocks)
+            if dupes:
+                self.pool.free_stored(dupes)
+            return
         if all(
             b >= len(prompt) or prompt[:b] in self._prefix
             for b in self._buckets

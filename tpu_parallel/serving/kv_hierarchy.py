@@ -1,0 +1,511 @@
+"""Hierarchical KV memory: a radix prefix tree over the paged block pool
+with a host-RAM offload tier and an export format for cross-replica
+migration.
+
+The flat :class:`~tpu_parallel.serving.prefix_cache.PrefixCache` shares
+only BUCKET-aligned whole prefixes under plain LRU: a prompt hits iff an
+exact bucket-length key was stored, a Zipf-skewed multi-tenant mix
+thrashes the LRU (every cold tenant's store evicts a hot tenant's
+entry), and an evicted prefix is gone — the next request recomputes it.
+This module is the three-level memory hierarchy that replaces it on the
+block-paged path (RadixAttention, Zheng et al./SGLang, over the
+refcounted block pool of Kwon et al./vLLM — see PAPERS.md):
+
+**Level 1 — HBM, the radix tree** (:class:`RadixPrefixCache`).  A tree
+keyed on token sequences whose nodes each hold ONE refcounted physical
+block: an edge is exactly ``block_tokens`` token ids, so walking the
+tree IS longest-common-prefix matching at block granularity — *any*
+shared prefix hits, not just bucket-aligned ones, and a hit of k blocks
+is k table pointer writes through the existing
+:meth:`~tpu_parallel.serving.cache_pool.PagedCachePool.map_prefix` COW
+machinery (partial-block tails never arise: the tree stores only FULL
+blocks, so remainders always start on a block boundary and the engine's
+copy-on-write reserve drops to zero).  Eviction is FREQUENCY-AWARE, not
+LRU: the victim is the resident leaf minimizing ``last_use +
+hit_recency_bonus * hits`` — a hot tenant's header survives a flood of
+one-shot cold prompts that would have LRU-evicted it.
+
+**Level 2 — host RAM, the offload tier.**  An evicted-but-warm node
+SPILLS instead of dying: its block's K/V (payloads, positions, int8
+scales) copies to pinned host arrays via one batched ``device_get``
+(:meth:`PagedCachePool.export_blocks`) and the device block frees.  A
+later lookup that walks into host-resident nodes RESTORES them — fresh
+blocks allocated, one batched ``device_put`` + scatter
+(:meth:`PagedCachePool.import_stored`) — and the hit proceeds as if the
+prefix had never left HBM: zero recompute, one PCIe copy.  The tier has
+its own capacity (``host_capacity_blocks``), its own frequency-aware
+eviction, and typed accounting (offloads / restored blocks / host
+evictions / restore fallbacks when device blocks are too scarce to
+restore without starving admission).
+
+**Level 3 — the wire, cross-replica migration**
+(:class:`KVPrefixExport`).  The same export format ships a relocated
+request's KV blocks replica-to-replica: the cluster frontend captures an
+export before a relocation cancels the source slot (``cluster/swap.py``
+drain-timeout relocation), imports it into the target engine's prefix
+cache, and the forced-prefix replay's admission HITS instead of
+re-prefilling — bitwise-identical continuation (cached K/V is a pure
+function of tokens, positions and params; the export carries
+``weights_version`` so a cross-version import refuses typed rather than
+silently continuing under different weights).  Autopilot scale-ups
+reuse it to warm-start a newcomer's cache from the hottest prefixes of
+a live donor (``cluster/migration.py``).
+
+Tier invariant: along any root-to-node path, device-resident nodes form
+a contiguous PREFIX followed by host-resident nodes — a prefix is only
+usable from block 0, so eviction always takes the deepest (leaf-most)
+nodes first and restore always fills from the front of a host run.
+
+Ownership: nodes hold allocator REFERENCES, never tables — all block
+mutation stays inside ``cache_pool.py`` (references flow through
+``pin_blocks`` / ``free_stored`` / ``snapshot_blocks`` /
+``import_stored``; the ``scripts/check_blocks.py`` AST gate fences both
+raw table writes and direct allocator calls).  Refcount conservation —
+Σ node-held refs == the tree's resident block count, audited against
+the allocator by ``tests/test_kv_hierarchy.py``'s property suite — is
+the hierarchy's load-bearing invariant.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# typed verdicts for an export landing in an engine
+# (``ServingEngine.import_prefix``); the cluster frontend counts one
+# ``cluster_kv_migrations_total{status=...}`` per attempt.  Everything
+# except IMPORTED / ALREADY_CACHED is a counted fallback — the replay
+# recomputes its forced prefix exactly as before this subsystem existed.
+MIGRATE_IMPORTED = "imported"  # blocks landed; the replay will hit
+MIGRATE_ALREADY_CACHED = "already_cached"  # target already holds it
+MIGRATE_NOT_PAGED = "not_paged"  # fixed-slot target: no block pool
+MIGRATE_NO_PREFIX_CACHE = "no_prefix_cache"  # target caches nothing
+MIGRATE_NO_BLOCKS = "no_blocks"  # target pool too tight right now
+MIGRATE_NO_KEY = "no_key"  # no bucket key fits (aligned-LRU target)
+MIGRATE_INCOMPATIBLE = "incompatible"  # block size / leaf shapes differ
+MIGRATE_WEIGHTS_VERSION = "weights_version"  # KV from other weights
+MIGRATION_STATUSES = (
+    MIGRATE_IMPORTED,
+    MIGRATE_ALREADY_CACHED,
+    MIGRATE_NOT_PAGED,
+    MIGRATE_NO_PREFIX_CACHE,
+    MIGRATE_NO_BLOCKS,
+    MIGRATE_NO_KEY,
+    MIGRATE_INCOMPATIBLE,
+    MIGRATE_WEIGHTS_VERSION,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class KVPrefixExport:
+    """A block-aligned KV prefix as host bytes — the exchange unit for
+    both the offload tier's spill format and replica-to-replica
+    migration.
+
+    ``tokens`` is the covered token prefix (``length`` ids, a multiple
+    of ``block_tokens``); ``leaves`` holds one numpy array per
+    block-axis cache leaf (the :meth:`PagedCachePool.export_blocks`
+    layout — flatten order, block dim at axis 0, ``length //
+    block_tokens`` rows each); ``meta`` is the exporter's per-block
+    shape signature and ``weights_version`` the weight set the K/V was
+    computed under — importers refuse on either mismatch, because a
+    shape-compatible import under different weights would CONTINUE the
+    stream with silently wrong attention reads."""
+
+    tokens: Tuple[int, ...]
+    length: int
+    block_tokens: int
+    weights_version: str
+    meta: tuple
+    leaves: tuple
+
+    @property
+    def n_blocks(self) -> int:
+        return self.length // self.block_tokens
+
+
+class _Node:
+    """One radix-tree node == one KV block.  ``run`` is the
+    ``block_tokens``-id edge from ``parent``; exactly one of ``block``
+    (device-resident, holds one allocator reference) or ``host``
+    (offloaded leaf arrays, the export layout at k=1) is set."""
+
+    __slots__ = (
+        "run", "parent", "children", "block", "host", "hits", "last_use",
+        "born",
+    )
+
+    def __init__(self, run, parent, born: int):
+        self.run = run
+        self.parent = parent
+        self.children: Dict[tuple, "_Node"] = {}
+        self.block: Optional[int] = None
+        self.host: Optional[list] = None
+        self.hits = 0
+        self.last_use = born
+        self.born = born
+
+
+class RadixPrefixCache:
+    """Token-level radix prefix index over a
+    :class:`~tpu_parallel.serving.cache_pool.PagedCachePool`, with an
+    optional host-RAM offload tier (see the module docstring).
+
+    Drop-in for the engine's :class:`PrefixCache` surface on the paged
+    path: ``lookup`` returns ``(block_ids, matched_length)`` (lengths
+    are block multiples — the ``buckets`` argument is accepted and
+    ignored), ``pop_lru`` is the admission gate's block-pressure valve
+    (it spills-or-drops one resident node), and ``hits`` / ``misses`` /
+    ``evictions`` feed the same metrics mirror.  ``max_device_blocks``
+    bounds HBM blocks the tree holds references to;
+    ``host_capacity_blocks`` bounds the warm tier (0 disables it —
+    evictions then drop outright, the radix-only configuration).
+    """
+
+    def __init__(
+        self,
+        pool,
+        max_device_blocks: int,
+        host_capacity_blocks: int = 0,
+        hit_recency_bonus: int = 8,
+    ):
+        if max_device_blocks < 1:
+            raise ValueError(
+                f"max_device_blocks={max_device_blocks} < 1"
+            )
+        if host_capacity_blocks < 0:
+            raise ValueError(
+                f"host_capacity_blocks={host_capacity_blocks} < 0"
+            )
+        self.pool = pool
+        self.block_tokens = int(pool.block_tokens)
+        self.max_device_blocks = int(max_device_blocks)
+        self.host_capacity = int(host_capacity_blocks)
+        # each hit is worth this many lookup/insert ops of recency in the
+        # eviction score — the "frequency-aware" dial (0 = pure recency)
+        self.hit_recency_bonus = int(hit_recency_bonus)
+        self._seq = 0  # monotone op counter: the deterministic recency axis
+        self._root = _Node(None, None, 0)
+        self.device_blocks = 0  # resident nodes == device refs held
+        self.host_blocks_in_use = 0
+        # lookup-level tallies (PrefixCache-compatible: one hit or miss
+        # per lookup call) + the hierarchy's own typed accounting
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0  # device refs dropped (spill or outright)
+        self.offloads = 0  # device -> host spills
+        self.restored_blocks = 0  # host -> device restores (blocks)
+        self.host_evictions = 0  # host copies dropped for good
+        self.restore_failures = 0  # host hit unrestorable (no blocks)
+
+    # -- PrefixCache-compatible surface ------------------------------------
+
+    def __len__(self) -> int:
+        """Device-resident entries (one per block) — the entry-count
+        gauge's value."""
+        return self.device_blocks
+
+    def reset_counters(self) -> None:
+        """Zero the tallies (tree contents stay) — the bench's
+        measure-after-warmup reset, same contract as PrefixCache."""
+        self.hits = self.misses = self.evictions = 0
+        self.offloads = self.restored_blocks = 0
+        self.host_evictions = self.restore_failures = 0
+
+    def lookup(
+        self,
+        prompt: Sequence[int],
+        buckets=None,
+        reserve: int = 0,
+    ):
+        """Longest cached prefix of ``prompt`` at block granularity,
+        STRICTLY shorter than the prompt (the first sampled token needs
+        the last real token's hidden state).  Host-resident tail nodes
+        restore to fresh device blocks first — ``reserve`` is how many
+        free blocks the caller's own admissions still need, so a restore
+        can never consume blocks the admission gate already promised.
+        Returns ``(block_ids, length)`` or None; one counted hit or miss
+        per call.  ``buckets`` is accepted for PrefixCache call-site
+        compatibility and ignored (the tree needs no alignment)."""
+        del buckets
+        self._seq += 1
+        prompt = tuple(int(t) for t in prompt)
+        bt = self.block_tokens
+        max_blocks = (len(prompt) - 1) // bt
+        chain: List[_Node] = []
+        cur = self._root
+        for j in range(max_blocks):
+            child = cur.children.get(prompt[j * bt : (j + 1) * bt])
+            if child is None:
+                break
+            chain.append(child)
+            cur = child
+        device_n = 0
+        for node in chain:
+            if node.block is None:
+                break
+            device_n += 1
+        if device_n < len(chain):
+            # warm-tier hit: restore the leading host run (partial when
+            # device blocks are scarce; the restored prefix still hits)
+            device_n += self._restore(
+                chain, chain[device_n:], reserve=reserve
+            )
+        if device_n == 0:
+            self.misses += 1
+            return None
+        for node in chain[:device_n]:
+            node.hits += 1
+            node.last_use = self._seq
+        self.hits += 1
+        blocks = tuple(node.block for node in chain[:device_n])
+        return blocks, device_n * bt
+
+    def insert(self, tokens: Sequence[int], blocks) -> list:
+        """Index a freshly prefilled FULL-block prefix: ``blocks`` are
+        handed over with one reference each (the engine's
+        ``snapshot_blocks`` bumps).  New nodes keep their block's
+        reference; runs already resident return their handed-in block in
+        the DUPES list for the caller to release; host-resident runs
+        ADOPT the fresh device block (a free promotion — the host copy
+        drops).  Capacity is enforced after the walk, never against the
+        just-inserted path."""
+        self._seq += 1
+        tokens = tuple(int(t) for t in tokens)
+        bt = self.block_tokens
+        n = len(blocks)
+        if len(tokens) != n * bt:
+            raise ValueError(
+                f"insert of {len(tokens)} tokens with {n} blocks at "
+                f"{bt} tokens/block — full blocks only"
+            )
+        cur = self._root
+        path: List[_Node] = []
+        dupes: list = []
+        for j in range(n):
+            run = tokens[j * bt : (j + 1) * bt]
+            child = cur.children.get(run)
+            if child is None:
+                child = _Node(run, cur, self._seq)
+                cur.children[run] = child
+                child.block = int(blocks[j])
+                self.device_blocks += 1
+            elif child.block is None:
+                # host-resident: adopt the fresh device block (the warm
+                # copy is now redundant)
+                child.block = int(blocks[j])
+                child.host = None
+                self.host_blocks_in_use -= 1
+                self.device_blocks += 1
+            else:
+                dupes.append(blocks[j])
+            child.last_use = self._seq
+            path.append(child)
+            cur = child
+        self._enforce_device(protect=frozenset(id(p) for p in path))
+        return dupes
+
+    def covers(self, tokens: Sequence[int], length: int) -> bool:
+        """True when the first ``length`` tokens (a block multiple) are
+        already DEVICE-resident — the store path's dedup probe."""
+        tokens = tuple(int(t) for t in tokens)
+        bt = self.block_tokens
+        cur = self._root
+        for j in range(length // bt):
+            cur = cur.children.get(tokens[j * bt : (j + 1) * bt])
+            if cur is None or cur.block is None:
+                return False
+        return True
+
+    def pop_lru(self) -> bool:
+        """Evict ONE device-resident node (lowest frequency+recency
+        score, deepest-first by construction) — the admission gate's
+        block-pressure valve.  Spills to the host tier when it has room;
+        True when a device reference was actually dropped."""
+        return self._evict_one(protect=frozenset())
+
+    # -- stats -------------------------------------------------------------
+
+    @property
+    def device_bytes(self) -> int:
+        return self.device_blocks * self.pool.bytes_per_block
+
+    @property
+    def host_bytes(self) -> int:
+        return self.host_blocks_in_use * self.pool.bytes_per_block
+
+    def hottest_chains(self, max_blocks: int):
+        """Up to ``max_blocks`` blocks of root-to-leaf device chains,
+        hottest leaf first — the autopilot scale-up's warm-start
+        shopping list (``cluster/migration.py`` exports each chain and
+        imports it into the newcomer)."""
+        leaves = [
+            n
+            for n in self._walk()
+            if n.block is not None
+            and not any(
+                c.block is not None for c in n.children.values()
+            )
+        ]
+        leaves.sort(key=self._score, reverse=True)
+        out, seen = [], set()
+        for leaf in leaves:
+            chain: List[_Node] = []
+            cur = leaf
+            while cur.run is not None:
+                chain.append(cur)
+                cur = cur.parent
+            chain.reverse()
+            # chains must stay contiguous from the root to be importable,
+            # so sibling chains repeat shared ancestors — the budget
+            # counts DISTINCT blocks, not chain lengths, or shared
+            # prefixes would eat it twice
+            fresh = [n.block for n in chain if n.block not in seen]
+            if len(seen) + len(fresh) > max_blocks:
+                continue
+            seen.update(fresh)
+            out.append(
+                (
+                    tuple(t for node in chain for t in node.run),
+                    tuple(node.block for node in chain),
+                )
+            )
+            if len(seen) >= max_blocks:
+                break
+        return out
+
+    # -- internals ---------------------------------------------------------
+
+    def _walk(self):
+        stack = [self._root]
+        while stack:
+            node = stack.pop()
+            if node.run is not None:
+                yield node
+            stack.extend(node.children.values())
+
+    def _score(self, node: _Node):
+        """Eviction score (higher = hotter): recency in op-sequence
+        units plus a per-hit bonus; ``born`` breaks ties
+        deterministically."""
+        return (
+            node.last_use + self.hit_recency_bonus * node.hits,
+            node.born,
+        )
+
+    def _restore(self, chain, host_nodes, reserve: int = 0) -> int:
+        """Restore the leading run of ``host_nodes`` to fresh device
+        blocks: one batched upload + scatter through the pool.  Restores
+        only what fits beyond ``reserve`` and the slots' entitlements —
+        a partial restore still extends the hit; a zero restore counts
+        one typed fallback.  Returns restored block count."""
+        avail = self.pool.blocks_available() - int(reserve)
+        k = min(len(host_nodes), max(0, avail))
+        if k == 0:
+            self.restore_failures += 1
+            return 0
+        take = host_nodes[:k]
+        rows = [
+            np.concatenate([n.host[i] for n in take], axis=0)
+            for i in range(len(take[0].host))
+        ]
+        blocks = self.pool.import_stored(rows, k)
+        if blocks is None:
+            self.restore_failures += 1
+            return 0
+        for node, blk in zip(take, blocks):
+            node.block = int(blk)
+            node.host = None
+            self.host_blocks_in_use -= 1
+            self.device_blocks += 1
+            node.last_use = self._seq
+        self.restored_blocks += k
+        # restoring may overshoot the device budget: evict cold nodes,
+        # never the chain the caller is about to map
+        self._enforce_device(
+            protect=frozenset(id(n) for n in chain)
+        )
+        return k
+
+    def _enforce_device(self, protect=frozenset()) -> None:
+        while self.device_blocks > self.max_device_blocks:
+            if not self._evict_one(protect=protect):
+                break  # only protected nodes remain: transient overshoot
+
+    def _evict_one(self, protect) -> bool:
+        """Drop one device reference: the coldest node with no
+        device-resident child (deepest-first keeps the contiguous-prefix
+        invariant).  Spills to the host tier when it has room — making
+        room by dropping a strictly colder host entry first — else the
+        node (and its unreachable host descendants) drop for good."""
+        cands = [
+            n
+            for n in self._walk()
+            if n.block is not None
+            and id(n) not in protect
+            and not any(
+                c.block is not None for c in n.children.values()
+            )
+        ]
+        if not cands:
+            return False
+        victim = min(cands, key=self._score)
+        # only evicted-but-WARM blocks spill: a node nothing ever hit
+        # (the typical case — a prompt's one-off suffix blocks) drops
+        # outright, so the host tier holds reusable prefixes instead of
+        # churning PCIe copies on bytes no lookup will ever want back
+        spill = self.host_capacity > 0 and victim.hits > 0
+        if spill and self.host_blocks_in_use >= self.host_capacity:
+            spill = self._evict_host_one(colder_than=victim)
+        if spill:
+            victim.host = self.pool.export_blocks([victim.block])
+            self.host_blocks_in_use += 1
+            self.offloads += 1
+        self.pool.free_stored((victim.block,))
+        victim.block = None
+        self.device_blocks -= 1
+        self.evictions += 1
+        if victim.host is None:
+            self._drop_subtree(victim)
+        return True
+
+    def _evict_host_one(self, colder_than: Optional[_Node] = None) -> bool:
+        """Drop the coldest childless host node for good; refuses when
+        it would drop something HOTTER than the node about to spill."""
+        cands = [
+            n
+            for n in self._walk()
+            if n.host is not None and not n.children
+        ]
+        if not cands:
+            return False
+        victim = min(cands, key=self._score)
+        if colder_than is not None and (
+            self._score(victim) > self._score(colder_than)
+        ):
+            return False
+        self._drop_subtree(victim)
+        return True
+
+    def _drop_subtree(self, node: _Node) -> None:
+        """Unlink ``node`` (and any host-resident descendants — they are
+        unreachable without their prefix) from the tree."""
+        stack = list(node.children.values())
+        while stack:
+            sub = stack.pop()
+            stack.extend(sub.children.values())
+            if sub.host is not None:
+                self.host_blocks_in_use -= 1
+                self.host_evictions += 1
+            # device descendants are impossible here: eviction is
+            # deepest-first and the tier invariant keeps device nodes in
+            # a contiguous prefix above any host node
+            assert sub.block is None, "device node below an evicted one"
+        if node.host is not None:
+            self.host_blocks_in_use -= 1
+            self.host_evictions += 1
+        if node.parent is not None:
+            node.parent.children.pop(node.run, None)
+        node.children.clear()

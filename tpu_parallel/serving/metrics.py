@@ -95,6 +95,29 @@ class ServingMetrics:
         self._prefix_hits = r.gauge("serving_prefix_hits")
         self._prefix_misses = r.gauge("serving_prefix_misses")
         self._prefix_evictions = r.gauge("serving_prefix_evictions")
+        # the hit/miss tallies AS A RATE plus the cache's live footprint
+        # (entry count + device bytes) — the radix-vs-LRU comparison is
+        # scrapeable, not just bench-post-processed
+        self._prefix_hit_rate = r.gauge("serving_prefix_hit_rate")
+        self._prefix_entries = r.gauge("serving_prefix_entries")
+        self._prefix_entry_bytes = r.gauge("serving_prefix_entry_bytes")
+        # a legitimately-empty cache sets the bytes gauge to 0, which is
+        # NOT the same summary() answer as "this layout cannot compute
+        # entry bytes" (fixed-slot rows) — track set-ness explicitly
+        self._prefix_bytes_known = False
+        # host-RAM KV offload tier (kv_hierarchy.RadixPrefixCache):
+        # occupancy gauges + mirrored cumulative tallies (the cache owns
+        # the counts, exactly like the prefix hit/miss mirror above)
+        self._kv_host_blocks = r.gauge("serving_kv_host_blocks_in_use")
+        self._kv_host_bytes = r.gauge("serving_kv_host_bytes")
+        self._kv_host_offloads = r.gauge("serving_kv_host_offloads")
+        self._kv_host_restored = r.gauge(
+            "serving_kv_host_restored_blocks"
+        )
+        self._kv_host_evictions = r.gauge("serving_kv_host_evictions")
+        self._kv_restore_failures = r.gauge(
+            "serving_kv_host_restore_failures"
+        )
         # speculative decode: drafted vs accepted tokens (acceptance rate
         # = the drafter's hit quality), and verify positions computed but
         # not delivered (pads + rejected drafts + post-finish surplus —
@@ -332,13 +355,37 @@ class ServingMetrics:
         if drafted > 0:
             self._spec_acceptance.observe(accepted / drafted)
 
-    def sync_prefix_cache(self, prefix_cache) -> None:
-        """Mirror a :class:`~tpu_parallel.serving.prefix_cache.PrefixCache`'s
-        cumulative counters (the cache owns the tallies; metrics snapshots
-        them so ``summary()`` is self-contained)."""
+    def sync_prefix_cache(self, prefix_cache, entry_bytes=None) -> None:
+        """Mirror a prefix cache's cumulative counters (the cache owns
+        the tallies — :class:`~tpu_parallel.serving.prefix_cache.
+        PrefixCache` and :class:`~tpu_parallel.serving.kv_hierarchy.
+        RadixPrefixCache` expose the same surface; metrics snapshots
+        them so ``summary()`` is self-contained), plus the live hit RATE
+        and footprint gauges.  ``entry_bytes`` is the cache's resident
+        device bytes when the engine can compute them (paged layouts;
+        None leaves the gauge untouched)."""
         self._prefix_hits.set(prefix_cache.hits)
         self._prefix_misses.set(prefix_cache.misses)
         self._prefix_evictions.set(prefix_cache.evictions)
+        probes = prefix_cache.hits + prefix_cache.misses
+        self._prefix_hit_rate.set(
+            prefix_cache.hits / probes if probes else 0.0
+        )
+        self._prefix_entries.set(len(prefix_cache))
+        if entry_bytes is not None:
+            self._prefix_entry_bytes.set(entry_bytes)
+            self._prefix_bytes_known = True
+
+    def sync_host_tier(self, radix) -> None:
+        """Mirror the host-RAM offload tier's occupancy and cumulative
+        tallies off a :class:`~tpu_parallel.serving.kv_hierarchy.
+        RadixPrefixCache` (same ownership model as the prefix mirror)."""
+        self._kv_host_blocks.set(radix.host_blocks_in_use)
+        self._kv_host_bytes.set(radix.host_bytes)
+        self._kv_host_offloads.set(radix.offloads)
+        self._kv_host_restored.set(radix.restored_blocks)
+        self._kv_host_evictions.set(radix.host_evictions)
+        self._kv_restore_failures.set(radix.restore_failures)
 
     def seed_block_pool(self, pool) -> None:
         """Watermark a paged pool's CUMULATIVE COW/share tallies so this
@@ -402,6 +449,19 @@ class ServingMetrics:
             "prefix_evictions": self.prefix_evictions,
             "prefix_hit_rate": (
                 round(self.prefix_hits / probes, 4) if probes else None
+            ),
+            "prefix_entries": int(self._prefix_entries.value),
+            "prefix_entry_bytes": (
+                int(self._prefix_entry_bytes.value)
+                if self._prefix_bytes_known
+                else None
+            ),
+            "kv_host_blocks_in_use": int(self._kv_host_blocks.value),
+            "kv_host_offloads": int(self._kv_host_offloads.value),
+            "kv_host_restored_blocks": int(self._kv_host_restored.value),
+            "kv_host_evictions": int(self._kv_host_evictions.value),
+            "kv_host_restore_failures": int(
+                self._kv_restore_failures.value
             ),
             "finished": self.finished,
             "rejected": self.rejected,

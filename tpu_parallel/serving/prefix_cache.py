@@ -27,6 +27,13 @@ table pointer write + refcount bump — O(1), zero K/V copies), and the
 references to the :class:`~tpu_parallel.serving.cache_pool.BlockAllocator`.
 The LRU/lookup machinery is identical either way — the cache never
 inspects its values.
+
+This is the ALIGNED-LRU tier-0 cache.  The paged path can swap it for
+the token-level radix hierarchy
+(:class:`~tpu_parallel.serving.kv_hierarchy.RadixPrefixCache` —
+block-granular matching, frequency-aware eviction, host-RAM offload
+tier) via ``ServingEngine(kv_radix_cache=True)``; both expose the same
+lookup/store/evict/counter surface to the engine and metrics.
 """
 
 from __future__ import annotations
@@ -123,6 +130,12 @@ class PrefixCache:
         self._entries[key] = (row_tree, int(length))
         self._evict_overflow()
         return True
+
+    def values(self):
+        """Snapshot of the stored entry values (``(payload, length)``
+        pairs, LRU order) — the metrics mirror's entry-bytes accounting
+        reads block counts off these without reaching into the dict."""
+        return list(self._entries.values())
 
     def pop_lru(self) -> bool:
         """Evict the least-recently-used entry NOW; False when empty.
