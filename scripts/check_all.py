@@ -37,7 +37,10 @@ from typing import Dict, List, Sequence
 CHECKERS: Dict[str, str] = {
     "check_clock": "serving/cluster time flows through the injectable clock",
     "check_scopes": "collectives sit inside jax.named_scope",
-    "check_host_sync": "no per-slot device sync in serving host loops",
+    "check_host_sync": (
+        "no device sync in serving host loops (per-slot tax) or in "
+        "launch bodies (the overlap-killing pattern)"
+    ),
     "check_blocks": (
         "block-table mutation AND allocator reference minting stay "
         "inside cache_pool.py (radix/offload/migration layers only "

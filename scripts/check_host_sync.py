@@ -1,4 +1,5 @@
-"""Static check: serving code never syncs the device inside a host loop.
+"""Static check: serving code never syncs the device inside a host loop,
+and never syncs inside a ``launch`` body (the overlap-killing pattern).
 
 The serving engine's whole perf story is dispatch amortization — one
 device round-trip per TICK (the fused decode tick pays one per
@@ -13,17 +14,27 @@ outside loops by construction; a loop that genuinely needs one (e.g. the
 standalone speculative host loop, which syncs once per verify tick)
 annotates the line with ``# host-sync: <why>`` and is whitelisted.
 
+The LAUNCH rule: the engine's double-buffered tick splits into
+``launch()`` (dispatch, no sync) and ``collect()`` (one sync +
+delivery), so tick N's host bookkeeping can overlap tick N+1's device
+compute.  ONE sync anywhere on the launch side serializes the pipeline
+— the host stalls before the next tick is even dispatched and the
+overlap ratio silently collapses to zero.  So any device-sync call
+lexically inside a function named ``launch`` or ``_launch*`` under
+``tpu_parallel/serving/`` flags, loop or no loop (same ``# host-sync:``
+whitelist for a justified exception).
+
 Like ``check_clock.py`` (the injectable-clock contract) this turns a
 prose rule into a tier-1 test
-(``tests/test_cluster.py::test_serving_no_per_slot_host_sync``).  The
-check is LEXICAL: it sees calls written inside loop bodies, not syncs
-reached through function calls — the gated debug fetch in
-``CachePool.assert_slot_aligned`` (called per slot under
-``spec_check_invariants=True``) is out of scope by design.
+(``tests/test_cluster.py::test_serving_no_per_slot_host_sync`` and the
+``check_all`` registry).  The check is LEXICAL: it sees calls written
+inside loop/launch bodies, not syncs reached through function calls —
+the gated debug fetch in ``CachePool.assert_slot_aligned`` (called per
+slot under ``spec_check_invariants=True``) is out of scope by design.
 
 Usage: ``python scripts/check_host_sync.py [paths...]`` — prints one
-``file:line: <call> syncs the device inside a host loop`` per violation,
-exits nonzero on any.
+``file:line: <call> syncs the device ...`` per violation, exits nonzero
+on any.
 """
 
 from __future__ import annotations
@@ -59,16 +70,23 @@ def _flag_of(node: ast.Call) -> str | None:
     return None
 
 
+def _is_launch_name(name: str) -> bool:
+    """Function names the launch rule covers: the engine's public
+    ``launch`` and its ``_launch_*`` dispatch helpers."""
+    return name == "launch" or name.startswith("_launch")
+
+
 def check_source(source: str, filename: str) -> List[str]:
     """Return ``file:line: message`` strings for every device-sync call
     lexically inside a ``for``/``while`` body or a comprehension's
-    per-iteration positions, minus lines carrying the
-    ``# host-sync: <why>`` whitelist annotation."""
+    per-iteration positions, OR anywhere inside a ``launch``/``_launch*``
+    function body (the launch/collect overlap contract), minus lines
+    carrying the ``# host-sync: <why>`` whitelist annotation."""
     tree = ast.parse(source, filename=filename)
     lines = source.splitlines()
     problems: List[str] = []
 
-    def flag(node: ast.Call) -> None:
+    def flag(node: ast.Call, in_launch: bool) -> None:
         flagged = _flag_of(node)
         if flagged is None:
             return
@@ -76,7 +94,17 @@ def check_source(source: str, filename: str) -> List[str]:
         # (black puts the closing paren — and the trailing comment — on
         # its own line), so scan the call's whole lineno..end_lineno span
         span = lines[node.lineno - 1 : (node.end_lineno or node.lineno)]
-        if not any(WHITELIST_MARK in line for line in span):
+        if any(WHITELIST_MARK in line for line in span):
+            return
+        if in_launch:
+            problems.append(
+                f"{filename}:{node.lineno}: {flagged}() syncs the "
+                "device inside a launch body (the overlap-killing "
+                "pattern — launch dispatches, collect syncs; move it "
+                "to the collect side, or annotate "
+                "'# host-sync: <why>')"
+            )
+        else:
             problems.append(
                 f"{filename}:{node.lineno}: {flagged}() syncs the "
                 "device inside a host loop (per-slot sync — hoist "
@@ -84,9 +112,9 @@ def check_source(source: str, filename: str) -> List[str]:
                 "'# host-sync: <why>')"
             )
 
-    def walk(node: ast.AST, in_loop: bool) -> None:
-        if isinstance(node, ast.Call) and in_loop:
-            flag(node)
+    def walk(node: ast.AST, in_loop: bool, in_launch: bool) -> None:
+        if isinstance(node, ast.Call) and (in_loop or in_launch):
+            flag(node, in_launch)
         if isinstance(
             node, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
         ):
@@ -95,32 +123,39 @@ def check_source(source: str, filename: str) -> List[str]:
             # ITERATION; only the FIRST generator's iterable evaluates
             # once (so `np.asarray(x)` as the thing being iterated stays
             # legal while `[np.asarray(f(s)) for s in slots]` flags)
-            walk(node.generators[0].iter, in_loop)
+            walk(node.generators[0].iter, in_loop, in_launch)
             for i, gen in enumerate(node.generators):
                 if i > 0:
-                    walk(gen.iter, True)
-                walk(gen.target, True)
+                    walk(gen.iter, True, in_launch)
+                walk(gen.target, True, in_launch)
                 for cond in gen.ifs:
-                    walk(cond, True)
+                    walk(cond, True, in_launch)
             if isinstance(node, ast.DictComp):
-                walk(node.key, True)
-                walk(node.value, True)
+                walk(node.key, True, in_launch)
+                walk(node.value, True, in_launch)
             else:
-                walk(node.elt, True)
+                walk(node.elt, True, in_launch)
             return
         enter_loop = in_loop or isinstance(node, (ast.For, ast.While))
+        enter_launch = in_launch
         # a nested function DEF inside a loop body is not executed per
         # iteration at its definition site's cost — but calls inside it
         # are only flagged if ITS body contains a loop of its own, so
-        # reset the loop context at function boundaries
+        # reset the loop context at function boundaries.  The launch
+        # context instead TURNS ON at a launch-named def and stays on
+        # for nested defs/lambdas (they run on the launch side too).
         if isinstance(
             node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
         ):
             enter_loop = False
+            if not isinstance(node, ast.Lambda) and _is_launch_name(
+                node.name
+            ):
+                enter_launch = True
         for child in ast.iter_child_nodes(node):
-            walk(child, enter_loop)
+            walk(child, enter_loop, enter_launch)
 
-    walk(tree, False)
+    walk(tree, False, False)
     return problems
 
 

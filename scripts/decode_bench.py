@@ -219,15 +219,23 @@ def run_spec(batch, prompt_len, new_tokens, kv_dtype="bf16", ks=(2, 4, 8),
 
 
 def run_engine(batch, prompt_len, new_tokens, kv_dtype="bf16",
-               ticks=(1, 4, 8, 16), reps=3, warmup=1):
+               ticks=(1, 4, 8, 16), reps=3, warmup=1, chunk=0,
+               overlap=False):
     """ENGINE-mode decode throughput: the ServingEngine's decode hot loop
     across the ``--fused-tick`` sweep — T=1 is the per-step tick (one
     host dispatch + sync per token, the DECODE_r06 348-tok/s-at-batch-1
     configuration), T>1 the fused lax.scan tick with donated cache +
     slot state.  One JSON record per T, parity-asserted against static
     ``generate()`` (greedy bitwise), carrying the engine's own dispatch
-    metrics (tokens_per_dispatch, host_ms_per_tick) so the record shows
-    WHERE the speedup comes from, not just that it happened."""
+    metrics (tokens_per_dispatch, dispatches_per_tick, host_ms_per_tick)
+    so the record shows WHERE the speedup comes from, not just that it
+    happened.
+
+    ``chunk`` > 0 runs chunked prefill, which at T>1 rides the UNIFIED
+    ragged tick (chunk advance + decode in one dispatch — T=1 keeps the
+    per-phase alternating engine as the comparison row); ``overlap``
+    drains through the launch/collect pipeline and the record's
+    ``host_overlap_ratio`` shows the measured launch-ahead fraction."""
     import numpy as np
 
     from tpu_parallel.models import GPTLM, tiny_test
@@ -264,6 +272,7 @@ def run_engine(batch, prompt_len, new_tokens, kv_dtype="bf16",
             model, params, n_slots=batch,
             scheduler=SchedulerConfig(max_prefills_per_tick=batch),
             decode_steps_per_tick=steps,
+            prefill_chunk_tokens=chunk or None,
         )
 
         def run_once(n_new):
@@ -271,7 +280,7 @@ def run_engine(batch, prompt_len, new_tokens, kv_dtype="bf16",
                 eng.add_request(Request(prompt=p, max_new_tokens=n_new))
                 for p in prompts
             ]
-            eng.run()
+            eng.run(overlap=overlap)
             return outs
 
         for _ in range(max(warmup, 1)):
@@ -300,6 +309,9 @@ def run_engine(batch, prompt_len, new_tokens, kv_dtype="bf16",
             kv_cache=kv_dtype,
             model="gpt2_125m" if on_tpu else "tiny_256",
             decode_steps_per_tick=steps,
+            prefill_chunk_tokens=chunk or None,
+            unified_tick=eng.unified_tick,
+            overlap=bool(overlap),
             engine_decode_tokens_per_sec=round(
                 batch * (new_tokens - 1) / decode_dt, 1
             ),
@@ -308,6 +320,14 @@ def run_engine(batch, prompt_len, new_tokens, kv_dtype="bf16",
             # metrics accumulate over the `reps` timed runs; report the
             # PER-RUN dispatch count so records compare across --reps
             host_dispatches=round(s["host_dispatches"] / reps),
+            dispatches_per_tick=round(
+                s["host_dispatches"] / max(s["ticks"], 1), 3
+            ),
+            dispatches_per_token=round(
+                s["host_dispatches"] / max(s["tokens_out"], 1), 4
+            ),
+            host_overlap_ratio=s["host_overlap_ratio"],
+            unified_tick_tokens_mean=s["unified_tick_tokens_mean"],
             host_ms_per_tick_p50=s["host_ms_per_tick_p50"],
         )), flush=True)
 
@@ -381,6 +401,14 @@ def main():
     ap.add_argument("--fused-tick", type=str, default="1,4,8,16",
                     help="decode_steps_per_tick values the --engine "
                          "sweep measures (1 = the per-step tick)")
+    ap.add_argument("--chunk", type=int, default=0,
+                    help="--engine: prefill_chunk_tokens (0 = off); at "
+                         "T>1 chunked prompts ride the UNIFIED ragged "
+                         "tick, at T=1 the per-phase alternating engine")
+    ap.add_argument("--overlap", action="store_true",
+                    help="--engine: drain through the double-buffered "
+                         "launch/collect pipeline (records "
+                         "host_overlap_ratio)")
     args = ap.parse_args()
 
     combos = []
@@ -417,7 +445,8 @@ def main():
                                   warmup=args.warmup)
             elif args.engine:
                 run_engine(*combo, ticks=fused_ticks, reps=args.reps,
-                           warmup=args.warmup)
+                           warmup=args.warmup, chunk=args.chunk,
+                           overlap=args.overlap)
                 continue  # run_engine prints one record per T itself
             else:
                 record = run_one(*combo, reps=args.reps, warmup=args.warmup)

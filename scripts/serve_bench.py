@@ -13,6 +13,7 @@ Usage:
       [--slots S] [--new T] [--prompt-min P] [--prompt-max P]
       [--prompt-dist] [--prefix-len P] [--buckets auto|off|B1,B2,...]
       [--chunk C] [--prefix-cache N] [--spec K] [--compare] [--smoke]
+      [--unified-bench --unified-record FILE]
       [--replicas N] [--router rr|least|prefix[,...]] [--fault]
       [--prefix-groups G] [--trace-out FILE] [--metrics-out FILE]
       [--trace-record FILE] [--trace-replay FILE --time-compress X]
@@ -1605,6 +1606,172 @@ def run_kv_hierarchy_bench(model, params, cfg, *, seed, logger,
     return record, violations
 
 
+def run_unified_bench(model, params, cfg, *, seed, logger, n_requests=24):
+    """SERVE_r08: the UNIFIED ragged tick vs the per-phase ALTERNATING
+    engine under a mixed prefill+decode Zipf workload — long multi-chunk
+    prompts (tenant headers drawn Zipf, so the prefix load is realistic)
+    continuously interleaving with in-flight decodes.  Four legs on the
+    IDENTICAL workload: alternating (per-slot chunk extends + fused
+    decode dispatch, ``unified_tick=False``), unified (one dispatch per
+    tick), unified+overlap (the launch/collect pipeline), and the
+    speculative pair (per-step verify vs fused verify blocks).  Gates:
+    every leg bitwise-identical to its baseline; the unified tick cuts
+    device dispatches per delivered token >= 2x vs alternating; ITL p95
+    no worse; measured host/device overlap ratio > 0 on the pipelined
+    leg."""
+    import json
+    import time as _time
+
+    from tpu_parallel.serving import (
+        Request, SchedulerConfig, ServingEngine,
+    )
+
+    if cfg.seq_len < 128:
+        # the CPU tiny default's 32-token window can't hold multi-chunk
+        # prompts plus a decode run — build the bench's own small-but-
+        # real model (the kv-hierarchy bench's pattern)
+        from tpu_parallel.models import GPTLM, tiny_test
+
+        cfg = tiny_test(
+            dtype=jax.numpy.float32, remat=False, d_model=128,
+            n_layers=3, n_heads=4, seq_len=128,
+        )
+        model = GPTLM(cfg)
+        params = model.init(
+            {"params": jax.random.PRNGKey(1)},
+            jax.numpy.ones((1, 8), jax.numpy.int32), train=False,
+        )["params"]
+    chunk = cfg.seq_len // 8           # 16 at seq 128: 3-6 chunks/prompt
+    new_tokens = cfg.seq_len // 8 + 2  # decode long enough to interleave
+    prefix_len = chunk
+    pmax = cfg.seq_len - new_tokens - prefix_len - 1
+    prompts, _ = make_zipf_prompts(
+        cfg, n_requests=n_requests, prompt_min=chunk + 2,
+        prompt_max=pmax, prefix_len=prefix_len, seed=seed, zipf_s=1.1,
+        tenants=8,
+    )
+    legs = {
+        "alternating": dict(
+            prefill_chunk_tokens=chunk, decode_steps_per_tick=8,
+            unified_tick=False,
+        ),
+        "unified": dict(
+            prefill_chunk_tokens=chunk, decode_steps_per_tick=8,
+        ),
+        "unified_overlap": dict(
+            prefill_chunk_tokens=chunk, decode_steps_per_tick=8,
+        ),
+        "alternating_spec": dict(
+            prefill_chunk_tokens=chunk, decode_steps_per_tick=1,
+            draft_tokens=3,
+        ),
+        "unified_spec": dict(
+            prefill_chunk_tokens=chunk, decode_steps_per_tick=8,
+            draft_tokens=3,
+        ),
+    }
+    results, tokens_by_leg = {}, {}
+    for leg, kwargs in legs.items():
+        eng = ServingEngine(
+            model, params, n_slots=4,
+            scheduler=SchedulerConfig(max_prefills_per_tick=2),
+            rng=jax.random.PRNGKey(seed), **kwargs,
+        )
+        outs = [
+            eng.add_request(Request(prompt=p, max_new_tokens=new_tokens))
+            for p in prompts
+        ]
+        # one warm drain compiles every shape, then measure from clean
+        # metrics on the SAME engine (long-lived server discipline)
+        eng.run(overlap=leg == "unified_overlap")
+        warm_tokens = [list(o.tokens) for o in outs]
+        eng.reset_metrics()
+        outs = [
+            eng.add_request(Request(prompt=p, max_new_tokens=new_tokens))
+            for p in prompts
+        ]
+        t0 = _time.perf_counter()
+        eng.run(overlap=leg == "unified_overlap")
+        wall = _time.perf_counter() - t0
+        tokens_by_leg[leg] = [list(o.tokens) for o in outs]
+        assert tokens_by_leg[leg] == warm_tokens  # warm == measured
+        s = eng.metrics.summary()
+        results[leg] = {
+            "host_dispatches": s["host_dispatches"],
+            "tokens_out": s["tokens_out"],
+            "dispatches_per_token": round(
+                s["host_dispatches"] / max(s["tokens_out"], 1), 4
+            ),
+            "prefill_chunks": s["prefill_chunks"],
+            "ticks": s["ticks"],
+            "itl_ms_p50": s["itl_ms_p50"],
+            "itl_ms_p95": s["itl_ms_p95"],
+            "ttft_ms_p95": s["ttft_ms_p95"],
+            "host_ms_per_tick_p50": s["host_ms_per_tick_p50"],
+            "host_ms_per_tick_p95": s["host_ms_per_tick_p95"],
+            "host_overlap_ratio": s["host_overlap_ratio"],
+            "unified_tick_tokens_mean": s["unified_tick_tokens_mean"],
+            "tokens_per_sec": s["tokens_per_sec"],
+            "wall_s": round(wall, 3),
+        }
+    violations = []
+    for base, fast in (
+        ("alternating", "unified"),
+        ("alternating", "unified_overlap"),
+        ("alternating_spec", "unified_spec"),
+        # spec-vs-nonspec greedy parity closes the square
+        ("alternating", "alternating_spec"),
+    ):
+        if tokens_by_leg[base] != tokens_by_leg[fast]:
+            bad = sum(
+                1 for a, b in zip(tokens_by_leg[base], tokens_by_leg[fast])
+                if a != b
+            )
+            violations.append(
+                f"{fast} diverged from {base} on {bad}/{n_requests} "
+                "requests"
+            )
+    cut = (
+        results["alternating"]["dispatches_per_token"]
+        / max(results["unified"]["dispatches_per_token"], 1e-9)
+    )
+    if cut < 2.0:
+        violations.append(
+            f"unified dispatch cut {cut:.2f}x < 2x vs alternating"
+        )
+    if results["unified_overlap"]["host_overlap_ratio"] <= 0:
+        violations.append("pipelined leg measured zero host overlap")
+    itl_base = results["alternating"]["itl_ms_p95"]
+    itl_uni = results["unified"]["itl_ms_p95"]
+    if itl_base is not None and itl_uni is not None and (
+        itl_uni > itl_base * 1.05
+    ):
+        violations.append(
+            f"unified ITL p95 {itl_uni}ms regressed vs alternating "
+            f"{itl_base}ms"
+        )
+    record = {
+        "bench": "serve_unified",
+        "backend": jax.default_backend(),
+        "model": getattr(cfg, "_name", None) or "tiny_128",
+        "seq_len": cfg.seq_len,
+        "n_requests": n_requests,
+        "n_slots": 4,
+        "prompt_zipf": "1.1:8",
+        "prefill_chunk_tokens": chunk,
+        "new_tokens": new_tokens,
+        "decode_steps_per_tick": 8,
+        "dispatch_cut_vs_alternating": round(cut, 2),
+        "legs": results,
+        "bitwise_ok": not any("diverged" in v for v in violations),
+        "invariants_ok": not violations,
+        "violations": violations,
+    }
+    logger.log_record(record)
+    print(json.dumps(record, indent=2))
+    return record, violations
+
+
 class _GarbageDrafter:
     """Adversarial smoke drafter: drafts one more than the true greedy
     next token (it knows the references), so every draft is wrong and the
@@ -1667,8 +1834,25 @@ def smoke(model, params, cfg, prompts, new_tokens):
         "fused_chunked": dict(
             decode_steps_per_tick=4,
             prefill_chunk_tokens=max(2, shortest // 2),
+            unified_tick=False,  # the per-phase pin the unified modes beat
         ),
         "chunked": dict(prefill_chunk_tokens=max(2, shortest // 2)),
+        # the UNIFIED ragged tick: chunked prefill + fused decode in ONE
+        # dispatch per tick (in-device final-chunk activation), and its
+        # speculative form (T draft-verify blocks per dispatch with
+        # in-scan NGram drafting) — both gated bitwise against static
+        # generate() like every other mode; "chunked"/"fused_chunked"
+        # above pin the per-phase (unified_tick=False is implied at T=4
+        # only for fused_chunked's explicit pin) baselines they must
+        # match
+        "unified": dict(
+            prefill_chunk_tokens=max(2, shortest // 2),
+            decode_steps_per_tick=8, unified_tick=True,
+        ),
+        "unified_spec": dict(
+            prefill_chunk_tokens=max(2, shortest // 2),
+            decode_steps_per_tick=4, draft_tokens=3,
+        ),
         "prefix": dict(prefix_cache_size=4),
         "spec": dict(draft_tokens=3),
         "spec_adversarial": dict(
@@ -1789,6 +1973,17 @@ def main():
     ap.add_argument("--kv-record", type=str, default="",
                     help="kv-bench: write the record to this JSON file "
                          "(SERVE_r07.json)")
+    ap.add_argument("--unified-bench", action="store_true",
+                    help="unified-ragged-tick acceptance bench "
+                         "(SERVE_r08): alternating vs unified vs "
+                         "pipelined engines on a mixed prefill+decode "
+                         "Zipf workload at equal budgets — bitwise "
+                         "parity, >= 2x dispatch cut per token, "
+                         "measured host overlap; nonzero exit on any "
+                         "violation")
+    ap.add_argument("--unified-record", type=str, default="",
+                    help="unified-bench: write the record to this JSON "
+                         "file (SERVE_r08.json)")
     ap.add_argument("--capacity-probe", action="store_true",
                     help="emit a serve_paged_capacity record: concurrent "
                          "short-request admissions and burst decode "
@@ -1962,6 +2157,30 @@ def main():
             ),
         )
         print(f"trace recorded: {recorded}")
+
+    if args.unified_bench:
+        import json
+
+        logger = MetricLogger(logdir=".", name=args.out)
+        record, violations = run_unified_bench(
+            model, params, cfg, seed=args.seed, logger=logger,
+            n_requests=min(args.requests, 24),
+        )
+        logger.close()
+        if args.unified_record:
+            with open(args.unified_record, "w") as fh:
+                json.dump(record, fh, indent=2)
+                fh.write("\n")
+            print(f"record: {args.unified_record}")
+        if violations:
+            print(
+                f"unified_bench: {len(violations)} INVARIANT "
+                "VIOLATION(S)",
+                file=sys.stderr,
+            )
+            sys.exit(1)
+        print("unified_bench: all invariants held")
+        return
 
     if args.kv_bench:
         import json

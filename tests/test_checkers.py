@@ -153,6 +153,51 @@ def test_check_host_sync_semantics():
         chs.check_paths((os.path.join(REPO_ROOT, "no_such_dir"),))
 
 
+def test_check_host_sync_launch_rule():
+    """The launch/collect overlap gate: ANY device sync lexically inside
+    a ``launch``/``_launch*`` body flags (loop or no loop — one sync on
+    the launch side serializes the double-buffered pipeline), including
+    inside nested defs, while collect-side syncs, launch-side host-only
+    numpy, and the ``# host-sync:`` whitelist stay legal."""
+    chs = _load("check_host_sync")
+    bad = (
+        "import numpy as np\n"
+        "class Engine:\n"
+        "    def launch(self, fetch):\n"
+        "        a = np.asarray(fetch())\n"
+        "        fetch().block_until_ready()\n"
+        "    def _launch_fused(self, fetch):\n"
+        "        def inner():\n"
+        "            return np.asarray(fetch())\n"
+        "        return inner()\n"
+        "    def collect(self, pending):\n"
+        "        return np.asarray(pending)\n"
+    )
+    found = chs.check_source(bad, "engine.py")
+    assert len(found) == 3, found
+    assert all("launch body" in p for p in found)
+    assert any(":4:" in p for p in found)
+    assert any(":5:" in p for p in found)
+    assert any(":8:" in p for p in found)
+    ok = (
+        "import numpy as np\n"
+        "import jax.numpy as jnp\n"
+        "class Engine:\n"
+        "    def launch(self, prompt):\n"
+        "        block = np.zeros((4, 8), np.int32)\n"
+        "        dev = jnp.asarray(block)\n"
+        "        legal = np.asarray(prompt)  # host-sync: host list\n"
+        "        return dev, legal\n"
+        "    def relaunch_probe(self, fetch):\n"
+        "        return np.asarray(fetch())\n"
+    )
+    assert chs.check_source(ok, "engine.py") == []
+    # the live engine's launch side is clean — the gate would catch a
+    # regression that moved a sync back before the dispatch
+    results = chs.check_paths()
+    assert results == [], results
+
+
 def test_check_blocks_semantics():
     """The block-table gate catches subscript stores, augmented stores
     and deletes; reads, copies and local rebinds stay legal, and the

@@ -597,6 +597,57 @@ def test_crash_midflight_exact_fused_tick(env):
         )
 
 
+def test_crash_midflight_exact_unified_tick(env):
+    """The crash guarantee under the UNIFIED ragged tick (chunked
+    prefill + fused decode in one dispatch, fused speculative verify on
+    the spec leg): a replica dying mid-flight — possibly mid-chunk — is
+    replayed forced-prefix on the survivor, greedy output bitwise equal
+    to a no-fault unified baseline."""
+    _, _, _, prompts, _ = env
+    for kw in (
+        dict(
+            prefill_buckets=(4, 8, 16), prefill_chunk_tokens=4,
+            decode_steps_per_tick=4,
+        ),
+        dict(
+            prefill_buckets=(4, 8, 16), prefill_chunk_tokens=4,
+            decode_steps_per_tick=4, draft_tokens=2,
+        ),
+    ):
+        baseline_eng = _engine(env, **kw)
+        assert baseline_eng.unified_tick
+        base_outs = [
+            baseline_eng.add_request(Request(prompt=p, max_new_tokens=12))
+            for p in prompts
+        ]
+        baseline_eng.run()
+        assert all(o.status == FINISHED for o in base_outs)
+
+        h0 = ReplicaHandle(
+            0, _engine(env, **kw), fault_plan=FaultPlan(crash_at_tick=2)
+        )
+        h1 = ReplicaHandle(1, _engine(env, **kw))
+        fe = Frontend([h0, h1], router="rr")
+        outs = [
+            fe.submit(Request(prompt=p, max_new_tokens=12))
+            for p in prompts
+        ]
+        fe.run(max_ticks=400)
+        assert h0.health == DEAD
+        assert fe.summary()["replica_deaths"] == 1
+        for i, (out, base) in enumerate(zip(outs, base_outs)):
+            assert out.status == FINISHED, (
+                f"request {i}: {out.status} ({out.finish_reason})"
+            )
+            np.testing.assert_array_equal(
+                np.asarray(out.tokens), np.asarray(base.tokens),
+                err_msg=(
+                    f"request {i} diverged after unified-tick failover "
+                    f"({kw})"
+                ),
+            )
+
+
 def test_crash_stream_indices_stay_contiguous(env):
     """Across a failover the client stream never re-delivers or skips:
     every request's event indices are exactly 0..n-1 in order."""

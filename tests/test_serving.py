@@ -1243,17 +1243,24 @@ def test_fused_tick_cancel_from_stream_callback(rng):
 
 
 def test_fused_tick_knob_validation(rng):
-    """decode_steps_per_tick < 1 refuses; explicit T > 1 with an
-    engine-level drafter refuses (spec keeps its per-step verify tick);
-    'auto' resolves to 8 plain and 1 speculative."""
+    """decode_steps_per_tick < 1 refuses; explicit T > 1 with a CUSTOM
+    drafter refuses (in-scan drafting can only mirror the traceable
+    NGram drafter), while the default drafter fuses T verify blocks per
+    dispatch; 'auto' resolves to 8 plain and 1 speculative; unified_tick
+    needs a fused tick."""
     cfg, model, _, params = _build(rng)
     with pytest.raises(ValueError, match="decode_steps_per_tick"):
         ServingEngine(model, params, n_slots=1, decode_steps_per_tick=0)
-    with pytest.raises(NotImplementedError, match="draft_tokens"):
+    with pytest.raises(NotImplementedError, match="drafter"):
         ServingEngine(
             model, params, n_slots=1, decode_steps_per_tick=4,
-            draft_tokens=2,
+            draft_tokens=2, drafter=OracleDrafter({}),
         )
+    spec_fused = ServingEngine(
+        model, params, n_slots=1, decode_steps_per_tick=4, draft_tokens=2,
+    )
+    assert spec_fused.decode_steps_per_tick == 4
+    assert spec_fused._spec_fused_fn is not None
     assert ServingEngine(model, params, n_slots=1).decode_steps_per_tick == 8
     assert (
         ServingEngine(
@@ -1261,6 +1268,409 @@ def test_fused_tick_knob_validation(rng):
         ).decode_steps_per_tick
         == 1
     )
+    with pytest.raises(ValueError, match="unified_tick"):
+        ServingEngine(
+            model, params, n_slots=1, decode_steps_per_tick=1,
+            unified_tick=True,
+        )
+    assert ServingEngine(model, params, n_slots=1).unified_tick
+    assert not ServingEngine(
+        model, params, n_slots=1, unified_tick=False
+    ).unified_tick
+
+
+# -- the unified ragged tick (prefill+decode in one dispatch) ---------------
+
+
+def _drive_interleaved(model, params, prompts, budgets, **kw):
+    """Submit prompts staggered so chunked prefills interleave running
+    decodes, run to idle; returns (engine, outputs)."""
+    eng = ServingEngine(
+        model, params,
+        scheduler=SchedulerConfig(max_prefills_per_tick=2), **kw,
+    )
+    outs = [eng.add_request(_req(prompts[0], budgets[0]))]
+    eng.step()
+    for p, n in zip(prompts[1:], budgets[1:]):
+        outs.append(eng.add_request(_req(p, n)))
+        eng.step()
+    eng.run()
+    return eng, outs
+
+
+@pytest.mark.parametrize(
+    "variant", ["plain", "int8", "paged", "paged_prefix"]
+)
+def test_unified_tick_bitwise_vs_per_phase(rng, variant):
+    """Acceptance (tentpole): the unified ragged tick — chunked prefills
+    and fused decode in ONE dispatch per tick, with in-device
+    final-chunk activation — is BITWISE identical to the per-phase
+    engine (unified_tick=False: per-slot chunk extends, then the decode
+    dispatch) across staggered arrivals, chunk+decode interleave and
+    slot reuse; per layout (fixed / int8 / paged / paged+prefix-cache)."""
+    overrides = {"int8": dict(kv_cache_dtype="int8")}.get(variant, {})
+    cfg, model, _, params = _build(rng, **overrides)
+    layout = {
+        "paged": dict(kv_block_tokens="auto"),
+        "paged_prefix": dict(kv_block_tokens="auto", prefix_cache_size=2),
+    }.get(variant, {})
+    lens, budgets = [3, 12, 9, 14, 5], [9, 5, 7, 4, 6]
+    prompts = [
+        [int(t) for t in np.asarray(
+            jax.random.randint(
+                jax.random.fold_in(rng, 20 + i), (L,), 1, cfg.vocab_size
+            )
+        )]
+        for i, L in enumerate(lens)
+    ]
+    kw = dict(
+        n_slots=2, prefill_buckets=(4, 8, 16), prefill_chunk_tokens=4,
+        decode_steps_per_tick=4, **layout,
+    )
+    phase_eng, phased = _drive_interleaved(
+        model, params, prompts, budgets, unified_tick=False, **kw
+    )
+    uni_eng, unified = _drive_interleaved(
+        model, params, prompts, budgets, unified_tick=True, **kw
+    )
+    assert uni_eng.unified_tick and not phase_eng.unified_tick
+    for i, (a, b) in enumerate(zip(phased, unified)):
+        assert a.status == FINISHED and b.status == FINISHED, (
+            f"request {i}: {a.status} / {b.status}"
+        )
+        np.testing.assert_array_equal(
+            np.asarray(b.tokens), np.asarray(a.tokens),
+            err_msg=f"request {i} ({variant})",
+        )
+    # both really chunked; the unified engine paid FEWER device
+    # dispatches for the same tokens (chunk extends rode the decode
+    # dispatch) — the tick's raison d'etre
+    assert uni_eng.metrics.prefill_chunks >= 3
+    assert phase_eng.metrics.prefill_chunks == uni_eng.metrics.prefill_chunks
+    assert uni_eng.metrics.host_dispatches < phase_eng.metrics.host_dispatches
+    assert uni_eng.pool.n_free == 2
+
+
+def test_unified_tick_chunk_only_progress_regression(rng):
+    """Satellite bugfix: a tick holding ONLY mid-chunk prefill rows (no
+    decode-live slots) makes progress by chunk advancement alone — the
+    no-progress RuntimeError guard must not fire on it.  Pinned by
+    stepping a single long chunked prompt through an otherwise-idle
+    unified engine, tick by tick."""
+    cfg, model, _, params = _build(rng)
+    long = [int(t) for t in np.asarray(
+        jax.random.randint(rng, (14,), 1, cfg.vocab_size)
+    )]
+    ref = np.asarray(generate(
+        model, params, jnp.asarray(long, jnp.int32)[None, :],
+        max_new_tokens=4,
+    ))[0]
+    eng = ServingEngine(
+        model, params, n_slots=1, prefill_buckets=(4, 8, 16),
+        prefill_chunk_tokens=4, decode_steps_per_tick=8,
+    )
+    assert eng.unified_tick
+    out = eng.add_request(_req(long, 4))
+    # ticks 1..3 hold only the mid-chunk prefill row: every one must
+    # advance the chunk (not raise, not spin) and deliver nothing
+    for tick in range(3):
+        events = eng.step()
+        assert events == [], f"tick {tick} delivered early: {events}"
+        assert len(out.tokens) == 0
+    assert eng.metrics.prefill_chunks == 3
+    eng.run()
+    assert out.status == FINISHED
+    np.testing.assert_array_equal(np.asarray(out.tokens), ref)
+
+
+def test_unified_tick_eos_at_activation_and_mid_block(rng):
+    """EOS discipline through the unified tick: an EOS that IS the
+    in-device-sampled first token retires the slot before it ever
+    decodes, and an EOS mid-decode-block truncates delivery — both
+    bitwise equal to the per-phase engine, slot clean for reuse."""
+    cfg, model, _, params = _build(rng)
+    long = [int(t) for t in np.asarray(
+        jax.random.randint(rng, (11,), 1, cfg.vocab_size)
+    )]
+    ref = list(np.asarray(generate(
+        model, params, jnp.asarray(long, jnp.int32)[None, :],
+        max_new_tokens=12,
+    ))[0])
+    kw = dict(
+        n_slots=1, prefill_buckets=(4, 8, 16), prefill_chunk_tokens=4,
+        decode_steps_per_tick=8,
+    )
+
+    def drive(eos, unified):
+        eng = ServingEngine(model, params, unified_tick=unified, **kw)
+        out = eng.add_request(_req(long, 12, eos_token_id=eos))
+        eng.run()
+        nxt = eng.add_request(_req(long, 3))
+        eng.run()
+        assert eng.pool.n_free == 1
+        return out, nxt
+
+    # eos_idx 0: the EOS IS the in-device-sampled activation token (the
+    # request retires without ever decoding); eos_idx 3: EOS lands
+    # mid-decode-block (both engines stop at that token's FIRST greedy
+    # occurrence — wherever it is, they must agree bitwise)
+    for eos_idx in (0, 3):
+        eos = int(ref[eos_idx])
+        a, a_next = drive(eos, unified=False)
+        b, b_next = drive(eos, unified=True)
+        assert a.finish_reason == b.finish_reason == "eos"
+        assert b.tokens == a.tokens and b.tokens[-1] == eos
+        assert b_next.tokens == a_next.tokens
+
+
+def test_unified_tick_chunk_starts_batch(rng):
+    """Scheduler satellite: under the unified tick, chunked prompts
+    share ONE admission group — two long prompts admit the SAME tick
+    (each claiming a slot, both riding the one [n_slots, chunk_tokens]
+    dispatch) instead of serializing one admission per tick; outputs
+    stay bitwise."""
+    cfg, model, _, params = _build(rng)
+    longs = [
+        [int(t) for t in np.asarray(
+            jax.random.randint(
+                jax.random.fold_in(rng, 40 + i), (11 + i,), 1,
+                cfg.vocab_size
+            )
+        )]
+        for i in range(2)
+    ]
+    refs = [
+        np.asarray(generate(
+            model, params, jnp.asarray(p, jnp.int32)[None, :],
+            max_new_tokens=5,
+        ))[0]
+        for p in longs
+    ]
+    eng = ServingEngine(
+        model, params, n_slots=2,
+        scheduler=SchedulerConfig(max_prefills_per_tick=2),
+        prefill_buckets=(4, 8, 16), prefill_chunk_tokens=4,
+        decode_steps_per_tick=4,
+    )
+    outs = [eng.add_request(_req(p, 5)) for p in longs]
+    eng.step()
+    # both admitted (and mid-chunk) after ONE tick
+    assert eng.in_flight == 2 and eng.scheduler.depth == 0
+    eng.run()
+    for out, ref in zip(outs, refs):
+        assert out.status == FINISHED
+        np.testing.assert_array_equal(np.asarray(out.tokens), ref)
+
+
+@pytest.mark.parametrize("variant", ["plain", "int8", "paged"])
+def test_spec_fused_tick_bitwise(rng, variant):
+    """Fused speculative verify: T draft-verify-accept blocks per
+    dispatch with in-scan NGram drafting — bitwise identical to the
+    per-step spec engine AND the static reference across staggered
+    arrivals, budgets exhausting mid-block, per layout."""
+    overrides = {"int8": dict(kv_cache_dtype="int8")}.get(variant, {})
+    cfg, model, _, params = _build(rng, **overrides)
+    layout = (
+        dict(kv_block_tokens="auto") if variant == "paged" else {}
+    )
+    lens, budgets = [3, 9, 6, 12, 5], [6, 5, 9, 3, 7]
+    prompts = [
+        [int(t) for t in np.asarray(
+            jax.random.randint(
+                jax.random.fold_in(rng, 60 + i), (L,), 1, cfg.vocab_size
+            )
+        )]
+        for i, L in enumerate(lens)
+    ]
+    kw = dict(
+        n_slots=2, prefill_buckets=(4, 8, 16), draft_tokens=3, **layout
+    )
+    step_eng, stepped = _drive_engine(
+        model, params, prompts, budgets, staggered=True,
+        decode_steps_per_tick=1, **kw,
+    )
+    fused_eng, fused = _drive_engine(
+        model, params, prompts, budgets, staggered=True,
+        decode_steps_per_tick=4, **kw,
+    )
+    for i, (a, b) in enumerate(zip(stepped, fused)):
+        assert a.status == FINISHED and b.status == FINISHED
+        np.testing.assert_array_equal(
+            np.asarray(b.tokens), np.asarray(a.tokens),
+            err_msg=f"request {i} ({variant})",
+        )
+    # the fused spec engine really amortized its verify dispatches
+    assert fused_eng.metrics.host_dispatches < step_eng.metrics.host_dispatches
+    # and both drafted (the drafter twin really ran in-scan)
+    assert fused_eng.metrics.tokens_drafted > 0
+    assert fused_eng.pool.n_free == 2
+
+
+def test_spec_fused_eos_mid_block_and_chunked(rng):
+    """Fused spec composes with chunked prefill (the unified spec tick)
+    and truncates at EOS mid-verify-block — bitwise vs the per-step
+    spec engine."""
+    cfg, model, prompt, params = _build(rng, n_rows=1, prompt_len=4)
+    ref = list(np.asarray(
+        generate(model, params, prompt[:1], max_new_tokens=12)
+    )[0])
+    eos_idx = next(i for i in range(2, 7) if ref[i] not in ref[:i])
+    eos = int(ref[eos_idx])
+    short = [int(t) for t in np.asarray(prompt[0])]
+    long = [int(t) for t in np.asarray(
+        jax.random.randint(jax.random.fold_in(rng, 3), (12,), 1,
+                           cfg.vocab_size)
+    )]
+
+    def drive(steps):
+        eng = ServingEngine(
+            model, params, n_slots=2,
+            scheduler=SchedulerConfig(max_prefills_per_tick=2),
+            prefill_buckets=(4, 8, 16), prefill_chunk_tokens=4,
+            draft_tokens=3, decode_steps_per_tick=steps,
+        )
+        a = eng.add_request(_req(short, 12, eos_token_id=eos))
+        eng.step()
+        b = eng.add_request(_req(long, 5))
+        eng.run()
+        return a, b
+
+    a1, b1 = drive(1)
+    a4, b4 = drive(4)
+    assert a1.finish_reason == a4.finish_reason == "eos"
+    assert a4.tokens == a1.tokens == ref[: eos_idx + 1]
+    assert b4.tokens == b1.tokens and b1.status == FINISHED
+
+
+def test_unified_tick_compile_count_pin(rng):
+    """Jit compile-count pin: the unified fn compiles ONCE (its chunk
+    and state shapes are fixed by (n_slots, chunk_tokens, seq_len)), so
+    a mixed chunked workload adds the ONE unified program on top of the
+    fused-tick family — the compile-shape family stays O(#buckets + 1)."""
+    from tpu_parallel.serving import engine as engine_mod
+
+    engine_mod._engine_fns.cache_clear()
+    engine_mod._fused_engine_fn.cache_clear()
+    engine_mod._unified_engine_fn.cache_clear()
+    cfg, model, _, params = _build(rng)
+    eng = ServingEngine(
+        model, params, n_slots=4,
+        scheduler=SchedulerConfig(max_prefills_per_tick=2),
+        prefill_buckets=(4, 8, 16), prefill_chunk_tokens=4,
+        decode_steps_per_tick=8,
+    )
+    if not hasattr(eng._unified_fn, "_cache_size"):
+        pytest.skip("jax.jit cache inspection unavailable")
+    lengths = [3, 5, 9, 11, 14, 6, 13]
+    for i, L in enumerate(lengths):
+        p = [int(t) for t in np.asarray(
+            jax.random.randint(
+                jax.random.fold_in(rng, 80 + i), (L,), 1, cfg.vocab_size
+            )
+        )]
+        eng.add_request(_req(p, 2 + (i % 5)))
+        if i % 2:
+            eng.step()
+    eng.run()
+    assert eng.metrics.finished == len(lengths)
+    assert eng._unified_fn._cache_size() == 1  # ONE unified program, ever
+    assert eng._fused_fn._cache_size() == 1
+
+
+def test_run_overlap_bitwise_and_donation_audit(rng):
+    """Double-buffered host/device overlap: run(overlap=True) launches
+    tick N+1 before collecting tick N on pure-decode stretches — output
+    BITWISE identical to the sequential loop, measured overlap ratio
+    > 0, and the donation audit: after a launch the previous tick's
+    state/cache buffers are deleted (donated into the in-flight
+    dispatch), and the engine never reads the pending tick's donated
+    buffers before collect (a read would raise on the deleted buffer)."""
+    cfg, model, _, params = _build(rng)
+    lens, budgets = [3, 5, 9, 4], [12, 9, 11, 10]
+    prompts = [
+        [int(t) for t in np.asarray(
+            jax.random.randint(
+                jax.random.fold_in(rng, 90 + i), (L,), 1, cfg.vocab_size
+            )
+        )]
+        for i, L in enumerate(lens)
+    ]
+    for layout in ({}, {"kv_block_tokens": "auto"}):
+        seq_eng = ServingEngine(
+            model, params, n_slots=2,
+            scheduler=SchedulerConfig(max_prefills_per_tick=2), **layout,
+        )
+        seq = [
+            seq_eng.add_request(_req(p, n))
+            for p, n in zip(prompts, budgets)
+        ]
+        seq_eng.run()
+        ov_eng = ServingEngine(
+            model, params, n_slots=2,
+            scheduler=SchedulerConfig(max_prefills_per_tick=2), **layout,
+        )
+        ov = [
+            ov_eng.add_request(_req(p, n))
+            for p, n in zip(prompts, budgets)
+        ]
+        ov_eng.run(overlap=True)
+        for i, (a, b) in enumerate(zip(seq, ov)):
+            assert a.status == FINISHED and b.status == FINISHED
+            np.testing.assert_array_equal(
+                np.asarray(b.tokens), np.asarray(a.tokens),
+                err_msg=f"request {i} ({layout})",
+            )
+        s = ov_eng.metrics.summary()
+        assert s["host_overlap_ratio"] > 0, layout
+        assert s["overlapped_dispatches"] > 0
+        assert seq_eng.metrics.summary()["host_overlap_ratio"] == 0.0
+    # donation audit on the pipelined pair: launch-ahead donates the
+    # previous tick's state+cache into the in-flight dispatch
+    eng = ServingEngine(model, params, n_slots=1)
+    out = eng.add_request(_req(prompts[0], 28))
+    eng.step()  # admit + first fused tick (clean state now)
+    assert eng._can_launch_ahead()
+    p1 = eng.launch()
+    old_state = jax.tree_util.tree_leaves(eng._dev_state)
+    old_cache = jax.tree_util.tree_leaves(eng.pool.cache)
+    assert eng._can_launch_ahead()
+    p2 = eng.launch(ahead=True)  # donates p1's returned buffers
+    assert all(leaf.is_deleted() for leaf in old_state), (
+        "launch-ahead did not donate the pending tick's state buffers"
+    )
+    assert all(leaf.is_deleted() for leaf in old_cache)
+    ev1 = eng.collect(p1)
+    ev2 = eng.collect(p2)
+    assert len(ev1) == len(ev2) == eng.decode_steps_per_tick
+    eng.run()
+    assert out.status == FINISHED and len(out.tokens) == 28
+
+
+def test_run_overlap_finish_and_retire_in_flight(rng):
+    """Overlap pipeline edge: requests FINISHING inside a pipelined tick
+    retire cleanly — the overlapped surplus tick parks on the device
+    live-mask, the host retires at collect, and the trailing pending
+    tick is always collected (no hang, no stray tokens, slots free)."""
+    cfg, model, prompt, params = _build(rng, n_rows=2)
+    refs = [
+        np.asarray(generate(
+            model, params, prompt[i : i + 1], max_new_tokens=9
+        ))[0]
+        for i in range(2)
+    ]
+    eng = ServingEngine(
+        model, params, n_slots=2,
+        scheduler=SchedulerConfig(max_prefills_per_tick=2),
+        decode_steps_per_tick=4,
+    )
+    outs = [eng.add_request(_req(prompt[i], 9)) for i in range(2)]
+    events = eng.run(overlap=True)
+    for i, out in enumerate(outs):
+        assert out.status == FINISHED and out.finish_reason == "length"
+        np.testing.assert_array_equal(np.asarray(out.tokens), refs[i])
+    assert eng.pool.n_free == 2 and not eng.has_work()
+    # 9-token budgets on 4-step ticks: the finish lands mid-pipeline
+    assert sum(1 for ev in events if ev.token >= 0) == 18
 
 
 @pytest.mark.slow

@@ -254,3 +254,52 @@ def test_generate_speculative_rejects_overlong(rng):
         generate_speculative(
             model, params, prompt, max_new_tokens=4, draft_tokens=-1,
         )
+
+
+def test_ngram_device_drafter_matches_host():
+    """The traceable NGram twin (``ngram_draft_tokens`` — the fused spec
+    tick's in-scan drafter) is TOKEN-IDENTICAL to the host
+    ``NGramDrafter`` on randomized histories: same n-gram ladder, same
+    most-recent-match tie-break, same continuation clamp, same
+    zero-padded block layout.  Swept over context lengths, caps and
+    (max_ngram, min_ngram) configs — this is what makes fused-vs-per-step
+    spec greedy output bitwise by construction."""
+    import numpy as np
+
+    from tpu_parallel.serving.spec_decode import (
+        NGramDrafter,
+        ngram_draft_tokens,
+    )
+
+    rng = np.random.default_rng(0)
+    L, k = 24, 4
+    for max_ngram, min_ngram in ((3, 1), (2, 2), (4, 1)):
+        host = NGramDrafter(max_ngram=max_ngram, min_ngram=min_ngram)
+        hist = np.zeros((64, L), np.int32)
+        hlen = np.zeros(64, np.int32)
+        cap = np.zeros(64, np.int32)
+        want_drafts = np.zeros((64, k), np.int32)
+        want_dlen = np.zeros(64, np.int32)
+        for r in range(64):
+            n = int(rng.integers(1, L + 1))
+            # small vocab so suffix n-grams actually recur
+            ctx = rng.integers(0, 4, size=n).astype(np.int32)
+            hist[r, :n] = ctx
+            hlen[r] = n
+            cap[r] = int(rng.integers(0, k + 1))
+            d = list(host.draft([int(t) for t in ctx], int(cap[r])))[
+                : int(cap[r])
+            ]
+            want_dlen[r] = len(d)
+            want_drafts[r, : len(d)] = d
+        drafts, dlen = ngram_draft_tokens(
+            hist, hlen, cap, k, max_ngram=max_ngram, min_ngram=min_ngram
+        )
+        np.testing.assert_array_equal(
+            np.asarray(dlen), want_dlen,
+            err_msg=f"dlen (max_ngram={max_ngram}, min_ngram={min_ngram})",
+        )
+        np.testing.assert_array_equal(
+            np.asarray(drafts), want_drafts,
+            err_msg=f"drafts (max_ngram={max_ngram}, min_ngram={min_ngram})",
+        )

@@ -129,9 +129,11 @@ def decode_step(
     :func:`_generate_core` scan body (aligned batches, ``write_index=None``)
     and the continuous-batching engine (``tpu_parallel.serving.engine``,
     which passes per-row ``write_index`` so each slot's K/V lands at its own
-    cache depth — both on its per-step tick and as the scan body of its
-    FUSED multi-step tick, which is what makes fused-vs-per-step greedy
-    output bitwise identical by construction).
+    cache depth — on its per-step tick, as the scan body of its FUSED
+    multi-step tick, and as the decode phase of its UNIFIED ragged tick
+    right after a :func:`prefill_extend_step` chunk phase in the same
+    dispatch; sharing this one core is what makes every tick family's
+    greedy output bitwise identical by construction).
     """
     hidden, updated = model.apply(
         {"params": params, "cache": cache},
@@ -208,6 +210,15 @@ def prefill_extend_step(model: GPTLM, params, cache, tokens: jax.Array,
     ``CachePool.copy_prefix`` lands a cached prefix, the prompt remainder
     runs through here at ``write_start = prefix_len``.  Returns
     ``(hidden [b, T, d_model], cache)``.
+
+    RAGGED MULTI-PHASE batches (the engine's unified tick): ``b`` is the
+    whole slot pool and only SOME rows are prefilling — non-prefill rows
+    ride as all-pad (every position -1) with ``write_start`` parked at
+    ``seq_len``, so their writes drop whole-row and their outputs are
+    never read.  Per-row ``write_start`` plus per-row pad raggedness is
+    exactly the bucketed-prefill discipline, so mixing phases in one
+    call changes no row's math (row-parallel ops — the same argument
+    that makes batch composition invisible everywhere else).
     """
     hidden, updated = model.apply(
         {"params": params, "cache": cache},

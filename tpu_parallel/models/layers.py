@@ -530,8 +530,13 @@ class Attention(nn.Module):
     instead of the shared scalar ``cache_index`` — rows in the same step
     may sit at different depths of their generations, and a multi-token
     step extends a row's cache by one prompt chunk (the engine's chunked
-    prefill).  The attention read is unchanged (it already keys off the
-    stored per-slot position table, not slot indices), so aligned and
+    prefill).  A row whose ``write_index`` is parked at ``seq_len``
+    drops its ENTIRE multi-token write (every target out of range /
+    unmapped — the scatter-discard contract), which is what lets the
+    engine's unified ragged tick run one fixed-shape chunk pass over
+    the whole slot pool with only the prefilling rows landing writes.
+    The attention read is unchanged (it already keys off the stored
+    per-slot position table, not slot indices), so aligned and
     slot-indexed layouts read identically.
     """
 

@@ -11,14 +11,24 @@ the host pays one dispatch + one sync per 8 tokens instead of per token —
 and lets requests join (prefill into a freed slot) and leave (EOS /
 length retirement) between ticks:
 
-- tick = [chunked-prefill advance] + [admissions] + [one decode tick:
-  ``decode_steps_per_tick`` fused scan steps, each masked per slot] +
-  [retirements].  With ``draft_tokens > 0`` the decode step becomes a
-  SPECULATIVE verify tick (``serving/spec_decode.py``): a host-side
-  drafter proposes up to K tokens per slot, one multi-token forward
-  scores them all, and each slot advances by its accepted prefix + one
-  bonus token — output provably identical to one-token ticks (greedy:
-  bitwise; sampled: in distribution via the Leviathan rejection rule).
+- tick = [admissions] + [ONE unified ragged dispatch: every in-flight
+  chunked prefill consumes its next prompt chunk (in-device final-chunk
+  activation) while decode slots run ``decode_steps_per_tick`` fused
+  scan steps, each masked per slot] + [retirements].  The per-phase
+  form (``unified_tick=False``, or T=1) advances chunks as separate
+  per-slot extend dispatches before the decode dispatch — the parity
+  baseline the unified tick is pinned bitwise against.  With
+  ``draft_tokens > 0`` the decode step becomes a SPECULATIVE verify
+  tick (``serving/spec_decode.py``): a drafter proposes up to K tokens
+  per slot, one multi-token forward scores them all, and each slot
+  advances by its accepted prefix + one bonus token — output provably
+  identical to one-token ticks (greedy: bitwise; sampled: in
+  distribution via the Leviathan rejection rule).  An explicit T > 1
+  fuses T whole draft-verify-accept blocks per dispatch, drafting
+  in-scan from a device-resident token history via the traceable NGram
+  twin.  ``step()`` itself is ``collect(launch())``; ``run(
+  overlap=True)`` pipelines the halves one tick deep so tick N's host
+  sync + delivery overlaps tick N+1's device compute.
 - the decode step threads per-slot positions and per-slot cache write
   indices (``write_index`` — the slot-indexed write path in
   ``models/layers.py``) because rows sit at different depths of their
@@ -174,8 +184,10 @@ from tpu_parallel.serving.spec_decode import (
     Drafter,
     NGramDrafter,
     adapt_draft_len,
+    adapt_draft_len_traced,
     draft_for_row,
     filter_logits,
+    ngram_draft_tokens,
     verify_tokens,
 )
 
@@ -412,6 +424,241 @@ def _verify_core(
     return out_tokens, accepted, cache
 
 
+def _ragged_chunk_phase(
+    model, params, tok, pos, widx, live, budget, eos, temp, topk, topp,
+    ctoks, clen, cstart, cfinal, cbudget, cache, rng, table=None,
+):
+    """The unified tick's PREFILL PHASE: one multi-token forward over the
+    fixed ``[n_slots, chunk_tokens]`` input block advances every
+    mid-chunked-prefill slot by its next prompt chunk while every other
+    row rides along as padding (positions -1, writes parked at column
+    ``seq_len`` — the standard ragged discard).  Rows whose chunk
+    COMPLETES their prompt (``cfinal``) activate IN-DEVICE: their first
+    token samples from the chunk's last real position's logits with the
+    slot's own knobs and the slot state flips to decode-live — so a
+    prompt can finish prefilling and start decoding inside the SAME
+    dispatch, exactly as the per-phase engine's advance-then-decode tick
+    ordering, minus its extra dispatch + sync per chunk slot.
+
+    ``ctoks`` [n, C] right-padded chunk tokens, ``clen`` [n] real tokens
+    this tick (0 = row not prefilling), ``cstart`` [n] the slot's prefill
+    depth (write offset), ``cfinal`` [n] whether this chunk is the
+    prompt's last, ``cbudget`` [n] ``max_new_tokens`` for activating
+    rows.  Returns ``(act_emit [n], new slot state, cache)`` where
+    ``act_emit`` carries each activating row's sampled first token (-1
+    elsewhere) — delivered by the host BEFORE the tick's decode tokens,
+    mirroring the per-phase activation order.
+    """
+    cfg = model.config
+    seq_len = cfg.seq_len
+    chunk = ctoks.shape[1]
+    iota = jnp.arange(chunk, dtype=jnp.int32)[None, :]
+    positions = jnp.where(
+        iota < clen[:, None], cstart[:, None] + iota, -1
+    )
+    wstart = jnp.where(clen > 0, cstart, seq_len)
+    hidden, cache = prefill_extend_step(
+        model, params, cache, ctoks, positions, wstart, block_table=table
+    )
+    logits = _full_last_logits(
+        cfg, params, hidden, jnp.maximum(clen - 1, 0)
+    )
+    tok0 = sample_tokens(logits, rng, temp, topk, topp)
+    act = cfinal & (clen > 0)
+    nb = cbudget - 1
+    done0 = (tok0 == eos) | (nb <= 0)
+    new_pos = cstart + clen
+    tok = jnp.where(act, tok0, tok)
+    pos = jnp.where(act, new_pos, pos)
+    widx = jnp.where(act, new_pos, widx)
+    budget = jnp.where(act, nb, budget)
+    live = jnp.where(act, ~done0, live)
+    act_emit = jnp.where(act, tok0, -1)
+    return act_emit, (tok, pos, widx, live, budget), cache
+
+
+def _unified_tick_core(
+    model, params, steps, tok, pos, widx, live, budget, eos, temp, topk,
+    topp, ctoks, clen, cstart, cfinal, cbudget, cache, rng, table=None,
+):
+    """THE unified ragged engine tick: prefill-chunk slots consume their
+    next prompt chunk (with in-device final-chunk activation,
+    :func:`_ragged_chunk_phase`) and decode slots run ``steps`` masked
+    decode scan steps (:func:`_fused_decode_core`) in ONE jitted
+    dispatch — a tick that previously cost one extend dispatch PER chunk
+    slot plus the decode dispatch now costs exactly one, and a prefill
+    chunk no longer stalls in-flight decodes for a dispatch of its own.
+    Greedy output is bitwise identical to the per-phase engine by the
+    same row-parallel argument as the batched bucketed prefill (batch
+    composition is invisible to each row; every op is row/position
+    parallel).  Returns ``(act_emit [n], block [steps, n], counts [n],
+    state, cache)``.
+    """
+    rng_act, rng_scan = jax.random.split(rng)
+    act_emit, (tok, pos, widx, live, budget), cache = _ragged_chunk_phase(
+        model, params, tok, pos, widx, live, budget, eos, temp, topk,
+        topp, ctoks, clen, cstart, cfinal, cbudget, cache, rng_act,
+        table=table,
+    )
+    block, counts, state, cache = _fused_decode_core(
+        model, params, steps, tok, pos, widx, live, budget, eos, temp,
+        topk, topp, cache, rng_scan, table=table,
+    )
+    return act_emit, block, counts, state, cache
+
+
+def _fused_spec_core(
+    model, params, steps, k, max_ngram, min_ngram, adaptive, tok, pos,
+    widx, live, budget, keff, hist, eos, temp, topk, topp, kmax,
+    cache, rng, table=None,
+):
+    """``steps`` speculative draft-verify-accept blocks in ONE jitted
+    ``lax.scan`` — the fused treatment of the verify tick, which before
+    this paid one dispatch + one sync per block.  Each scan step is the
+    per-step :func:`_verify_core` tick verbatim (same
+    :func:`~tpu_parallel.models.generate.verify_step`, same
+    :func:`~tpu_parallel.serving.spec_decode.verify_tokens` sharing
+    ``filter_logits`` with the sampler), with the two host-side jobs
+    folded on device:
+
+    - DRAFTING: block ``t+1``'s context contains block ``t``'s accepted
+      tokens, so the scan carries the per-slot token ``hist`` [n,
+      seq_len] and drafts via
+      :func:`~tpu_parallel.serving.spec_decode.ngram_draft_tokens` — the
+      traceable twin of the host ``NGramDrafter`` (token-identical, so
+      fused-vs-per-step greedy output stays bitwise; the engine refuses
+      to fuse any OTHER drafter, whose host state the scan cannot see).
+    - ADAPTATION: ``keff`` rides the carry and grows/shrinks per block
+      by the shared :func:`adapt_draft_len` law (``adaptive`` static).
+
+    Budget/EOS discipline matches the per-step tick token-for-token: a
+    block delivers ``accepted + 1`` tokens truncated at the first EOS,
+    the slot drops out of ``live`` (writes parked at ``seq_len``), and
+    surplus verify K/V beyond the finish is dead weight masked by the
+    aligned layout.  Returns ``(blocks [steps, n, K+1], counts
+    [steps, n], drafted [steps, n], accepted [steps, n], state, cache)``
+    where ``counts`` is each block's DELIVERED token count per slot
+    (0 = slot not live that block).
+    """
+    cfg = model.config
+    seq_len = cfg.seq_len
+    offs = jnp.arange(k + 1, dtype=jnp.int32)[None, :]
+    rows = jnp.arange(tok.shape[0])
+
+    def body(carry, step_rng):
+        tok, pos, widx, live, budget, keff, hist, cache = carry
+        hlen = pos + 1
+        cap = jnp.minimum(
+            jnp.minimum(keff, seq_len - 1 - widx), budget - 1
+        )
+        cap = jnp.where(live, jnp.maximum(cap, 0), 0)
+        drafts, dlen = ngram_draft_tokens(
+            hist, hlen, cap, k, max_ngram, min_ngram
+        )
+        dlen = jnp.where(live, dlen, 0)
+        widx_eff = jnp.where(live, widx, seq_len)
+        tokens = jnp.concatenate([tok[:, None], drafts], axis=1)
+        positions = jnp.where(
+            offs <= dlen[:, None], pos[:, None] + offs, -1
+        )
+        hidden, cache = verify_step(
+            model, params, cache, tokens, positions, widx_eff,
+            block_table=table,
+        )
+        logits = _full_logits(cfg, params, hidden)
+        out_tokens, accepted = verify_tokens(
+            drafts, dlen, logits, step_rng, temp, topk, topp
+        )
+        # delivery truncation, the per-step host loop's law: accepted + 1
+        # tokens, cut at the first EOS; a length finish only ever lands
+        # on the block's last token (draft_for_row's budget clamp)
+        in_block = offs <= accepted[:, None]
+        is_eos = (out_tokens == eos[:, None]) & in_block
+        eos_at = jnp.where(
+            is_eos.any(axis=1),
+            jnp.argmax(is_eos, axis=1).astype(jnp.int32),
+            k + 1,
+        )
+        e = jnp.minimum(accepted + 1, eos_at + 1)
+        e = jnp.where(live, e, 0)
+        emitted = jnp.where(offs < e[:, None], out_tokens, -1)
+        new_budget = budget - e
+        done = live & (is_eos.any(axis=1) | (new_budget <= 0))
+        # history gains the block's accepted + bonus tokens at columns
+        # pos + 1 + j (out-of-range targets for dead rows drop)
+        for j in range(k + 1):
+            col = jnp.where(
+                live & (j <= accepted), pos + 1 + j, seq_len
+            )
+            hist = hist.at[rows, col].set(out_tokens[:, j])
+        adv = jnp.where(live, accepted + 1, 0)
+        pos = pos + adv
+        widx = widx + adv
+        tok = jnp.where(
+            live,
+            jnp.take_along_axis(
+                out_tokens, accepted[:, None], axis=1
+            )[:, 0],
+            tok,
+        )
+        budget = jnp.where(live, new_budget, budget)
+        new_live = live & ~done
+        if adaptive:
+            keff = jnp.where(
+                new_live & (kmax > 0),
+                adapt_draft_len_traced(keff, dlen, accepted, kmax),
+                keff,
+            )
+        return (
+            (tok, pos, widx, new_live, budget, keff, hist, cache),
+            (emitted, e, dlen, accepted),
+        )
+
+    (tok, pos, widx, live, budget, keff, hist, cache), outs = lax.scan(
+        body,
+        (tok, pos, widx, live, budget, keff, hist, cache),
+        jax.random.split(rng, steps),
+    )
+    blocks, counts, drafted, accepted = outs
+    return (
+        blocks, counts, drafted, accepted,
+        (tok, pos, widx, live, budget, keff, hist), cache,
+    )
+
+
+def _unified_spec_core(
+    model, params, steps, k, max_ngram, min_ngram, adaptive, tok, pos,
+    widx, live, budget, keff, hist, eos, temp, topk, topp, kmax, ctoks,
+    clen, cstart, cfinal, cbudget, cache, rng, table=None,
+):
+    """The unified ragged tick's SPECULATIVE form: the same chunk-phase
+    prologue as :func:`_unified_tick_core` (a freshly-activated row's
+    first token lands in ``hist`` so the first verify block can draft
+    from it), then ``steps`` fused draft-verify blocks
+    (:func:`_fused_spec_core`) — prefill chunks, activation, drafting,
+    verify and acceptance all inside one dispatch."""
+    seq_len = model.config.seq_len
+    rng_act, rng_scan = jax.random.split(rng)
+    act_emit, (tok, pos, widx, live, budget), cache = _ragged_chunk_phase(
+        model, params, tok, pos, widx, live, budget, eos, temp, topk,
+        topp, ctoks, clen, cstart, cfinal, cbudget, cache, rng_act,
+        table=table,
+    )
+    act = act_emit >= 0
+    # an activating row's context = prompt (uploaded with the state) +
+    # its first token; its draft length starts at the slot cap
+    rows = jnp.arange(tok.shape[0])
+    col = jnp.where(act, cstart + clen, seq_len)
+    hist = hist.at[rows, col].set(jnp.maximum(act_emit, 0))
+    keff = jnp.where(act, kmax, keff)
+    blocks, counts, drafted, accepted, state, cache = _fused_spec_core(
+        model, params, steps, k, max_ngram, min_ngram, adaptive, tok,
+        pos, widx, live, budget, keff, hist, eos, temp, topk, topp,
+        kmax, cache, rng_scan, table=table,
+    )
+    return act_emit, blocks, counts, drafted, accepted, state, cache
+
+
 def _extend_core_paged(
     model, params, tokens, positions, last_idx, write_start, table, cache,
     rng,
@@ -523,31 +770,6 @@ def _engine_fns(model):
     return prefill, extend, decode, verify, sample, insert, default_row_fns()
 
 
-@jax.jit
-def _own_slot_state(tok, pos, widx, live, budget, eos, temp, topk, topp):
-    """ONE dispatch that turns the host's slot-state upload into
-    XLA-OWNED buffers.  ``jnp.asarray`` of a numpy array can be a
-    zero-copy VIEW of host memory on CPU, and the fused tick DONATES the
-    state tuple — donating a borrowed buffer lets XLA recycle memory it
-    does not own, so the returned state would alias freed numpy storage
-    and later host allocations scribble over the live slot state
-    (observed as flaky mid-run corruption under heap churn).  Routing
-    every array through an actual computation defeats jax's
-    input->output forwarding, so the results are always
-    device-allocated; doing all nine in one jitted call keeps the
-    upload at one dispatch instead of nine eager ones."""
-
-    def own(x):
-        if x.dtype == jnp.bool_:
-            return jnp.logical_and(x, True)
-        return x + jnp.zeros((), x.dtype)
-
-    return (
-        tuple(own(x) for x in (tok, pos, widx, live, budget)),
-        tuple(own(x) for x in (eos, temp, topk, topp)),
-    )
-
-
 @functools.lru_cache(maxsize=8)
 def _fused_engine_fn(model, steps: int):
     """The jitted fused decode tick at compiled width ``steps``, cached
@@ -579,6 +801,123 @@ def _paged_fused_engine_fn(model, steps: int):
         ),
         donate_argnums=(1, 4),
     )
+
+
+@functools.lru_cache(maxsize=8)
+def _unified_engine_fn(model, steps: int, chunk: int):
+    """The jitted UNIFIED ragged tick (chunk phase + decode scan) at
+    compiled widths ``(steps, chunk)`` — exactly ONE program per engine
+    configuration, so the compile-shape family stays O(#buckets + 1):
+    the bucketed prefill/extend shapes plus this.  Donation contract
+    matches :func:`_fused_engine_fn` (slot state + cache donated; knobs
+    and the per-tick chunk operands are small uploads, never donated),
+    and the state tuples are structurally identical, so pure-decode
+    ticks chain the SAME donated carry through ``_fused_fn`` without a
+    re-upload."""
+    return jax.jit(
+        lambda params, state, knobs, chunk_ops, cache, rng: (
+            _unified_tick_core(
+                model, params, steps, *state, *knobs, *chunk_ops, cache,
+                rng,
+            )
+        ),
+        donate_argnums=(1, 4),
+    )
+
+
+@functools.lru_cache(maxsize=8)
+def _paged_unified_engine_fn(model, steps: int, chunk: int):
+    """Paged variant of :func:`_unified_engine_fn`: the block table rides
+    the tick's inputs un-donated exactly as on the paged fused tick —
+    both the chunk phase's multi-token writes and the decode scan route
+    through it, loop-invariant through the scan."""
+    return jax.jit(
+        lambda params, state, knobs, chunk_ops, table, cache, rng: (
+            _unified_tick_core(
+                model, params, steps, *state, *knobs, *chunk_ops, cache,
+                rng, table=table,
+            )
+        ),
+        donate_argnums=(1, 5),
+    )
+
+
+@functools.lru_cache(maxsize=8)
+def _fused_spec_engine_fn(
+    model, steps: int, chunk: int, k: int, max_ngram: int, min_ngram: int,
+    adaptive: bool,
+):
+    """The jitted FUSED speculative tick: ``steps`` draft-verify-accept
+    blocks per dispatch (``chunk`` > 0 additionally folds the ragged
+    chunk phase in front — the unified spec tick).  The spec slot state
+    (the fused 5-tuple + per-slot draft length + the token-history
+    carry) and the cache are donated; knobs/chunk operands are not."""
+    if chunk > 0:
+        return jax.jit(
+            lambda params, state, knobs, chunk_ops, cache, rng: (
+                _unified_spec_core(
+                    model, params, steps, k, max_ngram, min_ngram,
+                    adaptive, *state, *knobs, *chunk_ops, cache, rng,
+                )
+            ),
+            donate_argnums=(1, 4),
+        )
+    return jax.jit(
+        lambda params, state, knobs, cache, rng: _fused_spec_core(
+            model, params, steps, k, max_ngram, min_ngram, adaptive,
+            *state, *knobs, cache, rng,
+        ),
+        donate_argnums=(1, 3),
+    )
+
+
+@functools.lru_cache(maxsize=8)
+def _paged_fused_spec_engine_fn(
+    model, steps: int, chunk: int, k: int, max_ngram: int, min_ngram: int,
+    adaptive: bool,
+):
+    """Paged :func:`_fused_spec_engine_fn` — block table un-donated."""
+    if chunk > 0:
+        return jax.jit(
+            lambda params, state, knobs, chunk_ops, table, cache, rng: (
+                _unified_spec_core(
+                    model, params, steps, k, max_ngram, min_ngram,
+                    adaptive, *state, *knobs, *chunk_ops, cache, rng,
+                    table=table,
+                )
+            ),
+            donate_argnums=(1, 5),
+        )
+    return jax.jit(
+        lambda params, state, knobs, table, cache, rng: _fused_spec_core(
+            model, params, steps, k, max_ngram, min_ngram, adaptive,
+            *state, *knobs, cache, rng, table=table,
+        ),
+        donate_argnums=(1, 4),
+    )
+
+
+@jax.jit
+def _own_arrays(tree):
+    """ONE dispatch that turns a host-array upload into XLA-OWNED
+    buffers — THE ownership laundering every donated upload must pass
+    through.  ``jnp.asarray`` of a numpy array can be a zero-copy VIEW
+    of host memory on CPU, and the fused/unified ticks DONATE the
+    slot-state tree — donating a borrowed buffer lets XLA recycle
+    memory it does not own, so the returned state would alias freed
+    numpy storage and later host allocations scribble over the live
+    slot state (observed as flaky mid-run corruption under heap
+    churn).  Routing every array through an actual computation defeats
+    jax's input->output forwarding, so the results are always
+    device-allocated; one jitted call per tree structure keeps the
+    upload at one dispatch instead of one per leaf."""
+
+    def own(x):
+        if x.dtype == jnp.bool_:
+            return jnp.logical_and(x, True)
+        return x + jnp.zeros((), x.dtype)
+
+    return jax.tree_util.tree_map(own, tree)
 
 
 @functools.lru_cache(maxsize=8)
@@ -641,6 +980,37 @@ class _ChunkState:
         self.offset = offset
 
 
+class _PendingTick:
+    """One engine tick in flight between :meth:`ServingEngine.launch` and
+    :meth:`ServingEngine.collect`: the dispatch's UNSYNCED device result
+    handles (``payload``) plus the host bookkeeping collect needs.  The
+    donation-ownership contract rides here — every buffer the launch's
+    dispatch returned (slot state, cache pool) is device-owned and must
+    not be read until this tick's collect."""
+
+    __slots__ = (
+        "kind", "start", "t0", "tick_span", "events", "admitted",
+        "chunks_advanced", "chunk_tokens", "chunk_spans", "active_tokens",
+        "entering", "finals", "payload", "overlapped",
+    )
+
+    def __init__(self, start: float):
+        self.kind = "idle"
+        self.start = start
+        self.t0 = 0.0
+        self.tick_span = None
+        self.events: List[StreamEvent] = []
+        self.admitted: List[RequestOutput] = []
+        self.chunks_advanced = 0
+        self.chunk_tokens = 0
+        self.chunk_spans: List[tuple] = []
+        self.active_tokens = 0
+        self.entering: Tuple[int, ...] = ()
+        self.finals: List[tuple] = []
+        self.payload = None
+        self.overlapped = False
+
+
 class ServingEngine:
     """In-process continuous-batching engine over one model + params.
 
@@ -693,9 +1063,38 @@ class ServingEngine:
       mid-scan (EOS, budget) park their writes at column ``seq_len`` for
       the remaining steps.  Streaming granularity becomes per-tick
       (bounded by T).  ``"auto"`` (default) = 8; spec engines
-      (``draft_tokens > 0``) and mesh serving keep their per-step paths
-      (auto resolves to 1 there; explicit T > 1 raises).  1 = the
-      per-step engine.
+      (``draft_tokens > 0``) resolve to 1 under "auto" but an EXPLICIT
+      T > 1 fuses T draft-verify blocks per dispatch (below); mesh
+      serving keeps the per-step path (auto resolves to 1; explicit
+      T > 1 raises).  1 = the per-step engine.
+    - ``unified_tick``: the UNIFIED RAGGED tick — prefill-chunk slots
+      consume their next prompt chunk (fixed ``[n_slots, chunk_tokens]``
+      input block, right-padded, pad positions -1) while decode slots
+      run their T masked scan steps, in ONE jitted dispatch per engine
+      tick, with final-chunk activation (first-token sampling) done
+      in-device.  A tick that used to pay one extend dispatch PER chunk
+      slot plus the decode dispatch pays exactly one, so prefill chunks
+      stop stalling in-flight decodes (Sarathi-Serve's stall-free
+      coalesced batching over this engine's bucket quantum).  ``"auto"``
+      (default) = on whenever the fused tick is; ``False`` keeps the
+      per-phase advance-then-decode tick (the parity baseline — greedy
+      output is bitwise identical either way, pinned in tests).  With
+      ``draft_tokens > 0`` and an explicit T > 1 the same treatment
+      fuses SPECULATIVE ticks: T draft-verify-accept blocks per
+      dispatch, drafting in-scan via the traceable NGram twin
+      (:func:`~tpu_parallel.serving.spec_decode.ngram_draft_tokens`) —
+      custom drafters refuse (their host state is invisible mid-scan).
+
+    Double-buffered host/device overlap (``run(overlap=True)``, or the
+    :meth:`launch` / :meth:`collect` halves directly): tick N's
+    device->host sync, delivery and admission bookkeeping overlap tick
+    N+1's device compute — :meth:`launch` dispatches without syncing and
+    :meth:`collect` syncs/delivers, with a one-tick-deep pipeline on
+    pure-decode ticks (the only ticks whose launch reads no host-mutable
+    state).  The donation-ownership contract from the fused tick is the
+    invariant: buffers a launch's dispatch returned belong to the device
+    until that tick's collect (``scripts/check_host_sync.py`` gates
+    launch bodies against syncs lexically).
 
     Speculative decode knobs (exact for every drafter — see the module
     docstring and ``docs/10_serving_engine.md``):
@@ -752,6 +1151,7 @@ class ServingEngine:
         kv_radix_cache: bool = False,
         kv_host_blocks: int = 0,
         decode_steps_per_tick: Union[int, str] = "auto",
+        unified_tick: Union[str, bool] = "auto",
         draft_tokens: int = 0,
         drafter: Optional[Drafter] = None,
         spec_adaptive: bool = True,
@@ -961,8 +1361,12 @@ class ServingEngine:
         # fused multi-step decode tick: T > 1 runs T masked decode steps
         # in one jitted lax.scan with the cache AND the per-slot state
         # donated — one host dispatch + one sync per T tokens ("auto" =
-        # 8; the spec path keeps its per-step verify tick, and the
-        # shard_map harness exposes no donation, so both resolve to 1)
+        # 8 plain; speculative engines resolve to 1 under "auto" — an
+        # EXPLICIT T > 1 with draft_tokens > 0 instead fuses T
+        # draft-verify blocks per dispatch, drafting ON DEVICE via the
+        # traceable NGram twin, so it refuses custom drafters whose
+        # host state the scan cannot see; the shard_map harness exposes
+        # no donation, so a mesh always resolves/refuses to 1)
         if decode_steps_per_tick == "auto":
             fused = 1 if (draft_tokens > 0 or mesh is not None) else 8
         else:
@@ -971,11 +1375,15 @@ class ServingEngine:
                 raise ValueError(
                     f"decode_steps_per_tick={decode_steps_per_tick} < 1"
                 )
-            if fused > 1 and draft_tokens > 0:
+            if fused > 1 and draft_tokens > 0 and (
+                type(self._drafter) is not NGramDrafter
+            ):
                 raise NotImplementedError(
-                    "decode_steps_per_tick > 1 with draft_tokens > 0 — "
-                    "speculative slots keep the per-step verify tick "
-                    "(draft-verify is itself a multi-token tick)"
+                    "decode_steps_per_tick > 1 with draft_tokens > 0 "
+                    "fuses T draft-verify blocks in one scan, drafting "
+                    "on device via the traceable NGram drafter — a "
+                    "custom Drafter's host state is invisible to the "
+                    "scan; keep decode_steps_per_tick=1 for it"
                 )
             if fused > 1 and mesh is not None:
                 raise NotImplementedError(
@@ -984,12 +1392,58 @@ class ServingEngine:
                     "— mesh serving decodes per-step"
                 )
         self._fused_steps = fused
-        if fused > 1:
+        self._spec_fused = fused > 1 and draft_tokens > 0
+        # the UNIFIED ragged tick: prefill-chunk slots and decode slots
+        # advance in ONE dispatch per tick (phase mask + per-slot token
+        # raggedness; in-device final-chunk activation).  "auto" turns
+        # it on whenever the fused tick is ("False" keeps the per-phase
+        # chunk-advance-then-decode tick — the parity baseline).
+        if unified_tick not in ("auto", True, False):
+            raise ValueError(f"unified_tick={unified_tick!r}")
+        if unified_tick is True and fused < 2:
+            raise ValueError(
+                "unified_tick=True needs decode_steps_per_tick > 1 (the "
+                "unified tick IS the fused tick with the ragged chunk "
+                "phase folded in)"
+            )
+        self._unified = (
+            fused > 1 and mesh is None
+            if unified_tick == "auto"
+            else bool(unified_tick)
+        )
+        chunkw = int(prefill_chunk_tokens or 0) if self._unified else 0
+        self._unified_fn = None
+        self._spec_fused_fn = None
+        self._spec_unified_fn = None
+        if self._spec_fused:
+            mk = (
+                _paged_fused_spec_engine_fn
+                if self._paged
+                else _fused_spec_engine_fn
+            )
+            spec_sig = (
+                draft_tokens, self._drafter.max_ngram,
+                self._drafter.min_ngram, bool(spec_adaptive),
+            )
+            # two programs when chunking is configured: the pure-decode
+            # fused verify scan and the unified (chunk-phase) variant;
+            # their state tuples match, so the donated carry chains
+            self._spec_fused_fn = mk(model, fused, 0, *spec_sig)
+            if chunkw > 0:
+                self._spec_unified_fn = mk(model, fused, chunkw, *spec_sig)
+            self._fused_fn = None
+        elif fused > 1:
             self._fused_fn = (
                 _paged_fused_engine_fn(model, fused)
                 if self._paged
                 else _fused_engine_fn(model, fused)
             )
+            if chunkw > 0:
+                self._unified_fn = (
+                    _paged_unified_engine_fn(model, fused, chunkw)
+                    if self._paged
+                    else _unified_engine_fn(model, fused, chunkw)
+                )
         else:
             self._fused_fn = None
         # device-resident slot state (fused path): uploaded lazily after
@@ -1230,20 +1684,70 @@ class ServingEngine:
 
     def step(self) -> List[StreamEvent]:
         """One engine tick: expire stale queue entries, advance in-flight
-        chunked prefills by one chunk each, admit into free slots (bounded
-        by the scheduler's prefill budget, same-bucket admissions as one
-        batched prefill), one decode tick over the pool
-        (``decode_steps_per_tick`` fused scan steps — or one per-step /
-        speculative-verify step), retire finished slots.  Returns this
-        tick's events."""
+        chunked prefills (one unified-dispatch phase, or one per-slot
+        chunk extend each on the per-phase engine), admit into free slots
+        (bounded by the scheduler's prefill budget, same-bucket
+        admissions as one batched prefill), one decode tick over the
+        pool (``decode_steps_per_tick`` fused scan steps — or one
+        per-step / speculative-verify step), retire finished slots.
+        ``collect(launch())`` — the two halves exist so a caller (or
+        ``run(overlap=True)``) can overlap tick N's host bookkeeping
+        with tick N+1's device compute.  Returns this tick's events."""
+        return self.collect(self.launch())
+
+    def launch(self, ahead: bool = False) -> _PendingTick:
+        """The tick's HOST->DEVICE half: expire, fold/advance chunked
+        prefills, admit, and DISPATCH the tick's decode work WITHOUT
+        syncing.  Returns the pending handle :meth:`collect` finishes.
+
+        ``ahead=True`` marks a pipelined launch (tick N+1 dispatched
+        while tick N is still uncollected — only legal on a pure-decode
+        tick, :meth:`_can_launch_ahead`); the paged write-window then
+        covers two ticks, since the host mirrors lag the device by one.
+        Between launch and collect every donated buffer belongs to the
+        device: nothing here may read device results (the launch-body
+        sync gate in ``scripts/check_host_sync.py``)."""
         now = self.clock()
-        tr = self.tracer
-        tick_span = (
-            tr.span("tick", track="scheduler", tick=self.metrics.ticks)
-            if tr.enabled
+        p = _PendingTick(now)
+        p.overlapped = ahead
+        if self.tracer.enabled:
+            p.tick_span = self.tracer.span(
+                "tick", track="scheduler", tick=self.metrics.ticks
+            )
+        self._expire_queue(now, p.events)
+        unified = self._unified and self._fused_steps > 1
+        if not unified:
+            # per-phase: chunked prefills first, one extend dispatch per
+            # slot — a chunk finishing this tick decodes this tick
+            p.chunks_advanced = len(self._chunking)
+            for slot in sorted(self._chunking):
+                p.events.extend(self._advance_chunk(slot))
+        bucket_key = (
+            self._admission_key
+            if (self._buckets is not None or self._chunk_tokens is not None)
             else None
         )
-        events: List[StreamEvent] = []
+        p.admitted = self.scheduler.schedule(
+            self.pool.n_free, now, bucket_key=bucket_key,
+            can_admit=self._block_gate() if self._paged else None,
+        )
+        p.events.extend(self._admit_batch(p.admitted))
+        if unified:
+            # chunk slots (newly started ones included) ride THIS tick's
+            # unified dispatch — same chunk-per-tick cadence as the
+            # per-phase engine, minus its per-slot dispatches
+            p.chunks_advanced = len(self._chunking)
+        self._launch_decode(p)
+        # active tokens RESIDENT during this tick's decode = slots'
+        # written depths + chunked prefills' post-advance offsets,
+        # captured BEFORE delivery retires finished slots — the capacity
+        # denominator behind kv_bytes_per_active_token
+        p.active_tokens = int(self._pos[self._active].sum()) + sum(
+            st.offset for st in self._chunking.values()
+        ) + sum(plen for (_, _, plen) in p.finals)
+        return p
+
+    def _expire_queue(self, now: float, events: List[StreamEvent]) -> None:
         for out in self.scheduler.expire(now):
             # terminal notification with no token (token/index = -1):
             # expiry is asynchronous — unlike REJECTED, which the caller
@@ -1266,32 +1770,49 @@ class ServingEngine:
                 out.request.on_token(event)
             events.append(event)
             self.metrics.record_expired()
-        # chunked prefills first: their slots are already owned, and a
-        # chunk finishing this tick decodes this tick
-        chunks_advanced = len(self._chunking)
-        for slot in sorted(self._chunking):
-            events.extend(self._advance_chunk(slot))
-        bucket_key = (
-            self._admission_key
-            if (self._buckets is not None or self._chunk_tokens is not None)
-            else None
+
+    def _launch_decode(self, p: _PendingTick) -> None:
+        """Dispatch the tick's decode-phase device work (no sync)."""
+        unified_chunks = bool(
+            self._unified and self._fused_steps > 1 and self._chunking
         )
-        admitted = self.scheduler.schedule(
-            self.pool.n_free, now, bucket_key=bucket_key,
-            can_admit=self._block_gate() if self._paged else None,
-        )
-        events.extend(self._admit_batch(admitted))
-        # active tokens RESIDENT during this tick's decode = slots'
-        # written depths + in-flight chunked prefills' offsets, captured
-        # BEFORE delivery retires finished slots — the capacity
-        # denominator behind kv_bytes_per_active_token
-        active_tokens = int(self._pos[self._active].sum()) + sum(
-            st.offset for st in self._chunking.values()
-        )
-        decoded = False
-        if self._active.any():
-            events.extend(self._decode_tick())
-            decoded = True
+        if not self._active.any() and not unified_chunks:
+            return
+        p.t0 = self.tracer.now()
+        p.entering = tuple(int(s) for s in np.nonzero(self._active)[0])
+        if self._spec_fused:
+            self._launch_spec_fused(p, unified_chunks)
+        elif self._spec_width > 0:
+            self._launch_spec_step(p)
+        elif self._fused_steps > 1:
+            if unified_chunks:
+                self._launch_unified(p)
+            else:
+                self._launch_fused(p)
+        else:
+            self._launch_per_step(p)
+
+    def collect(self, p: _PendingTick) -> List[StreamEvent]:
+        """The tick's DEVICE->HOST half: ONE sync on the launch's result
+        handles, then delivery, retirement, metric syncs and the tick
+        record.  Pure host work apart from the sync — under
+        ``run(overlap=True)`` all of it runs while the NEXT tick's
+        device dispatch is already computing."""
+        events = p.events
+        if p.kind == "fused":
+            events.extend(self._collect_fused(p))
+        elif p.kind == "unified":
+            events.extend(self._collect_unified(p))
+        elif p.kind == "spec_fused":
+            events.extend(self._collect_spec_fused(p))
+        elif p.kind == "spec":
+            events.extend(self._collect_spec_step(p))
+        elif p.kind == "step":
+            events.extend(self._collect_per_step(p))
+        decoded = p.kind != "idle"
+        admitted = p.admitted
+        chunks_advanced = p.chunks_advanced
+        active_tokens = p.active_tokens
         if self._prefix is not None:
             entry_bytes = None
             if self._radix is not None:
@@ -1331,10 +1852,12 @@ class ServingEngine:
             prefills=len(admitted),
             decoded=decoded,
             stall=stall,
-            host_ms=(end - now) * 1000.0,
+            host_ms=(end - p.start) * 1000.0,
         )
-        if tick_span is not None:
-            tick_span.finish(
+        if p.overlapped:
+            self.metrics.record_overlap()
+        if p.tick_span is not None:
+            p.tick_span.finish(
                 stall=stall,
                 queue_depth=self.scheduler.depth,
                 admitted=len(admitted),
@@ -1349,12 +1872,66 @@ class ServingEngine:
             or bool(self._chunking)
         )
 
-    def run(self, max_ticks: Optional[int] = None) -> List[StreamEvent]:
-        """Tick until idle (or ``max_ticks``); returns all events."""
+    def _can_launch_ahead(self) -> bool:
+        """True when the NEXT tick may dispatch before the pending one
+        collects: a pure-decode fused/unified tick whose launch reads no
+        host-mutable state — device-resident slot state chains through
+        donation, the queue is empty (no admissions or expiries), no
+        chunk work, and the host mirrors are clean.  Any other tick
+        flushes the pipeline first (host mirrors must catch up before
+        they feed a dispatch)."""
+        return (
+            self._fused_steps > 1
+            and not self._state_dirty
+            and self._dev_state is not None
+            and not self._chunking
+            and self.scheduler.depth == 0
+            and bool(self._active.any())
+        )
+
+    def run(
+        self, max_ticks: Optional[int] = None, overlap: bool = False
+    ) -> List[StreamEvent]:
+        """Tick until idle (or ``max_ticks``); returns all events.
+
+        ``overlap=True`` runs the one-tick-deep launch/collect pipeline:
+        on pure-decode stretches tick N+1's device dispatch is issued
+        BEFORE tick N's results are synced, so tick N's host sync +
+        delivery bookkeeping overlaps tick N+1's device compute (the
+        ``serving_host_overlap_ratio`` gauge records how often).  Output
+        is bitwise identical to the sequential loop: a launch-ahead only
+        happens when the next launch reads no host-mutable state, and a
+        slot that finishes inside tick N is already dead in the device
+        live-mask tick N+1 carries — its surplus tick is parked, and the
+        host retires it when tick N collects."""
         events: List[StreamEvent] = []
         ticks = 0
-        while self.has_work() and (max_ticks is None or ticks < max_ticks):
-            events.extend(self.step())
+        if not overlap:
+            while self.has_work() and (
+                max_ticks is None or ticks < max_ticks
+            ):
+                events.extend(self.step())
+                ticks += 1
+            return events
+        pending: Optional[_PendingTick] = None
+        while True:
+            if pending is not None:
+                if self._can_launch_ahead() and (
+                    max_ticks is None or ticks < max_ticks
+                ):
+                    nxt = self.launch(ahead=True)
+                    ticks += 1
+                    events.extend(self.collect(pending))
+                    pending = nxt
+                else:
+                    events.extend(self.collect(pending))
+                    pending = None
+                continue
+            if not self.has_work() or (
+                max_ticks is not None and ticks >= max_ticks
+            ):
+                break
+            pending = self.launch()
             ticks += 1
         return events
 
@@ -1556,8 +2133,16 @@ class ServingEngine:
     @property
     def decode_steps_per_tick(self) -> int:
         """Decode steps per fused tick (1 = the per-step engine — spec
-        and mesh serving resolve here; ``"auto"`` resolves to 8)."""
+        "auto" and mesh serving resolve here; plain ``"auto"`` resolves
+        to 8)."""
         return self._fused_steps
+
+    @property
+    def unified_tick(self) -> bool:
+        """True when chunked-prefill and decode slots advance in ONE
+        dispatch per tick (the unified ragged tick; "auto" = on whenever
+        the fused tick is)."""
+        return self._unified and self._fused_steps > 1
 
     @property
     def prefill_buckets(self) -> Optional[Tuple[int, ...]]:
@@ -1659,6 +2244,12 @@ class ServingEngine:
         admissions across ticks."""
         length = len(out.request.prompt)
         if self._chunk_tokens is not None and length > self._chunk_tokens:
+            if self._unified and self._fused_steps > 1:
+                # unified tick: chunk starts BATCH — every one admitted
+                # this tick claims its slot and rides the same fixed
+                # [n_slots, chunk_tokens] dispatch, so long prompts no
+                # longer serialize one admission per tick
+                return ("chunk",)
             return ("chunk", id(out))
         if self._buckets is None:
             return ("exact",)
@@ -2068,6 +2659,24 @@ class ServingEngine:
         out.status = RUNNING
         self._slot_out[slot] = out
         self._chunking[slot] = _ChunkState(out, offset)
+        if self._unified and self._fused_steps > 1:
+            # the unified tick runs this slot's first chunk inside THIS
+            # tick's one dispatch; activation may happen in-device, so
+            # the slot's sampling knobs (and spec caps) must reach the
+            # device state before then — mark them now and dirty the
+            # upload
+            sp = out.request.sampling
+            self._temp[slot] = sp.temperature
+            self._topk[slot] = sp.top_k
+            self._topp[slot] = sp.top_p
+            req_k = out.request.draft_tokens
+            cap = self._spec_width if req_k is None else min(
+                req_k, self._spec_width
+            )
+            self._spec_max[slot] = cap
+            self._spec_k[slot] = cap
+            self._state_dirty = True
+            return []
         return self._advance_chunk(slot)
 
     def _advance_chunk(self, slot: int) -> List[StreamEvent]:
@@ -2192,18 +2801,13 @@ class ServingEngine:
         out.first_token_time = self.clock()
         return self._deliver(slot, tok0)
 
-    def _decode_tick(self) -> List[StreamEvent]:
-        if self._spec_width > 0:
-            return self._spec_tick()
-        if self._fused_steps > 1:
-            return self._fused_tick()
-        t0 = self.tracer.now()
+    def _launch_per_step(self, p: _PendingTick) -> None:
         if self._paged:
             seq_len = self.model.config.seq_len
-            for slot in np.nonzero(self._active)[0]:
+            for slot in p.entering:
                 w = int(self._widx[slot])
                 if w < seq_len:
-                    self.pool.ensure_writable(int(slot), w, w + 1)
+                    self.pool.ensure_writable(slot, w, w + 1)
             nxt, self.pool.cache = self._decode_fn(
                 self.params,
                 jnp.asarray(self._tok),
@@ -2228,15 +2832,19 @@ class ServingEngine:
                 self.pool.cache,
                 self._next_rng(),
             )
-        nxt = np.asarray(nxt)  # forces the async dispatch; t1 is real time
+        p.kind = "step"
+        p.payload = nxt
+
+    def _collect_per_step(self, p: _PendingTick) -> List[StreamEvent]:
+        nxt = np.asarray(p.payload)  # ONE sync; t1 is real device time
         events = []
         trace = self.tracer.enabled
         t1 = self.tracer.now()
         if trace:
-            self.tracer.record("decode_tick", "scheduler", t0, t1)
+            self.tracer.record("decode_tick", "scheduler", p.t0, t1)
         # every slot's current token was just written into the cache;
         # advance even the slots that retire on this token's delivery
-        for slot in np.nonzero(self._active)[0]:
+        for slot in p.entering:
             if not self._active[slot]:
                 # an earlier slot's on_token callback cancel()ed this one
                 # mid-loop: its slot is released, nothing to deliver
@@ -2244,16 +2852,16 @@ class ServingEngine:
             if trace:
                 out = self._slot_out[slot]
                 self.tracer.record(
-                    "decode", f"slot {int(slot)}", t0, t1,
-                    request_id=out.request.request_id, slot=int(slot),
+                    "decode", f"slot {slot}", p.t0, t1,
+                    request_id=out.request.request_id, slot=slot,
                     token_index=len(out.tokens),
                 )
             self._pos[slot] += 1
             self._widx[slot] += 1
             self._tok[slot] = int(nxt[slot])
-            events.append(self._deliver(int(slot), int(nxt[slot])))
-        # DELIVERED tokens (== _spec_tick's numerator): a slot cancelled
-        # mid-loop by a stream callback contributes nothing
+            events.append(self._deliver(slot, int(nxt[slot])))
+        # DELIVERED tokens (== the spec tick's numerator): a slot
+        # cancelled mid-loop by a stream callback contributes nothing
         self.metrics.record_dispatch(tokens=len(events))
         return events
 
@@ -2263,7 +2871,12 @@ class ServingEngine:
         retirement, cancel); between mutations the fused tick re-donates
         the arrays the previous tick returned, so a steady-state decode
         never re-uploads.  Budget and EOS derive from the live request
-        records (budget = remaining new tokens; EOS -1 = no stop id)."""
+        records (budget = remaining new tokens; EOS -1 = no stop id);
+        mid-chunked-prefill slots contribute their EOS too — the unified
+        tick's in-device activation checks it before the host ever sees
+        the first token.  Spec-fused engines additionally carry each
+        slot's adaptive draft length and its token HISTORY row (prompt +
+        delivered tokens — the in-scan drafter's context)."""
         n = self.pool.n_slots
         budget = np.zeros(n, np.int32)
         eos = np.full(n, -1, np.int32)
@@ -2272,41 +2885,80 @@ class ServingEngine:
             budget[slot] = out.request.max_new_tokens - len(out.tokens)
             if out.request.eos_token_id is not None:
                 eos[slot] = int(out.request.eos_token_id)
+        for slot, st in self._chunking.items():
+            if st.out.request.eos_token_id is not None:
+                eos[slot] = int(st.out.request.eos_token_id)
 
+        if self._spec_fused:
+            seq_len = self.model.config.seq_len
+            hist = np.zeros((n, seq_len), np.int32)
+            for slot, out in enumerate(self._slot_out):
+                if out is None:
+                    continue
+                ctx = list(out.request.prompt)
+                if self._active[slot]:
+                    ctx = ctx + out.tokens
+                ctx = ctx[:seq_len]
+                hist[slot, : len(ctx)] = ctx
+            self._dev_state, self._dev_knobs = _own_arrays((
+                (
+                    self._tok, self._pos, self._widx, self._active,
+                    budget, self._spec_k, hist,
+                ),
+                (
+                    eos, self._temp, self._topk, self._topp,
+                    self._spec_max,
+                ),
+            ))
+            self._state_dirty = False
+            return
         # one jitted call producing XLA-OWNED buffers (never zero-copy
-        # views of the host mirrors — see _own_slot_state for why
-        # donating a borrowed buffer corrupts live state)
-        self._dev_state, self._dev_knobs = _own_slot_state(
-            self._tok, self._pos, self._widx, self._active, budget,
-            eos, self._temp, self._topk, self._topp,
-        )
+        # views of the host mirrors — see _own_arrays for why donating
+        # a borrowed buffer corrupts live state)
+        self._dev_state, self._dev_knobs = _own_arrays((
+            (self._tok, self._pos, self._widx, self._active, budget),
+            (eos, self._temp, self._topk, self._topp),
+        ))
         self._state_dirty = False
 
-    def _fused_tick(self) -> List[StreamEvent]:
-        """One FUSED decode tick: ``_fused_steps`` masked decode steps in
-        one jitted lax.scan with the cache and slot-state buffers donated
-        (:func:`_fused_decode_core`).  The host unpacks the returned
-        ``[T, n_slots]`` token block and per-slot progress counts through
-        the existing per-token delivery path — greedy output is bitwise
-        identical to the per-step tick; streaming granularity becomes
-        per-tick (at most ``decode_steps_per_tick`` tokens per event
-        flush)."""
-        t0 = self.tracer.now()
+    def _ensure_decode_writable(self, p: _PendingTick, width: int) -> None:
+        """Paged launches: make every column this tick CAN write writable
+        up front (budget-clamped so a finishing slot never draws blocks
+        beyond its admission entitlement); the table then rides the
+        scan's inputs loop-invariant — steady-state ticks re-upload
+        nothing and the compile count stays pinned.  ``width`` is the
+        tick's worst-case per-slot column advance (T decode steps, or
+        T * (K + 1) verify columns); a pipelined (``ahead``) launch
+        doubles it — the host mirrors lag the in-flight tick by up to
+        one width, and the budget clamp keeps the doubled window inside
+        the slot's entitlement."""
+        seq_len = self.model.config.seq_len
+        if p.overlapped:
+            width *= 2
+        for slot in p.entering:
+            out = self._slot_out[slot]
+            if out is None:
+                continue
+            w = int(self._widx[slot])
+            rem = out.request.max_new_tokens - len(out.tokens)
+            end = min(w + min(width, max(rem, 0)), seq_len)
+            self.pool.ensure_writable(slot, w, end)
+        for slot, out, plen in p.finals:
+            # a chunk completing this tick activates in-device and
+            # decodes from its prompt length immediately
+            end = min(
+                plen + min(width, out.request.max_new_tokens), seq_len
+            )
+            self.pool.ensure_writable(slot, plen, end)
+
+    def _launch_fused(self, p: _PendingTick) -> None:
+        """Dispatch one FUSED decode tick: ``_fused_steps`` masked decode
+        steps in one jitted lax.scan with the cache and slot-state
+        buffers donated (:func:`_fused_decode_core`)."""
         if self._state_dirty or self._dev_state is None:
             self._upload_slot_state()
         if self._paged:
-            # make every column this tick CAN write writable up front
-            # (budget-clamped so a finishing slot never draws blocks
-            # beyond its admission entitlement); the table then rides the
-            # scan's inputs loop-invariant — steady-state ticks re-upload
-            # nothing and the compile count stays pinned
-            seq_len = self.model.config.seq_len
-            for slot in np.nonzero(self._active)[0]:
-                out = self._slot_out[slot]
-                w = int(self._widx[slot])
-                rem = out.request.max_new_tokens - len(out.tokens)
-                end = min(w + min(self._fused_steps, max(rem, 0)), seq_len)
-                self.pool.ensure_writable(int(slot), w, end)
+            self._ensure_decode_writable(p, self._fused_steps)
             block, counts, self._dev_state, self.pool.cache = self._fused_fn(
                 self.params, self._dev_state, self._dev_knobs,
                 self._device_table(), self.pool.cache, self._next_rng(),
@@ -2316,29 +2968,124 @@ class ServingEngine:
                 self.params, self._dev_state, self._dev_knobs,
                 self.pool.cache, self._next_rng(),
             )
-        # ONE device->host sync per T decode steps — the whole point
-        block, counts = np.asarray(block), np.asarray(counts)
+        p.kind = "fused"
+        p.payload = (block, counts)
+
+    def _build_chunk_block(self, p: _PendingTick):
+        """Fold every in-flight chunked prefill into this tick's unified
+        dispatch: build the fixed ``[n_slots, chunk_tokens]`` right-padded
+        input block (pad positions -1 via ``clen``), advance each slot's
+        offset, and mark the slots whose chunk COMPLETES the prompt —
+        their activation happens in-device and the host finishes the
+        bookkeeping at collect.  Paged slots make their chunk's write
+        range writable here (launch side, before the dispatch)."""
+        cfg = self.model.config
+        n, width = self.pool.n_slots, self._chunk_tokens
+        ctoks = np.zeros((n, width), np.int32)
+        clen = np.zeros(n, np.int32)
+        cstart = np.full(n, cfg.seq_len, np.int32)
+        cfinal = np.zeros(n, bool)
+        cbudget = np.ones(n, np.int32)
+        consumed = 0
+        for slot in sorted(self._chunking):
+            st = self._chunking[slot]
+            prompt = st.out.request.prompt
+            take = min(width, len(prompt) - st.offset)
+            ctoks[slot, :take] = prompt[st.offset : st.offset + take]
+            clen[slot] = take
+            cstart[slot] = st.offset
+            if self._paged:
+                self.pool.ensure_writable(slot, st.offset, st.offset + take)
+            p.chunk_spans.append((
+                slot, st.out.request.request_id,
+                st.offset // width, st.offset + take,
+                st.offset + take >= len(prompt),
+            ))
+            st.offset += take
+            consumed += take
+            if st.offset >= len(prompt):
+                cfinal[slot] = True
+                cbudget[slot] = st.out.request.max_new_tokens
+                p.finals.append((slot, st.out, len(prompt)))
+        for slot, _, _ in p.finals:
+            del self._chunking[slot]
+        p.chunk_tokens = consumed
+        # chunk operands are per-tick uploads, never donated — plain
+        # device puts are safe (no ownership hazard to launder)
+        return (
+            jnp.asarray(ctoks), jnp.asarray(clen), jnp.asarray(cstart),
+            jnp.asarray(cfinal), jnp.asarray(cbudget),
+        )
+
+    def _launch_unified(self, p: _PendingTick) -> None:
+        """Dispatch one UNIFIED ragged tick: the chunk phase (every
+        mid-prefill slot's next chunk, in-device final-chunk activation)
+        plus the fused decode scan, as ONE jitted call
+        (:func:`_unified_tick_core`) — the tick that used to cost one
+        extend dispatch per chunk slot plus the decode dispatch."""
+        if self._state_dirty or self._dev_state is None:
+            self._upload_slot_state()
+        chunk_ops = self._build_chunk_block(p)
+        if self._paged:
+            self._ensure_decode_writable(p, self._fused_steps)
+            out = self._unified_fn(
+                self.params, self._dev_state, self._dev_knobs, chunk_ops,
+                self._device_table(), self.pool.cache, self._next_rng(),
+            )
+        else:
+            out = self._unified_fn(
+                self.params, self._dev_state, self._dev_knobs, chunk_ops,
+                self.pool.cache, self._next_rng(),
+            )
+        act_emit, block, counts, self._dev_state, self.pool.cache = out
+        p.kind = "unified"
+        p.payload = (act_emit, block, counts)
+
+    def _collect_chunks(self, p: _PendingTick, t1: float) -> None:
+        """Collect-side chunk bookkeeping shared by the unified tick
+        kinds: tracer spans and the chunk-continuation tally — counting
+        only chunks FOLDED into this tick's dispatch (``chunk_spans``),
+        so a per-phase spec-fused tick, whose chunks already counted
+        through ``_advance_chunk``'s ``record_prefill_call``, never
+        double-tallies."""
+        if p.chunk_spans and self.tracer.enabled:
+            for slot, rid, idx, offset, final in p.chunk_spans:
+                self.tracer.record(
+                    "prefill_chunk", f"slot {slot}", p.t0, t1,
+                    request_id=rid, slot=slot, chunk=idx, offset=offset,
+                    final=final,
+                )
+        if p.chunk_spans:
+            self.metrics.record_chunks(len(p.chunk_spans))
+
+    def _check_progress(self, p: _PendingTick, counts) -> None:
+        """The no-progress desync guard: a slot that was decode-live at
+        LAUNCH always enters the scan live with budget >= 1, so zero
+        progress means the device state desynced from the host mirrors —
+        fail loudly instead of spinning run() forever.  Scoped to
+        ``p.entering`` (decode-live AT LAUNCH, still active now): a tick
+        holding only mid-chunk prefill rows has no entering slots, so
+        pure chunk advancement counts as progress instead of tripping
+        the guard (the unified tick's chunk-only regression), and a
+        pipelined tick's stale mirror of a slot that finished in flight
+        is skipped via the activity re-check."""
         stuck = [
-            int(s) for s in np.nonzero(self._active)[0] if counts[s] == 0
+            s for s in p.entering if counts[s] == 0 and self._active[s]
         ]
         if stuck:
-            # an active slot always enters the scan live with budget >= 1,
-            # so zero progress means the device state desynced from the
-            # host mirrors — fail loudly instead of spinning run() forever
             raise RuntimeError(
                 f"fused tick made no progress on active slots {stuck} "
                 f"(device live={np.asarray(self._dev_state[3])}, "
-                f"budget={np.asarray(self._dev_state[4])}) — slot state "
+                f"budget={np.asarray(self._dev_state[4])}, "
+                f"chunk tokens advanced={p.chunk_tokens}) — slot state "
                 "desynced from host mirrors"
             )
+
+    def _deliver_block(self, p: _PendingTick, block, counts, t1):
+        """Deliver a fused/unified tick's ``[T, n_slots]`` token block
+        through the per-token delivery path."""
         events: List[StreamEvent] = []
         trace = self.tracer.enabled
-        t1 = self.tracer.now()
-        if trace:
-            self.tracer.record(
-                "decode_tick", "scheduler", t0, t1,
-                steps=self._fused_steps, tokens=int(counts.sum()),
-            )
         for slot in np.nonzero(self._active)[0]:
             c = int(counts[slot])
             # re-check liveness: a stream callback may have cancel()ed
@@ -2349,7 +3096,7 @@ class ServingEngine:
             if trace:
                 out = self._slot_out[slot]
                 self.tracer.record(
-                    "decode", f"slot {int(slot)}", t0, t1,
+                    "decode", f"slot {int(slot)}", p.t0, t1,
                     request_id=out.request.request_id, slot=int(slot),
                     token_index=len(out.tokens), tokens=c,
                 )
@@ -2375,17 +3122,90 @@ class ServingEngine:
                     # cancelled the request mid-block: the surplus
                     # device tokens die with the released slot
                     break
+        return events
+
+    def _collect_fused(self, p: _PendingTick) -> List[StreamEvent]:
+        """Collect one fused decode tick: ONE device->host sync per T
+        decode steps — the whole point — then the per-token delivery
+        path.  Greedy output is bitwise identical to the per-step tick;
+        streaming granularity becomes per-tick (at most
+        ``decode_steps_per_tick`` tokens per event flush)."""
+        block, counts = p.payload
+        block, counts = np.asarray(block), np.asarray(counts)
+        self._check_progress(p, counts)
+        trace = self.tracer.enabled
+        t1 = self.tracer.now()
+        if trace:
+            self.tracer.record(
+                "decode_tick", "scheduler", p.t0, t1,
+                steps=self._fused_steps, tokens=int(counts.sum()),
+            )
+        events = self._deliver_block(p, block, counts, t1)
         # DELIVERED tokens, not counts.sum(): cancelled slots' surplus
-        # device tokens are dropped above, and all three tick types keep
+        # device tokens are dropped above, and every tick type keeps
         # the same amortization numerator (see record_dispatch docstring)
         self.metrics.record_dispatch(tokens=len(events))
         return events
 
-    def _spec_tick(self) -> List[StreamEvent]:
-        """One speculative verify tick: draft per active slot (host-side,
-        capped by the adaptive length, the slot's remaining token budget,
-        and seq_len), verify every slot's block in ONE multi-token
-        forward, deliver each slot's accepted prefix + bonus token.
+    def _activate_from_device(
+        self, slot: int, out: RequestOutput, tok0: int, prompt_len: int
+    ) -> StreamEvent:
+        """Finish a unified-tick in-device activation on the host side:
+        the device already sampled the first token, flipped the slot
+        live, and advanced its state — mirror that WITHOUT dirtying the
+        upload flag (the device state is the fresher of the two), then
+        deliver the first token."""
+        self._tok[slot] = tok0
+        self._pos[slot] = prompt_len
+        self._widx[slot] = prompt_len
+        self._active[slot] = True
+        self._slot_out[slot] = out
+        # the device seeded the slot's adaptive draft length at its cap
+        self._spec_k[slot] = self._spec_max[slot]
+        out.status = RUNNING
+        out.first_token_time = self.clock()
+        return self._deliver(slot, tok0)
+
+    def _collect_unified(self, p: _PendingTick) -> List[StreamEvent]:
+        """Collect one unified ragged tick: sync the activation row and
+        the decode block together (still ONE sync), deliver activations
+        first (the per-phase engine's chunk-advance-then-decode order),
+        then the decode block."""
+        act_emit, block, counts = p.payload
+        act_emit, block, counts = (
+            np.asarray(act_emit), np.asarray(block), np.asarray(counts),
+        )
+        events: List[StreamEvent] = []
+        trace = self.tracer.enabled
+        t1 = self.tracer.now()
+        self._collect_chunks(p, t1)
+        for slot, out, plen in p.finals:
+            events.append(
+                self._activate_from_device(
+                    slot, out, int(act_emit[slot]), plen
+                )
+            )
+        self._check_progress(p, counts)
+        if trace:
+            self.tracer.record(
+                "decode_tick", "scheduler", p.t0, t1,
+                steps=self._fused_steps, tokens=int(counts.sum()),
+                chunk_tokens=p.chunk_tokens,
+            )
+        events.extend(self._deliver_block(p, block, counts, t1))
+        delivered = sum(1 for ev in events if ev.token >= 0)
+        self.metrics.record_dispatch(tokens=delivered)
+        # unified-tick amortization: prompt chunk tokens consumed +
+        # tokens this ONE dispatch delivered (activations included;
+        # admission-prefill events live outside this local list)
+        self.metrics.record_unified_tick(p.chunk_tokens + delivered)
+        return events
+
+    def _launch_spec_step(self, p: _PendingTick) -> None:
+        """Dispatch one speculative verify tick: draft per active slot
+        (host-side, capped by the adaptive length, the slot's remaining
+        token budget, and seq_len), then ONE multi-token verify forward
+        over every slot's block.
 
         Per-slot variable acceptance rides the FIXED compiled width: short
         drafts pad with -1 positions (columns invalidated, never
@@ -2400,7 +3220,7 @@ class ServingEngine:
         n = self.pool.n_slots
         drafts = np.zeros((n, k), np.int32)
         dlen = np.zeros(n, np.int32)
-        active = np.nonzero(self._active)[0]
+        active = p.entering
         for slot in active:
             out = self._slot_out[slot]
             # rem >= 1 for an active slot; draft_for_row clamps so a
@@ -2415,7 +3235,6 @@ class ServingEngine:
             )
             dlen[slot] = len(d)
             drafts[slot, : len(d)] = d
-        t0 = self.tracer.now()
         if self._paged:
             # the verify writes current token + dlen draft columns;
             # draft_for_row already clamped dlen inside the budget, so
@@ -2455,13 +3274,24 @@ class ServingEngine:
                 self.pool.cache,
                 self._next_rng(),
             )
+        p.kind = "spec"
+        p.payload = (block, accepted, dlen)
+
+    def _collect_spec_step(self, p: _PendingTick) -> List[StreamEvent]:
+        """Collect one speculative verify tick: one sync, then deliver
+        each slot's accepted prefix + bonus token (truncated at a
+        mid-block EOS/length finish)."""
+        k = self._spec_width
+        block, accepted, dlen = p.payload
         block, accepted = np.asarray(block), np.asarray(accepted)
         events = []
         trace = self.tracer.enabled
         t1 = self.tracer.now()
         if trace:
-            self.tracer.record("verify_tick", "scheduler", t0, t1, width=k)
-        for slot in active:
+            self.tracer.record(
+                "verify_tick", "scheduler", p.t0, t1, width=k
+            )
+        for slot in p.entering:
             if not self._active[slot]:
                 # an earlier slot's on_token callback cancel()ed this one
                 # mid-loop: slot released, its accepted block dies with it
@@ -2471,7 +3301,7 @@ class ServingEngine:
             if trace:
                 out = self._slot_out[slot]
                 self.tracer.record(
-                    "verify", f"slot {int(slot)}", t0, t1,
+                    "verify", f"slot {int(slot)}", p.t0, t1,
                     request_id=out.request.request_id, slot=int(slot),
                     draft_k=drafted, accepted=a,
                     token_index=len(out.tokens),
@@ -2505,6 +3335,146 @@ class ServingEngine:
             if self._spec_check:
                 self.pool.assert_slot_aligned(int(slot))
         self.metrics.record_dispatch(tokens=len(events))
+        return events
+
+    def _launch_spec_fused(
+        self, p: _PendingTick, unified_chunks: bool
+    ) -> None:
+        """Dispatch one FUSED speculative tick: ``_fused_steps``
+        draft-verify-accept blocks in one jitted lax.scan, drafting
+        in-scan from the device-resident token history
+        (:func:`_fused_spec_core`); with chunk work this tick, the
+        unified chunk phase rides in front (:func:`_unified_spec_core`)
+        — chunks, activation, drafting, verify and acceptance in ONE
+        dispatch."""
+        if self._state_dirty or self._dev_state is None:
+            self._upload_slot_state()
+        chunk_ops = (
+            self._build_chunk_block(p) if unified_chunks else None
+        )
+        if self._paged:
+            self._ensure_decode_writable(
+                p, self._fused_steps * (self._spec_width + 1)
+            )
+            args = (self.params, self._dev_state, self._dev_knobs)
+            if chunk_ops is not None:
+                out = self._spec_unified_fn(
+                    *args, chunk_ops, self._device_table(),
+                    self.pool.cache, self._next_rng(),
+                )
+            else:
+                out = self._spec_fused_fn(
+                    *args, self._device_table(), self.pool.cache,
+                    self._next_rng(),
+                )
+        else:
+            args = (self.params, self._dev_state, self._dev_knobs)
+            if chunk_ops is not None:
+                out = self._spec_unified_fn(
+                    *args, chunk_ops, self.pool.cache, self._next_rng()
+                )
+            else:
+                out = self._spec_fused_fn(
+                    *args, self.pool.cache, self._next_rng()
+                )
+        if chunk_ops is not None:
+            (act_emit, blocks, counts, drafted, accepted,
+             self._dev_state, self.pool.cache) = out
+        else:
+            act_emit = None
+            (blocks, counts, drafted, accepted,
+             self._dev_state, self.pool.cache) = out
+        p.kind = "spec_fused"
+        p.payload = (act_emit, blocks, counts, drafted, accepted)
+
+    def _collect_spec_fused(self, p: _PendingTick) -> List[StreamEvent]:
+        """Collect one fused speculative tick: ONE sync per T verify
+        blocks, then per-block delivery — each block's accepted prefix +
+        bonus, truncated at a mid-block finish, with the host replaying
+        the same adaptation law the scan applied so the ``_spec_k``
+        mirrors stay exact."""
+        k = self._spec_width
+        act_emit, blocks, counts, drafted, accepted = p.payload
+        if act_emit is not None:
+            act_emit = np.asarray(act_emit)
+        blocks, counts, drafted, accepted = (
+            np.asarray(blocks), np.asarray(counts), np.asarray(drafted),
+            np.asarray(accepted),
+        )
+        events: List[StreamEvent] = []
+        trace = self.tracer.enabled
+        t1 = self.tracer.now()
+        self._collect_chunks(p, t1)
+        for slot, out, plen in p.finals:
+            events.append(
+                self._activate_from_device(
+                    slot, out, int(act_emit[slot]), plen
+                )
+            )
+        # the per-slot DELIVERED totals play the fused tick's counts role
+        self._check_progress(p, counts.sum(axis=0))
+        if trace:
+            self.tracer.record(
+                "verify_tick", "scheduler", p.t0, t1, width=k,
+                steps=self._fused_steps, tokens=int(counts.sum()),
+                chunk_tokens=p.chunk_tokens,
+            )
+        for t in range(blocks.shape[0]):
+            for slot in range(self.pool.n_slots):
+                e = int(counts[t, slot])
+                if e == 0 or not self._active[slot]:
+                    continue
+                a = int(accepted[t, slot])
+                d = int(drafted[t, slot])
+                if trace:
+                    out = self._slot_out[slot]
+                    self.tracer.record(
+                        "verify", f"slot {slot}", p.t0, t1,
+                        request_id=out.request.request_id, slot=slot,
+                        draft_k=d, accepted=a,
+                        token_index=len(out.tokens),
+                    )
+                self._pos[slot] += a + 1
+                self._widx[slot] += a + 1
+                self._tok[slot] = int(blocks[t, slot, a])
+                delivered = 0
+                for tok in blocks[t, slot, :e]:
+                    event = self._deliver(slot, int(tok))
+                    events.append(event)
+                    delivered += 1
+                    if event.finished:
+                        if delivered != e:
+                            # the scan truncated AT the finish: its
+                            # EOS/budget law and _deliver's must agree
+                            raise AssertionError(
+                                f"slot {slot}: host finished at token "
+                                f"{delivered} of a {e}-token spec block"
+                            )
+                        break
+                    if not self._active[slot]:
+                        break  # cancelled mid-block by a stream callback
+                self.metrics.record_spec(
+                    drafted=d, accepted=a, wasted=(k + 1) - delivered,
+                )
+                if (
+                    self._spec_adaptive
+                    and self._active[slot]
+                    and self._spec_max[slot] > 0
+                ):
+                    # replay the scan's adaptation law on the mirrors
+                    self._spec_k[slot] = adapt_draft_len(
+                        int(self._spec_k[slot]), d, a,
+                        int(self._spec_max[slot]),
+                    )
+                if self._spec_check:
+                    self.pool.assert_slot_aligned(slot)
+        delivered = sum(1 for ev in events if ev.token >= 0)
+        self.metrics.record_dispatch(tokens=delivered)
+        if act_emit is not None:
+            # the UNIFIED spec dispatch (chunk phase folded in) — the
+            # pure fused verify scan is dispatch-amortized but not a
+            # unified ragged tick, so it keeps no series here
+            self.metrics.record_unified_tick(p.chunk_tokens + delivered)
         return events
 
     def _deliver(self, slot: int, token: int) -> StreamEvent:

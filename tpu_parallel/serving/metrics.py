@@ -159,6 +159,19 @@ class ServingMetrics:
             "serving_tokens_per_dispatch"
         )
         self._host_ms_per_tick = r.histogram("serving_host_ms_per_tick")
+        # the unified ragged tick + double-buffered launch/collect
+        # pipeline: tokens (prompt chunk tokens consumed + tokens
+        # generated) each unified dispatch advanced, and how many
+        # decode-family dispatches were launched while the previous
+        # tick's results were still uncollected — host bookkeeping for
+        # tick N overlapping device compute for tick N+1.  The ratio
+        # gauge is overlapped / decode dispatches (0 without the
+        # pipeline; > 0 is the acceptance gate for measured overlap).
+        self._unified_tick_tokens = r.histogram(
+            "serving_unified_tick_tokens"
+        )
+        self._overlapped = r.counter("serving_overlapped_dispatches_total")
+        self._overlap_ratio = r.gauge("serving_host_overlap_ratio")
         # per-tick stall attribution, pre-registered so every cause shows
         # a (possibly zero) series in exports
         self._stall = {
@@ -288,6 +301,8 @@ class ServingMetrics:
             self._host_ms_per_tick.observe(host_ms)
         if decoded:
             self._decode_ticks.inc()
+            if int(self._overlapped.value):
+                self._refresh_overlap_ratio()
         self._tokens_out.inc(new_tokens)
         self._prefills.inc(prefills)
         self._queue_depth.observe(queue_depth)
@@ -338,11 +353,45 @@ class ServingMetrics:
 
     def record_dispatch(self, tokens: Optional[int] = None) -> None:
         """One decode-family host->device dispatch (per-step decode,
-        speculative verify, or fused tick); ``tokens`` is how many
-        generated tokens it delivered — the amortization numerator."""
+        speculative verify, or fused/unified tick); ``tokens`` is how
+        many generated tokens it delivered — the amortization
+        numerator."""
         self._host_dispatches.inc()
         if tokens is not None:
             self._tokens_per_dispatch.observe(tokens)
+
+    def record_chunks(self, chunks: int) -> None:
+        """Chunk continuations folded into a UNIFIED tick's single
+        dispatch — counted like the per-phase engine's per-slot chunk
+        extends (``prefill_chunks``) but WITHOUT a prefill call or a
+        dispatch of their own: the whole point of the unified tick is
+        that the chunk phase shares the decode dispatch."""
+        self._prefill_chunks.inc(chunks)
+
+    def record_unified_tick(self, tokens: int) -> None:
+        """One unified ragged tick's advancement: prompt chunk tokens
+        consumed plus tokens generated by its ONE dispatch (chunk
+        counting goes through :meth:`record_chunks`)."""
+        self._unified_tick_tokens.observe(tokens)
+
+    def record_overlap(self) -> None:
+        """One decode-family dispatch launched while the PREVIOUS tick's
+        results were still uncollected (the launch/collect pipeline's
+        launch-ahead) — tick N's host sync + delivery then overlapped
+        tick N+1's device compute."""
+        self._overlapped.inc()
+        self._refresh_overlap_ratio()
+
+    def _refresh_overlap_ratio(self) -> None:
+        """Overlapped / decode dispatches.  Recomputed on every decode
+        tick AND at summary time, not only when an overlap lands — a
+        long non-overlapped stretch after early launch-aheads must pull
+        the ratio DOWN, or the gauge (and the bench records built on
+        it) would freeze at the early high-water mark."""
+        decode = max(int(self._decode_ticks.value), 1)
+        self._overlap_ratio.set(
+            min(int(self._overlapped.value) / decode, 1.0)
+        )
 
     def record_spec(self, drafted: int, accepted: int, wasted: int) -> None:
         """One active slot's share of a speculative verify tick: how many
@@ -493,6 +542,22 @@ class ServingMetrics:
             "host_dispatches": self.host_dispatches,
             "tokens_per_dispatch_mean": hist_mean(
                 self._tokens_per_dispatch, 3
+            ),
+            "unified_tick_tokens_mean": hist_mean(
+                self._unified_tick_tokens, 3
+            ),
+            "overlapped_dispatches": int(self._overlapped.value),
+            "host_overlap_ratio": (
+                round(
+                    min(
+                        int(self._overlapped.value)
+                        / max(self.decode_ticks, 1),
+                        1.0,
+                    ),
+                    4,
+                )
+                if int(self._overlapped.value)
+                else 0.0
             ),
             "host_ms_per_tick_p50": (
                 None

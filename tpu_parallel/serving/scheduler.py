@@ -265,7 +265,13 @@ class FIFOScheduler:
         OTHER buckets (bounded unfairness: a request can be overtaken only
         while the head of the queue, which admits this tick regardless,
         shares a bucket with someone behind it).  The engine runs the
-        returned set as one padded batched prefill call.
+        returned set as one padded batched prefill call.  Chunked
+        prompts are a group like any other, but WHICH group depends on
+        the engine's tick model: the per-phase engine keys them
+        uniquely (one chunk start per tick — each start is its own
+        batch-1 dispatch), while the unified ragged tick keys them all
+        ``("chunk",)`` so several long prompts claim slots in one tick
+        and ride the same fixed-shape chunk-phase dispatch.
         """
         if now is None:
             now = self.clock()

@@ -295,6 +295,94 @@ def verify_tokens(
     return tokens.astype(jnp.int32), accepted
 
 
+def ngram_draft_tokens(
+    history: jax.Array,
+    length: jax.Array,
+    cap: jax.Array,
+    k: int,
+    max_ngram: int = 3,
+    min_ngram: int = 1,
+) -> Tuple[jax.Array, jax.Array]:
+    """DEVICE prompt-lookup drafting — the traceable twin of
+    :class:`NGramDrafter`, token-for-token identical by construction
+    (pinned in ``tests/test_spec_decode.py``): longest suffix n-gram
+    first (``max_ngram`` down to ``min_ngram``), most recent earlier
+    occurrence wins, propose up to ``cap`` following tokens.
+
+    This is what lets the FUSED speculative tick run ``T`` draft-verify
+    blocks inside one ``lax.scan``: block ``t+1``'s context includes
+    block ``t``'s accepted tokens, which live only on device mid-scan —
+    a host drafter would force one dispatch + one sync per block, the
+    exact per-step tax the fused tick exists to kill.  The token
+    ``history`` [rows, L] rides the scan carry (the engine re-uploads it
+    only on admission, like the rest of the slot state).
+
+    ``length`` [rows] is each row's live context length (prompt +
+    generated, INCLUDING the current unwritten token — the same context
+    :meth:`NGramDrafter.draft` sees); ``cap`` [rows] the per-row draft
+    budget (:func:`draft_for_row`'s clamp, computed by the caller;
+    ``<= 0`` drafts nothing).  Entries of ``history`` at or beyond
+    ``length`` are never read.  Returns ``(drafts [rows, k], dlen
+    [rows])`` with drafts zero-padded beyond ``dlen`` — byte-identical
+    to the engine's host-side draft block layout.
+    """
+    if k < 1:
+        raise ValueError(f"k={k} < 1")
+    if not 1 <= min_ngram <= max_ngram:
+        raise ValueError(
+            f"need 1 <= min_ngram ({min_ngram}) <= max_ngram ({max_ngram})"
+        )
+    L = history.shape[-1]
+
+    def one_row(hist, hlen, kcap):
+        iota = jnp.arange(L, dtype=jnp.int32)
+        drafts = jnp.zeros((k,), jnp.int32)
+        dlen = jnp.zeros((), jnp.int32)
+        found = jnp.zeros((), bool)
+        # static unroll over the (tiny) n-gram size ladder: largest g
+        # with any match wins, exactly like the host drafter's outer loop
+        for g in range(max_ngram, min_ngram - 1, -1):
+            ok_g = (kcap > 0) & (g <= hlen - 1)
+            sfx = hist[jnp.clip(hlen - g + jnp.arange(g), 0, L - 1)]
+            match = jnp.ones((L,), bool)
+            for j in range(g):
+                at = jnp.clip(iota + j, 0, L - 1)
+                match = match & (hist[at] == sfx[j]) & (iota + j < L)
+            # s <= hlen - g - 1 keeps the continuation nonempty (the host
+            # drafter's `if cont` can only be empty at s == hlen - g,
+            # which its range already excludes)
+            match = match & (iota <= hlen - g - 1) & ok_g
+            s = jnp.max(jnp.where(match, iota, -1))
+            hit = s >= 0
+            cont = hist[jnp.clip(s + g + jnp.arange(k), 0, L - 1)]
+            take = jnp.where(hit, jnp.minimum(kcap, hlen - (s + g)), 0)
+            use = hit & ~found
+            drafts = jnp.where(use, cont, drafts)
+            dlen = jnp.where(use, take, dlen)
+            found = found | hit
+        # zero-pad beyond dlen — the host block layout (np.zeros + fill)
+        drafts = jnp.where(jnp.arange(k) < dlen, drafts, 0)
+        return drafts, dlen
+
+    return jax.vmap(one_row)(
+        history,
+        jnp.asarray(length, jnp.int32),
+        jnp.asarray(cap, jnp.int32),
+    )
+
+
+def adapt_draft_len_traced(
+    k: jax.Array, drafted: jax.Array, accepted: jax.Array, k_max: jax.Array,
+) -> jax.Array:
+    """Traceable :func:`adapt_draft_len` (k_min fixed at 1) — the fused
+    spec tick's in-scan per-slot adaptation, same grow/shrink law so the
+    fused and per-step engines ride identical draft-length trajectories."""
+    grown = jnp.minimum(k + 1, k_max)
+    shrunk = jnp.maximum(1, accepted + 1)
+    adapted = jnp.where(accepted >= drafted, grown, shrunk)
+    return jnp.where(drafted <= 0, k, adapted)
+
+
 def draft_for_row(
     drafter: Drafter,
     context: Sequence[int],
