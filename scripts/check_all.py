@@ -20,9 +20,16 @@ and the single tier-1 test ``tests/test_checkers.py::test_all_ast_gates``
 — iterate it.  Adding the next checker is ONE line here plus its module,
 not a fifth copy of the wiring.
 
+A second registry, ``RUNTIME_CHECKS``, holds gates that RUN the product
+instead of parsing it — today ``check_daemon``, the serving-daemon
+start/submit/SIGTERM-drain smoke.  The CLI runs both registries; the
+AST-only ``run_all()`` default keeps ``test_all_ast_gates`` instant,
+and each runtime gate carries its own tier-1 test entry
+(``tests/test_daemon.py`` for the daemon smoke).
+
 Usage: ``python scripts/check_all.py [names...]`` — runs every gate (or
 just the named ones) over its own default paths, prints each problem,
-exits nonzero on any.
+exits nonzero on any.  ``--ast-only`` skips the runtime gates.
 """
 
 from __future__ import annotations
@@ -48,15 +55,26 @@ CHECKERS: Dict[str, str] = {
     ),
 }
 
+# gates that RUN the product rather than parse it (slower; spawn
+# subprocesses).  Kept out of CHECKERS so run_all()'s default stays the
+# instant AST sweep; the CLI and their own tier-1 tests run them.
+RUNTIME_CHECKS: Dict[str, str] = {
+    "check_daemon": (
+        "the serving daemon starts, serves over HTTP, drains on "
+        "SIGTERM and exits 0 with a clean journal"
+    ),
+}
+
 SCRIPTS_DIR = os.path.dirname(os.path.abspath(__file__))
 
 
 def load_checker(name: str):
     """Import one checker module from the scripts directory by path (no
     sys.path mutation — safe from tests and other tools)."""
-    if name not in CHECKERS:
+    if name not in CHECKERS and name not in RUNTIME_CHECKS:
         raise ValueError(
-            f"unknown checker {name!r} (registered: {sorted(CHECKERS)})"
+            f"unknown checker {name!r} (registered: "
+            f"{sorted(CHECKERS) + sorted(RUNTIME_CHECKS)})"
         )
     spec = importlib.util.spec_from_file_location(
         name, os.path.join(SCRIPTS_DIR, f"{name}.py")
@@ -83,7 +101,13 @@ def run_all(names: Sequence[str] = ()) -> Dict[str, List[str]]:
 
 
 def main(argv: List[str]) -> int:
-    results = run_all(argv[1:])
+    args = [a for a in argv[1:] if a != "--ast-only"]
+    ast_only = "--ast-only" in argv[1:]
+    names = args or (
+        list(CHECKERS) + ([] if ast_only else list(RUNTIME_CHECKS))
+    )
+    results = run_all(names)
+    contracts = {**CHECKERS, **RUNTIME_CHECKS}
     failed = 0
     for name, problems in results.items():
         for problem in problems:
@@ -92,7 +116,7 @@ def main(argv: List[str]) -> int:
             failed += 1
             print(
                 f"{name}: {len(problems)} violation(s) — "
-                f"{CHECKERS[name]}",
+                f"{contracts[name]}",
                 file=sys.stderr,
             )
         else:

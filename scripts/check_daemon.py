@@ -1,0 +1,51 @@
+"""Runtime gate: the serving daemon starts, serves, drains, exits 0.
+
+Unlike its AST siblings this checker RUNS the product: it delegates to
+``scripts/daemon_bench.py --smoke`` — one real daemon subprocess, an
+HTTP submit, an SSE stream replay, SIGTERM, and the clean-journal
+assertions — so ``python scripts/check_all.py`` catches a daemon that
+cannot complete its own lifecycle, not just one that types wall-clock
+calls in the wrong file.  It exposes the same ``check_paths() ->
+[problems]`` surface the registry iterates.
+
+Registered in ``check_all.RUNTIME_CHECKS`` (not ``CHECKERS``): the AST
+gates stay instant and side-effect-free for ``tests/test_checkers.py::
+test_all_ast_gates``, while this one runs as its own tier-1 entry
+(``tests/test_daemon.py::test_daemon_smoke_subprocess``) and in the
+``check_all`` CLI.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from typing import List, Sequence
+
+SCRIPTS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+DEFAULT_PATHS: Sequence[str] = ()  # runtime check: no tree to walk
+
+
+def check_paths(paths: Sequence[str] = DEFAULT_PATHS) -> List[str]:
+    spec = importlib.util.spec_from_file_location(
+        "daemon_bench", os.path.join(SCRIPTS_DIR, "daemon_bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return [f"daemon smoke: {p}" for p in mod.run_smoke()]
+
+
+def main(argv: List[str]) -> int:
+    problems = check_paths()
+    for problem in problems:
+        print(problem, file=sys.stderr)
+    if problems:
+        print(f"check_daemon: {len(problems)} problem(s)", file=sys.stderr)
+        return 1
+    print("check_daemon: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

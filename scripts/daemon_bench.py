@@ -1,0 +1,604 @@
+"""Daemon crash/drain soak: kill -9 the serving process mid-traffic,
+restart it, and PROVE the journal-replay contract.
+
+Three entry modes:
+
+- (default) ``--soak``: the acceptance gate.  For each seeded trial:
+  start the daemon as a real subprocess, feed it a seeded request
+  schedule over HTTP (every request carries a client dedupe token),
+  SIGKILL the process at a seeded point mid-traffic, restart it on the
+  SAME journal, retry every submission idempotently (real clients retry
+  on connection loss), run the remainder out, and assert:
+
+  1. **zero lost accepted requests** — every journaled submit reaches
+     exactly one ``finished`` terminal across the two process lives;
+  2. **zero duplicate completions** — each dedupe token maps to exactly
+     one journal submit and one terminal (retries after the crash
+     dedupe instead of re-admitting);
+  3. **bitwise token parity** — every completed stream equals the
+     static greedy reference, so the crash+replay (journal prefix +
+     forced-prefix recompute) changed NOTHING about the output;
+  4. **zero leaked KV reservations** — ``/statez`` shows
+     ``inflight_tokens == 0`` and every replica's slots/queues empty
+     after quiesce;
+  5. **graceful exit** — SIGTERM drains and exits 0 inside the grace
+     window, with a clean shutdown record as the journal's last word.
+
+  ``--record DAEMON_r01.json`` writes the per-trial evidence.
+
+- ``--smoke``: the fast CI gate (wired into ``scripts/check_all.py``
+  and tier-1 via ``tests/test_daemon.py``): one subprocess — start,
+  healthz, submit over HTTP, stream to completion, SIGTERM, assert a
+  clean drained exit 0 and a clean journal.  No kill -9 (that is the
+  soak's job); one model build is the whole cost.
+
+- ``--serve``: INTERNAL child mode — build the tiny-model fleet, wrap
+  it in :class:`~tpu_parallel.daemon.ServingDaemon` + HTTP server,
+  write the ready file, install signals, pump until shut down, exit
+  with ``daemon.run()``'s code.  The parent modes spawn this.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+DEFAULT_NEW_TOKENS = 8
+SOAK_NEW_TOKENS = 20  # long enough that a seeded kill lands mid-stream
+READY_TIMEOUT = 300.0  # cold jax import + compile on a 1-core box
+
+
+# -- HTTP client helpers -----------------------------------------------------
+
+
+def http_json(method, url, body=None, timeout=120.0):
+    """One JSON request; returns (status_code, payload) and never
+    raises on HTTP error codes (connection errors DO raise)."""
+    data = None if body is None else json.dumps(body).encode()
+    req = urllib.request.Request(url, data=data, method=method)
+    if data is not None:
+        req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read() or b"{}")
+
+
+def wait_ready(ready_file, proc, timeout=READY_TIMEOUT):
+    """Poll for the child's ready file; returns its payload dict."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"daemon child exited rc={proc.returncode} before ready"
+            )
+        if os.path.exists(ready_file):
+            try:
+                with open(ready_file) as fh:
+                    info = json.load(fh)
+                if "port" in info:
+                    return info
+            except (ValueError, OSError):
+                pass  # mid-write
+        time.sleep(0.05)
+    raise RuntimeError(f"daemon child not ready within {timeout}s")
+
+
+def spawn_daemon(args, journal, ready_file, extra=()):
+    """Start the --serve child with this script's interpreter/env."""
+    if os.path.exists(ready_file):
+        os.remove(ready_file)
+    cmd = [
+        sys.executable, os.path.abspath(__file__), "--serve",
+        "--journal", journal, "--ready-file", ready_file,
+        "--replicas", str(args.replicas), "--slots", str(args.slots),
+        "--grace", str(args.grace), "--fsync-batch", str(args.fsync_batch),
+        *extra,
+    ]
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.Popen(cmd, env=env)
+
+
+# -- schedule + references ---------------------------------------------------
+
+
+def make_schedule(seed, n_requests, new_tokens):
+    """Seeded prompts + dedupe tokens (pure function of seed)."""
+    rnd = random.Random(seed)
+    schedule = []
+    for i in range(n_requests):
+        length = rnd.randrange(3, 12)
+        prompt = [rnd.randrange(1, 250) for _ in range(length)]
+        schedule.append({
+            "dedupe_token": f"soak-{seed}-{i}",
+            "prompt": prompt,
+            "max_new_tokens": new_tokens,
+        })
+    return schedule
+
+
+def greedy_references(schedule):
+    """Static-generate greedy continuation for every prompt — the
+    parity oracle the daemon's crash+replay output must match."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_parallel.models import GPTLM, tiny_test
+    from tpu_parallel.models.generate import generate
+
+    cfg = tiny_test(remat=False)
+    model = GPTLM(cfg)
+    probe = jnp.zeros((1, 16), jnp.int32)
+    params = model.init(
+        {"params": jax.random.PRNGKey(1)}, probe, train=False
+    )["params"]
+    refs = {}
+    for entry in schedule:
+        prompt = entry["prompt"]
+        # generate() returns [batch, max_new_tokens] — continuation only
+        cont = np.asarray(generate(
+            model, params, jnp.asarray(prompt, jnp.int32)[None, :],
+            max_new_tokens=entry["max_new_tokens"],
+        ))[0]
+        refs[entry["dedupe_token"]] = [int(t) for t in cont]
+    return refs
+
+
+# -- the serve child ---------------------------------------------------------
+
+
+def serve(args):
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(REPO_ROOT, ".pytest_xla_cache"),
+    )
+    from tpu_parallel.cluster import Frontend, FrontendConfig
+    from tpu_parallel.daemon import (
+        DaemonConfig,
+        DaemonHTTPServer,
+        ServingDaemon,
+    )
+    from tpu_parallel.models import GPTLM, tiny_test
+    from tpu_parallel.obs.registry import MetricRegistry
+    from tpu_parallel.serving import SchedulerConfig, ServingEngine
+
+    cfg = tiny_test(remat=False)
+    model = GPTLM(cfg)
+    probe = jax.numpy.zeros((1, 16), jax.numpy.int32)
+    params = model.init(
+        {"params": jax.random.PRNGKey(1)}, probe, train=False
+    )["params"]
+
+    def frontend_factory(clock):
+        engines = [
+            ServingEngine(
+                model, params, n_slots=args.slots,
+                scheduler=SchedulerConfig(max_prefills_per_tick=2),
+            )
+            for _ in range(args.replicas)
+        ]
+        return Frontend(
+            engines, router="least",
+            config=FrontendConfig(restart=None),
+            clock=clock, registry=MetricRegistry(),
+        )
+
+    daemon = ServingDaemon(
+        frontend_factory, args.journal,
+        config=DaemonConfig(
+            grace_seconds=args.grace, fsync_batch=args.fsync_batch,
+        ),
+    )
+    server = DaemonHTTPServer(daemon, port=args.port).start()
+    daemon.install_signals()
+    with open(args.ready_file + ".tmp", "w") as fh:
+        json.dump({"port": server.port, "pid": os.getpid()}, fh)
+    os.replace(args.ready_file + ".tmp", args.ready_file)
+    rc = daemon.run()
+    server.stop()
+    return rc
+
+
+# -- invariants --------------------------------------------------------------
+
+
+def journal_invariants(journal_path, problems):
+    """Scan the journal the way recovery does and check the no-loss /
+    no-duplicate bookkeeping.  Returns the folded state."""
+    from tpu_parallel.daemon import load_state
+
+    state = load_state(journal_path)
+    by_token = {}
+    for rid in state.order:
+        entry = state.entries[rid]
+        tok = entry.dedupe_token
+        if tok is not None:
+            by_token.setdefault(tok, []).append(rid)
+    for tok, rids in by_token.items():
+        if len(rids) != 1:
+            problems.append(
+                f"dedupe token {tok} journaled {len(rids)} submits "
+                f"({rids}) — duplicate admission"
+            )
+    for entry in state.unfinished:
+        problems.append(
+            f"request {entry.request_id} journaled accepted but never "
+            "reached a terminal — lost accepted work"
+        )
+    return state
+
+
+def state_leak_check(port, problems, label):
+    code, payload = http_json(
+        "GET", f"http://127.0.0.1:{port}/statez"
+    )
+    if code != 200:
+        problems.append(f"{label}: /statez returned {code}")
+        return
+    cluster = payload["cluster"]
+    if cluster["inflight_tokens"] != 0:
+        problems.append(
+            f"{label}: leaked token reservations: "
+            f"{cluster['inflight_tokens']}"
+        )
+    for rep in cluster["replicas"]:
+        if rep["active_slots"] or rep["queue_depth"]:
+            problems.append(
+                f"{label}: replica {rep['replica']} not quiesced: "
+                f"slots={rep['active_slots']} queue={rep['queue_depth']}"
+            )
+
+
+def stop_gracefully(proc, grace, problems, label):
+    proc.send_signal(signal.SIGTERM)
+    try:
+        rc = proc.wait(timeout=grace + 60)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        problems.append(f"{label}: SIGTERM did not exit within grace")
+        return
+    if rc != 0:
+        problems.append(f"{label}: drain exit code {rc} != 0")
+
+
+# -- modes -------------------------------------------------------------------
+
+
+def run_smoke(tmpdir=None, keep=False):
+    """start -> submit -> stream -> SIGTERM drain -> clean exit.  The
+    fast gate check_all and tier-1 run.  Returns a problem list."""
+    import tempfile
+
+    from tpu_parallel.daemon import REC_SHUTDOWN, read_journal
+
+    problems = []
+    tmpdir = tmpdir or tempfile.mkdtemp(prefix="daemon_smoke_")
+    journal = os.path.join(tmpdir, "journal.jsonl")
+    ready = os.path.join(tmpdir, "ready.json")
+    args = argparse.Namespace(
+        replicas=1, slots=2, grace=60.0, fsync_batch=8,
+    )
+    proc = spawn_daemon(args, journal, ready)
+    try:
+        info = wait_ready(ready, proc)
+        port = info["port"]
+        code, payload = http_json(
+            "GET", f"http://127.0.0.1:{port}/healthz"
+        )
+        if code != 200 or not payload.get("ok"):
+            problems.append(f"healthz {code}: {payload}")
+        schedule = make_schedule(seed=7, n_requests=2,
+                                 new_tokens=DEFAULT_NEW_TOKENS)
+        rids = []
+        for entry in schedule:
+            code, rec = http_json(
+                "POST", f"http://127.0.0.1:{port}/v1/submit", entry
+            )
+            if code != 200:
+                problems.append(f"submit {code}: {rec}")
+                continue
+            rids.append(rec["request_id"])
+        # idempotence: resubmitting the first token dedupes
+        code, rec = http_json(
+            "POST", f"http://127.0.0.1:{port}/v1/submit", schedule[0]
+        )
+        if code != 200 or rec["request_id"] != rids[0]:
+            problems.append(f"dedupe resubmit mismatched: {code} {rec}")
+        deadline = time.monotonic() + 120
+        for rid in rids:
+            while time.monotonic() < deadline:
+                code, rec = http_json(
+                    "GET", f"http://127.0.0.1:{port}/v1/result/{rid}"
+                )
+                if code == 200 and rec["status"] == "finished":
+                    if len(rec["tokens"]) != DEFAULT_NEW_TOKENS:
+                        problems.append(
+                            f"{rid}: {len(rec['tokens'])} tokens != "
+                            f"{DEFAULT_NEW_TOKENS}"
+                        )
+                    break
+                time.sleep(0.05)
+            else:
+                problems.append(f"{rid}: never finished")
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metricsz", timeout=30
+        ) as resp:
+            metrics_text = resp.read().decode()
+        if "daemon_journal_records_total" not in metrics_text:
+            problems.append("metricsz missing daemon_* series")
+        if rids:
+            # SSE replay of a finished stream: N token events + a
+            # finished event with the typed reason
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/stream/{rids[0]}"
+            )
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                events = [
+                    json.loads(line[len(b"data: "):])
+                    for line in resp.read().split(b"\n")
+                    if line.startswith(b"data: ")
+                ]
+            toks = [e["token"] for e in events if "token" in e]
+            if len(toks) != DEFAULT_NEW_TOKENS or not events[-1].get(
+                "finished"
+            ):
+                problems.append(
+                    f"stream replay malformed: {len(toks)} tokens, "
+                    f"tail {events[-1] if events else None}"
+                )
+        state_leak_check(port, problems, "smoke")
+        stop_gracefully(proc, args.grace, problems, "smoke")
+        records, torn = read_journal(journal)
+        if torn:
+            problems.append(f"{torn} torn record(s) after a clean exit")
+        last = records[-1] if records else {}
+        if last.get("record") != REC_SHUTDOWN or not last.get("clean"):
+            problems.append(
+                f"journal's last word is {last} — expected a clean "
+                "shutdown record"
+            )
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+        if not keep and not problems:
+            import shutil
+
+            shutil.rmtree(tmpdir, ignore_errors=True)
+    return problems
+
+
+def run_soak(args):
+    """The seeded kill-9 / restart / drain acceptance soak."""
+    from tpu_parallel.daemon import load_state
+
+    record = {"bench": "daemon_soak", "trials": []}
+    problems = []
+    refs_cache = {}
+    for trial in range(args.trials):
+        seed = args.seed + trial
+        rnd = random.Random(seed ^ 0xD43)
+        tmpdir = os.path.join(
+            args.workdir or "/tmp", f"daemon_soak_{os.getpid()}_{seed}"
+        )
+        os.makedirs(tmpdir, exist_ok=True)
+        journal = os.path.join(tmpdir, "journal.jsonl")
+        ready = os.path.join(tmpdir, "ready.json")
+        if os.path.exists(journal):
+            os.remove(journal)
+        schedule = make_schedule(seed, args.requests, args.new)
+        if seed not in refs_cache:
+            refs_cache[seed] = greedy_references(schedule)
+        refs = refs_cache[seed]
+        trial_problems = []
+
+        # ---- life 1: accept traffic, SIGKILL at a seeded point
+        proc = spawn_daemon(args, journal, ready)
+        info = wait_ready(ready, proc)
+        port = info["port"]
+        kill_after = rnd.randrange(2, max(3, args.requests - 2))
+        accepted = {}
+        killed = False
+        for i, entry in enumerate(schedule):
+            try:
+                code, rec = http_json(
+                    "POST", f"http://127.0.0.1:{port}/v1/submit", entry
+                )
+            except (urllib.error.URLError, OSError):
+                break  # the daemon is gone (we killed it)
+            if code == 200:
+                accepted[entry["dedupe_token"]] = rec["request_id"]
+            else:
+                trial_problems.append(
+                    f"life1 submit {i} rejected {code}: {rec}"
+                )
+            if i + 1 == kill_after:
+                # let some tokens stream so the kill lands mid-request
+                time.sleep(rnd.uniform(0.2, 0.6))
+                proc.send_signal(signal.SIGKILL)
+                proc.wait(timeout=30)
+                killed = True
+                break
+        if not killed:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=30)
+        durable = load_state(journal)
+        life1 = {
+            "accepted": len(accepted),
+            "kill_after": kill_after,
+            "durable_submits": len(durable.order),
+            "durable_unfinished": len(durable.unfinished),
+            "torn_records": durable.torn_records,
+        }
+        if len(durable.order) < len(accepted):
+            trial_problems.append(
+                f"life1: {len(accepted)} accepts acknowledged but only "
+                f"{len(durable.order)} journaled — the WAL lied"
+            )
+
+        # ---- life 2: restart on the same journal, idempotent retries
+        proc = spawn_daemon(args, journal, ready)
+        info = wait_ready(ready, proc)
+        port = info["port"]
+        dedupe_hits = 0
+        all_rids = {}
+        for entry in schedule:
+            code, rec = http_json(
+                "POST", f"http://127.0.0.1:{port}/v1/submit", entry
+            )
+            if code != 200:
+                trial_problems.append(
+                    f"life2 submit rejected {code}: {rec}"
+                )
+                continue
+            tok = entry["dedupe_token"]
+            all_rids[tok] = rec["request_id"]
+            if tok in accepted:
+                if rec["request_id"] != accepted[tok]:
+                    trial_problems.append(
+                        f"life2: dedupe {tok} re-admitted as "
+                        f"{rec['request_id']} != {accepted[tok]}"
+                    )
+                else:
+                    dedupe_hits += 1
+        deadline = time.monotonic() + 240
+        finished = {}
+        pending = dict(all_rids)
+        while pending and time.monotonic() < deadline:
+            for tok, rid in list(pending.items()):
+                code, rec = http_json(
+                    "GET", f"http://127.0.0.1:{port}/v1/result/{rid}"
+                )
+                if code == 200 and rec["status"] in (
+                    "finished", "failed", "cancelled", "rejected",
+                    "expired",
+                ):
+                    finished[tok] = rec
+                    del pending[tok]
+            time.sleep(0.05)
+        for tok, rid in pending.items():
+            trial_problems.append(f"{tok} ({rid}): never terminal")
+
+        # ---- invariants
+        for tok, rec in finished.items():
+            if rec["status"] != "finished":
+                trial_problems.append(
+                    f"{tok}: status {rec['status']} "
+                    f"({rec['finish_reason']}) — lost accepted work"
+                )
+                continue
+            if rec["tokens"] != refs[tok]:
+                trial_problems.append(
+                    f"{tok}: tokens diverge from the greedy reference "
+                    "through crash+replay"
+                )
+        state_leak_check(port, trial_problems, f"trial{trial}")
+        stop_gracefully(
+            proc, args.grace, trial_problems, f"trial{trial}"
+        )
+        state = journal_invariants(journal, trial_problems)
+        trial_rec = {
+            "seed": seed,
+            "life1": life1,
+            "dedupe_hits_on_retry": dedupe_hits,
+            "recoveries": state.recoveries,
+            "journal_records": state.next_seq,
+            "finished": sum(
+                1 for r in finished.values()
+                if r["status"] == "finished"
+            ),
+            "requests": args.requests,
+            "problems": list(trial_problems),
+        }
+        record["trials"].append(trial_rec)
+        problems.extend(trial_problems)
+        if not trial_problems:
+            import shutil
+
+            shutil.rmtree(tmpdir, ignore_errors=True)
+        print(
+            f"trial {trial} (seed {seed}): accepted={len(accepted)} "
+            f"kill_after={kill_after} dedupe_hits={dedupe_hits} "
+            f"finished={trial_rec['finished']}/{args.requests} "
+            f"problems={len(trial_problems)}"
+        )
+    caught = sum(
+        t["life1"]["durable_unfinished"] for t in record["trials"]
+    )
+    if caught == 0:
+        problems.append(
+            "no trial caught accepted-but-unfinished work at the kill "
+            "point — the soak proved nothing about recovery; lengthen "
+            "--new or add trials"
+        )
+    record["unfinished_at_kill_total"] = caught
+    record["ok"] = not problems
+    if args.record:
+        with open(args.record, "w") as fh:
+            json.dump(record, fh, indent=2)
+            fh.write("\n")
+        print(f"record: {args.record}")
+    return problems
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--serve", action="store_true",
+                    help="INTERNAL: run the daemon child process")
+    ap.add_argument("--smoke", action="store_true",
+                    help="fast gate: start, submit, SIGTERM drain, "
+                         "assert clean exit (no kill -9)")
+    ap.add_argument("--soak", action="store_true",
+                    help="seeded kill-9/restart soak (the default)")
+    ap.add_argument("--journal", type=str, default="")
+    ap.add_argument("--ready-file", type=str, default="")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--slots", type=int, default=2)
+    ap.add_argument("--grace", type=float, default=60.0)
+    ap.add_argument("--fsync-batch", type=int, default=8)
+    ap.add_argument("--requests", type=int, default=10)
+    ap.add_argument("--new", type=int, default=SOAK_NEW_TOKENS)
+    ap.add_argument("--trials", type=int, default=3)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--workdir", type=str, default="")
+    ap.add_argument("--record", type=str, default="")
+    args = ap.parse_args()
+
+    if args.serve:
+        if not args.journal or not args.ready_file:
+            ap.error("--serve needs --journal and --ready-file")
+        sys.exit(serve(args))
+    if args.smoke:
+        problems = run_smoke()
+    else:
+        problems = run_soak(args)
+    for problem in problems:
+        print(problem, file=sys.stderr)
+    if problems:
+        print(
+            f"daemon_bench: {len(problems)} INVARIANT VIOLATION(S)",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+    print("daemon_bench: OK")
+
+
+if __name__ == "__main__":
+    main()

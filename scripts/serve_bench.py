@@ -37,9 +37,15 @@ request continues from shipped blocks bitwise-identically.
 
 Workload record/replay: ``--trace-record PATH`` dumps the generated
 request schedule (arrival, prompt, prefix group, priority, deadline)
-as JSONL; ``--trace-replay PATH`` re-feeds a recorded schedule through
-the same runners — single-engine or cluster — with ``--time-compress
-X`` dividing every arrival gap (a day-in-the-life at 10-100x).
+as JSONL; ``--trace-replay PATH`` (alias ``--workload PATH``) re-feeds
+a recorded schedule through the same runners — single-engine or
+cluster — with ``--time-compress X`` dividing every arrival gap (a
+day-in-the-life at 10-100x).  The loader also accepts a serving
+daemon's write-ahead journal directly (``tpu_parallel/daemon/``): the
+journal's ``submit`` records carry the SAME workload field names as
+trace entries — one exchange format, not two — so yesterday's
+production traffic replays against today's configuration with zero
+conversion steps (arrivals rebase to the first submit).
 ``--priority-dist`` / ``--deadline-dist`` (``VALUE:WEIGHT,...``;
 deadlines accept ``none``) shape the generated schedule's priority
 classes and per-request deadlines from weighted draws on a child rng —
@@ -293,24 +299,81 @@ def write_trace(path, schedule, meta=None):
 def load_trace(path, time_compress=1.0):
     """Load a recorded schedule; ``time_compress`` divides every arrival
     (10 = a day-in-the-life replayed in 1/10th the time — same order,
-    same prompts, compressed gaps)."""
+    same prompts, compressed gaps).
+
+    Accepts BOTH exchange surfaces that share the workload schema: a
+    ``--trace-record`` file (``trace_meta`` header + request lines) and
+    a serving daemon's write-ahead journal (``journal_meta`` header —
+    only its ``submit`` records are requests; their ``arrival`` stamps
+    are process-monotonic clock readings, so they rebase to the first
+    submit = 0)."""
     import json
 
     if time_compress <= 0:
         raise SystemExit(f"--time-compress {time_compress} must be > 0")
     schedule = []
+    journal = False
+    bad_line = None  # ONE torn record at the tail is legal, like recovery
+    # journal arrival stamps are process-monotonic and NOT comparable
+    # across restarts: each lifetime (delimited by recovery/shutdown
+    # records, or a clock regression) rebases so the replayed arrivals
+    # stay monotone in FILE (= seq) order — the order traffic actually
+    # happened
+    new_life = True
+    base = life_t0 = 0.0
+    prev_raw = None
+    workload_keys = (
+        "arrival", "prompt", "prompt_len", "prefix_group", "priority",
+        "deadline", "max_new_tokens",
+    )
     with open(path) as fh:
-        for line in fh:
+        for lineno, line in enumerate(fh, 1):
             line = line.strip()
             if not line:
                 continue
-            rec = json.loads(line)
-            if rec.get("record") == "trace_meta":
+            if bad_line is not None:
+                raise SystemExit(
+                    f"{path}:{bad_line}: unparseable record is not a "
+                    "torn tail — refusing to replay a corrupt workload"
+                )
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                bad_line = lineno
+                continue
+            kind = rec.get("record")
+            if kind == "trace_meta":
+                continue
+            if kind == "journal_meta":
+                journal = True
+                new_life = True
+                continue
+            if journal or kind is not None:
+                # journal mode: only submit records are workload;
+                # tokens/terminal/decision records are bookkeeping
+                if kind in ("recovery", "shutdown"):
+                    new_life = True  # a restarted process's clock follows
+                    continue
+                if kind != "submit":
+                    continue
+                raw = float(rec["arrival"])
+                if new_life or (prev_raw is not None and raw < prev_raw):
+                    base = schedule[-1]["arrival"] if schedule else 0.0
+                    life_t0 = raw
+                    new_life = False
+                prev_raw = raw
+                rec = {k: rec.get(k) for k in workload_keys}
+                rec["arrival"] = base + (raw - life_t0)
+                schedule.append(rec)
                 continue
             rec["arrival"] = float(rec["arrival"]) / time_compress
             schedule.append(rec)
     if not schedule:
         raise SystemExit(f"trace {path} holds no requests")
+    if journal:
+        for rec in schedule:
+            rec["arrival"] = round(rec["arrival"] / time_compress, 6)
+        return schedule  # file order IS seq order IS the true order
     return sorted(schedule, key=lambda r: r["arrival"])
 
 
@@ -2016,10 +2079,12 @@ def main():
                     help="dump the generated request schedule (arrival, "
                          "prompt, prefix-group, priority, deadline) as "
                          "JSONL — the workload-replay exchange format")
-    ap.add_argument("--trace-replay", type=str, default="",
-                    help="re-feed a recorded schedule instead of "
-                         "generating one (overrides --requests/--rate "
-                         "workload shape)")
+    ap.add_argument("--trace-replay", "--workload", type=str,
+                    default="", dest="trace_replay",
+                    help="re-feed a recorded schedule — a --trace-record "
+                         "file OR a daemon write-ahead journal (same "
+                         "workload schema) — instead of generating one "
+                         "(overrides --requests/--rate workload shape)")
     ap.add_argument("--time-compress", type=float, default=1.0,
                     help="divide every replayed arrival time by this "
                          "factor (10 = day-in-the-life at 10x speed)")

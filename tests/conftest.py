@@ -70,6 +70,7 @@ _SLOW_FILES = {
     "test_paged_kv.py",
     "test_cluster.py",
     "test_swap.py",
+    "test_daemon.py",
 }
 _SLOW_TESTS = {
     "test_pp_aux_gradient_invariance",

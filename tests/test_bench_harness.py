@@ -169,3 +169,109 @@ def test_wedge_reports_phase_and_carries_last_good(tmp_path):
     assert payload["phase"] == "probe"
     assert payload["last_good"]["value"] == 99999.0
     assert payload["last_good"]["commit"] == "abc1234"
+
+
+def test_daemon_journal_replays_as_workload(tmp_path):
+    """One journal format, not two: serve_bench --trace-replay (alias
+    --workload) loads a daemon write-ahead journal directly — submit
+    records become the schedule (arrivals rebased to the first submit,
+    bookkeeping records skipped, torn tail tolerated), and the loaded
+    schedule round-trips through the plain trace format unchanged."""
+    from tpu_parallel.daemon import JournalWriter
+
+    sb = _serve_bench()
+
+    class Clk:
+        def __init__(self):
+            self.t = 100.0
+
+        def __call__(self):
+            self.t += 1.0
+            return self.t
+
+    path = str(tmp_path / "journal.jsonl")
+    w = JournalWriter(path, Clk())
+    prompts = [[4, 5, 6], [7, 8], [9, 10, 11, 12]]
+    for i, p in enumerate(prompts):
+        w.append({
+            "record": "submit", "request_id": f"r{i}",
+            "dedupe_token": f"tok-{i}", "client_id": "c",
+            "arrival": 100.0 + 2.0 * i,
+            "prompt": p, "prompt_len": len(p), "prefix_group": 0,
+            "priority": i, "deadline": 3.5 if i == 2 else None,
+            "max_new_tokens": 8,
+        })
+        w.append({
+            "record": "tokens", "request_id": f"r{i}",
+            "index": 0, "tokens": [1, 2],
+        })
+    w.append({
+        "record": "terminal", "request_id": "r0",
+        "status": "finished", "finish_reason": "length", "n_tokens": 8,
+    })
+    w.close()
+    with open(path, "a") as fh:
+        fh.write('{"record": "tokens", "request_id": "r1", "tok')  # torn
+
+    sched = sb.load_trace(path)
+    assert [e["prompt"] for e in sched] == prompts
+    assert [e["arrival"] for e in sched] == [0.0, 2.0, 4.0]  # rebased
+    assert [e["priority"] for e in sched] == [0, 1, 2]
+    assert sched[2]["deadline"] == 3.5
+    assert all(e["max_new_tokens"] == 8 for e in sched)
+    # time compression behaves exactly like trace replay
+    fast = sb.load_trace(path, time_compress=2.0)
+    assert [e["arrival"] for e in fast] == [0.0, 1.0, 2.0]
+    # round trip through the PLAIN trace format: identical schedule
+    trace = str(tmp_path / "trace.jsonl")
+    sb.write_trace(trace, sched, meta=dict(source="journal"))
+    assert sb.load_trace(trace) == sched
+    # the requests build exactly like trace entries
+    req = sb._schedule_request(sched[2])
+    assert list(req.prompt) == prompts[2]
+    assert req.priority == 2 and req.deadline == 3.5
+
+
+def test_journal_workload_multi_lifetime_rebase_and_corruption(tmp_path):
+    """Journal arrival stamps are process-monotonic, NOT comparable
+    across restarts: a journal spanning a crash (second life's clock
+    restarts near zero) must replay in FILE (= seq) order with monotone
+    rebased arrivals — not scrambled by a min-rebase sort.  And garbage
+    anywhere but the tail refuses loudly instead of silently replaying
+    a smaller workload."""
+    import json
+
+    sb = _serve_bench()
+    path = str(tmp_path / "journal.jsonl")
+
+    def sub(seq, rid, arrival):
+        return {"record": "submit", "seq": seq, "request_id": rid,
+                "arrival": arrival, "prompt": [1, 2], "prompt_len": 2,
+                "prefix_group": 0, "priority": 0, "deadline": None,
+                "max_new_tokens": 4}
+
+    records = [
+        {"record": "journal_meta", "journal_version": 1, "seq": 0},
+        sub(1, "a", 100.0),
+        sub(2, "b", 103.0),
+        # kill -9; restart: new process, clock restarts LOW
+        {"record": "recovery", "seq": 3, "replayed": 1},
+        sub(4, "c", 0.5),
+        sub(5, "d", 2.5),
+    ]
+    with open(path, "w") as fh:
+        for rec in records:
+            fh.write(json.dumps(rec) + "\n")
+    sched = sb.load_trace(path)
+    # file order preserved — life 2 does NOT jump ahead of life 1
+    assert [e["prompt_len"] for e in sched] == [2, 2, 2, 2]
+    assert [e["arrival"] for e in sched] == [0.0, 3.0, 3.0, 5.0]
+    arr = [e["arrival"] for e in sched]
+    assert arr == sorted(arr)  # monotone across the lifetime seam
+    # mid-file garbage: typed refusal, not a silently smaller workload
+    lines = open(path).read().splitlines()
+    lines.insert(2, '{"record": "submit", "request_id": "x", "arri')
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    with pytest.raises(SystemExit):
+        sb.load_trace(path)

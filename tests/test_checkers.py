@@ -263,3 +263,48 @@ def test_check_blocks_allocator_reference_fence():
     assert any(
         "tpu_parallel/serving" in p for p in cb.DEFAULT_PATHS
     )
+
+
+def test_check_clock_daemon_walk_and_wallclock_exemption():
+    """The clock gate's daemon extension (PR 14): the walk now covers
+    ``tpu_parallel/daemon/`` — a wall-time call anywhere in the daemon
+    package is flagged — EXCEPT ``daemon/wallclock.py``, the one
+    sanctioned adapter, whose real source would otherwise trip the gate
+    (proving the exemption is load-bearing, not decorative)."""
+    cc = _load("check_clock")
+    assert "tpu_parallel/daemon" in cc.DEFAULT_PATHS
+    # the live daemon tree passes (wallclock.py skipped by exemption)
+    daemon_dir = os.path.join(REPO_ROOT, "tpu_parallel", "daemon")
+    assert cc.check_paths((daemon_dir,)) == []
+    # the exemption matches exactly the adapter, nothing else
+    assert cc.is_wallclock_file("tpu_parallel/daemon/wallclock.py")
+    assert cc.is_wallclock_file(
+        os.path.join(daemon_dir, "wallclock.py")
+    )
+    assert not cc.is_wallclock_file("tpu_parallel/daemon/daemon.py")
+    assert not cc.is_wallclock_file("tpu_parallel/serving/engine.py")
+    # wallclock.py's REAL source is only legal BECAUSE of the exemption
+    with open(os.path.join(daemon_dir, "wallclock.py")) as fh:
+        src = fh.read()
+    assert cc.check_source(src, "wallclock.py"), (
+        "wallclock.py no longer reads wall time — the exemption (and "
+        "this test) should be retired"
+    )
+    # a wall-time read planted elsewhere in the daemon package IS
+    # caught by the same walk
+    bad = "import time\ndef pump():\n    return time.monotonic()\n"
+    assert cc.check_source(bad, "tpu_parallel/daemon/daemon.py")
+
+
+def test_runtime_checks_registered_separately():
+    """``check_daemon`` (the start/submit/SIGTERM-drain smoke) lives in
+    the RUNTIME_CHECKS registry: resolvable by name like the AST gates,
+    but excluded from the default ``run_all()`` sweep so
+    ``test_all_ast_gates`` stays instant — the smoke itself runs as its
+    own tier-1 entry in tests/test_daemon.py."""
+    assert "check_daemon" in check_all.RUNTIME_CHECKS
+    assert "check_daemon" not in check_all.CHECKERS
+    mod = check_all.load_checker("check_daemon")
+    assert callable(mod.check_paths)
+    with pytest.raises(ValueError):
+        check_all.load_checker("no_such_gate")

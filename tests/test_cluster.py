@@ -1421,3 +1421,151 @@ def test_prefix_affinity_beats_round_robin(env):
     rr = drive("rr")
     assert affinity is not None and rr is not None
     assert affinity > rr, (affinity, rr)
+
+
+# -- deadline double-check race: ONE typed terminal, ONE counter ------------
+
+
+def test_deadline_between_passes_unified_counter(env):
+    """A deadline expiring BETWEEN the tick-top ``_enforce_deadlines``
+    pass and the post-step dispatch pass (which reads a FRESH clock) is
+    shed on the same unified path as the tick-top sweep: exactly one
+    typed ``deadline`` terminal, one count on
+    ``cluster_deadline_sheds_total``, and the engine never sees the
+    request."""
+    _, _, _, prompts, _ = env
+    dt = 0.3
+    box = {"t": 0.0}
+
+    def clock():  # every read advances: time moves WITHIN a tick
+        box["t"] += dt
+        return box["t"]
+
+    eng = _engine(env, clock=clock, n_slots=1)
+    fe = Frontend(
+        [eng], clock=clock,
+        config=FrontendConfig(dispatch_queue_depth=1),
+    )
+    filler = fe.submit(Request(prompt=prompts[0], max_new_tokens=8))
+    seen = []
+    victim = fe.submit(Request(
+        prompt=prompts[1], max_new_tokens=8, deadline=2 * dt,
+        on_token=lambda ev: seen.append(ev),
+    ))
+    # tick 1: at the tick-top read the victim has waited exactly dt
+    # (inside deadline); the filler fills the only dispatch slot, the
+    # replica's step advances the clock, and the post-step dispatch
+    # pass reads a fresh clock past the deadline — the race window
+    events = fe.step()
+    assert victim.status == CANCELLED
+    assert victim.finish_reason == "deadline"
+    assert victim.replicas == []  # never handed to an engine
+    terms = [
+        ev for ev in events
+        if ev.request_id == victim.request.request_id
+    ]
+    assert len(terms) == 1 and terms[0].finish_reason == "deadline"
+    assert len([ev for ev in seen if ev.finished]) == 1
+    assert fe.summary()["deadline_sheds"] == 1
+    # the tick-top sweep rides the SAME counter (no second path)
+    lazy = fe.submit(Request(
+        prompt=prompts[2], max_new_tokens=8, deadline=dt / 2,
+    ))
+    fe.step()
+    assert lazy.status == CANCELLED and lazy.finish_reason == "deadline"
+    assert fe.summary()["deadline_sheds"] == 2
+    assert fe.summary()["cancelled"] == 2
+    fe.run(max_ticks=200)
+    assert filler.status == FINISHED
+    assert fe.summary()["inflight_tokens"] == 0
+    assert eng.pool.n_free == 1
+
+
+# -- cancel racing drain and migration (PR 14 satellite) --------------------
+
+
+def test_cancel_pending_during_drain_one_terminal_no_leaks(env):
+    """Client cancel of a request PENDING at the frontend mid-drain:
+    exactly one terminal event, the drain still completes, and nothing
+    leaks — reservations zero, the pool fully free and aligned."""
+    _, _, _, prompts, refs = env
+    t = [0.0]
+    eng = _engine(env, clock=lambda: t[0], n_slots=1)
+    fe = Frontend(
+        [eng], clock=lambda: t[0],
+        config=FrontendConfig(dispatch_queue_depth=1),
+    )
+    a = fe.submit(Request(prompt=prompts[0], max_new_tokens=8))
+    fe.step()
+    assert a.status == "running"
+    seen = []
+    # b is accepted but still PENDING at the frontend when drain begins
+    b = fe.submit(Request(
+        prompt=prompts[1], max_new_tokens=8,
+        on_token=lambda ev: seen.append(ev),
+    ))
+    assert b.replicas == []
+    fe.drain(max_ticks=0)  # gate closed + queued remainder pulled back
+    assert fe.draining
+    assert fe.cancel(b.request.request_id) is True
+    assert b.status == CANCELLED
+    assert fe.cancel(b.request.request_id) is False  # already terminal
+    fe.run(max_ticks=200)  # the drain's remainder
+    assert a.status == FINISHED
+    np.testing.assert_array_equal(np.asarray(a.tokens), refs[0])
+    assert len([ev for ev in seen if ev.finished]) == 1
+    assert fe.summary()["inflight_tokens"] == 0
+    assert eng.pool.n_free == 1
+    eng.pool.assert_slot_aligned(0)
+
+
+def test_cancel_midrelocation_with_kv_export_one_terminal_no_leaks(env):
+    """Client cancel of a request caught MID-RELOCATION — pulled back
+    to pending with its KV export captured (cluster/migration.py), the
+    swap drain-timeout state — terminates once and leaks nothing: the
+    export's host blocks drop with the state, both engines' allocators
+    audit clean, reservations end zero."""
+    _, _, _, prompts, _ = env
+    t = [0.0]
+    kw = dict(kv_block_tokens=4, prefix_cache_size=16,
+              kv_radix_cache=True)
+    eng_a = _engine(env, clock=lambda: t[0], n_slots=2, **kw)
+    eng_b = _engine(env, clock=lambda: t[0], n_slots=2, **kw)
+    fe = Frontend([eng_a, eng_b], clock=lambda: t[0])
+    seen = []
+    a = fe.submit(Request(
+        prompt=prompts[1], max_new_tokens=8,
+        on_token=lambda ev: seen.append(ev),
+    ))
+    for _ in range(30):  # run until at least one full block is written
+        fe.step()
+        if len(a.tokens) >= 5:
+            break
+    assert 0 < len(a.tokens) < 8
+    st = next(s for s in fe._by_attempt.values() if s.out is a)
+    handle, erid = st.handle, st.engine_rid
+    # mirror SwapController._relocate_open exactly: forget, detach,
+    # capture BEFORE the cancel frees the blocks, requeue pending
+    handle.forget(erid)
+    fe._by_attempt.pop(erid)
+    fe._capture_relocation_kv(st, handle, erid)
+    st.handle = None
+    st.engine_rid = None
+    handle.engine.cancel(erid, reason="swap_relocate")
+    fe._pending.append(st)
+    assert st.kv_export is not None  # genuinely mid-migration
+    assert fe.summary()["kv_exports"] == 1
+    # the race: the client cancels while the relocation is in flight
+    assert fe.cancel(a.request.request_id) is True
+    assert a.status == CANCELLED
+    assert fe.cancel(a.request.request_id) is False
+    assert len([ev for ev in seen if ev.finished]) == 1
+    assert fe.summary()["inflight_tokens"] == 0
+    # no KV install ever ran — the export died with the cancel, typed
+    assert all(
+        v == 0 for v in fe.summary()["kv_migrations"].values()
+    )
+    fe.drain(max_ticks=50)
+    for eng in (eng_a, eng_b):
+        eng.pool.allocator.check()
+        assert eng.in_flight == 0

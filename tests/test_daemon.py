@@ -1,0 +1,613 @@
+"""Durable-daemon tests: write-ahead journal semantics, in-process
+crash + journal-replay recovery (bitwise greedy parity through a
+kill), dedupe-token idempotence, the drain/fast-shutdown contract on a
+fake clock, the stdlib HTTP+SSE face, and the real-subprocess SIGTERM
+smoke that ``scripts/check_all.py`` also runs."""
+
+import json
+import os
+import sys
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_parallel.cluster import Frontend, FrontendConfig
+from tpu_parallel.daemon import (
+    EXIT_CLEAN,
+    EXIT_FORCED,
+    REC_RECOVERY,
+    REC_SHUTDOWN,
+    REC_SUBMIT,
+    REC_TERMINAL,
+    REC_TOKENS,
+    DaemonConfig,
+    DaemonHTTPServer,
+    JournalCorrupt,
+    JournalWriter,
+    ServingDaemon,
+    WallClock,
+    load_state,
+    read_journal,
+    replay_state,
+)
+from tpu_parallel.models import GPTLM, tiny_test
+from tpu_parallel.models.generate import generate
+from tpu_parallel.obs.registry import MetricRegistry
+from tpu_parallel.serving import (
+    REJECT_DRAINING,
+    REJECTED,
+    Request,
+    SchedulerConfig,
+    ServingEngine,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeClock:
+    """Callable clock + sleep — the daemon's full fake-time surface."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def sleep(self, seconds: float) -> None:
+        self.t += seconds
+
+
+@pytest.fixture(scope="module")
+def env():
+    cfg = tiny_test(dtype=jnp.float32, remat=False)
+    model = GPTLM(cfg)
+    rng = jax.random.PRNGKey(11)
+    lens = [3, 5, 4, 7]
+    prompts = [
+        [int(t) for t in np.asarray(
+            jax.random.randint(
+                jax.random.fold_in(rng, i), (L,), 1, cfg.vocab_size
+            )
+        )]
+        for i, L in enumerate(lens)
+    ]
+    probe = jax.random.randint(rng, (1, max(lens)), 1, cfg.vocab_size)
+    params = model.init(
+        {"params": jax.random.PRNGKey(1)}, probe, train=False
+    )["params"]
+    refs = [
+        [int(t) for t in np.asarray(generate(
+            model, params, jnp.asarray(p, jnp.int32)[None, :],
+            max_new_tokens=8,
+        ))[0]]
+        for p in prompts
+    ]
+    return cfg, model, params, prompts, refs
+
+
+def _factory(env, **fe_kw):
+    cfg, model, params, _, _ = env
+
+    def frontend_factory(clock):
+        engine = ServingEngine(
+            model, params, n_slots=2,
+            scheduler=SchedulerConfig(max_prefills_per_tick=2),
+            decode_steps_per_tick=1,
+        )
+        return Frontend(
+            [engine], router="least",
+            config=FrontendConfig(restart=None, **fe_kw),
+            clock=clock, registry=MetricRegistry(),
+        )
+
+    return frontend_factory
+
+
+def _daemon(env, path, clock=None, fe_kw=None, **cfg_kw):
+    cfg_kw.setdefault("fsync_batch", 4)
+    return ServingDaemon(
+        _factory(env, **(fe_kw or {})), str(path),
+        clock=clock or FakeClock(),
+        config=DaemonConfig(**cfg_kw),
+    )
+
+
+# -- journal unit semantics -------------------------------------------------
+
+
+def test_journal_roundtrip_seq_and_fsync_batching(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    clk = FakeClock()
+    w = JournalWriter(path, clk, fsync_batch=3)
+    base_syncs = w.fsyncs
+    for i in range(4):
+        w.append({"record": "tokens", "request_id": "r", "tokens": [i]})
+    # 4 non-sync-now records at batch 3: exactly one batched fsync fired
+    assert w.fsyncs == base_syncs + 1
+    w.append({"record": REC_SUBMIT, "request_id": "s", "prompt": [1]})
+    assert w.fsyncs == base_syncs + 2  # submits sync immediately
+    w.close()
+    records, torn = read_journal(path)
+    assert torn == 0
+    seqs = [r["seq"] for r in records]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    assert records[0]["record"] == "journal_meta"
+    # a new writer continues the sequence instead of restarting it
+    w2 = JournalWriter(path, clk, next_seq=load_state(path).next_seq)
+    rec = w2.append({"record": "tokens", "request_id": "r", "tokens": []})
+    assert rec["seq"] > seqs[-1]
+    w2.close()
+
+
+def test_journal_torn_tail_tolerated_midfile_corruption_raises(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    w = JournalWriter(path, FakeClock())
+    w.append({"record": REC_SUBMIT, "request_id": "a", "prompt": [1]})
+    w.append({"record": REC_TOKENS, "request_id": "a", "tokens": [5]})
+    w.close()
+    with open(path, "a") as fh:
+        fh.write('{"record": "tokens", "request_id": "a", "toke')  # torn
+    records, torn = read_journal(path)
+    assert torn == 1
+    assert [r["record"] for r in records][-1] == REC_TOKENS
+    # the same garbage MID-file is corruption, not a torn tail
+    lines = open(path).read().splitlines()
+    lines.insert(1, "not json at all")
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    with pytest.raises(JournalCorrupt):
+        read_journal(path)
+
+
+def test_replay_state_folds_tokens_by_index_and_terminals():
+    records = [
+        {"record": REC_SUBMIT, "seq": 0, "request_id": "a",
+         "dedupe_token": "da", "prompt": [1], "max_new_tokens": 4},
+        {"record": REC_TOKENS, "seq": 1, "request_id": "a",
+         "index": 0, "tokens": [10, 11]},
+        # overlapping re-delivery (post-recovery re-stream): idempotent
+        {"record": REC_TOKENS, "seq": 2, "request_id": "a",
+         "index": 1, "tokens": [11, 12]},
+        {"record": REC_SUBMIT, "seq": 3, "request_id": "b",
+         "dedupe_token": "db", "prompt": [2], "max_new_tokens": 4},
+        {"record": REC_TERMINAL, "seq": 4, "request_id": "a",
+         "status": "finished", "finish_reason": "length"},
+    ]
+    state = replay_state(records)
+    assert state.entries["a"].tokens == [10, 11, 12]
+    assert not state.entries["a"].unfinished
+    assert [e.request_id for e in state.unfinished] == ["b"]
+    assert state.dedupe == {"da": "a", "db": "b"}
+    assert state.next_seq == 5
+    assert not state.clean_shutdown
+
+
+# -- crash + replay recovery (the tentpole contract) ------------------------
+
+
+def test_crash_replay_recovers_unfinished_bitwise(env, tmp_path):
+    """kill -9 simulation mid-stream: the restarted daemon re-admits
+    every accepted-but-unfinished request from the journal with its
+    durable prefix forced, finishes them, and the full streams equal
+    the never-crashed greedy reference bitwise.  Dedupe-token retries
+    after the crash return the SAME records — no duplicate admission,
+    no duplicate completion, nothing lost."""
+    _, _, _, prompts, refs = env
+    path = tmp_path / "j.jsonl"
+    d1 = _daemon(env, path)
+    for i in range(3):
+        rec = d1.submit(
+            Request(prompt=prompts[i], max_new_tokens=8,
+                    request_id=f"r{i}"),
+            dedupe_token=f"tok-{i}",
+        )
+        assert rec["status"] == "queued"
+    for _ in range(5):
+        d1.tick()
+    partial = [len(d1.result(f"r{i}")["tokens"]) for i in range(3)]
+    assert any(0 < n < 8 for n in partial), partial  # crash lands mid-stream
+    d1.journal.abort()  # the kill -9: no shutdown record, no final sync
+
+    d2 = _daemon(env, path)
+    st = load_state(str(path))
+    assert st.recoveries == 1  # the restart journaled its replay
+    # idempotent client retry: same dedupe token, same record, and the
+    # journal must NOT grow a second submit for it
+    submits_before = sum(
+        1 for r in read_journal(str(path))[0]
+        if r["record"] == REC_SUBMIT
+    )
+    dup = d2.submit(
+        Request(prompt=prompts[0], max_new_tokens=8),
+        dedupe_token="tok-0",
+    )
+    assert dup["request_id"] == "r0" and dup["recovered"]
+    assert int(d2.registry.counter("daemon_dedupe_hits_total").value) == 1
+    for _ in range(60):
+        if all(
+            d2.result(f"r{i}")["status"] == "finished" for i in range(3)
+        ):
+            break
+        d2.tick()
+    for i in range(3):
+        rec = d2.result(f"r{i}")
+        assert rec["status"] == "finished"
+        assert rec["tokens"] == refs[i]  # bitwise through the crash
+    submits_after = sum(
+        1 for r in read_journal(str(path))[0]
+        if r["record"] == REC_SUBMIT
+    )
+    assert submits_after == submits_before  # zero duplicate admissions
+    assert d2.frontend._reserved == 0
+    pool = d2.frontend.replicas[0].engine.pool
+    assert pool.n_free == pool.n_slots  # zero leaked reservations
+    assert int(
+        d2.registry.counter("daemon_recovered_requests_total").value
+    ) == 3
+
+
+def test_recovery_synthesizes_lost_terminals(env, tmp_path):
+    """A crash can eat the terminal record after the last token was
+    durable: recovery must close such requests (length / delivered-EOS)
+    instead of re-admitting and over-generating."""
+    path = str(tmp_path / "j.jsonl")
+    w = JournalWriter(path, FakeClock())
+    w.append({"record": REC_SUBMIT, "request_id": "full",
+              "dedupe_token": "tf", "prompt": [3, 4],
+              "max_new_tokens": 3})
+    w.append({"record": REC_TOKENS, "request_id": "full", "index": 0,
+              "tokens": [7, 8, 9]})  # budget exhausted, terminal lost
+    w.append({"record": REC_SUBMIT, "request_id": "eos",
+              "dedupe_token": "te", "prompt": [3, 4],
+              "max_new_tokens": 6, "eos_token_id": 42})
+    w.append({"record": REC_TOKENS, "request_id": "eos", "index": 0,
+              "tokens": [7, 42]})  # EOS delivered, terminal lost
+    w.abort()
+    d = _daemon(env, path)
+    full, eos = d.result("full"), d.result("eos")
+    assert full["status"] == "finished"
+    assert full["finish_reason"] == "length"
+    assert eos["status"] == "finished" and eos["finish_reason"] == "eos"
+    assert not d.frontend.has_work()  # nothing re-admitted
+    assert int(
+        d.registry.counter("daemon_recovered_completions_total").value
+    ) == 2
+    # and the synthesized terminals are durable for the NEXT restart
+    st = load_state(path)
+    assert not st.unfinished
+
+
+def test_recovery_rejection_is_loud_and_typed(env, tmp_path):
+    """A replayed request the restarted config can no longer admit
+    terminates REJECTED with the frontend's typed reason — journaled —
+    never silently dropped."""
+    _, _, _, prompts, _ = env
+    path = tmp_path / "j.jsonl"
+    d1 = _daemon(env, path)
+    d1.submit(Request(prompt=prompts[0], max_new_tokens=8,
+                      request_id="big"), dedupe_token="tb")
+    d1.tick()
+    d1.journal.abort()
+    # restart with a token budget too small for the replay
+    d2 = _daemon(env, path, fe_kw={"max_inflight_tokens": 4})
+    rec = d2.result("big")
+    assert rec["status"] == REJECTED
+    assert rec["finish_reason"] == "token_budget"
+    terminals = [
+        r for r in read_journal(str(path))[0]
+        if r["record"] == REC_TERMINAL and r["request_id"] == "big"
+    ]
+    assert len(terminals) == 1 and terminals[0]["status"] == REJECTED
+
+
+# -- dedupe idempotence ------------------------------------------------------
+
+
+def test_dedupe_completed_request_returns_cached_result(env, tmp_path):
+    _, _, _, prompts, refs = env
+    d = _daemon(env, tmp_path / "j.jsonl")
+    d.submit(Request(prompt=prompts[0], max_new_tokens=8,
+                     request_id="x", dedupe_token="same"))
+    for _ in range(30):
+        if d.result("x")["status"] == "finished":
+            break
+        d.tick()
+    accepted = int(d.registry.counter("daemon_accepted_total").value)
+    again = d.submit(Request(prompt=prompts[0], max_new_tokens=8,
+                             dedupe_token="same"))
+    assert again["request_id"] == "x" and again["tokens"] == refs[0]
+    assert int(
+        d.registry.counter("daemon_accepted_total").value
+    ) == accepted  # no second admission
+    assert not d.frontend.has_work()
+
+
+# -- drain / shutdown contract ----------------------------------------------
+
+
+def test_sigterm_drain_finishes_inflight_rejects_new_exits_clean(
+    env, tmp_path
+):
+    _, _, _, prompts, refs = env
+    path = tmp_path / "j.jsonl"
+    d = _daemon(env, path)
+    d.submit(Request(prompt=prompts[0], max_new_tokens=8,
+                     request_id="r0"))
+    d.tick()
+    d.request_drain()  # SIGTERM equivalent
+    rc = d.run(max_ticks=100)
+    assert rc == EXIT_CLEAN
+    assert d.result("r0")["tokens"] == refs[0]  # in-flight finished
+    # late submission refused typed `draining`
+    late = d.submit(Request(prompt=prompts[1], max_new_tokens=4))
+    assert late["status"] == REJECTED
+    assert late["finish_reason"] == REJECT_DRAINING
+    records, torn = read_journal(str(path))
+    assert torn == 0
+    assert records[-1]["record"] == REC_SHUTDOWN and records[-1]["clean"]
+    st = load_state(str(path))
+    assert st.clean_shutdown and not st.unfinished
+
+
+def test_second_sigterm_forces_fast_shutdown_journal_recovers(
+    env, tmp_path
+):
+    """SIGTERM twice = fast shutdown NOW: exit code 1, shutdown record
+    not clean, and the open request survives into the next recovery."""
+    _, _, _, prompts, refs = env
+    path = tmp_path / "j.jsonl"
+    d = _daemon(env, path)
+    d.submit(Request(prompt=prompts[0], max_new_tokens=8,
+                     request_id="r0"), dedupe_token="t0")
+    d.tick()
+    d.request_drain()
+    d.request_drain()  # the second TERM
+    rc = d.run(max_ticks=100)
+    assert rc == EXIT_FORCED
+    records, _ = read_journal(str(path))
+    assert records[-1]["record"] == REC_SHUTDOWN
+    assert not records[-1]["clean"]
+    d2 = _daemon(env, path)
+    for _ in range(40):
+        if d2.result("r0")["status"] == "finished":
+            break
+        d2.tick()
+    assert d2.result("r0")["tokens"] == refs[0]
+
+
+def test_blown_grace_window_forces_shutdown(env, tmp_path):
+    """A drain that cannot finish inside grace_seconds exits forced
+    instead of hanging — the journal carries the remainder."""
+    _, _, _, prompts, _ = env
+    clk = FakeClock()
+    d = _daemon(env, tmp_path / "j.jsonl", clock=clk, grace_seconds=5.0)
+    d.submit(Request(prompt=prompts[0], max_new_tokens=8,
+                     request_id="r0"))
+    d.request_drain()
+    d._begin_drain()
+    clk.t += 10.0  # wall time blows straight through the grace window
+    rc = d.run(max_ticks=3)
+    assert rc == EXIT_FORCED
+    st = load_state(str(tmp_path / "j.jsonl"))
+    assert not st.clean_shutdown
+
+
+# -- frontend journal hooks --------------------------------------------------
+
+
+def test_frontend_journal_hook_fires_on_lifecycle_points(env, tmp_path):
+    _, _, _, prompts, _ = env
+    notes = []
+    d = _daemon(env, tmp_path / "j.jsonl")
+    d.frontend.set_journal(lambda kind, payload: notes.append(kind))
+    d.frontend.submit(Request(prompt=prompts[0], max_new_tokens=2))
+    assert "submit_accepted" in notes
+    d.frontend.run(max_ticks=30)
+    assert "terminal" in notes
+    d.frontend.drain()
+    assert "drain_begin" in notes
+
+
+# -- HTTP + SSE face ---------------------------------------------------------
+
+
+def test_http_endpoints_and_sse_stream(env, tmp_path):
+    """The stdlib network face against a live wall-clock daemon: submit
+    over HTTP (journal-durable), SSE stream to completion, healthz
+    flip on drain, statez leak fields, cancel route."""
+    import urllib.request
+
+    _, _, _, prompts, refs = env
+    d = ServingDaemon(
+        _factory(env), str(tmp_path / "j.jsonl"),
+        clock=WallClock(),
+        config=DaemonConfig(fsync_batch=4, grace_seconds=30.0),
+    )
+    server = DaemonHTTPServer(d).start()
+    rc_box = []
+    pump = threading.Thread(
+        target=lambda: rc_box.append(d.run()), daemon=True
+    )
+    pump.start()
+    base = f"http://127.0.0.1:{server.port}"
+
+    def call(method, path, body=None):
+        data = None if body is None else json.dumps(body).encode()
+        req = urllib.request.Request(base + path, data=data, method=method)
+        if data is not None:
+            req.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return resp.status, json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as exc:
+            return exc.code, json.loads(exc.read() or b"{}")
+
+    try:
+        code, health = call("GET", "/healthz")
+        assert code == 200 and health["ok"]
+        code, rec = call("POST", "/v1/submit", {
+            "prompt": prompts[0], "max_new_tokens": 8,
+            "dedupe_token": "http-0",
+        })
+        assert code == 200
+        rid = rec["request_id"]
+        # malformed body is a 400, not a daemon error
+        code, _ = call("POST", "/v1/submit", {"prompt": "nope"})
+        assert code == 400
+        # SSE: tokens then the finished event
+        with urllib.request.urlopen(
+            base + f"/v1/stream/{rid}", timeout=60
+        ) as resp:
+            payload = resp.read()
+        events = [
+            json.loads(line[len(b"data: "):])
+            for line in payload.split(b"\n")
+            if line.startswith(b"data: ")
+        ]
+        toks = [e["token"] for e in events if "token" in e]
+        assert toks == refs[0]
+        assert events[-1]["finished"]
+        assert events[-1]["finish_reason"] == "length"
+        # cancel an unknown id 404s; a live one cancels
+        code, _ = call("POST", "/v1/cancel/nope")
+        assert code == 404
+        code, rec2 = call("POST", "/v1/submit", {
+            "prompt": prompts[1], "max_new_tokens": 8,
+        })
+        assert code == 200
+        code, _ = call("POST", f"/v1/cancel/{rec2['request_id']}")
+        assert code == 200
+        code, state = call("GET", "/statez")
+        assert code == 200
+        assert "inflight_tokens" in state["cluster"]
+        # drain: healthz flips 503 for the balancer, daemon exits 0
+        d.request_drain()
+        pump.join(timeout=60)
+        assert rc_box == [EXIT_CLEAN]
+        code, health = call("GET", "/healthz")
+        assert code == 503
+    finally:
+        server.stop()
+
+
+# -- the real-subprocess smoke (also scripts/check_all.py's gate) -----------
+
+
+def test_daemon_smoke_subprocess():
+    """start -> HTTP submit -> SSE replay -> SIGTERM -> exit 0 with a
+    clean journal, as one REAL process receiving real signals.  This is
+    exactly what ``check_all``'s ``check_daemon`` runtime gate runs."""
+    sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+    try:
+        import check_daemon
+    finally:
+        sys.path.pop(0)
+    problems = check_daemon.check_paths()
+    assert problems == [], "\n".join(problems)
+
+
+def test_sighup_reload_journals_typed_decision(env, tmp_path):
+    """SIGHUP's reload flows are journaled as typed DECISION records:
+    no reload_path configured, unreadable spec, and a spec without a
+    checkpoint_dir each refuse loudly instead of killing the pump."""
+    # no reload_path
+    d = _daemon(env, tmp_path / "a.jsonl")
+    d.request_reload()
+    d.run(max_ticks=1)
+    recs, _ = read_journal(str(tmp_path / "a.jsonl"))
+    decisions = [r for r in recs if r["record"] == "decision"]
+    assert decisions and decisions[-1]["verdict"] == "no_reload_path"
+    # unreadable spec file
+    d2 = _daemon(env, tmp_path / "b.jsonl",
+                 reload_path=str(tmp_path / "missing.json"))
+    d2.request_reload()
+    d2.run(max_ticks=1)
+    recs, _ = read_journal(str(tmp_path / "b.jsonl"))
+    assert [r for r in recs if r["record"] == "decision"][-1][
+        "verdict"
+    ] == "unreadable"
+    # spec without a checkpoint_dir
+    spec = tmp_path / "spec.json"
+    spec.write_text("{}")
+    d3 = _daemon(env, tmp_path / "c.jsonl", reload_path=str(spec))
+    d3.request_reload()
+    d3.run(max_ticks=1)
+    recs, _ = read_journal(str(tmp_path / "c.jsonl"))
+    assert [r for r in recs if r["record"] == "decision"][-1][
+        "verdict"
+    ] == "no_checkpoint_dir"
+    assert int(
+        d3.registry.counter("daemon_signals_total", signal="hup").value
+    ) == 1
+
+
+def test_torn_tail_truncated_before_reopen_double_restart(env, tmp_path):
+    """A writer reopening after a torn write must TRUNCATE the fragment
+    — appending onto it would weld the next record into mid-file
+    garbage and brick the journal (JournalCorrupt) on the SECOND
+    restart.  Two full crash+recover cycles over a torn tail must both
+    succeed, with nothing durable lost."""
+    _, _, _, prompts, refs = env
+    path = tmp_path / "j.jsonl"
+    d1 = _daemon(env, path)
+    d1.submit(Request(prompt=prompts[0], max_new_tokens=8,
+                      request_id="r0"), dedupe_token="t0")
+    for _ in range(3):
+        d1.tick()
+    d1.journal.abort()
+    with open(path, "a") as fh:  # the write the SIGKILL cut mid-record
+        fh.write('{"record": "tokens", "request_id": "r0", "toke')
+    # restart 1: fragment dropped BEFORE reading (the daemon truncates
+    # ahead of load_state so recovery acts on exactly what stays
+    # durable), recovery replays, MORE records append
+    d2 = _daemon(env, path)
+    # the fragment is GONE (not merely tolerated): the whole file —
+    # including the records recovery just appended — parses torn-free
+    assert read_journal(str(path))[1] == 0
+    for _ in range(3):
+        d2.tick()
+    d2.journal.abort()  # crash again mid-stream
+    # restart 2: the journal must still parse (no mid-file corruption)
+    d3 = _daemon(env, path)
+    for _ in range(40):
+        if d3.result("r0")["status"] == "finished":
+            break
+        d3.tick()
+    assert d3.result("r0")["tokens"] == refs[0]
+    records, torn = read_journal(str(path))
+    assert torn == 0  # every surviving record is parseable
+
+
+def test_completed_retention_bounds_memory(env, tmp_path):
+    """Terminal records past ``completed_retention`` evict oldest-first
+    (with their dedupe tokens): daemon memory is bounded at any uptime,
+    the open count stays exact, and an evicted token re-admits as a
+    fresh request instead of replaying a record that no longer exists."""
+    _, _, _, prompts, _ = env
+    d = _daemon(env, tmp_path / "j.jsonl", completed_retention=2)
+    rids = []
+    for i in range(4):
+        rec = d.submit(
+            Request(prompt=prompts[i % len(prompts)], max_new_tokens=2,
+                    request_id=f"r{i}"),
+            dedupe_token=f"t{i}",
+        )
+        rids.append(rec["request_id"])
+        for _ in range(20):
+            if d.result(f"r{i}") is None or (
+                d.result(f"r{i}")["status"] == "finished"
+            ):
+                break
+            d.tick()
+    assert len(d._requests) == 2  # bounded: only the newest two remain
+    assert d.result("r0") is None and d.result("r3") is not None
+    assert "t0" not in d._dedupe and "t3" in d._dedupe
+    assert d._open_count == 0
+    # an evicted dedupe token is a NEW admission now (fresh request id)
+    again = d.submit(
+        Request(prompt=prompts[0], max_new_tokens=2), dedupe_token="t0"
+    )
+    assert again["request_id"] != "r0"

@@ -340,6 +340,10 @@ class Frontend:
         self._retries = r.counter("cluster_retries_total")
         self._requeued = r.counter("cluster_requeued_total")
         self._cancelled = r.counter("cluster_cancelled_total")
+        # the ONE deadline-shed counter: every typed ``deadline``
+        # terminal — tick-top sweep or pre-dispatch check alike — goes
+        # through _shed_deadline and lands here exactly once
+        self._deadline_sheds = r.counter("cluster_deadline_sheds_total")
         self._failed = r.counter("cluster_failed_total")
         self._deaths = r.counter("cluster_replica_deaths_total")
         self._watchdog_degraded = r.counter(
@@ -376,6 +380,26 @@ class Frontend:
         # a retiree's, whose terminal gauge row and trace history a new
         # engine must not inherit
         self._next_replica_id = max(self._by_id) + 1
+        # write-ahead journal hook (tpu_parallel/daemon/): when set, the
+        # frontend notifies it at the durability-relevant points —
+        # accepted submissions, terminal events, drain begin, swap
+        # begin, autopilot actions — so a daemon shell can journal every
+        # state change it must survive.  None costs nothing.
+        self._journal: Optional[Callable[[str, dict], None]] = None
+        self._journal_ap_seen = 0  # autopilot actions already notified
+
+    # -- journal hook ------------------------------------------------------
+
+    def set_journal(self, sink: Optional[Callable[[str, dict], None]]) -> None:
+        """Attach (or clear) the write-ahead journal hook: ``sink(kind,
+        payload)`` fires at submit-accept / terminal / drain-begin /
+        swap-begin and once per autopilot action.  The daemon shell is
+        the intended consumer; the frontend never depends on it."""
+        self._journal = sink
+
+    def _journal_note(self, kind: str, **payload) -> None:
+        if self._journal is not None:
+            self._journal(kind, payload)
 
     # -- admission ---------------------------------------------------------
 
@@ -443,6 +467,10 @@ class Frontend:
                 return reject(REJECT_SHED)
         self._reserved += need
         self._pending.append(_ClientState(out, next(self._seq), need))
+        self._journal_note(
+            "submit_accepted", request_id=request.request_id,
+            reserved_tokens=need,
+        )
         return out
 
     # -- the tick ----------------------------------------------------------
@@ -465,6 +493,15 @@ class Frontend:
             # the autopilot senses and actuates before dispatch too, so
             # shed state, fleet size and retuned budgets shape this tick
             self._autopilot.tick(now)
+            if self._journal is not None:
+                acts = self._autopilot.actions
+                for act in acts[self._journal_ap_seen:]:
+                    self._journal_note(
+                        "autopilot_action", kind=act.kind,
+                        reason=act.reason, tick=act.tick,
+                        detail=dict(act.detail),
+                    )
+                self._journal_ap_seen = len(acts)
         self._enforce_deadlines(now)
         self._dispatch(now)
         for handle in self.replicas:
@@ -678,6 +715,7 @@ class Frontend:
         to completion.  On return every accepted request is terminal and
         every replica's cache pool is fully released."""
         self.draining = True
+        self._journal_note("drain_begin")
         span = (
             self.tracer.span("drain", track="router")
             if self.tracer.enabled
@@ -773,6 +811,9 @@ class Frontend:
         )
         self._swap = SwapController(
             self, params, version, policy or SwapPolicy()
+        )
+        self._journal_note(
+            "swap_begin", version=version, replicas=len(self.replicas)
         )
         if self.tracer.enabled:
             self.tracer.instant(
@@ -1029,7 +1070,7 @@ class Frontend:
                 and st.out.arrival_time is not None
                 and now - st.out.arrival_time > deadline
             ):
-                self._cancel_state(st, "deadline", now)
+                self._shed_deadline(st, now)
                 continue
             if not self._try_place(st, now):
                 leftover.append(st)
@@ -1324,7 +1365,17 @@ class Frontend:
             if deadline is None or st.out.done:
                 continue
             if now - st.out.arrival_time > deadline:
-                self._cancel_state(st, "deadline", now)
+                self._shed_deadline(st, now)
+
+    def _shed_deadline(self, st: _ClientState, now: float) -> None:
+        """The ONE deadline-expiry terminal: both sweeps — the tick-top
+        ``_enforce_deadlines`` pass and the pre-dispatch check (whose
+        fresh clock read can observe an expiry BETWEEN the two passes) —
+        shed through here, so every deadline miss is one typed
+        ``deadline`` cancel counted once on one counter, wherever in the
+        tick it was caught."""
+        self._deadline_sheds.inc()
+        self._cancel_state(st, "deadline", now)
 
     def _cancel_state(self, st: _ClientState, reason: str, now: float) -> None:
         """Cancel wherever the request is.  Finalizes the cluster record
@@ -1356,6 +1407,10 @@ class Frontend:
         st.handle = None
         st.engine_rid = None
         self._reserved -= st.budget
+        self._journal_note(
+            "terminal", request_id=st.out.request.request_id,
+            status=status, reason=reason, n_tokens=len(st.out.tokens),
+        )
 
     def _emit_terminal(self, st: _ClientState, reason: str) -> None:
         event = StreamEvent(
@@ -1447,6 +1502,7 @@ class Frontend:
             "retries": int(self._retries.value),
             "requeued": int(self._requeued.value),
             "cancelled": int(self._cancelled.value),
+            "deadline_sheds": int(self._deadline_sheds.value),
             "failed": int(self._failed.value),
             "replica_deaths": int(self._deaths.value),
             "watchdog_degraded": int(self._watchdog_degraded.value),

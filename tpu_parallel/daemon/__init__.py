@@ -1,0 +1,66 @@
+"""Durable serving daemon: the long-lived wall-clock process around the
+cluster frontend (docs/13_daemon.md).
+
+``daemon/journal.py`` is the write-ahead request journal (append-only
+JSONL, sequence numbers, batched fsync) and its crash-recovery replay;
+``daemon/daemon.py`` is the shell — recovery, dedupe-token idempotence,
+the SIGTERM/SIGHUP signal contract and the tick pump; ``daemon/http.py``
+is the stdlib HTTP + SSE network face; ``daemon/wallclock.py`` is the
+ONE place in the serving stack allowed to read real time
+(``scripts/check_clock.py`` enforces it).
+"""
+
+from tpu_parallel.daemon.daemon import (
+    DAEMON_TRACK,
+    EXIT_CLEAN,
+    EXIT_FORCED,
+    DaemonConfig,
+    ServingDaemon,
+)
+from tpu_parallel.daemon.http import DaemonHTTPServer, build_request
+from tpu_parallel.daemon.journal import (
+    JOURNAL_VERSION,
+    REC_DECISION,
+    REC_META,
+    REC_RECOVERY,
+    REC_SHUTDOWN,
+    REC_SUBMIT,
+    REC_TERMINAL,
+    REC_TOKENS,
+    JournalCorrupt,
+    JournalEntry,
+    JournalWriter,
+    RecoveryState,
+    drop_torn_tail,
+    load_state,
+    read_journal,
+    replay_state,
+)
+from tpu_parallel.daemon.wallclock import WallClock
+
+__all__ = [
+    "DAEMON_TRACK",
+    "EXIT_CLEAN",
+    "EXIT_FORCED",
+    "DaemonConfig",
+    "DaemonHTTPServer",
+    "JOURNAL_VERSION",
+    "JournalCorrupt",
+    "JournalEntry",
+    "JournalWriter",
+    "REC_DECISION",
+    "REC_META",
+    "REC_RECOVERY",
+    "REC_SHUTDOWN",
+    "REC_SUBMIT",
+    "REC_TERMINAL",
+    "REC_TOKENS",
+    "RecoveryState",
+    "ServingDaemon",
+    "WallClock",
+    "build_request",
+    "drop_torn_tail",
+    "load_state",
+    "read_journal",
+    "replay_state",
+]

@@ -1,0 +1,705 @@
+"""The durable serving daemon: a long-lived wall-clock process around
+the cluster :class:`~tpu_parallel.cluster.frontend.Frontend`.
+
+Everything below this layer runs on the injectable clock and is soaked
+deterministically by the chaos/swap/autopilot harnesses; this module is
+the thin shell that finally lets it SERVE — and makes accepted work
+survive the process itself:
+
+- **Write-ahead journal** (``daemon/journal.py``): every accepted
+  submission is journaled and fsynced BEFORE the accept is returned;
+  delivered tokens and terminal events follow with per-tick batched
+  fsync.  A ``kill -9`` mid-stream followed by a restart on the same
+  journal path REPLAYS the log: finished requests become idempotent
+  dedupe-token responses, accepted-but-unfinished requests re-admit
+  with their durable token prefix forced (the cluster's own
+  forced-prefix machinery), so greedy streams continue bitwise and no
+  acknowledged request is ever lost or completed twice.
+- **Signal layer**: SIGTERM begins a graceful drain (in-flight work
+  finishes, new submissions are refused typed ``draining``, exit 0
+  within ``grace_seconds``); a second SIGTERM — or a blown grace
+  window — forces a fast shutdown with the journal as the recovery
+  contract for whatever was still open (exit 1).  SIGHUP re-reads
+  ``reload_path`` and rolls new weights through the PR 10 swap path.
+- **Clock discipline**: the daemon owns the ONE
+  :class:`~tpu_parallel.daemon.wallclock.WallClock` and injects it into
+  the frontend, so per-request wall-clock deadlines ride the exact same
+  deadline machinery the fake-clock tests pin.  Handing the constructor
+  a fake clock instead makes the entire daemon — journal, recovery,
+  drain, dedupe — a deterministic unit-test subject
+  (``tests/test_daemon.py`` crash-replays it in-process).
+
+Threading: the tick pump (``run()``) and the HTTP handler threads
+(``daemon/http.py``) serialize on one RLock; per-request streaming
+rides lock-free subscriber queues fed from inside the tick.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import signal as _signal
+import threading
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from tpu_parallel.daemon.journal import (
+    REC_DECISION,
+    REC_RECOVERY,
+    REC_SHUTDOWN,
+    REC_SUBMIT,
+    REC_TERMINAL,
+    REC_TOKENS,
+    JournalWriter,
+    drop_torn_tail,
+    load_state,
+)
+from tpu_parallel.daemon.wallclock import WallClock
+from tpu_parallel.obs.tracer import NULL_TRACER
+from tpu_parallel.serving.request import (
+    FINISHED,
+    QUEUED,
+    REJECTED,
+    RUNNING,
+    Request,
+    SamplingParams,
+    StreamEvent,
+)
+
+DAEMON_TRACK = "daemon"  # tracer track for signals/recovery/shutdown
+
+# exit codes (the signal contract; docs/13_daemon.md)
+EXIT_CLEAN = 0  # drained: every accepted request terminal, journal clean
+EXIT_FORCED = 1  # fast shutdown: open work recovers from the journal
+
+
+@dataclasses.dataclass(frozen=True)
+class DaemonConfig:
+    """Daemon shell knobs.
+
+    - ``grace_seconds``: the SIGTERM drain window — in-flight work that
+      outlives it is abandoned to the journal (fast shutdown, exit 1).
+    - ``idle_sleep_seconds``: tick-pump sleep while the frontend has no
+      work (busy ticks never sleep).
+    - ``fsync_batch``: journal records per disk barrier (submissions and
+      shutdown records always sync immediately).
+    - ``reload_path``: SIGHUP reads this JSON file
+      (``{"checkpoint_dir": ..., "step": ...}``) and rolls the weights
+      through ``Frontend.begin_swap`` — the PR 10 canary/rollback
+      machinery, not a blind rebind.  None = SIGHUP is a counted no-op.
+    - ``completed_retention``: terminal records (and their dedupe
+      tokens) kept in memory for idempotent replies, oldest-evicted
+      beyond it — the daemon's memory stays bounded at any uptime.
+      The journal keeps everything; only the in-RAM dedupe horizon is
+      bounded.
+    """
+
+    grace_seconds: float = 30.0
+    idle_sleep_seconds: float = 0.005
+    fsync_batch: int = 32
+    reload_path: Optional[str] = None
+    completed_retention: int = 50_000
+
+    def __post_init__(self):
+        if self.grace_seconds <= 0:
+            raise ValueError(f"grace_seconds={self.grace_seconds} <= 0")
+        if self.fsync_batch < 1:
+            raise ValueError(f"fsync_batch={self.fsync_batch} < 1")
+        if self.completed_retention < 1:
+            raise ValueError(
+                f"completed_retention={self.completed_retention} < 1"
+            )
+
+
+class _DaemonRequest:
+    """Daemon-side state for one accepted request: the client-visible
+    record, the dedupe token, journal staging, and stream subscribers."""
+
+    __slots__ = (
+        "record", "dedupe_token", "base", "staged", "staged_index",
+        "terminal_staged", "subscribers", "out",
+    )
+
+    def __init__(self, record: Dict, dedupe_token: Optional[str]):
+        self.record = record
+        self.dedupe_token = dedupe_token
+        self.base = len(record["tokens"])  # durable prefix at admission
+        self.staged: List[int] = []  # tokens awaiting a journal record
+        self.staged_index = self.base
+        self.terminal_staged = False
+        self.subscribers: List[queue.Queue] = []
+        self.out = None  # the live ClusterOutput (None once terminal)
+
+
+class ServingDaemon:
+    """The durable daemon shell (module docstring).
+
+    ``frontend_factory(clock)`` builds the :class:`Frontend` — the
+    daemon injects its clock so deadlines, SLO windows and journal
+    timestamps share one time axis.  Construction RECOVERS: an existing
+    journal at ``journal_path`` is scanned, finished requests become
+    idempotent dedupe responses, unfinished ones re-admit with their
+    durable token prefix forced.
+    """
+
+    def __init__(
+        self,
+        frontend_factory: Callable,
+        journal_path: str,
+        *,
+        config: Optional[DaemonConfig] = None,
+        clock=None,
+    ):
+        self.config = config or DaemonConfig()
+        self.clock = clock if clock is not None else WallClock()
+        self.frontend = frontend_factory(self.clock)
+        self.registry = self.frontend.registry
+        self.tracer = self.frontend.tracer or NULL_TRACER
+        self._lock = threading.RLock()
+        self._requests: Dict[str, _DaemonRequest] = {}
+        self._dedupe: Dict[str, str] = {}
+        # request ids with staged journal work, in first-dirty order
+        self._dirty: Dict[str, None] = {}
+        self._open_count = 0  # live (non-terminal) records, O(1)
+        # terminal records in completion order, for bounded retention
+        self._completed: deque = deque()
+        self.ticks = 0
+        self._draining = False
+        self._drain_deadline: Optional[float] = None
+        self._stopped = False
+        # signal flags — handlers only flip these (async-signal-safe);
+        # the run loop acts on them
+        self._drain_requested = False
+        self._force_stop = False
+        self._reload_requested = False
+        r = self.registry
+        self._m_records = r.counter("daemon_journal_records_total")
+        self._m_fsyncs = r.counter("daemon_journal_fsyncs_total")
+        self._m_dedupe_hits = r.counter("daemon_dedupe_hits_total")
+        self._m_recovered = r.counter("daemon_recovered_requests_total")
+        self._m_recovered_done = r.counter(
+            "daemon_recovered_completions_total"
+        )
+        self._m_ticks = r.counter("daemon_ticks_total")
+        self._m_accepted = r.counter("daemon_accepted_total")
+        # observed swap/autopilot decisions flow through the frontend's
+        # journal hook into REC_DECISION records
+        self.frontend.set_journal(self._frontend_note)
+        # drop a torn final record BEFORE reading: recovery must act on
+        # exactly what stays durable, and appending after a fragment
+        # would turn tolerable tail damage into mid-file corruption
+        drop_torn_tail(journal_path)
+        state = load_state(journal_path)
+        self.journal = JournalWriter(
+            journal_path, self.clock,
+            fsync_batch=self.config.fsync_batch,
+            next_seq=state.next_seq,
+        )
+        self.recoveries = state.recoveries
+        self._recover(state)
+
+    # -- journal plumbing --------------------------------------------------
+
+    def _append(self, rec: Dict) -> Dict:
+        before = self.journal.fsyncs
+        out = self.journal.append(rec)
+        self._m_records.inc()
+        self._m_fsyncs.inc(self.journal.fsyncs - before)
+        return out
+
+    def _sync(self) -> None:
+        if self.journal.sync():
+            self._m_fsyncs.inc()
+
+    def _frontend_note(self, kind: str, payload: Dict) -> None:
+        """Frontend journal hook: operator-grade decisions (swap
+        rollouts, autopilot actions, drain begin) become DECISION
+        records.  Per-request submit/terminal hooks are ignored here —
+        the daemon journals those itself with dedupe context."""
+        if kind in ("swap_begin", "autopilot_action", "drain_begin"):
+            self._append({"record": REC_DECISION, "kind": kind, **payload})
+
+    # -- recovery ----------------------------------------------------------
+
+    def _recover(self, state) -> None:
+        span = (
+            self.tracer.span("recovery", track=DAEMON_TRACK)
+            if self.tracer.enabled else None
+        )
+        replayed = completed = 0
+        for entry in state.finished:
+            rec = self._completed_record(entry)
+            dr = _DaemonRequest(rec, entry.dedupe_token)
+            self._register(dr)
+            self._note_terminal(dr, was_open=False)
+        for entry in state.unfinished:
+            sub = entry.submit
+            delivered = list(entry.tokens)
+            remainder = int(sub["max_new_tokens"]) - len(delivered)
+            eos = sub.get("eos_token_id")
+            record = {
+                "request_id": entry.request_id,
+                "status": RUNNING if delivered else QUEUED,
+                "finish_reason": None,
+                "detail": None,
+                "tokens": delivered,
+                "recovered": True,
+            }
+            if remainder <= 0 or (eos is not None and eos in delivered):
+                # the crash ate the terminal record but the durable
+                # prefix already satisfies the stopping contract:
+                # synthesize the terminal instead of re-admitting
+                reason = (
+                    "eos" if eos is not None and eos in delivered
+                    else "length"
+                )
+                record["status"] = FINISHED
+                record["finish_reason"] = reason
+                dr = _DaemonRequest(record, entry.dedupe_token)
+                self._register(dr)
+                self._note_terminal(dr, was_open=False)
+                self._append({
+                    "record": REC_TERMINAL,
+                    "request_id": entry.request_id,
+                    "status": FINISHED, "finish_reason": reason,
+                    "n_tokens": len(delivered), "recovered": True,
+                })
+                completed += 1
+                self._m_recovered_done.inc()
+                continue
+            dr = _DaemonRequest(record, entry.dedupe_token)
+            self._register(dr)
+            req = Request(
+                prompt=list(sub["prompt"]) + delivered,
+                max_new_tokens=remainder,
+                sampling=SamplingParams(**sub.get("sampling") or {}),
+                eos_token_id=eos,
+                request_id=entry.request_id,
+                client_id=sub.get("client_id"),
+                priority=int(sub.get("priority") or 0),
+                deadline=sub.get("deadline"),
+                on_token=self._make_on_token(dr),
+            )
+            out = self.frontend.submit(req)
+            if out.status == REJECTED:
+                # loud, typed loss: the journal promised this request a
+                # future the restarted config no longer affords
+                self._terminal_now(
+                    dr, REJECTED, out.finish_reason, detail=out.detail
+                )
+                continue
+            dr.out = out
+            self._open_count += 1
+            replayed += 1
+            self._m_recovered.inc()
+        if state.entries or state.torn_records:
+            self._append({
+                "record": REC_RECOVERY,
+                "replayed": replayed,
+                "already_complete": completed,
+                "finished_in_journal": len(state.finished),
+                "torn_records": state.torn_records,
+            })
+        self._enforce_retention()  # recovery records are all journaled
+        if span is not None:
+            span.finish(replayed=replayed, completed=completed)
+
+    @staticmethod
+    def _completed_record(entry) -> Dict:
+        term = entry.terminal
+        return {
+            "request_id": entry.request_id,
+            "status": term.get("status", FINISHED),
+            "finish_reason": term.get("finish_reason"),
+            "detail": term.get("detail"),
+            "tokens": list(entry.tokens),
+            "recovered": True,
+        }
+
+    def _register(self, dr: _DaemonRequest) -> None:
+        self._requests[dr.record["request_id"]] = dr
+        if dr.dedupe_token:
+            self._dedupe[dr.dedupe_token] = dr.record["request_id"]
+
+    def _note_terminal(self, dr: _DaemonRequest, was_open: bool) -> None:
+        """Terminal bookkeeping: keep the open count O(1) and queue the
+        record for retention.  Eviction itself is deferred to
+        :meth:`_enforce_retention` AFTER the tick's journal flush — a
+        record evicted while its terminal/tokens were still staged
+        would vanish from the journal too, and a restart would replay
+        (and duplicate) an already-completed request."""
+        if was_open:
+            self._open_count = max(0, self._open_count - 1)
+        self._completed.append(dr.record["request_id"])
+
+    def _enforce_retention(self) -> None:
+        """Evict the oldest completed records past the retention bound
+        (their in-RAM dedupe horizon ends; the journal keeps
+        everything).  Only ever called with the journal flushed; a head
+        record that somehow still has staged work stops the sweep."""
+        while len(self._completed) > self.config.completed_retention:
+            old = self._completed[0]
+            if old in self._dirty:
+                return  # staged journal work: flush must win first
+            self._completed.popleft()
+            gone = self._requests.get(old)
+            if gone is None or gone.out is not None:
+                continue  # superseded id or somehow live again: skip
+            del self._requests[old]
+            if gone.dedupe_token and self._dedupe.get(
+                gone.dedupe_token
+            ) == old:
+                del self._dedupe[gone.dedupe_token]
+
+    # -- admission ---------------------------------------------------------
+
+    def submit(
+        self, request: Request, dedupe_token: Optional[str] = None
+    ) -> Dict:
+        """Accept one request: dedupe first (an already-seen token
+        returns the live/completed record instead of re-admitting —
+        client retries across a daemon crash are idempotent), then the
+        frontend's typed admission gate, then the DURABLE accept — the
+        submit record is fsynced before this returns."""
+        with self._lock:
+            dedupe_token = dedupe_token or request.dedupe_token
+            if dedupe_token and dedupe_token in self._dedupe:
+                self._m_dedupe_hits.inc()
+                # a SNAPSHOT, like result(): the live record mutates
+                # under the tick while the HTTP thread serializes this
+                return self.result(self._dedupe[dedupe_token])
+            record = {
+                "request_id": request.request_id,
+                "status": QUEUED,
+                "finish_reason": None,
+                "detail": None,
+                "tokens": [],
+                "recovered": False,
+            }
+            dr = _DaemonRequest(record, dedupe_token)
+            request.on_token = self._make_on_token(dr)
+            now = self.clock()
+            out = self.frontend.submit(request)
+            if out.status == REJECTED:
+                record["status"] = REJECTED
+                record["finish_reason"] = out.finish_reason
+                record["detail"] = out.detail
+                return record  # rejections are not journaled/deduped
+            dr.out = out
+            sampling = request.sampling
+            try:
+                self._append({
+                    "record": REC_SUBMIT,
+                    "request_id": request.request_id,
+                    "dedupe_token": dedupe_token,
+                    "client_id": request.client_id,
+                    # trace-schema workload fields (serve_bench
+                    # --workload replays journals like traces)
+                    "arrival": round(now, 6),
+                    "prompt": [int(t) for t in request.prompt],
+                    "prompt_len": len(request.prompt),
+                    "prefix_group": 0,
+                    "priority": request.priority,
+                    "deadline": request.deadline,
+                    "max_new_tokens": request.max_new_tokens,
+                    "eos_token_id": request.eos_token_id,
+                    "sampling": {
+                        "temperature": sampling.temperature,
+                        "top_k": sampling.top_k,
+                        "top_p": sampling.top_p,
+                    },
+                })
+            except Exception:
+                # an accept we cannot make durable must not exist: the
+                # frontend admission is withdrawn before the error
+                # surfaces, so no un-journaled request keeps generating
+                # and no dedupe entry vouches for it
+                self.frontend.cancel(
+                    request.request_id, reason="journal_error"
+                )
+                raise
+            # registered only AFTER the durable append: a failed write
+            # leaves no acknowledged-but-undurable state behind
+            self._register(dr)
+            self._open_count += 1
+            self._m_accepted.inc()
+            return self.result(request.request_id)
+
+    def cancel(self, request_id: str, reason: str = "cancelled") -> bool:
+        with self._lock:
+            return self.frontend.cancel(request_id, reason=reason)
+
+    def result(self, request_id: str) -> Optional[Dict]:
+        with self._lock:
+            dr = self._requests.get(request_id)
+            if dr is None:
+                return None
+            rec = dict(dr.record)
+            rec["tokens"] = list(rec["tokens"])
+            return rec
+
+    def subscribe(self, request_id: str):
+        """Stream attachment: returns ``(snapshot, q)`` — the tokens
+        already delivered plus a queue of future :class:`StreamEvent`s
+        (``q`` is None when the request is already terminal; the
+        snapshot record tells the subscriber how it ended)."""
+        with self._lock:
+            dr = self._requests.get(request_id)
+            if dr is None:
+                return None, None
+            snapshot = self.result(request_id)
+            if dr.out is None:  # terminal
+                return snapshot, None
+            q: queue.Queue = queue.Queue()
+            dr.subscribers.append(q)
+            return snapshot, q
+
+    def unsubscribe(self, request_id: str, q) -> None:
+        """Detach a stream queue (the HTTP layer calls this when the
+        SSE connection ends, finished or disconnected)."""
+        with self._lock:
+            dr = self._requests.get(request_id)
+            if dr is not None and q in dr.subscribers:
+                dr.subscribers.remove(q)
+
+    # -- delivery (runs inside frontend.step under the daemon lock) --------
+
+    def _make_on_token(self, dr: _DaemonRequest):
+        def on_token(ev: StreamEvent) -> None:
+            record = dr.record
+            if ev.token >= 0:
+                record["status"] = RUNNING
+                record["tokens"].append(int(ev.token))
+                dr.staged.append(int(ev.token))
+            if ev.finished:
+                out = dr.out
+                record["status"] = (
+                    out.status if out is not None else FINISHED
+                )
+                record["finish_reason"] = ev.finish_reason
+                if out is not None:
+                    record["detail"] = out.detail
+                dr.terminal_staged = True
+                was_open = dr.out is not None
+                dr.out = None
+                if record["request_id"] in self._requests:
+                    self._note_terminal(dr, was_open)
+            if dr.staged or dr.terminal_staged:
+                self._dirty[record["request_id"]] = None
+            for q in dr.subscribers:
+                q.put(StreamEvent(
+                    request_id=record["request_id"],
+                    token=ev.token,
+                    index=dr.base + ev.index if ev.index >= 0 else -1,
+                    finished=ev.finished,
+                    finish_reason=ev.finish_reason,
+                ))
+        return on_token
+
+    def _flush_dirty(self) -> None:
+        """Journal this tick's deliveries: one TOKENS record per request
+        with new tokens, then its TERMINAL record when it ended — order
+        within a request is what replay correctness rides on."""
+        for rid in self._dirty:
+            dr = self._requests.get(rid)
+            if dr is None:
+                continue
+            if dr.staged:
+                self._append({
+                    "record": REC_TOKENS,
+                    "request_id": rid,
+                    "index": dr.staged_index,
+                    "tokens": dr.staged,
+                })
+                dr.staged_index += len(dr.staged)
+                dr.staged = []
+            if dr.terminal_staged:
+                rec = dr.record
+                self._append({
+                    "record": REC_TERMINAL,
+                    "request_id": rid,
+                    "status": rec["status"],
+                    "finish_reason": rec["finish_reason"],
+                    "n_tokens": len(rec["tokens"]),
+                })
+                dr.terminal_staged = False
+        self._dirty = {}
+
+    def _terminal_now(
+        self, dr: _DaemonRequest, status: str, reason: Optional[str],
+        detail: Optional[str] = None,
+    ) -> None:
+        """Immediate journaled terminal outside the tick path (recovery
+        rejections)."""
+        rec = dr.record
+        rec["status"] = status
+        rec["finish_reason"] = reason
+        rec["detail"] = detail
+        was_open = dr.out is not None
+        dr.out = None
+        self._note_terminal(dr, was_open)
+        self._append({
+            "record": REC_TERMINAL,
+            "request_id": rec["request_id"],
+            "status": status, "finish_reason": reason,
+            "n_tokens": len(rec["tokens"]),
+        })
+
+    # -- the pump ----------------------------------------------------------
+
+    def tick(self) -> List[StreamEvent]:
+        """One daemon tick: a frontend step, then the tick's journal
+        batch (tokens + terminals) and ONE batched fsync window."""
+        with self._lock:
+            events = self.frontend.step()
+            self._flush_dirty()
+            self._sync()
+            self._enforce_retention()
+            self.ticks += 1
+            self._m_ticks.inc()
+            self.registry.gauge("daemon_open_requests").set(
+                self._open_count
+            )
+            self.registry.gauge("daemon_draining").set(
+                1.0 if self._draining else 0.0
+            )
+            return events
+
+    def install_signals(self) -> None:
+        """Wire the POSIX contract (main thread only): SIGTERM/SIGINT =
+        graceful drain, repeated = force fast shutdown, SIGHUP = weight
+        reload through the swap path.  Handlers only set flags."""
+        _signal.signal(_signal.SIGTERM, self._on_term)
+        _signal.signal(_signal.SIGINT, self._on_term)
+        if hasattr(_signal, "SIGHUP"):
+            _signal.signal(_signal.SIGHUP, self._on_hup)
+
+    def _on_term(self, signum, frame) -> None:
+        if self._drain_requested:
+            self._force_stop = True
+        else:
+            self._drain_requested = True
+
+    def _on_hup(self, signum, frame) -> None:
+        self._reload_requested = True
+
+    def request_drain(self) -> None:
+        """Programmatic SIGTERM equivalent (tests, embedders)."""
+        self._on_term(None, None)
+
+    def request_reload(self) -> None:
+        self._reload_requested = True
+
+    def _begin_drain(self) -> None:
+        self._draining = True
+        self._drain_deadline = self.clock() + self.config.grace_seconds
+        self.registry.counter(
+            "daemon_signals_total", signal="term"
+        ).inc()
+        with self._lock:
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "drain_begin", track=DAEMON_TRACK,
+                    open=self._open_count,
+                )
+            # close the gate, gate every engine, pull queued work back —
+            # then keep pumping ticks under the grace window
+            self.frontend.drain(max_ticks=0)
+
+    def _do_reload(self) -> None:
+        self._reload_requested = False
+        self.registry.counter("daemon_signals_total", signal="hup").inc()
+        path = self.config.reload_path
+
+        def decide(verdict, **extra):
+            # under the lock: HTTP submit threads append concurrently
+            with self._lock:
+                self._append({
+                    "record": REC_DECISION, "kind": "reload",
+                    "verdict": verdict, **extra,
+                })
+
+        if path is None:
+            return decide("no_reload_path")
+        import json as _json
+        try:
+            with open(path, encoding="utf-8") as fh:
+                spec = _json.load(fh)
+        except (OSError, ValueError) as exc:
+            return decide("unreadable", detail=repr(exc))
+        if not spec.get("checkpoint_dir"):
+            return decide("no_checkpoint_dir")
+        with self._lock:
+            status = self.frontend.begin_swap(
+                checkpoint_dir=spec["checkpoint_dir"],
+                step=spec.get("step"),
+                version=spec.get("version"),
+            )
+            self._append({
+                "record": REC_DECISION, "kind": "reload",
+                "verdict": status.get("verdict") or status.get("state"),
+            })
+
+    def _shutdown(self, clean: bool) -> int:
+        with self._lock:
+            self._stopped = True
+            open_req = self._open_count
+            self._flush_dirty()
+            self._append({
+                "record": REC_SHUTDOWN, "clean": clean,
+                "open_requests": open_req,
+            })
+            self.journal.close()
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "shutdown", track=DAEMON_TRACK, clean=clean,
+                open=open_req,
+            )
+        return EXIT_CLEAN if clean else EXIT_FORCED
+
+    def run(self, max_ticks: Optional[int] = None) -> int:
+        """The pump: tick until shut down.  Returns the process exit
+        code — 0 for a clean drained exit, 1 for a forced fast shutdown
+        (open work waits in the journal for the next recovery)."""
+        ticks = 0
+        while max_ticks is None or ticks < max_ticks:
+            if self._force_stop:
+                self.registry.counter(
+                    "daemon_signals_total", signal="term_force"
+                ).inc()
+                return self._shutdown(clean=not self.frontend.has_work())
+            if self._reload_requested:
+                self._do_reload()
+            if self._drain_requested and not self._draining:
+                self._begin_drain()
+            self.tick()
+            ticks += 1
+            if self._draining:
+                if not self.frontend.has_work():
+                    return self._shutdown(clean=True)
+                if self.clock() > self._drain_deadline:
+                    # grace blown: abandon the remainder to the journal
+                    return self._shutdown(clean=False)
+            elif not self.frontend.has_work():
+                self.clock.sleep(self.config.idle_sleep_seconds)
+        return EXIT_FORCED  # max_ticks exhausted with the daemon still up
+
+    # -- introspection -----------------------------------------------------
+
+    def status(self) -> Dict:
+        with self._lock:
+            open_req = self._open_count
+            return {
+                "draining": self._draining,
+                "stopped": self._stopped,
+                "ticks": self.ticks,
+                "open_requests": open_req,
+                "requests": len(self._requests),
+                "recoveries": self.recoveries,
+                "journal": {
+                    "path": self.journal.path,
+                    "records": self.journal.records,
+                    "fsyncs": self.journal.fsyncs,
+                    "next_seq": self.journal.next_seq,
+                },
+            }

@@ -1,0 +1,254 @@
+"""Stdlib-only streaming network frontend for the serving daemon.
+
+One :class:`ThreadingHTTPServer` over the daemon's locked surface —
+handler threads call ``daemon.submit/cancel/result/subscribe`` (which
+serialize on the daemon lock) while the tick pump runs in the main
+thread.  No framework, no dependency: the container bakes nothing
+extra, and the protocol is plain HTTP + Server-Sent Events.
+
+Endpoints (docs/13_daemon.md is the reference):
+
+- ``POST /v1/submit`` — JSON body ``{"prompt": [ids], "max_new_tokens",
+  "dedupe_token", "priority", "deadline", "client_id", "temperature",
+  "top_k", "top_p", "eos_token_id"}``.  200 with the request record on
+  accept (the submit is journal-durable before the response); typed
+  rejections map to 503 (``draining``) / 429 (everything else) with the
+  same record shape.  Dedupe-token replays return the existing record —
+  acknowledged work is idempotent across client retries and daemon
+  restarts.
+- ``GET /v1/stream/<id>`` — SSE: every already-delivered token replays
+  first (``index`` continues across daemon restarts), then live events;
+  the final event carries ``finished`` + the typed ``finish_reason``.
+  A client disconnect mid-stream CANCELS the request in the cluster
+  (``reason="disconnected"``) — a reply nobody is reading is wasted
+  compute, exactly the deadline-cancel philosophy.
+- ``POST /v1/cancel/<id>`` — client cancel (200 / 404).
+- ``GET /v1/result/<id>`` — the current record snapshot (200 / 404).
+- ``GET /healthz`` — 200 while serving, 503 once draining (load
+  balancers pull the replica out during the SIGTERM grace window).
+- ``GET /statez`` — frontend summary + daemon status JSON (the bench's
+  leak assertions read ``inflight_tokens`` and per-replica pools here).
+- ``GET /metricsz`` — Prometheus text exposition of the shared
+  registry (``daemon_*``, ``cluster_*`` and per-engine series).
+"""
+
+from __future__ import annotations
+
+import json
+import queue as _queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from tpu_parallel.obs.exporters import prometheus_text
+from tpu_parallel.serving.request import (
+    REJECT_DRAINING,
+    REJECTED,
+    Request,
+    SamplingParams,
+)
+
+# SSE subscriber poll period: how often a quiet stream wakes to emit a
+# heartbeat comment (which is also how a dead client is detected)
+_STREAM_POLL_SECONDS = 2.0
+
+
+def build_request(body: dict) -> Request:
+    """Validate a submit payload into a :class:`Request` (ValueError on
+    a malformed body — the handler maps it to 400)."""
+    prompt = body.get("prompt")
+    if not isinstance(prompt, list) or not prompt:
+        raise ValueError("'prompt' must be a non-empty list of token ids")
+    if not all(isinstance(t, int) for t in prompt):
+        raise ValueError("'prompt' must contain integer token ids")
+    sampling = SamplingParams(
+        temperature=float(body.get("temperature", 0.0)),
+        top_k=int(body.get("top_k", 0)),
+        top_p=float(body.get("top_p", 0.0)),
+    )
+    deadline = body.get("deadline")
+    return Request(
+        prompt=prompt,
+        max_new_tokens=int(body.get("max_new_tokens", 32)),
+        sampling=sampling,
+        eos_token_id=body.get("eos_token_id"),
+        client_id=body.get("client_id"),
+        priority=int(body.get("priority", 0)),
+        deadline=None if deadline is None else float(deadline),
+        dedupe_token=body.get("dedupe_token"),
+    )
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    daemon = None  # set by DaemonHTTPServer
+
+    # -- plumbing ----------------------------------------------------------
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+    def _json(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _text(self, code: int, text: str, ctype: str) -> None:
+        body = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_body(self) -> Optional[dict]:
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            raw = self.rfile.read(length) if length else b"{}"
+            body = json.loads(raw or b"{}")
+        except (ValueError, OSError):
+            return None
+        return body if isinstance(body, dict) else None
+
+    # -- routes ------------------------------------------------------------
+
+    def do_POST(self):
+        d = self.daemon
+        if self.path == "/v1/submit":
+            body = self._read_body()
+            if body is None:
+                return self._json(400, {"error": "malformed JSON body"})
+            try:
+                req = build_request(body)
+            except (ValueError, TypeError) as exc:
+                return self._json(400, {"error": str(exc)})
+            record = d.submit(req, dedupe_token=body.get("dedupe_token"))
+            if record["status"] == REJECTED:
+                code = (
+                    503 if record["finish_reason"] == REJECT_DRAINING
+                    else 429
+                )
+                return self._json(code, record)
+            return self._json(200, record)
+        if self.path.startswith("/v1/cancel/"):
+            rid = self.path[len("/v1/cancel/"):]
+            if d.cancel(rid, reason="cancelled"):
+                return self._json(200, {"cancelled": rid})
+            return self._json(404, {"error": f"unknown/done request {rid}"})
+        return self._json(404, {"error": f"no route {self.path}"})
+
+    def do_GET(self):
+        d = self.daemon
+        if self.path == "/healthz":
+            status = d.status()
+            code = 503 if status["draining"] or status["stopped"] else 200
+            return self._json(code, {
+                "ok": code == 200,
+                "draining": status["draining"],
+                "ticks": status["ticks"],
+                "recoveries": status["recoveries"],
+            })
+        if self.path == "/statez":
+            return self._json(200, {
+                "daemon": d.status(),
+                "cluster": d.frontend.summary(),
+            })
+        if self.path == "/metricsz":
+            return self._text(
+                200, prometheus_text(d.registry),
+                "text/plain; version=0.0.4",
+            )
+        if self.path.startswith("/v1/result/"):
+            rid = self.path[len("/v1/result/"):]
+            record = d.result(rid)
+            if record is None:
+                return self._json(404, {"error": f"unknown request {rid}"})
+            return self._json(200, record)
+        if self.path.startswith("/v1/stream/"):
+            return self._stream(self.path[len("/v1/stream/"):])
+        return self._json(404, {"error": f"no route {self.path}"})
+
+    # -- SSE ---------------------------------------------------------------
+
+    def _sse(self, payload: dict) -> None:
+        self.wfile.write(f"data: {json.dumps(payload)}\n\n".encode())
+        self.wfile.flush()
+
+    def _stream(self, rid: str) -> None:
+        d = self.daemon
+        snapshot, q = d.subscribe(rid)
+        if snapshot is None:
+            return self._json(404, {"error": f"unknown request {rid}"})
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        try:
+            for i, tok in enumerate(snapshot["tokens"]):
+                self._sse({"request_id": rid, "token": tok, "index": i})
+            if q is None:  # already terminal: replay the ending and stop
+                self._sse({
+                    "request_id": rid, "finished": True,
+                    "status": snapshot["status"],
+                    "finish_reason": snapshot["finish_reason"],
+                })
+                return
+            while True:
+                try:
+                    ev = q.get(timeout=_STREAM_POLL_SECONDS)
+                except _queue.Empty:
+                    # heartbeat: also probes whether the client is gone
+                    self.wfile.write(b": keepalive\n\n")
+                    self.wfile.flush()
+                    continue
+                if ev.token >= 0:
+                    self._sse({
+                        "request_id": rid, "token": ev.token,
+                        "index": ev.index,
+                    })
+                if ev.finished:
+                    record = d.result(rid) or {}
+                    self._sse({
+                        "request_id": rid, "finished": True,
+                        "status": record.get("status"),
+                        "finish_reason": ev.finish_reason,
+                    })
+                    return
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            # the client hung up mid-stream: stop generating for it
+            d.cancel(rid, reason="disconnected")
+        finally:
+            if q is not None:
+                d.unsubscribe(rid, q)
+
+
+class DaemonHTTPServer:
+    """The daemon's network face: a threading HTTP server bound to
+    ``host:port`` (port 0 = ephemeral; read ``.port`` after start),
+    served from a background thread so the daemon's ``run()`` pump owns
+    the main thread (where the signal handlers live)."""
+
+    def __init__(self, daemon, host: str = "127.0.0.1", port: int = 0):
+        handler = type("_BoundHandler", (_Handler,), {"daemon": daemon})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.httpd.daemon_threads = True
+        self.host, self.port = self.httpd.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "DaemonHTTPServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, kwargs={"poll_interval": 0.1},
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -1,0 +1,348 @@
+"""Write-ahead request journal: the daemon's crash-recovery contract.
+
+Append-only JSONL with monotone sequence numbers and batched fsync.
+Every record the daemon must not lose across a ``kill -9`` goes through
+here BEFORE the effect is acknowledged to a client:
+
+- ``submit``   — an ACCEPTED submission (the full request payload plus
+  the client's dedupe token).  Synced durably before the accept is
+  returned, so an acknowledged request can never vanish.  The payload
+  field names are intentionally the serve_bench trace-schema names
+  (``arrival`` / ``prompt`` / ``prompt_len`` / ``prefix_group`` /
+  ``priority`` / ``deadline`` / ``max_new_tokens``) — ONE workload
+  exchange format, so ``serve_bench --trace-replay`` (alias
+  ``--workload``) replays a production journal directly.
+- ``tokens``   — tokens delivered to a request this tick (``index`` is
+  the position of the first one).  Batched per tick; a torn tail loses
+  at most the unsynced suffix, and greedy recovery regenerates exactly
+  those tokens (forced-prefix replay is bitwise).
+- ``terminal`` — a request reached a terminal state (status + typed
+  ``finish_reason``).  A journaled terminal is what makes the dedupe
+  token idempotent: a resubmission after it returns the completed
+  record instead of re-admitting.
+- ``decision`` — swap rollouts, autopilot actions, drain begin: the
+  operator-action audit trail.
+- ``recovery`` — a restart replayed the journal (counts ride along).
+- ``shutdown`` — the process exited; ``clean`` distinguishes a drained
+  exit (nothing open) from a forced fast shutdown (the journal IS the
+  recovery contract for whatever was still open).
+
+Durability model: every ``append`` writes and flushes the line to the
+OS immediately (a crashed *process* loses nothing flushed); ``fsync``
+— the expensive disk barrier that survives a crashed *machine* — is
+batched: forced for ``submit``/``shutdown`` records, otherwise issued
+once at least ``fsync_batch`` records are pending (``sync()`` at each
+tick boundary).  Recovery (:func:`read_journal`) tolerates exactly one
+torn record at the END of the file (the write the crash interrupted);
+corruption anywhere else raises :class:`JournalCorrupt` loudly.
+
+Timestamps come from the injected clock and are only comparable within
+one process lifetime (the wall clock is monotonic per process) — replay
+logic never compares times across a restart, only sequence numbers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Callable, Dict, List, Optional, Tuple
+
+JOURNAL_VERSION = 1
+
+# record kinds (the "record" field)
+REC_META = "journal_meta"
+REC_SUBMIT = "submit"
+REC_TOKENS = "tokens"
+REC_TERMINAL = "terminal"
+REC_DECISION = "decision"
+REC_RECOVERY = "recovery"
+REC_SHUTDOWN = "shutdown"
+
+# record kinds whose append forces an immediate fsync: an accepted
+# submission must be durable before the client hears "accepted", a
+# recovery record is the restart's first promise, and a shutdown record
+# is the last thing the process does
+_SYNC_NOW = frozenset({REC_SUBMIT, REC_RECOVERY, REC_SHUTDOWN})
+
+
+class JournalCorrupt(RuntimeError):
+    """The journal failed its integrity scan somewhere a torn tail
+    cannot explain (mid-file garbage, non-monotone sequence numbers)."""
+
+
+class JournalWriter:
+    """Append-only JSONL writer with sequence numbers and batched fsync.
+
+    ``clock`` is injectable (the daemon passes its :class:`~tpu_parallel.
+    daemon.wallclock.WallClock`); every record gets ``seq`` (monotone,
+    continuing across restarts via ``next_seq``) and ``at`` (clock time,
+    process-local).  ``fsync_batch`` records may ride the OS page cache
+    between disk barriers — except the kinds in ``_SYNC_NOW``, which
+    sync before ``append`` returns.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        clock: Callable[[], float],
+        *,
+        fsync_batch: int = 32,
+        next_seq: int = 0,
+    ):
+        if fsync_batch < 1:
+            raise ValueError(f"fsync_batch={fsync_batch} < 1")
+        self.path = path
+        self.clock = clock
+        self.fsync_batch = fsync_batch
+        self._seq = next_seq
+        self._pending = 0  # records flushed to OS but not yet fsynced
+        self.records = 0  # lifetime appends (this writer)
+        self.fsyncs = 0
+        self.truncated_tail = drop_torn_tail(path)
+        fresh = not os.path.exists(path) or os.path.getsize(path) == 0
+        self._fh = open(path, "a", encoding="utf-8")
+        if fresh:
+            self.append({"record": REC_META, "journal_version": JOURNAL_VERSION})
+            self.sync()
+
+    def append(self, record: Dict) -> Dict:
+        """Assign seq + timestamp, write one line, flush to the OS.
+        Returns the full record as written.  Sync-now kinds fsync before
+        returning; everything else waits for :meth:`sync`."""
+        rec = dict(record)
+        rec["seq"] = self._seq
+        self._seq += 1
+        rec.setdefault("at", round(self.clock(), 6))
+        self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
+        self.records += 1
+        self._pending += 1
+        if rec.get("record") in _SYNC_NOW or self._pending >= self.fsync_batch:
+            self.sync()
+        return rec
+
+    def sync(self) -> bool:
+        """Batched disk barrier: fsync when anything is pending (tick
+        boundary) — a no-op on a clean writer.  Returns whether a real
+        fsync was issued."""
+        if self._pending == 0:
+            return False
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+        self.fsyncs += 1
+        self._pending = 0
+        return True
+
+    @property
+    def next_seq(self) -> int:
+        return self._seq
+
+    def close(self) -> None:
+        if not self._fh.closed:
+            self.sync()
+            self._fh.close()
+
+    def abort(self) -> None:
+        """Crash simulation for tests: drop the handle without the
+        closing sync (flushed lines survive, like a SIGKILL'd process)."""
+        if not self._fh.closed:
+            self._fh.close()
+
+
+def drop_torn_tail(path: str) -> int:
+    """Truncate a torn final record before APPENDING to a journal.
+
+    ``read_journal`` tolerates a torn tail while *reading*, but a writer
+    reopening in append mode would concatenate its first record onto the
+    fragment — turning tolerable tail damage into mid-file garbage that
+    bricks the journal (:class:`JournalCorrupt`) on the NEXT restart.
+    Dropping the fragment loses nothing: it was never durable, and the
+    reader already ignored it.  Returns the bytes truncated (0 when the
+    file is absent, empty, or newline-terminated)."""
+    if not os.path.exists(path) or os.path.getsize(path) == 0:
+        return 0
+    with open(path, "rb+") as fh:
+        fh.seek(0, os.SEEK_END)
+        size = fh.tell()
+        fh.seek(size - 1)
+        if fh.read(1) == b"\n":
+            return 0
+        # scan back to the last complete line's newline (chunked so a
+        # long torn record doesn't load the whole file)
+        pos = size
+        keep = 0
+        while pos > 0:
+            step = min(4096, pos)
+            fh.seek(pos - step)
+            chunk = fh.read(step)
+            nl = chunk.rfind(b"\n")
+            if nl != -1:
+                keep = pos - step + nl + 1
+                break
+            pos -= step
+        fh.truncate(keep)
+        fh.flush()
+        os.fsync(fh.fileno())
+        return size - keep
+
+
+def read_journal(path: str) -> Tuple[List[Dict], int]:
+    """Scan a journal file.  Returns ``(records, torn)`` where ``torn``
+    counts dropped trailing garbage (0 or 1 — the record a crash tore
+    mid-write).  Mid-file corruption or a sequence-number regression
+    raises :class:`JournalCorrupt`: a journal that lies about its order
+    must not drive recovery."""
+    records: List[Dict] = []
+    bad_at: Optional[int] = None
+    with open(path, encoding="utf-8") as fh:
+        for lineno, line in enumerate(fh, 1):
+            if bad_at is not None:
+                raise JournalCorrupt(
+                    f"{path}:{bad_at}: unparseable record is not at the "
+                    "tail — the journal is corrupt beyond a torn write"
+                )
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                bad_at = lineno  # legal only as the final line
+                continue
+            if not isinstance(rec, dict) or "record" not in rec:
+                bad_at = lineno
+                continue
+            records.append(rec)
+    last = -1
+    for rec in records:
+        seq = rec.get("seq")
+        if seq is None:
+            continue
+        if seq <= last:
+            raise JournalCorrupt(
+                f"{path}: sequence regressed {last} -> {seq}"
+            )
+        last = seq
+    return records, (0 if bad_at is None else 1)
+
+
+@dataclasses.dataclass
+class JournalEntry:
+    """Replay state for one journaled request: the submit payload, the
+    durable token prefix, and the terminal record (None = the crash
+    caught it accepted-but-unfinished — recovery re-admits it)."""
+
+    submit: Dict
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    terminal: Optional[Dict] = None
+
+    @property
+    def request_id(self) -> str:
+        return self.submit["request_id"]
+
+    @property
+    def dedupe_token(self) -> Optional[str]:
+        return self.submit.get("dedupe_token")
+
+    @property
+    def unfinished(self) -> bool:
+        return self.terminal is None
+
+
+@dataclasses.dataclass
+class RecoveryState:
+    """Everything a restart needs from the journal: per-request entries
+    in submit order, the dedupe index, the next sequence number, and the
+    scan's damage/shutdown accounting."""
+
+    entries: Dict[str, JournalEntry]
+    order: List[str]
+    dedupe: Dict[str, str]  # dedupe_token -> request_id
+    next_seq: int
+    torn_records: int
+    clean_shutdown: bool
+    recoveries: int  # prior recovery records (restart count)
+    decisions: int
+
+    @property
+    def unfinished(self) -> List[JournalEntry]:
+        return [
+            self.entries[rid]
+            for rid in self.order
+            if self.entries[rid].unfinished
+        ]
+
+    @property
+    def finished(self) -> List[JournalEntry]:
+        return [
+            self.entries[rid]
+            for rid in self.order
+            if not self.entries[rid].unfinished
+        ]
+
+
+def replay_state(records: List[Dict], torn: int = 0) -> RecoveryState:
+    """Fold a journal scan into :class:`RecoveryState`.  Token records
+    apply by INDEX (idempotent across overlapping replays: a re-delivery
+    of positions already durable overwrites them with identical values
+    under greedy decoding); a terminal closes its entry."""
+    entries: Dict[str, JournalEntry] = {}
+    order: List[str] = []
+    dedupe: Dict[str, str] = {}
+    next_seq = 0
+    clean = False
+    recoveries = 0
+    decisions = 0
+    for rec in records:
+        seq = rec.get("seq")
+        if seq is not None:
+            next_seq = max(next_seq, seq + 1)
+        kind = rec.get("record")
+        if kind == REC_SUBMIT:
+            rid = rec["request_id"]
+            if rid not in entries:  # duplicate submits cannot re-open
+                entries[rid] = JournalEntry(submit=rec)
+                order.append(rid)
+                tok = rec.get("dedupe_token")
+                if tok:
+                    dedupe[tok] = rid
+        elif kind == REC_TOKENS:
+            entry = entries.get(rec["request_id"])
+            if entry is None:
+                continue
+            index = int(rec.get("index", len(entry.tokens)))
+            toks = [int(t) for t in rec.get("tokens", ())]
+            del entry.tokens[index:]
+            entry.tokens.extend(toks)
+        elif kind == REC_TERMINAL:
+            entry = entries.get(rec["request_id"])
+            if entry is not None:
+                entry.terminal = rec
+        elif kind == REC_SHUTDOWN:
+            clean = bool(rec.get("clean"))
+        elif kind == REC_RECOVERY:
+            recoveries += 1
+            clean = False
+        elif kind == REC_DECISION:
+            decisions += 1
+        if kind in (REC_SUBMIT, REC_TOKENS, REC_TERMINAL):
+            clean = False  # work after a shutdown record reopens the log
+    return RecoveryState(
+        entries=entries,
+        order=order,
+        dedupe=dedupe,
+        next_seq=next_seq,
+        torn_records=torn,
+        clean_shutdown=clean,
+        recoveries=recoveries,
+        decisions=decisions,
+    )
+
+
+def load_state(path: str) -> RecoveryState:
+    """One-call journal scan + fold (missing/empty file = empty state)."""
+    if not os.path.exists(path) or os.path.getsize(path) == 0:
+        return replay_state([], 0)
+    records, torn = read_journal(path)
+    return replay_state(records, torn)

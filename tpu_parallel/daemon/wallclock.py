@@ -1,0 +1,40 @@
+"""The daemon's ONE wall-clock surface.
+
+Everything under ``tpu_parallel/serving`` and ``tpu_parallel/cluster``
+runs on an injectable clock — that is the determinism contract
+``scripts/check_clock.py`` enforces, and it is what lets the chaos
+harness replay fault storms tick-for-tick.  The daemon is the layer
+that finally has to touch real time (it serves real clients on real
+sockets), but it touches it HERE and nowhere else: :class:`WallClock`
+is injected into the :class:`~tpu_parallel.cluster.frontend.Frontend`
+as its ``clock`` and into the daemon loop as its sleep source, so every
+deadline, SLO window and journal timestamp flows through one swappable
+object.  Tests hand the daemon a fake clock instead and the whole
+recovery/drain story runs deterministically — the daemon shell stays as
+testable as the core it wraps.
+
+``check_clock`` permits direct ``time.*`` reads in THIS FILE ONLY (see
+``WALLCLOCK_FILES`` there); a ``time.monotonic()`` anywhere else in the
+daemon package is a static-check failure, not a code-review argument.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class WallClock:
+    """Callable monotonic clock + sleep, the production time source.
+
+    The daemon passes the instance itself as the frontend's ``clock``
+    (it is callable) and uses :meth:`sleep` to pace the tick pump.  A
+    fake replacement needs only ``__call__`` and ``sleep`` — see
+    ``tests/test_daemon.py``.
+    """
+
+    def __call__(self) -> float:
+        return time.monotonic()
+
+    def sleep(self, seconds: float) -> None:
+        if seconds > 0:
+            time.sleep(seconds)

@@ -94,6 +94,12 @@ class Request:
     client_id: Optional[str] = None
     priority: int = 0
     deadline: Optional[float] = None
+    # daemon-layer idempotence key (tpu_parallel/daemon/): a client
+    # retrying an acknowledged submission — across network failures or
+    # a daemon crash+recovery — reuses its dedupe token and gets the
+    # SAME request record back instead of a duplicate admission.  The
+    # engine and cluster frontend carry it untouched.
+    dedupe_token: Optional[str] = None
     # called synchronously with each StreamEvent for this request
     on_token: Optional[Callable[["StreamEvent"], None]] = None
 
