@@ -53,6 +53,10 @@ CHECKERS: Dict[str, str] = {
         "inside cache_pool.py (radix/offload/migration layers only "
         "hold references)"
     ),
+    "check_io": (
+        "durability-critical file IO under daemon/ and checkpoint/ "
+        "routes through the iofaults shim (seeded disk-fault coverage)"
+    ),
 }
 
 # gates that RUN the product rather than parse it (slower; spawn
@@ -61,7 +65,9 @@ CHECKERS: Dict[str, str] = {
 RUNTIME_CHECKS: Dict[str, str] = {
     "check_daemon": (
         "the serving daemon starts, serves over HTTP, drains on "
-        "SIGTERM and exits 0 with a clean journal"
+        "SIGTERM and exits 0 with a clean journal — and recovers a "
+        "seeded disk-fault trial (tail corruption typed-detected, "
+        "streams bitwise)"
     ),
 }
 

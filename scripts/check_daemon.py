@@ -33,7 +33,12 @@ def check_paths(paths: Sequence[str] = DEFAULT_PATHS) -> List[str]:
     )
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    return [f"daemon smoke: {p}" for p in mod.run_smoke()]
+    problems = [f"daemon smoke: {p}" for p in mod.run_smoke()]
+    # the integrity half (PR 15): one reduced seeded disk-fault trial —
+    # kill mid-stream, one-bit journal rot, restart must typed-detect
+    # the damage and recover every stream bitwise
+    problems += [f"disk-fault smoke: {p}" for p in mod.run_disk_smoke()]
+    return problems
 
 
 def main(argv: List[str]) -> int:

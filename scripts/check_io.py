@@ -1,0 +1,125 @@
+"""Static check: durability-critical IO goes through the fault shim.
+
+The journal's integrity story (per-record CRC, torn-tail truncation,
+degraded mode on persistent append/fsync failure) and the disk-fault
+soak (``daemon_bench --disk-faults``) are only as good as their
+COVERAGE: a raw ``open()`` / ``os.fsync()`` / ``os.write()`` under
+``tpu_parallel/daemon/`` or ``tpu_parallel/checkpoint/`` is a file
+operation the seeded fault injector can never reach — a durability
+promise the soak silently stops proving.  So every such call must route
+through :mod:`tpu_parallel.daemon.iofaults` (``open_file`` /
+``write_line`` / ``fsync_file`` / ``read_text``), and this gate fences
+the raw spellings.
+
+- Flagged: ``open(...)`` as a bare name, ``os.fsync(...)``,
+  ``os.write(...)`` (and their ``io.open`` / ``from os import fsync``
+  aliases are not — the gate is lexical, like its siblings; the repo
+  does not use them).
+- Exempt: ``iofaults.py`` itself (the shim IS the door) and any call
+  whose source line carries a ``# raw-io: <why>`` annotation — the
+  escape hatch for IO that is deliberately outside the fault domain,
+  same shape as ``check_host_sync``'s ``# host-sync:``.
+
+Registered in ``scripts/check_all.py`` and self-tested in
+``tests/test_checkers.py``.  Usage: ``python scripts/check_io.py
+[paths...]`` — prints one violation per line, exits nonzero on any.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List
+
+DEFAULT_PATHS = ("tpu_parallel/daemon", "tpu_parallel/checkpoint")
+
+# the one module allowed to spell raw IO: the shim itself
+SHIM_FILENAME = "iofaults.py"
+
+WHITELIST_MARK = "# raw-io:"
+
+# os.<attr> calls that bypass the shim's fault gates
+OS_ATTRS = frozenset({"fsync", "write"})
+
+
+def _flag_of(node: ast.Call):
+    func = node.func
+    if isinstance(func, ast.Name) and func.id == "open":
+        return "open"
+    if (
+        isinstance(func, ast.Attribute)
+        and func.attr in OS_ATTRS
+        and isinstance(func.value, ast.Name)
+        and func.value.id == "os"
+    ):
+        return f"os.{func.attr}"
+    return None
+
+
+def check_source(source: str, filename: str) -> List[str]:
+    """Return ``file:line: message`` strings for every raw-IO call in
+    ``source`` — unless the file IS the shim, or the call's line span
+    carries the ``# raw-io: <why>`` annotation."""
+    if os.path.basename(filename) == SHIM_FILENAME:
+        return []
+    tree = ast.parse(source, filename=filename)
+    lines = source.splitlines()
+    problems: List[str] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        flagged = _flag_of(node)
+        if flagged is None:
+            continue
+        span = lines[node.lineno - 1 : (node.end_lineno or node.lineno)]
+        if any(WHITELIST_MARK in line for line in span):
+            continue
+        problems.append(
+            f"{filename}:{node.lineno}: raw {flagged}() bypasses the IO "
+            "fault shim (route through iofaults.open_file/write_line/"
+            "fsync_file/read_text, or annotate '# raw-io: <why>')"
+        )
+    return problems
+
+
+def check_paths(paths=DEFAULT_PATHS) -> List[str]:
+    problems: List[str] = []
+    for path in paths:
+        if not os.path.exists(path):
+            # a typo'd path must not walk zero files and report OK
+            raise FileNotFoundError(f"check_io: no such path: {path}")
+        if os.path.isfile(path):
+            files = [path]
+        else:
+            files = sorted(
+                os.path.join(root, f)
+                for root, _, names in os.walk(path)
+                for f in names
+                if f.endswith(".py")
+            )
+        for fname in files:
+            with open(fname) as fh:  # raw-io: the checker reads source, not journals
+                problems.extend(check_source(fh.read(), fname))
+    return problems
+
+
+def main(argv: List[str]) -> int:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    os.chdir(repo_root)
+    paths = argv[1:] or list(DEFAULT_PATHS)
+    problems = check_paths(paths)
+    for problem in problems:
+        print(problem, file=sys.stderr)
+    if problems:
+        print(
+            f"check_io: {len(problems)} unshimmed IO call(s)",
+            file=sys.stderr,
+        )
+        return 1
+    print("check_io: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -1,7 +1,11 @@
 """Daemon crash/drain soak: kill -9 the serving process mid-traffic,
-restart it, and PROVE the journal-replay contract.
+restart it, and PROVE the journal-replay contract — and, under
+``--disk-faults``, prove the INTEGRITY contract: seeded media
+corruption (kill-torn tails, post-fsync bit rot, persistent fsync
+``EIO``) is always either typed-detected or bitwise-recomputed — zero
+silent wrong tokens, zero lost accepted requests.
 
-Three entry modes:
+Entry modes:
 
 - (default) ``--soak``: the acceptance gate.  For each seeded trial:
   start the daemon as a real subprocess, feed it a seeded request
@@ -26,16 +30,37 @@ Three entry modes:
 
   ``--record DAEMON_r01.json`` writes the per-trial evidence.
 
+- ``--disk-faults SEED``: the media-integrity soak.  Per seeded trial:
+  (a) life 1 accepts traffic and is SIGKILLed mid-stream; (b) the
+  harness flips ONE seeded bit inside the journal's last complete
+  record — post-fsync bit rot, the damage the per-record CRC exists
+  for; (c) life 2 restarts on the corrupted journal: the CRC-failed
+  tail record must be TRUNCATED (typed detection, never silent
+  replay), every surviving request recovers and finishes BITWISE
+  against the greedy reference, and a request whose submit record was
+  the corrupted one re-admits through the idempotent client retry;
+  (d) a separate DEGRADED leg starts a child with an injected
+  persistent-``EIO``-on-fsync plan
+  (``tpu_parallel/daemon/iofaults.py``): after the error threshold
+  the daemon must serve 503s with a typed ``degraded`` reason and a
+  ``degraded_reason`` on ``/healthz``, finish its accepted in-flight
+  work, and STILL drain exit 0 on SIGTERM.
+  ``--record DAEMON_r02.json`` writes the per-trial evidence.
+
 - ``--smoke``: the fast CI gate (wired into ``scripts/check_all.py``
   and tier-1 via ``tests/test_daemon.py``): one subprocess — start,
   healthz, submit over HTTP, stream to completion, SIGTERM, assert a
   clean drained exit 0 and a clean journal.  No kill -9 (that is the
-  soak's job); one model build is the whole cost.
+  soak's job); one model build is the whole cost.  ``--disk-smoke``
+  is its integrity sibling (one reduced ``--disk-faults`` trial, no
+  degraded leg) — ``check_daemon`` runs both.
 
 - ``--serve``: INTERNAL child mode — build the tiny-model fleet, wrap
   it in :class:`~tpu_parallel.daemon.ServingDaemon` + HTTP server,
   write the ready file, install signals, pump until shut down, exit
-  with ``daemon.run()``'s code.  The parent modes spawn this.
+  with ``daemon.run()``'s code.  ``--io-fsync-eio N`` arms the IO
+  fault shim with a persistent fsync-``EIO`` plan starting at fsync
+  index N.  The parent modes spawn this.
 """
 
 from __future__ import annotations
@@ -174,6 +199,15 @@ def serve(args):
         DaemonHTTPServer,
         ServingDaemon,
     )
+    from tpu_parallel.daemon import iofaults
+
+    if args.io_fsync_eio >= 0:
+        # the dead-disk shape: every fsync from index N on fails EIO —
+        # the child must DEGRADE (typed 503s, /healthz reason), not die
+        iofaults.install(iofaults.IOFaultPlan(
+            fsync_eio_at=args.io_fsync_eio,
+            fsync_eio_count=iofaults.PERSISTENT,
+        ))
     from tpu_parallel.models import GPTLM, tiny_test
     from tpu_parallel.obs.registry import MetricRegistry
     from tpu_parallel.serving import SchedulerConfig, ServingEngine
@@ -384,6 +418,312 @@ def run_smoke(tmpdir=None, keep=False):
     return problems
 
 
+def corrupt_tail_record(journal_path, rnd):
+    """Flip ONE seeded bit inside the journal's last COMPLETE record —
+    the post-fsync bit-rot shape the per-record CRC exists to catch.
+    (A SIGKILL may also have left an unterminated fragment after it;
+    recovery must truncate both.)  Returns ``(record_kind,
+    dedupe_token)`` of the corrupted record so the caller knows which
+    damage class it planted (a submit's loss re-admits via client
+    retry; a tokens/terminal loss regenerates bitwise)."""
+    import json as _json
+
+    with open(journal_path, "rb") as fh:
+        data = fh.read()
+    end = len(data)
+    if not data.endswith(b"\n"):
+        end = data.rfind(b"\n") + 1  # skip the torn fragment
+    start = data.rfind(b"\n", 0, end - 1) + 1
+    line = data[start:end - 1]  # the last complete record's bytes
+    try:
+        rec = _json.loads(line)
+    except ValueError:
+        rec = {}
+    bit = rnd.randrange(len(line) * 8)
+    flipped = bytearray(line)
+    flipped[bit // 8] ^= 1 << (bit % 8)
+    with open(journal_path, "wb") as fh:
+        fh.write(data[:start] + bytes(flipped) + data[end - 1:])
+    return rec.get("record", "unparseable"), rec.get("dedupe_token")
+
+
+def run_disk_trial(args, seed, refs, degraded_leg=True):
+    """One seeded disk-fault trial (see the module docstring's
+    ``--disk-faults`` contract).  Returns (trial_record, problems)."""
+    from tpu_parallel.daemon import load_state, read_journal
+
+    rnd = random.Random(seed ^ 0x10FA)
+    problems = []
+    tmpdir = os.path.join(
+        args.workdir or "/tmp", f"daemon_disk_{os.getpid()}_{seed}"
+    )
+    os.makedirs(tmpdir, exist_ok=True)
+    journal = os.path.join(tmpdir, "journal.jsonl")
+    ready = os.path.join(tmpdir, "ready.json")
+    if os.path.exists(journal):
+        os.remove(journal)
+    schedule = make_schedule(seed, args.requests, args.new)
+
+    # ---- life 1: accept traffic, SIGKILL mid-stream
+    proc = spawn_daemon(args, journal, ready)
+    info = wait_ready(ready, proc)
+    port = info["port"]
+    kill_after = rnd.randrange(2, max(3, args.requests))
+    accepted = {}
+    for i, entry in enumerate(schedule):
+        try:
+            code, rec = http_json(
+                "POST", f"http://127.0.0.1:{port}/v1/submit", entry
+            )
+        except (urllib.error.URLError, OSError):
+            break
+        if code == 200:
+            accepted[entry["dedupe_token"]] = rec["request_id"]
+        if i + 1 == kill_after:
+            time.sleep(rnd.uniform(0.2, 0.6))  # let tokens stream
+            break
+    proc.send_signal(signal.SIGKILL)
+    proc.wait(timeout=30)
+
+    # ---- seeded media corruption: one bit of the last durable record
+    kind, corrupted_token = corrupt_tail_record(journal, rnd)
+    pre_records = None
+    try:
+        pre_records, pre_torn = read_journal(journal)
+    except Exception as exc:
+        # the flip landed in the LAST record, so a typed torn-tail read
+        # must still succeed — anything else is a detection bug
+        problems.append(
+            f"read_journal refused a tail-corrupted journal: {exc!r}"
+        )
+        pre_torn = -1
+    if pre_torn == 0:
+        problems.append(
+            "planted bit flip was not detected as tail damage "
+            f"(corrupted a {kind} record)"
+        )
+
+    # ---- life 2: restart on the corrupted journal; idempotent retries
+    proc = spawn_daemon(args, journal, ready)
+    info = wait_ready(ready, proc)
+    port = info["port"]
+    # the CRC-failed record must be GONE (truncated), not tolerated
+    # forever: the restarted journal parses torn-free end to end
+    records, torn = read_journal(journal)
+    if torn:
+        problems.append(
+            f"life2: {torn} damaged record(s) survived the restart "
+            "truncation"
+        )
+    dedupe_hits = 0
+    readmitted = 0
+    all_rids = {}
+    for entry in schedule:
+        code, rec = http_json(
+            "POST", f"http://127.0.0.1:{port}/v1/submit", entry
+        )
+        if code != 200:
+            problems.append(f"life2 submit rejected {code}: {rec}")
+            continue
+        tok = entry["dedupe_token"]
+        all_rids[tok] = rec["request_id"]
+        if tok in accepted:
+            if rec["request_id"] == accepted[tok]:
+                dedupe_hits += 1
+            elif tok == corrupted_token:
+                # the corrupted record WAS this submit: its durability
+                # was lost with the bit, so the retry legitimately
+                # re-admits fresh — the typed, counted fallback
+                readmitted += 1
+            else:
+                problems.append(
+                    f"life2: dedupe {tok} re-admitted as "
+                    f"{rec['request_id']} != {accepted[tok]} (corrupted "
+                    f"record was {kind})"
+                )
+    deadline = time.monotonic() + 240
+    finished = {}
+    pending = dict(all_rids)
+    while pending and time.monotonic() < deadline:
+        for tok, rid in list(pending.items()):
+            code, rec = http_json(
+                "GET", f"http://127.0.0.1:{port}/v1/result/{rid}"
+            )
+            if code == 200 and rec["status"] in (
+                "finished", "failed", "cancelled", "rejected", "expired",
+            ):
+                finished[tok] = rec
+                del pending[tok]
+        time.sleep(0.05)
+    for tok, rid in pending.items():
+        problems.append(f"{tok} ({rid}): never terminal")
+    for tok, rec in finished.items():
+        if rec["status"] != "finished":
+            problems.append(
+                f"{tok}: status {rec['status']} ({rec['finish_reason']})"
+                " — lost accepted work"
+            )
+        elif rec["tokens"] != refs[tok]:
+            problems.append(
+                f"{tok}: tokens diverge from the greedy reference "
+                "through crash + media corruption (SILENT WRONG TOKENS)"
+            )
+    state_leak_check(port, problems, f"disk{seed}")
+    stop_gracefully(proc, args.grace, problems, f"disk{seed}")
+    state = journal_invariants(journal, problems)
+    trial = {
+        "seed": seed,
+        "kill_after": kill_after,
+        "corrupted_record": kind,
+        "corrupted_submit_readmitted": readmitted,
+        "dedupe_hits_on_retry": dedupe_hits,
+        "recoveries": state.recoveries,
+        "finished": sum(
+            1 for r in finished.values() if r["status"] == "finished"
+        ),
+        "requests": args.requests,
+    }
+
+    # ---- degraded leg: persistent fsync EIO -> typed 503s, clean drain
+    if degraded_leg:
+        dj = os.path.join(tmpdir, "degraded.jsonl")
+        if os.path.exists(dj):
+            os.remove(dj)
+        proc = spawn_daemon(
+            args, dj, ready, extra=("--io-fsync-eio", "3")
+        )
+        info = wait_ready(ready, proc)
+        port = info["port"]
+        deg_accepted = []
+        saw_degraded = False
+        for i, entry in enumerate(make_schedule(
+            seed ^ 0xDE6, args.requests, args.new
+        )):
+            code, rec = http_json(
+                "POST", f"http://127.0.0.1:{port}/v1/submit", entry
+            )
+            if code == 200:
+                deg_accepted.append(rec["request_id"])
+            elif code == 503 and rec.get("finish_reason") in (
+                "degraded", "journal_error"
+            ):
+                if rec.get("finish_reason") == "degraded":
+                    saw_degraded = True
+            else:
+                problems.append(
+                    f"degraded leg: submit {i} -> {code} {rec} (want "
+                    "200 or typed 503)"
+                )
+            time.sleep(0.05)
+        deadline = time.monotonic() + 60
+        reason = None
+        while time.monotonic() < deadline:
+            code, health = http_json(
+                "GET", f"http://127.0.0.1:{port}/healthz"
+            )
+            reason = health.get("degraded_reason")
+            if code == 503 and reason:
+                break
+            time.sleep(0.1)
+        if not reason:
+            problems.append(
+                "degraded leg: /healthz never exposed degraded_reason "
+                "under persistent fsync EIO"
+            )
+        if not saw_degraded:
+            problems.append(
+                "degraded leg: no submission was refused with the "
+                "typed 'degraded' reason"
+            )
+        # accepted-before-degrade work still finishes (drains), and
+        # SIGTERM still exits 0 while degraded
+        deadline = time.monotonic() + 120
+        for rid in deg_accepted:
+            while time.monotonic() < deadline:
+                code, rec = http_json(
+                    "GET", f"http://127.0.0.1:{port}/v1/result/{rid}"
+                )
+                if code == 200 and rec["status"] == "finished":
+                    break
+                time.sleep(0.05)
+            else:
+                problems.append(
+                    f"degraded leg: accepted {rid} never finished "
+                    "draining"
+                )
+        stop_gracefully(
+            proc, args.grace, problems, f"degraded{seed}"
+        )
+        trial["degraded"] = {
+            "accepted_before_degrade": len(deg_accepted),
+            "degraded_reason": reason,
+            "typed_degraded_rejects": saw_degraded,
+        }
+        # the degraded journal is NOT required to be clean (its disk
+        # was dying) — but it must never brick: a fresh scan tolerates
+        # at most tail damage
+        try:
+            load_state(dj)
+        except Exception as exc:
+            problems.append(
+                f"degraded leg: journal bricked after EIO storm: "
+                f"{exc!r}"
+            )
+    if not problems:
+        import shutil
+
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return trial, problems
+
+
+def run_disk_soak(args):
+    """The seeded media-corruption acceptance soak (>= 3 seeds)."""
+    record = {"bench": "daemon_disk_faults", "trials": []}
+    problems = []
+    refs_cache = {}
+    for trial in range(args.trials):
+        seed = args.disk_faults + trial
+        schedule = make_schedule(seed, args.requests, args.new)
+        if seed not in refs_cache:
+            refs_cache[seed] = greedy_references(schedule)
+        trial_rec, trial_problems = run_disk_trial(
+            args, seed, refs_cache[seed]
+        )
+        trial_rec["problems"] = list(trial_problems)
+        record["trials"].append(trial_rec)
+        problems.extend(trial_problems)
+        print(
+            f"disk trial {trial} (seed {seed}): "
+            f"corrupted={trial_rec['corrupted_record']} "
+            f"dedupe_hits={trial_rec['dedupe_hits_on_retry']} "
+            f"finished={trial_rec['finished']}/{args.requests} "
+            f"degraded_reason="
+            f"{trial_rec.get('degraded', {}).get('degraded_reason')} "
+            f"problems={len(trial_problems)}"
+        )
+    record["ok"] = not problems
+    if args.record:
+        with open(args.record, "w") as fh:
+            json.dump(record, fh, indent=2)
+            fh.write("\n")
+        print(f"record: {args.record}")
+    return problems
+
+
+def run_disk_smoke():
+    """One reduced disk-fault trial (no degraded leg): the integrity
+    half of the ``check_daemon`` runtime gate."""
+    args = argparse.Namespace(
+        replicas=1, slots=2, grace=60.0, fsync_batch=4,
+        requests=3, new=8, workdir="", record="",
+    )
+    seed = 5
+    schedule = make_schedule(seed, args.requests, args.new)
+    refs = greedy_references(schedule)
+    _, problems = run_disk_trial(args, seed, refs, degraded_leg=False)
+    return problems
+
+
 def run_soak(args):
     """The seeded kill-9 / restart / drain acceptance soak."""
     from tpu_parallel.daemon import load_state
@@ -564,6 +904,20 @@ def main():
     ap.add_argument("--smoke", action="store_true",
                     help="fast gate: start, submit, SIGTERM drain, "
                          "assert clean exit (no kill -9)")
+    ap.add_argument("--disk-smoke", action="store_true",
+                    help="fast integrity gate: one reduced disk-fault "
+                         "trial (kill + seeded tail bit flip + bitwise "
+                         "recovery), no degraded leg")
+    ap.add_argument("--disk-faults", type=int, default=None,
+                    metavar="SEED",
+                    help="seeded media-corruption soak: kill-torn "
+                         "tails, one-bit journal rot, persistent "
+                         "fsync-EIO degraded mode — trials use seeds "
+                         "SEED..SEED+trials-1")
+    ap.add_argument("--io-fsync-eio", type=int, default=-1,
+                    help="INTERNAL (--serve): arm the IO fault shim "
+                         "with persistent fsync EIO from this fsync "
+                         "index on")
     ap.add_argument("--soak", action="store_true",
                     help="seeded kill-9/restart soak (the default)")
     ap.add_argument("--journal", type=str, default="")
@@ -587,6 +941,10 @@ def main():
         sys.exit(serve(args))
     if args.smoke:
         problems = run_smoke()
+    elif args.disk_smoke:
+        problems = run_disk_smoke()
+    elif args.disk_faults is not None:
+        problems = run_disk_soak(args)
     else:
         problems = run_soak(args)
     for problem in problems:

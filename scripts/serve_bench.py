@@ -306,14 +306,30 @@ def load_trace(path, time_compress=1.0):
     a serving daemon's write-ahead journal (``journal_meta`` header —
     only its ``submit`` records are requests; their ``arrival`` stamps
     are process-monotonic clock readings, so they rebase to the first
-    submit = 0)."""
+    submit = 0).
+
+    Integrity: records are verified with the SAME helper recovery uses
+    (``tpu_parallel.daemon.journal.record_crc_ok`` — CRC checked when
+    present, legacy records pass), so a corrupted journal replays
+    exactly the workload a restart would recover: one damaged tail
+    record tolerated, damage anywhere else refuses loudly.  Before
+    this, replay trusted any PARSEABLE record — a bit-rotted journal
+    could silently replay a different workload than recovery saw."""
     import json
+
+    from tpu_parallel.daemon.journal import (
+        MAX_TORN_TAIL_LINES,
+        record_crc_ok,
+    )
 
     if time_compress <= 0:
         raise SystemExit(f"--time-compress {time_compress} must be > 0")
     schedule = []
     journal = False
-    bad_line = None  # ONE torn record at the tail is legal, like recovery
+    # a trailing run of damaged lines is legal exactly as recovery
+    # tolerates it (one torn/rotted record, which a flipped-in newline
+    # can split in two); damage followed by good records refuses
+    bad_run = []  # line numbers of the current trailing damaged run
     # journal arrival stamps are process-monotonic and NOT comparable
     # across restarts: each lifetime (delimited by recovery/shutdown
     # records, or a clock regression) rebases so the replayed arrivals
@@ -331,16 +347,35 @@ def load_trace(path, time_compress=1.0):
             line = line.strip()
             if not line:
                 continue
-            if bad_line is not None:
-                raise SystemExit(
-                    f"{path}:{bad_line}: unparseable record is not a "
-                    "torn tail — refusing to replay a corrupt workload"
-                )
             try:
                 rec = json.loads(line)
             except json.JSONDecodeError:
-                bad_line = lineno
+                bad_run.append(lineno)
+                if len(bad_run) > MAX_TORN_TAIL_LINES:
+                    raise SystemExit(
+                        f"{path}:{bad_run[0]}: damage spans more than a "
+                        "torn tail — refusing to replay a corrupt "
+                        "workload"
+                    )
                 continue
+            if record_crc_ok(rec) is False:
+                # CRC-failed records are rejected exactly like
+                # unparseable ones — read_journal and load_trace must
+                # never diverge on what counts as a valid record
+                bad_run.append(lineno)
+                if len(bad_run) > MAX_TORN_TAIL_LINES:
+                    raise SystemExit(
+                        f"{path}:{bad_run[0]}: checksum damage spans "
+                        "more than a torn tail — refusing to replay a "
+                        "corrupt workload"
+                    )
+                continue
+            if bad_run:
+                raise SystemExit(
+                    f"{path}:{bad_run[0]}: unparseable or checksum-"
+                    "failed record is not a torn tail — refusing to "
+                    "replay a corrupt workload"
+                )
             kind = rec.get("record")
             if kind == "trace_meta":
                 continue

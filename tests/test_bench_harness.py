@@ -275,3 +275,63 @@ def test_journal_workload_multi_lifetime_rebase_and_corruption(tmp_path):
         fh.write("\n".join(lines) + "\n")
     with pytest.raises(SystemExit):
         sb.load_trace(path)
+
+
+def test_journal_workload_rejects_crc_failed_records(tmp_path):
+    """Workload replay and recovery share ONE verification helper
+    (``journal.record_crc_ok``): a CRC-failed record is rejected by
+    ``load_trace`` exactly as ``read_journal`` rejects it — tolerated
+    once at the tail, typed refusal anywhere else.  Before this,
+    replay trusted any PARSEABLE record and a bit-rotted journal could
+    silently replay a workload recovery would never accept."""
+    from tpu_parallel.daemon import JournalWriter, read_journal
+    from tpu_parallel.daemon.journal import encode_record
+
+    sb = _serve_bench()
+    path = str(tmp_path / "journal.jsonl")
+
+    def sub(seq, rid, arrival):
+        line, _ = encode_record({
+            "record": "submit", "seq": seq, "request_id": rid,
+            "arrival": arrival, "prompt": [1, 2], "prompt_len": 2,
+            "prefix_group": 0, "priority": 0, "deadline": None,
+            "max_new_tokens": 4, "at": 0.0,
+        })
+        return line
+
+    meta, _ = encode_record(
+        {"record": "journal_meta", "journal_version": 2, "seq": 0}
+    )
+    lines = [meta, sub(1, "a", 1.0), sub(2, "b", 2.0), sub(3, "c", 3.0)]
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    assert len(sb.load_trace(path)) == 3  # clean journal replays whole
+    # one corrupted digit in the TAIL record (crc left stale): both
+    # surfaces tolerate it as tail damage — the workload just shrinks
+    tail_rot = lines[:3] + [
+        lines[3].replace('"arrival": 3.0', '"arrival": 9.0')
+    ]
+    with open(path, "w") as fh:
+        fh.write("\n".join(tail_rot) + "\n")
+    assert read_journal(path)[1] == 1
+    assert [e["arrival"] for e in sb.load_trace(path)] == [0.0, 1.0]
+    # the same rot MID-file: both surfaces refuse loudly
+    mid_rot = [
+        lines[0],
+        lines[1].replace('"arrival": 1.0', '"arrival": 9.0'),
+        lines[2], lines[3],
+    ]
+    with open(path, "w") as fh:
+        fh.write("\n".join(mid_rot) + "\n")
+    with pytest.raises(Exception):
+        read_journal(path)
+    with pytest.raises(SystemExit):
+        sb.load_trace(path)
+    # and a REAL writer's journal (crc on every record) replays whole
+    real = str(tmp_path / "real.jsonl")
+    w = JournalWriter(real, lambda: 0.0)
+    w.append({"record": "submit", "request_id": "r", "arrival": 0.0,
+              "prompt": [3], "prompt_len": 1, "prefix_group": 0,
+              "priority": 0, "deadline": None, "max_new_tokens": 2})
+    w.close()
+    assert len(sb.load_trace(real)) == 1

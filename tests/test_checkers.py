@@ -308,3 +308,45 @@ def test_runtime_checks_registered_separately():
     assert callable(mod.check_paths)
     with pytest.raises(ValueError):
         check_all.load_checker("no_such_gate")
+
+
+def test_check_io_semantics():
+    """The IO-shim gate flags raw open / os.fsync / os.write, honors
+    the ``# raw-io:`` escape hatch, exempts the shim module itself, and
+    fails loudly on a typo'd path — the coverage guarantee behind the
+    seeded disk-fault soak."""
+    ci = _load("check_io")
+    bad = (
+        "import os\n"
+        "def f(path, fd, data):\n"
+        "    fh = open(path)\n"
+        "    os.fsync(fd)\n"
+        "    os.write(fd, data)\n"
+        "    legal = open(path)  # raw-io: reads a config, not a journal\n"
+        "def g(path):\n"
+        "    with open(\n"
+        "        path, 'rb'\n"
+        "    ) as fh:  # raw-io: wrapped-call annotation spans lines\n"
+        "        return fh.read()\n"
+    )
+    found = ci.check_source(bad, "tpu_parallel/daemon/x.py")
+    assert len(found) == 3, found
+    assert any("open()" in p and ":3:" in p for p in found)
+    assert any("os.fsync()" in p for p in found)
+    assert any("os.write()" in p for p in found)
+    # the shim module is the one legal raw-IO site
+    assert ci.check_source(bad, "tpu_parallel/daemon/iofaults.py") == []
+    # methods/attributes named open on other objects stay legal
+    ok = (
+        "def h(fh, gz, os_mod):\n"
+        "    a = fh.open()\n"
+        "    b = gz.open('x')\n"
+        "    return a, b\n"
+    )
+    assert ci.check_source(ok, "tpu_parallel/daemon/y.py") == []
+    with pytest.raises(FileNotFoundError):
+        ci.check_paths((os.path.join(REPO_ROOT, "no_such_dir"),))
+    # registered: the registry sweep covers it with zero extra wiring
+    import check_all as ca
+
+    assert "check_io" in ca.CHECKERS

@@ -16,6 +16,9 @@ import pytest
 
 from tpu_parallel.cluster import Frontend, FrontendConfig
 from tpu_parallel.daemon import (
+    CORRUPT_CRC,
+    CORRUPT_GARBAGE,
+    CORRUPT_SEQ,
     EXIT_CLEAN,
     EXIT_FORCED,
     REC_RECOVERY,
@@ -23,16 +26,22 @@ from tpu_parallel.daemon import (
     REC_SUBMIT,
     REC_TERMINAL,
     REC_TOKENS,
+    REJECT_DEGRADED,
     DaemonConfig,
     DaemonHTTPServer,
+    IOFaultPlan,
     JournalCorrupt,
     JournalWriter,
     ServingDaemon,
     WallClock,
+    encode_record,
     load_state,
     read_journal,
+    record_crc_ok,
     replay_state,
 )
+from tpu_parallel.daemon import iofaults
+from tpu_parallel.daemon.journal import ROTATE_SUFFIX, drop_torn_tail
 from tpu_parallel.models import GPTLM, tiny_test
 from tpu_parallel.models.generate import generate
 from tpu_parallel.obs.registry import MetricRegistry
@@ -434,9 +443,11 @@ def test_http_endpoints_and_sse_stream(env, tmp_path):
     pump.start()
     base = f"http://127.0.0.1:{server.port}"
 
-    def call(method, path, body=None):
+    def call_port(port, method, path, body=None):
         data = None if body is None else json.dumps(body).encode()
-        req = urllib.request.Request(base + path, data=data, method=method)
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", data=data, method=method
+        )
         if data is not None:
             req.add_header("Content-Type", "application/json")
         try:
@@ -444,6 +455,9 @@ def test_http_endpoints_and_sse_stream(env, tmp_path):
                 return resp.status, json.loads(resp.read() or b"{}")
         except urllib.error.HTTPError as exc:
             return exc.code, json.loads(exc.read() or b"{}")
+
+    def call(method, path, body=None):
+        return call_port(server.port, method, path, body)
 
     try:
         code, health = call("GET", "/healthz")
@@ -483,6 +497,29 @@ def test_http_endpoints_and_sse_stream(env, tmp_path):
         code, state = call("GET", "/statez")
         assert code == 200
         assert "inflight_tokens" in state["cluster"]
+        assert state["daemon"]["degraded_reason"] is None
+        # bounded body read: an oversized submit refuses 413 WITHOUT
+        # buffering the payload (a second server on the same daemon,
+        # with a tiny cap, proves the knob)
+        small = DaemonHTTPServer(d, max_body_bytes=64).start()
+        try:
+            code, err = call_port(
+                small.port, "POST", "/v1/submit",
+                {"prompt": list(range(200)), "max_new_tokens": 4},
+            )
+            assert code == 413 and "limit" in err["error"]
+            # under the cap the same server still accepts
+            code, _ = call_port(
+                small.port, "POST", "/v1/submit",
+                {"prompt": [1, 2], "max_new_tokens": 4},
+            )
+            assert code == 200
+        finally:
+            small.stop()
+        with pytest.raises(ValueError):
+            DaemonHTTPServer(d, max_body_bytes=0)
+        with pytest.raises(ValueError):
+            DaemonHTTPServer(d, sse_keepalive_seconds=0)
         # drain: healthz flips 503 for the balancer, daemon exits 0
         d.request_drain()
         pump.join(timeout=60)
@@ -611,3 +648,384 @@ def test_completed_retention_bounds_memory(env, tmp_path):
         Request(prompt=prompts[0], max_new_tokens=2), dedupe_token="t0"
     )
     assert again["request_id"] != "r0"
+
+
+# -- integrity: IO faults, CRC, the corruption matrix ------------------------
+
+
+def test_iofault_plan_seeded_determinism():
+    """Same rng state + ops + kinds => identical plan; bad inputs
+    refuse loudly — the FaultPlan.from_seed contract, IO edition."""
+    import random
+
+    p1 = IOFaultPlan.from_seed(random.Random(9), ops=32)
+    p2 = IOFaultPlan.from_seed(random.Random(9), ops=32)
+    assert p1 == p2
+    k1 = IOFaultPlan.from_seed(
+        random.Random(4), ops=16, kinds=("fsync_eio", "bit_flip")
+    )
+    assert k1.fsync_eio_at is not None and k1.flip_read_at is not None
+    assert k1.enospc_at_write is None and k1.short_write_at is None
+    with pytest.raises(ValueError):
+        IOFaultPlan.from_seed(random.Random(0), ops=2)
+    with pytest.raises(ValueError):
+        IOFaultPlan.from_seed(random.Random(0), kinds=("bogus",))
+
+
+def test_iofault_injection_shapes(tmp_path):
+    """Each injected fault has its contract shape: short write / ENOSPC
+    leave a torn prefix AND raise; fsync raises EIO; a read bit flip
+    changes exactly the payload (same length, different bytes)."""
+    p = tmp_path / "f.txt"
+    with iofaults.inject(IOFaultPlan(short_write_at=1)) as inj:
+        with open(p, "w") as fh:
+            iofaults.write_line(fh, "hello world\n")
+            with pytest.raises(OSError):
+                iofaults.write_line(fh, "second record here\n")
+        text = p.read_text()
+        assert text.startswith("hello world\n")
+        assert "second record here" not in text
+        assert len(text) > len("hello world\n")  # the torn prefix landed
+        assert inj.injected["short_write"] == 1
+    with iofaults.inject(IOFaultPlan(enospc_at_write=0)) as inj:
+        with open(p, "w") as fh:
+            with pytest.raises(OSError) as exc:
+                iofaults.write_line(fh, "doomed record\n")
+        assert "ENOSPC" in str(exc.value) or "full" in str(exc.value)
+        assert inj.injected["enospc"] == 1
+    with iofaults.inject(IOFaultPlan(fsync_eio_at=0)) as inj:
+        with open(p, "a") as fh:
+            with pytest.raises(OSError):
+                iofaults.fsync_file(fh)
+        assert inj.injected["fsync_eio"] == 1
+    p.write_text("payload bytes\n")
+    with iofaults.inject(IOFaultPlan(flip_read_at=0, flip_read_bit=9)):
+        flipped = iofaults.read_text(str(p))
+    clean = p.read_text()
+    assert flipped != clean and len(flipped) == len(clean)
+    # with no injector installed the wrappers are the raw ops
+    assert iofaults.read_text(str(p)) == clean
+
+
+def test_journal_crc_round_trip_under_seeded_bit_flips(tmp_path):
+    """Every written record carries a verifying CRC; ONE flipped bit
+    anywhere in a record's line is detected — tolerated (torn) at the
+    tail, typed JournalCorrupt anywhere else.  Seeded sweep so the flip
+    lands in keys, values, digits and the crc field itself."""
+    import random
+
+    path = str(tmp_path / "j.jsonl")
+    w = JournalWriter(path, FakeClock())
+    w.append({"record": REC_SUBMIT, "request_id": "a", "prompt": [1, 2],
+              "max_new_tokens": 4, "dedupe_token": "da"})
+    w.append({"record": REC_TOKENS, "request_id": "a", "index": 0,
+              "tokens": [7, 8]})
+    w.append({"record": REC_TERMINAL, "request_id": "a",
+              "status": "finished", "finish_reason": "length"})
+    w.close()
+    records, torn = read_journal(path)
+    assert torn == 0
+    assert all(record_crc_ok(r) is True for r in records)
+    clean = open(path, "rb").read()
+    lines = clean.splitlines(keepends=True)
+    rnd = random.Random(17)
+    for trial in range(12):
+        lineno = rnd.randrange(1, len(lines))  # never the meta record
+        line = bytearray(lines[lineno])
+        bit = rnd.randrange((len(line) - 1) * 8)  # never the newline
+        line[bit // 8] ^= 1 << (bit % 8)
+        with open(path, "wb") as fh:
+            fh.write(b"".join(
+                [bytes(line) if i == lineno else orig
+                 for i, orig in enumerate(lines)]
+            ))
+        if lineno == len(lines) - 1:
+            got, t = read_journal(path)
+            # a flip that mints a "\n" splits the record into TWO bad
+            # tail lines — still tolerated, still exactly one record lost
+            assert 1 <= t <= 2, f"trial {trial}: tail flip not detected"
+            assert len(got) == len(lines) - 1
+        else:
+            with pytest.raises(JournalCorrupt) as exc:
+                read_journal(path)
+            assert exc.value.reason in (CORRUPT_CRC, CORRUPT_GARBAGE)
+
+
+def test_corruption_matrix_typed_distinctly(tmp_path):
+    """Mid-file garbage, a CRC mismatch, and a sequence regression are
+    DIFFERENT failures and each carries its own typed reason."""
+    def write_lines(lines):
+        path = str(tmp_path / "m.jsonl")
+        with open(path, "w") as fh:
+            fh.write("\n".join(lines) + "\n")
+        return path
+
+    rec_lines = []
+    for seq, rec in enumerate([
+        {"record": "journal_meta", "journal_version": 2},
+        {"record": REC_SUBMIT, "request_id": "a", "prompt": [1]},
+        {"record": REC_TOKENS, "request_id": "a", "index": 0,
+         "tokens": [5]},
+        {"record": REC_TERMINAL, "request_id": "a",
+         "status": "finished", "finish_reason": "length"},
+    ]):
+        line, _ = encode_record({**rec, "seq": seq, "at": 0.0})
+        rec_lines.append(line)
+    # baseline parses clean
+    assert read_journal(write_lines(rec_lines))[1] == 0
+    # (a) unparseable bytes mid-file
+    garbage = rec_lines[:2] + ["!!not json!!"] + rec_lines[2:]
+    with pytest.raises(JournalCorrupt) as exc:
+        read_journal(write_lines(garbage))
+    assert exc.value.reason == CORRUPT_GARBAGE
+    # (b) parseable record whose checksum disagrees: a one-digit edit
+    # of the token value with the original crc left in place
+    assert '"tokens": [5]' in rec_lines[2]
+    tampered = rec_lines[2].replace('"tokens": [5]', '"tokens": [6]')
+    with pytest.raises(JournalCorrupt) as exc:
+        read_journal(write_lines(
+            rec_lines[:2] + [tampered] + rec_lines[3:]
+        ))
+    assert exc.value.reason == CORRUPT_CRC
+    # (c) valid records whose order lies
+    back = [
+        encode_record({"record": REC_TOKENS, "request_id": "a",
+                       "index": 0, "tokens": [], "seq": s})[0]
+        for s in (5, 3)
+    ]
+    with pytest.raises(JournalCorrupt) as exc:
+        read_journal(write_lines(rec_lines[:1] + back))
+    assert exc.value.reason == CORRUPT_SEQ
+
+
+def test_crc_failed_tail_truncated_and_recovered_bitwise(env, tmp_path):
+    """Post-fsync bit rot on the journal's LAST record (line intact,
+    checksum wrong): the restart truncates exactly that record — same
+    treatment as a torn write — and forced-prefix recovery regenerates
+    whatever it held, bitwise."""
+    _, _, _, prompts, refs = env
+    path = tmp_path / "j.jsonl"
+    d1 = _daemon(env, path)
+    d1.submit(Request(prompt=prompts[0], max_new_tokens=8,
+                      request_id="r0"), dedupe_token="t0")
+    for _ in range(4):
+        d1.tick()
+    assert 0 < len(d1.result("r0")["tokens"]) < 8
+    d1.journal.abort()
+    data = open(path, "rb").read()
+    assert data.endswith(b"\n")
+    start = data.rfind(b"\n", 0, len(data) - 1) + 1
+    flipped = bytearray(data)
+    flipped[start + 10] ^= 0x10  # one bit inside the last record
+    with open(path, "wb") as fh:
+        fh.write(bytes(flipped))
+    records_before, torn = read_journal(str(path))
+    assert torn == 1  # reader: tolerated tail damage
+    dropped = drop_torn_tail(str(path))
+    assert dropped > 0  # truncater: the damaged record is GONE
+    d2 = _daemon(env, path)
+    assert read_journal(str(path))[1] == 0
+    for _ in range(60):
+        if d2.result("r0")["status"] == "finished":
+            break
+        d2.tick()
+    assert d2.result("r0")["tokens"] == refs[0]
+    assert d2.submit(
+        Request(prompt=prompts[0], max_new_tokens=8), dedupe_token="t0"
+    )["request_id"] == "r0"  # dedupe survived the damage
+
+
+def test_pre_crc_journal_replays_unchanged(env, tmp_path):
+    """A PR 14 journal (no crc fields) is still a valid recovery
+    source: CRCs are verified WHEN PRESENT, so the old format replays
+    — and finishes bitwise — without rewrite or refusal."""
+    _, _, _, prompts, refs = env
+    path = str(tmp_path / "old.jsonl")
+    recs = [
+        {"record": "journal_meta", "journal_version": 1, "seq": 0},
+        {"record": REC_SUBMIT, "seq": 1, "at": 0.1, "request_id": "r0",
+         "dedupe_token": "t0", "arrival": 0.1,
+         "prompt": [int(t) for t in prompts[0]],
+         "prompt_len": len(prompts[0]), "prefix_group": 0,
+         "priority": 0, "deadline": None, "max_new_tokens": 8,
+         "eos_token_id": None, "sampling": None},
+        {"record": REC_TOKENS, "seq": 2, "request_id": "r0",
+         "index": 0, "tokens": refs[0][:3]},
+    ]
+    with open(path, "w") as fh:
+        for rec in recs:
+            fh.write(json.dumps(rec) + "\n")
+    d = _daemon(env, path)
+    rec = d.result("r0")
+    assert rec is not None and rec["tokens"][:3] == refs[0][:3]
+    for _ in range(60):
+        if d.result("r0")["status"] == "finished":
+            break
+        d.tick()
+    assert d.result("r0")["tokens"] == refs[0]  # bitwise through formats
+
+
+# -- rotation + compaction ---------------------------------------------------
+
+
+def test_compaction_bounds_replay_records(env, tmp_path):
+    """After a run with requests >> completed_retention, rotation keeps
+    restart replay O(open + retained): the journal's record count stays
+    bounded while the lifetime record count grows, recovery still
+    dedupes retained tokens, and an OPEN request crosses a compaction
+    with its stream continuing bitwise."""
+    _, _, _, prompts, refs = env
+    path = tmp_path / "j.jsonl"
+    d = _daemon(
+        env, path, completed_retention=2, compact_interval_records=20,
+    )
+    for i in range(8):
+        d.submit(
+            Request(prompt=prompts[i % len(prompts)], max_new_tokens=4,
+                    request_id=f"r{i}"),
+            dedupe_token=f"t{i}",
+        )
+        for _ in range(20):
+            rec = d.result(f"r{i}")
+            if rec is None or rec["status"] == "finished":
+                break
+            d.tick()
+    lifetime = d.journal.records  # every record EVER appended
+    assert d.journal.rotations >= 1, "interval never triggered a rotate"
+    on_disk = len(read_journal(str(path))[0])
+    # disk holds at most: the snapshot (<= 3 records per retained
+    # request + meta) plus one interval's worth of fresh appends —
+    # NOT the lifetime
+    assert on_disk <= 20 + 3 * 3 + 2, (lifetime, on_disk)
+    assert lifetime > on_disk
+    # an OPEN request across a compaction: submit, stream partway,
+    # force a rotation mid-stream, then crash — recovery must continue
+    # bitwise from the compacted snapshot
+    d.submit(Request(prompt=prompts[0], max_new_tokens=8,
+                     request_id="open"), dedupe_token="topen")
+    for _ in range(3):
+        d.tick()
+    assert 0 < len(d.result("open")["tokens"]) < 8
+    d._compact()
+    open_records = [
+        r for r in read_journal(str(path))[0]
+        if r.get("request_id") == "open"
+    ]
+    # exactly the snapshot pair: one submit + one tokens record
+    assert [r["record"] for r in open_records] == [
+        REC_SUBMIT, REC_TOKENS
+    ]
+    d.journal.abort()  # crash right after the rotate
+    d2 = _daemon(env, path, completed_retention=2)
+    for _ in range(60):
+        if d2.result("open")["status"] == "finished":
+            break
+        d2.tick()
+    assert d2.result("open")["tokens"] == refs[0]
+    # retained dedupe survived compaction; evicted tokens re-admit
+    assert d2.submit(
+        Request(prompt=prompts[0], max_new_tokens=8),
+        dedupe_token="topen",
+    )["request_id"] == "open"
+
+
+def test_double_crash_during_compaction_loses_nothing(env, tmp_path):
+    """Both compaction crash windows: (a) crash AFTER the new segment
+    (sidecar) is written but BEFORE the old one retires — the orphan
+    sidecar is discarded and the old journal stays authoritative; (b)
+    crash right after the atomic replace — the snapshot alone recovers.
+    Neither loses an accepted request nor duplicates a completion."""
+    _, _, _, prompts, refs = env
+    path = tmp_path / "j.jsonl"
+    d = _daemon(env, path, completed_retention=4)
+    d.submit(Request(prompt=prompts[0], max_new_tokens=8,
+                     request_id="r0"), dedupe_token="t0")
+    for _ in range(3):
+        d.tick()
+    # (a) a half-written new segment, then the crash
+    with open(str(path) + ROTATE_SUFFIX, "w") as fh:
+        fh.write('{"record": "journal_meta", "journal_ver')  # torn
+    d.journal.abort()
+    d2 = _daemon(env, path, completed_retention=4)
+    assert not os.path.exists(str(path) + ROTATE_SUFFIX)  # discarded
+    for _ in range(60):
+        if d2.result("r0")["status"] == "finished":
+            break
+        d2.tick()
+    assert d2.result("r0")["tokens"] == refs[0]
+    # (b) a real rotate, then an immediate crash
+    d2.submit(Request(prompt=prompts[1], max_new_tokens=8,
+                      request_id="r1"), dedupe_token="t1")
+    for _ in range(3):
+        d2.tick()
+    d2._compact()
+    d2.journal.abort()
+    d3 = _daemon(env, path, completed_retention=4)
+    for _ in range(60):
+        if d3.result("r1")["status"] == "finished":
+            break
+        d3.tick()
+    assert d3.result("r1")["tokens"] == refs[1]
+    # no duplicate admissions across all three lives
+    state = load_state(str(path))
+    assert sorted(state.dedupe) == ["t0", "t1"]
+    assert not state.unfinished
+    submits = [
+        r for r in read_journal(str(path))[0]
+        if r["record"] == REC_SUBMIT
+    ]
+    assert len(submits) == len({r["request_id"] for r in submits})
+
+
+# -- degraded mode -----------------------------------------------------------
+
+
+def test_degraded_mode_typed_rejects_drains_and_exits_clean(
+    env, tmp_path
+):
+    """Persistent fsync EIO: the daemon counts the failures, enters
+    DEGRADED (typed reason exposed), refuses new submissions with the
+    typed ``degraded`` reason, finishes its in-flight work, and STILL
+    drains exit 0 on SIGTERM — the process never dies mid-accept."""
+    _, _, _, prompts, refs = env
+    path = tmp_path / "j.jsonl"
+    with iofaults.inject(IOFaultPlan(
+        fsync_eio_at=2, fsync_eio_count=iofaults.PERSISTENT
+    )) as inj:
+        d = _daemon(env, path, degrade_after_io_errors=2)
+        rec = d.submit(
+            Request(prompt=prompts[0], max_new_tokens=8,
+                    request_id="r0"),
+            dedupe_token="t0",
+        )
+        assert rec["status"] == "queued"  # accepted before the EIOs
+        for _ in range(30):
+            d.tick()
+            if d.degraded_reason is not None:
+                break
+        assert d.degraded_reason == "journal_io"
+        assert inj.injected["fsync_eio"] >= 2
+        assert int(
+            d.registry.counter(
+                "daemon_journal_integrity_io_errors_total"
+            ).value
+        ) >= 2
+        # new submissions refuse TYPED (the HTTP layer maps it to 503)
+        late = d.submit(Request(prompt=prompts[1], max_new_tokens=4))
+        assert late["status"] == REJECTED
+        assert late["finish_reason"] == REJECT_DEGRADED
+        assert "journal_io" in late["detail"]
+        # in-flight work drains to completion, bitwise
+        for _ in range(60):
+            if d.result("r0")["status"] == "finished":
+                break
+            d.tick()
+        assert d.result("r0")["tokens"] == refs[0]
+        assert d.status()["degraded_reason"] == "journal_io"
+        # SIGTERM still drains exit 0 while degraded
+        d.request_drain()
+        assert d.run(max_ticks=100) == EXIT_CLEAN
+    # the journal never bricked: a fresh scan tolerates at most tail
+    # damage, and the accepted request's submit record is durable
+    state = load_state(str(path))
+    assert "t0" in state.dedupe

@@ -22,12 +22,15 @@ from tpu_parallel.models import GPTLM, tiny_test
 from tpu_parallel.serving import (
     FINISHED,
     BlockAllocator,
+    KVIntegrityError,
     Request,
     SchedulerConfig,
     ServingEngine,
+    block_checksums,
 )
 from tpu_parallel.serving.kv_hierarchy import (
     MIGRATE_IMPORTED,
+    MIGRATE_INTEGRITY,
     MIGRATE_WEIGHTS_VERSION,
     RadixPrefixCache,
 )
@@ -67,9 +70,15 @@ class _FakePool:
             np.concatenate([self.content[int(b)] for b in blocks], axis=0)
         ]
 
-    def import_stored(self, rows, count):
+    def import_stored(self, rows, count, checksums=None):
         if count < 1:
             return ()
+        if checksums is not None:
+            got = block_checksums(rows, count)
+            if got != tuple(int(c) for c in checksums[:count]):
+                raise KVIntegrityError(
+                    "fake pool: import failed its checksum"
+                )
         if self.blocks_available() < count:
             return None
         blocks = tuple(self.allocator.alloc() for _ in range(count))
@@ -564,3 +573,242 @@ def test_warm_start_seeds_scale_up_replica(env):
     hit = newcomer.engine._radix.lookup(list(prompts[1]) + [7])
     assert hit is not None, "warm-started replica missed the hot header"
     newcomer.engine.pool.allocator.check()
+
+
+# -- transfer integrity + host-tier breaker ----------------------------------
+
+
+def test_restore_integrity_failure_typed_and_dropped():
+    """Checksum-failed host bytes NEVER restore: the corrupted node (and
+    its unreachable subtree) drops, the verified leading run still
+    restores, and the failure is typed-counted — the lookup falls back
+    to recompute instead of serving rotted KV."""
+    pool = _FakePool(16)
+    cache = RadixPrefixCache(
+        pool, max_device_blocks=2, host_capacity_blocks=4
+    )
+    toks = [1, 2, 3, 4, 2, 3, 4, 1]  # two blocks
+    _insert(pool, cache, toks)
+    assert cache.lookup(toks + [9]) is not None  # warm both nodes
+    cache.pop_lru()
+    cache.pop_lru()  # deepest-first: both spill
+    assert cache.host_blocks_in_use == 2 and cache.offloads == 2
+    # corrupt the DEEPER node's spilled bytes (one flipped value)
+    deeper = next(
+        n for n in cache._walk()
+        if n.host is not None and n.run == tuple(toks[4:8])
+    )
+    deeper.host[0].flat[0] += 1
+    hit = cache.lookup(toks + [9])
+    # the verified first block restored; the corrupted one dropped and
+    # its coverage falls back to recompute (shorter hit, never wrong)
+    assert hit is not None and hit[1] == BT
+    np.testing.assert_array_equal(
+        pool.content[int(hit[0][0])], _payload(tuple(toks[:4]))
+    )
+    assert cache.integrity_failures == 1
+    assert cache.host_blocks_in_use == 0  # corrupt copy gone for good
+    _conservation(pool, cache, held=0)
+    # corruption of the FIRST host node: zero restore, typed failure
+    cache.pop_lru()  # spill the restored block again
+    first = next(n for n in cache._walk() if n.host is not None)
+    first.host[0].flat[0] += 1
+    probes = cache.hits + cache.misses
+    assert cache.lookup(toks[:4] + [9]) is None
+    assert cache.misses == probes - cache.hits + 1
+    assert cache.integrity_failures == 2
+    assert cache.restore_failures == 1
+    _conservation(pool, cache, held=0)
+    pool.allocator.check()
+
+
+def test_host_tier_breaker_opens_and_half_open_reprobes():
+    """K consecutive restore failures take the offload tier DOWN (no
+    restores, no new spills — device-only serving continues); after the
+    probe window the next host hit is a half-open PROBE whose success
+    closes the breaker and restores the tier."""
+    pool = _FakePool(8)
+    cache = RadixPrefixCache(
+        pool, max_device_blocks=2, host_capacity_blocks=4,
+        breaker_failures=2, breaker_probe_ops=8,
+    )
+    hot = [1, 2, 3, 4]
+    _insert(pool, cache, hot)
+    assert cache.lookup(hot + [9]) is not None  # warm it
+    assert cache.pop_lru()  # spills (warm + tier up)
+    assert cache.offloads == 1 and cache.host_blocks_in_use == 1
+    # exhaust the free list so restores fail typed (no blocks)
+    held = [pool.seed_block(_payload((0, 0, 0, 0)))
+            for _ in range(pool.allocator.n_free)]
+    assert pool.blocks_available() == 0
+    for _ in range(2):
+        assert cache.lookup(hot + [9]) is None
+    assert cache.restore_failures == 2
+    assert not cache.host_tier_up
+    assert cache.breaker_trips == 1 and cache.breaker_state == 1
+    # while OPEN: no restore attempts (no new typed failures burned),
+    # and evictions stop spilling
+    rf = cache.restore_failures
+    assert cache.lookup(hot + [9]) is None
+    assert cache.restore_failures == rf
+    pool.free_stored(held)  # pressure gone — the breaker stays open
+    _insert(pool, cache, [5, 6, 7, 8])
+    assert cache.lookup([5, 6, 7, 8, 9]) is not None  # warm it
+    assert cache.breaker_state == 1
+    offs = cache.offloads
+    while cache.device_blocks > 0:
+        cache.pop_lru()
+    assert cache.offloads == offs, "spilled while the breaker was open"
+    # the op clock advances into the half-open probe window
+    while cache.breaker_state != 2:
+        cache.lookup([7, 7, 7, 7, 7])
+    hit = cache.lookup(hot + [9])  # the half-open probe
+    assert hit is not None and hit[1] == BT
+    assert cache.host_tier_up and cache.breaker_state == 0
+    assert cache.restored_blocks == 1
+    np.testing.assert_array_equal(
+        pool.content[int(hit[0][0])], _payload(tuple(hot))
+    )
+    # the recovered tier spills again
+    assert cache.pop_lru()
+    assert cache.offloads == offs + 1
+    _conservation(pool, cache, held=0)
+
+
+def test_failed_probe_rearms_breaker():
+    """A half-open probe that FAILS (the host copy rotted while the
+    tier was down) re-arms the open breaker instead of closing it."""
+    pool = _FakePool(8)
+    cache = RadixPrefixCache(
+        pool, max_device_blocks=2, host_capacity_blocks=4,
+        breaker_failures=1, breaker_probe_ops=2,
+    )
+    hot = [1, 2, 3, 4]
+    _insert(pool, cache, hot)
+    assert cache.lookup(hot + [9]) is not None
+    assert cache.pop_lru()
+    held = [pool.seed_block(_payload((0, 0, 0, 0)))
+            for _ in range(pool.allocator.n_free)]
+    assert cache.lookup(hot + [9]) is None  # 1 failure: trips at K=1
+    assert cache.breaker_state == 1
+    node = next(n for n in cache._walk() if n.host is not None)
+    node.host[0].flat[0] += 1  # rot while down
+    while cache.breaker_state != 2:
+        cache.lookup([7, 7, 7, 7, 7])
+    trips_rf = cache.restore_failures
+    assert cache.lookup(hot + [9]) is None  # the probe fails typed
+    assert cache.integrity_failures == 1
+    assert cache.restore_failures == trips_rf + 1
+    assert cache.breaker_state == 1, "failed probe must re-arm"
+    pool.free_stored(held)
+    _conservation(pool, cache, held=0)
+
+
+def test_breaker_engine_level_device_only_bitwise(env):
+    """Acceptance: K consecutive checksum-failed restores disable the
+    offload tier on a LIVE engine; serving continues BITWISE (recompute
+    fallback) against the tier-up outputs; after the probe window a
+    fresh spill + restore closes the breaker again."""
+    cfg, model, params, _ = env
+    rnd = np.random.RandomState(11)
+    headers = [
+        [int(t) for t in rnd.randint(1, cfg.vocab_size, 8)]
+        for _ in range(4)
+    ]
+    eng = ServingEngine(
+        model, params, n_slots=2, decode_steps_per_tick=1,
+        scheduler=SchedulerConfig(max_prefills_per_tick=2),
+        kv_block_tokens=4, prefix_cache_size=2, kv_host_blocks=16,
+        kv_radix_cache=True,
+    )
+    radix = eng._radix
+    radix.breaker_failures = 2
+    radix.breaker_probe_ops = 4
+
+    def go(h, tag):
+        out = eng.add_request(
+            Request(request_id=tag, prompt=h + [7, 9], max_new_tokens=4)
+        )
+        eng.run(max_ticks=200)
+        assert out.status == FINISHED
+        return list(out.tokens)
+
+    first = []
+    for i, h in enumerate(headers[:3]):
+        first.append(go(h, f"a{i}"))
+        go(h, f"w{i}")  # warm: its blocks spill under pressure
+    assert radix.offloads > 0
+    # media rot: EVERY spilled host copy corrupts (device_get hands
+    # back read-only views — rot them via a writable copy, exactly what
+    # decaying RAM would have done in place)
+    for n in radix._walk():
+        if n.host is not None:
+            rotted = [leaf.copy() for leaf in n.host]
+            rotted[0].flat[0] += 1
+            n.host = rotted
+    # two revisits -> two checksum-failed restores -> breaker OPEN,
+    # and the streams still match the original runs bitwise (recompute)
+    assert go(headers[0], "b0") == first[0]
+    assert go(headers[1], "b1") == first[1]
+    assert radix.integrity_failures >= 2
+    assert not radix.host_tier_up and radix.breaker_trips == 1
+    s = eng.metrics.summary()
+    assert s["kv_integrity_failures"] >= 2
+    assert s["kv_host_breaker_state"] == 1
+    assert s["kv_host_breaker_trips"] == 1
+    # device-only serving continues bitwise while the tier is down
+    offs = radix.offloads
+    assert go(headers[2], "b2") == first[2]
+    assert radix.offloads == offs, "spilled while down"
+    # purge the remaining rotted copies (they'd fail any probe), open
+    # the probe window, repopulate with a fresh spill, and probe
+    for n in list(radix._walk()):
+        if n.host is not None:
+            radix._drop_subtree(n)
+    while radix.breaker_state != 2:
+        radix.lookup([9, 9, 9, 9, 9])
+    fresh = go(headers[3], "a3")
+    go(headers[3], "w3")  # warm
+    go(headers[0], "c0")  # pressure: evicts + spills header 3 (state 2)
+    assert radix.offloads > offs, "half-open state never spilled"
+    restored = radix.restored_blocks
+    assert go(headers[3], "b3") == fresh  # the probe restore, bitwise
+    assert radix.restored_blocks > restored
+    assert radix.host_tier_up, "successful probe must close the breaker"
+    eng.pool.allocator.check()
+
+
+def test_import_prefix_integrity_refusal(env):
+    """A corrupted KVPrefixExport refuses TYPED at import: nothing
+    lands in the target pool, the verdict is ``integrity``, and the
+    caller's replay recomputes — never serves the rotted blocks."""
+    cfg, model, params, prompts = env
+
+    def mk():
+        return ServingEngine(
+            model, params, n_slots=2, decode_steps_per_tick=1,
+            scheduler=SchedulerConfig(max_prefills_per_tick=2),
+            kv_block_tokens=4, prefix_cache_size=16, kv_radix_cache=True,
+        )
+
+    a = mk()
+    a.add_request(
+        Request(request_id="mid", prompt=prompts[1], max_new_tokens=10)
+    )
+    for _ in range(5):
+        a.step()
+    export = a.export_prefix("mid")
+    assert export is not None and export.checksums
+    assert export.verified()
+    rotted = [leaf.copy() for leaf in export.leaves]
+    rotted[0].flat[0] += 1  # one rotted element in transit
+    import dataclasses
+
+    export = dataclasses.replace(export, leaves=tuple(rotted))
+    assert not export.verified()
+    b = mk()
+    before = b.pool.allocator.in_use
+    assert b.import_prefix(export) == MIGRATE_INTEGRITY
+    assert b.pool.allocator.in_use == before  # nothing landed
+    assert b._radix.lookup(list(prompts[1])) is None
+    b.pool.allocator.check()

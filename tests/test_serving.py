@@ -1910,3 +1910,115 @@ def test_engine_reset_metrics_rewires_scheduler_registry(rng):
     out = eng.add_request(_req(prompt[0], 3))
     eng.run()
     assert out.status == FINISHED and eng.metrics.finished == 1
+
+
+# -- device-side NaN/Inf integrity sentinel ----------------------------------
+
+
+def _poison(params):
+    """Every floating leaf becomes NaN — the corrupted-weights shape
+    that would otherwise stream confident garbage."""
+    return jax.tree_util.tree_map(
+        lambda x: (
+            jnp.full_like(x, jnp.nan)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x
+        ),
+        params,
+    )
+
+
+def test_nan_sentinel_fails_request_typed_at_prefill(rng):
+    """Non-finite logits at the FIRST sampled token: the request FAILS
+    typed ``integrity`` with zero tokens streamed, the slot releases,
+    and the trip is counted — never a garbage stream."""
+    from tpu_parallel.serving import FAIL_INTEGRITY, FAILED
+
+    cfg, model, prompt, params = _build(rng)
+    eng = ServingEngine(
+        model, _poison(params), n_slots=2, decode_steps_per_tick=1
+    )
+    events = []
+    out = eng.add_request(_req(prompt[0], 6, on_token=events.append))
+    eng.run(max_ticks=20)
+    assert out.status == FAILED
+    assert out.finish_reason == FAIL_INTEGRITY
+    assert out.tokens == []
+    assert eng.integrity_trips == 1
+    assert eng.metrics.summary()["integrity_trips"] == 1
+    assert eng.pool.n_free == eng.pool.n_slots  # slot released
+    assert len(events) == 1 and events[0].finished
+    assert events[0].finish_reason == FAIL_INTEGRITY
+    assert events[0].token == -1  # the sentinel never streams
+    assert not eng.has_work()
+
+
+def test_nan_sentinel_mid_stream_fused_tick(rng):
+    """Weights rot AFTER tokens already streamed, under the fused
+    multi-step tick: delivery stops at the trip (already-delivered
+    tokens stand), the request fails typed, and the pool stays clean."""
+    from tpu_parallel.serving import FAIL_INTEGRITY, FAILED
+
+    cfg, model, prompt, params = _build(rng)
+    eng = ServingEngine(
+        model, params, n_slots=2, decode_steps_per_tick=4
+    )
+    out = eng.add_request(_req(prompt[0], 12))
+    eng.step()
+    assert out.status == "running" and len(out.tokens) >= 1
+    delivered = list(out.tokens)
+    eng.params = _poison(params)  # the rot lands mid-flight
+    eng.run(max_ticks=10)
+    assert out.status == FAILED
+    assert out.finish_reason == FAIL_INTEGRITY
+    assert out.tokens == delivered  # nothing after the trip streamed
+    assert eng.integrity_trips == 1
+    assert eng.pool.n_free == eng.pool.n_slots
+    assert not eng.has_work()
+
+
+def test_nan_sentinel_escalates_replica_to_degraded(rng):
+    """The cluster view: a sentinel trip flips the replica HEALTHY ->
+    DEGRADED (routers deprioritize it) without killing it — an
+    escalation, not a death."""
+    from tpu_parallel.cluster.replica import DEGRADED, ReplicaHandle
+
+    cfg, model, prompt, params = _build(rng)
+    eng = ServingEngine(
+        model, _poison(params), n_slots=2, decode_steps_per_tick=1
+    )
+    handle = ReplicaHandle(0, eng)
+    handle.submit(_req(prompt[0], 4))
+    for _ in range(10):
+        handle.step()
+        if handle.health == DEGRADED:
+            break
+    assert handle.health == DEGRADED
+    assert eng.integrity_trips == 1
+    assert handle.open_requests == 0  # the failed request left the ledger
+
+
+@pytest.mark.parametrize("spec_steps", [1, 2])
+def test_nan_sentinel_spec_verify_path(rng, spec_steps):
+    """The sentinel covers speculative decoding too — per-step verify
+    AND the fused verify scan: weights rotting mid-stream under
+    draft-verify ticks fail the request typed instead of delivering an
+    argmax-over-NaN token chain."""
+    from tpu_parallel.serving import FAIL_INTEGRITY, FAILED
+
+    cfg, model, prompt, params = _build(rng)
+    eng = ServingEngine(
+        model, params, n_slots=2, draft_tokens=3,
+        decode_steps_per_tick=spec_steps,
+    )
+    out = eng.add_request(_req(prompt[0], 12))
+    eng.step()
+    assert out.status == "running" and len(out.tokens) >= 1
+    delivered = list(out.tokens)
+    eng.params = _poison(params)
+    eng.run(max_ticks=10)
+    assert out.status == FAILED
+    assert out.finish_reason == FAIL_INTEGRITY
+    assert out.tokens == delivered
+    assert eng.integrity_trips == 1
+    assert eng.pool.n_free == eng.pool.n_slots
+    assert not eng.has_work()

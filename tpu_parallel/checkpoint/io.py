@@ -24,6 +24,19 @@ import orbax.checkpoint as ocp
 Pytree = Any
 
 
+def _open_file(path, mode="r", **kwargs):
+    """Manifest reads/writes go through the injectable IO fault shim:
+    the weight-set integrity story (fingerprint verify on load) is only
+    as strong as the IO it reads through, and routing it here lets the
+    seeded disk-fault soak corrupt a manifest deterministically
+    (``scripts/check_io.py`` fences raw opens under this package).
+    Imported lazily so the checkpoint layer does not pull the daemon
+    package's import graph at module-import time."""
+    from tpu_parallel.daemon.iofaults import open_file
+
+    return open_file(path, mode, **kwargs)
+
+
 class Checkpointer:
     """Thin orbax wrapper bound to one run directory.
 
@@ -227,7 +240,7 @@ def save_serving_weights(
     path = _weights_dir(directory, step)
     with ocp.PyTreeCheckpointer() as ptc:
         ptc.save(path, args=ocp.args.PyTreeSave(params), force=True)
-    with open(_manifest_path(directory, step), "w") as fh:
+    with _open_file(_manifest_path(directory, step), "w") as fh:
         fh.write(manifest.to_json())
         fh.write("\n")
     return manifest
@@ -264,7 +277,7 @@ def load_serving_weights(
     mpath = _manifest_path(directory, step)
     if not os.path.exists(mpath):
         raise FileNotFoundError(f"no weight manifest at {mpath}")
-    with open(mpath) as fh:
+    with _open_file(mpath) as fh:
         manifest = WeightManifest.from_json(fh.read())
     path = _weights_dir(directory, step)
     with ocp.PyTreeCheckpointer() as ptc:

@@ -314,6 +314,9 @@ class ReplicaHandle:
         # KV blocks warm-started into this replica's prefix cache at
         # scale-up (cluster/migration.py; 0 = cold or no radix cache)
         self.kv_warm_blocks = 0
+        # engine integrity_trips watermark: a tick that trips the
+        # NaN/Inf sentinel escalates this replica to DEGRADED health
+        self._integrity_seen = 0
         # engine request_id -> live engine RequestOutput; pruned as
         # requests reach a terminal state
         self._ledger: Dict[str, RequestOutput] = {}
@@ -445,6 +448,17 @@ class ReplicaHandle:
         except Exception as exc:  # engine state unknown: replica is gone
             self.health = DEAD
             raise ReplicaDead(self.replica_id, repr(exc)) from exc
+        trips = getattr(self.engine, "integrity_trips", 0)
+        if trips > self._integrity_seen:
+            # the NaN/Inf sentinel tripped this tick: the affected
+            # request already FAILED typed; the replica escalates to
+            # DEGRADED so routers deprioritize an engine producing
+            # non-finite logits (the watchdog restores HEALTHY if
+            # subsequent work progresses cleanly — an escalation, not
+            # a death sentence)
+            self._integrity_seen = trips
+            if self.health == HEALTHY:
+                self.health = DEGRADED
         self._prune()
         return events
 
@@ -490,6 +504,7 @@ class ReplicaHandle:
         engine = self.engine_factory()  # may raise: handle stays as-is
         self.engine = engine
         self._ledger.clear()
+        self._integrity_seen = 0  # the fresh engine's counter restarts
         self.incarnation_ticks = 0
         self.restarts += 1
         self.health = PROBATION

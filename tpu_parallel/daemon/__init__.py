@@ -14,11 +14,20 @@ from tpu_parallel.daemon.daemon import (
     DAEMON_TRACK,
     EXIT_CLEAN,
     EXIT_FORCED,
+    REJECT_DEGRADED,
+    REJECT_JOURNAL,
     DaemonConfig,
     ServingDaemon,
 )
 from tpu_parallel.daemon.http import DaemonHTTPServer, build_request
+from tpu_parallel.daemon.iofaults import (
+    IOFaultInjector,
+    IOFaultPlan,
+)
 from tpu_parallel.daemon.journal import (
+    CORRUPT_CRC,
+    CORRUPT_GARBAGE,
+    CORRUPT_SEQ,
     JOURNAL_VERSION,
     REC_DECISION,
     REC_META,
@@ -32,18 +41,25 @@ from tpu_parallel.daemon.journal import (
     JournalWriter,
     RecoveryState,
     drop_torn_tail,
+    encode_record,
     load_state,
     read_journal,
+    record_crc_ok,
     replay_state,
 )
 from tpu_parallel.daemon.wallclock import WallClock
 
 __all__ = [
+    "CORRUPT_CRC",
+    "CORRUPT_GARBAGE",
+    "CORRUPT_SEQ",
     "DAEMON_TRACK",
     "EXIT_CLEAN",
     "EXIT_FORCED",
     "DaemonConfig",
     "DaemonHTTPServer",
+    "IOFaultInjector",
+    "IOFaultPlan",
     "JOURNAL_VERSION",
     "JournalCorrupt",
     "JournalEntry",
@@ -55,12 +71,16 @@ __all__ = [
     "REC_SUBMIT",
     "REC_TERMINAL",
     "REC_TOKENS",
+    "REJECT_DEGRADED",
+    "REJECT_JOURNAL",
     "RecoveryState",
     "ServingDaemon",
     "WallClock",
     "build_request",
     "drop_torn_tail",
+    "encode_record",
     "load_state",
     "read_journal",
+    "record_crc_ok",
     "replay_state",
 ]

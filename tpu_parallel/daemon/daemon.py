@@ -43,6 +43,7 @@ import threading
 from collections import deque
 from typing import Callable, Dict, List, Optional
 
+from tpu_parallel.daemon import iofaults
 from tpu_parallel.daemon.journal import (
     REC_DECISION,
     REC_RECOVERY,
@@ -72,6 +73,11 @@ DAEMON_TRACK = "daemon"  # tracer track for signals/recovery/shutdown
 EXIT_CLEAN = 0  # drained: every accepted request terminal, journal clean
 EXIT_FORCED = 1  # fast shutdown: open work recovers from the journal
 
+# typed degraded-mode rejection reasons (HTTP maps both to 503: the
+# balancer should route elsewhere, the client should retry elsewhere)
+REJECT_DEGRADED = "degraded"  # persistent journal failure: no new accepts
+REJECT_JOURNAL = "journal_error"  # THIS accept could not be made durable
+
 
 @dataclasses.dataclass(frozen=True)
 class DaemonConfig:
@@ -90,8 +96,19 @@ class DaemonConfig:
     - ``completed_retention``: terminal records (and their dedupe
       tokens) kept in memory for idempotent replies, oldest-evicted
       beyond it — the daemon's memory stays bounded at any uptime.
-      The journal keeps everything; only the in-RAM dedupe horizon is
-      bounded.
+      The retained horizon survives compaction; beyond it only the
+      in-RAM dedupe horizon ends.
+    - ``compact_interval_records``: once this many records have
+      appended since the last rotation, the journal COMPACTS — open
+      state snapshots into a fresh segment, retired records drop, so
+      restart replay reads O(open + retained) records instead of
+      O(lifetime).  0 disables rotation (the PR 14 unbounded-file
+      behavior).
+    - ``degrade_after_io_errors``: consecutive journal append/fsync
+      failures before the daemon enters DEGRADED mode — new
+      submissions refuse typed ``degraded`` (503), in-flight work
+      drains, ``/healthz`` flips 503 with the reason, and the process
+      stays up for its balancer instead of dying mid-accept.
     """
 
     grace_seconds: float = 30.0
@@ -99,6 +116,8 @@ class DaemonConfig:
     fsync_batch: int = 32
     reload_path: Optional[str] = None
     completed_retention: int = 50_000
+    compact_interval_records: int = 4096
+    degrade_after_io_errors: int = 3
 
     def __post_init__(self):
         if self.grace_seconds <= 0:
@@ -109,6 +128,23 @@ class DaemonConfig:
             raise ValueError(
                 f"completed_retention={self.completed_retention} < 1"
             )
+        if self.compact_interval_records < 0:
+            raise ValueError(
+                f"compact_interval_records="
+                f"{self.compact_interval_records} < 0"
+            )
+        if self.degrade_after_io_errors < 1:
+            raise ValueError(
+                f"degrade_after_io_errors="
+                f"{self.degrade_after_io_errors} < 1"
+            )
+
+
+def _submit_payload(rec: Dict) -> Dict:
+    """A journaled submit record minus its per-append stamps (``seq`` /
+    ``at`` / ``crc``) — the shape compaction re-journals with fresh
+    stamps into the new segment."""
+    return {k: v for k, v in rec.items() if k not in ("seq", "at", "crc")}
 
 
 class _DaemonRequest:
@@ -117,7 +153,7 @@ class _DaemonRequest:
 
     __slots__ = (
         "record", "dedupe_token", "base", "staged", "staged_index",
-        "terminal_staged", "subscribers", "out",
+        "terminal_staged", "subscribers", "out", "submit_rec",
     )
 
     def __init__(self, record: Dict, dedupe_token: Optional[str]):
@@ -129,6 +165,9 @@ class _DaemonRequest:
         self.terminal_staged = False
         self.subscribers: List[queue.Queue] = []
         self.out = None  # the live ClusterOutput (None once terminal)
+        # the journaled submit PAYLOAD (no seq/at/crc) — what compaction
+        # re-emits into the fresh segment so a restart can still replay
+        self.submit_rec: Optional[Dict] = None
 
 
 class ServingDaemon:
@@ -167,6 +206,11 @@ class ServingDaemon:
         self._draining = False
         self._drain_deadline: Optional[float] = None
         self._stopped = False
+        # degraded mode: persistent journal failure flips this to a
+        # typed reason — submissions refuse 503, /healthz exposes it,
+        # the process stays up (docs/13_daemon.md degraded contract)
+        self._degraded_reason: Optional[str] = None
+        self._io_errors = 0  # consecutive journal append/fsync failures
         # signal flags — handlers only flip these (async-signal-safe);
         # the run loop acts on them
         self._drain_requested = False
@@ -182,13 +226,25 @@ class ServingDaemon:
         )
         self._m_ticks = r.counter("daemon_ticks_total")
         self._m_accepted = r.counter("daemon_accepted_total")
+        self._m_io_errors = r.counter(
+            "daemon_journal_integrity_io_errors_total"
+        )
+        self._m_truncated = r.counter(
+            "daemon_journal_integrity_truncated_bytes_total"
+        )
+        self._m_compactions = r.counter("daemon_journal_compactions_total")
+        self._m_degraded_rejects = r.counter(
+            "daemon_degraded_rejects_total"
+        )
         # observed swap/autopilot decisions flow through the frontend's
         # journal hook into REC_DECISION records
         self.frontend.set_journal(self._frontend_note)
         # drop a torn final record BEFORE reading: recovery must act on
         # exactly what stays durable, and appending after a fragment
         # would turn tolerable tail damage into mid-file corruption
-        drop_torn_tail(journal_path)
+        truncated = drop_torn_tail(journal_path)
+        if truncated:
+            self._m_truncated.inc(truncated)
         state = load_state(journal_path)
         self.journal = JournalWriter(
             journal_path, self.clock,
@@ -201,23 +257,91 @@ class ServingDaemon:
     # -- journal plumbing --------------------------------------------------
 
     def _append(self, rec: Dict) -> Dict:
+        """Journal one record, with IO-failure accounting: an
+        ``OSError`` (injected or real — the record is NOT in the
+        journal, see ``JournalWriter.append``'s failure contract)
+        counts toward the degraded-mode threshold and re-raises for the
+        call site to refuse typed."""
         before = self.journal.fsyncs
-        out = self.journal.append(rec)
+        try:
+            out = self.journal.append(rec)
+        except OSError as exc:
+            self._m_fsyncs.inc(max(0, self.journal.fsyncs - before))
+            self._note_io_error(repr(exc))
+            raise
+        self._io_errors = 0
         self._m_records.inc()
         self._m_fsyncs.inc(self.journal.fsyncs - before)
         return out
 
     def _sync(self) -> None:
-        if self.journal.sync():
-            self._m_fsyncs.inc()
+        try:
+            if self.journal.sync():
+                self._m_fsyncs.inc()
+                self._io_errors = 0
+        except OSError as exc:
+            # the barrier failed but every record is still in the file
+            # (and the OS cache): retried next tick — persistent
+            # failure crosses the degraded threshold
+            self._note_io_error(repr(exc))
+
+    def _note_io_error(self, detail: str) -> None:
+        """One journal IO failure: counted, and past
+        ``degrade_after_io_errors`` consecutive failures (or a wedged
+        writer) the daemon enters DEGRADED mode instead of dying."""
+        self._io_errors += 1
+        self._m_io_errors.inc()
+        if self._degraded_reason is None and (
+            self.journal.wedged
+            or self._io_errors >= self.config.degrade_after_io_errors
+        ):
+            self._enter_degraded("journal_io", detail)
+
+    def _enter_degraded(self, reason: str, detail: str) -> None:
+        """Typed degraded mode: new submissions refuse 503
+        (``REJECT_DEGRADED``), in-flight work drains through the
+        frontend gate, ``/healthz``/``/statez`` expose the reason, and
+        the process STAYS UP — a daemon that dies mid-accept strands
+        its balancer; one that drains and reports lets the fleet route
+        around it.  SIGTERM still drains exit 0 from here."""
+        self._degraded_reason = reason
+        self.registry.counter("daemon_degraded_total", reason=reason).inc()
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "degraded", track=DAEMON_TRACK, reason=reason,
+                detail=detail,
+            )
+        try:
+            # best-effort: the disk that caused this may refuse the note
+            self._append({
+                "record": REC_DECISION, "kind": "degraded",
+                "reason": reason, "detail": detail,
+            })
+        except OSError:
+            pass
+        # close the admission gate and drain in-flight work; the pump
+        # keeps ticking (and the journal keeps retrying its barrier)
+        self.frontend.drain(max_ticks=0)
+
+    @property
+    def degraded_reason(self) -> Optional[str]:
+        return self._degraded_reason
 
     def _frontend_note(self, kind: str, payload: Dict) -> None:
         """Frontend journal hook: operator-grade decisions (swap
         rollouts, autopilot actions, drain begin) become DECISION
         records.  Per-request submit/terminal hooks are ignored here —
-        the daemon journals those itself with dedupe context."""
+        the daemon journals those itself with dedupe context.  Best
+        effort: an audit-trail append on failing media must not turn a
+        drain (or any frontend action) into a crash — the failure
+        still counts toward the degraded threshold via ``_append``."""
         if kind in ("swap_begin", "autopilot_action", "drain_begin"):
-            self._append({"record": REC_DECISION, "kind": kind, **payload})
+            try:
+                self._append(
+                    {"record": REC_DECISION, "kind": kind, **payload}
+                )
+            except OSError:
+                pass
 
     # -- recovery ----------------------------------------------------------
 
@@ -230,6 +354,7 @@ class ServingDaemon:
         for entry in state.finished:
             rec = self._completed_record(entry)
             dr = _DaemonRequest(rec, entry.dedupe_token)
+            dr.submit_rec = _submit_payload(entry.submit)
             self._register(dr)
             self._note_terminal(dr, was_open=False)
         for entry in state.unfinished:
@@ -256,6 +381,7 @@ class ServingDaemon:
                 record["status"] = FINISHED
                 record["finish_reason"] = reason
                 dr = _DaemonRequest(record, entry.dedupe_token)
+                dr.submit_rec = _submit_payload(sub)
                 self._register(dr)
                 self._note_terminal(dr, was_open=False)
                 self._append({
@@ -268,6 +394,7 @@ class ServingDaemon:
                 self._m_recovered_done.inc()
                 continue
             dr = _DaemonRequest(record, entry.dedupe_token)
+            dr.submit_rec = _submit_payload(sub)
             self._register(dr)
             req = Request(
                 prompt=list(sub["prompt"]) + delivered,
@@ -376,6 +503,17 @@ class ServingDaemon:
                 "tokens": [],
                 "recovered": False,
             }
+            if self._degraded_reason is not None:
+                # the durability substrate is gone: refusing typed (the
+                # HTTP layer maps this to 503) beats acknowledging work
+                # a dead journal cannot promise to keep
+                self._m_degraded_rejects.inc()
+                record["status"] = REJECTED
+                record["finish_reason"] = REJECT_DEGRADED
+                record["detail"] = (
+                    f"daemon degraded: {self._degraded_reason}"
+                )
+                return record
             dr = _DaemonRequest(record, dedupe_token)
             request.on_token = self._make_on_token(dr)
             now = self.clock()
@@ -387,39 +525,50 @@ class ServingDaemon:
                 return record  # rejections are not journaled/deduped
             dr.out = out
             sampling = request.sampling
+            payload = {
+                "record": REC_SUBMIT,
+                "request_id": request.request_id,
+                "dedupe_token": dedupe_token,
+                "client_id": request.client_id,
+                # trace-schema workload fields (serve_bench
+                # --workload replays journals like traces)
+                "arrival": round(now, 6),
+                "prompt": [int(t) for t in request.prompt],
+                "prompt_len": len(request.prompt),
+                "prefix_group": 0,
+                "priority": request.priority,
+                "deadline": request.deadline,
+                "max_new_tokens": request.max_new_tokens,
+                "eos_token_id": request.eos_token_id,
+                "sampling": {
+                    "temperature": sampling.temperature,
+                    "top_k": sampling.top_k,
+                    "top_p": sampling.top_p,
+                },
+            }
             try:
-                self._append({
-                    "record": REC_SUBMIT,
-                    "request_id": request.request_id,
-                    "dedupe_token": dedupe_token,
-                    "client_id": request.client_id,
-                    # trace-schema workload fields (serve_bench
-                    # --workload replays journals like traces)
-                    "arrival": round(now, 6),
-                    "prompt": [int(t) for t in request.prompt],
-                    "prompt_len": len(request.prompt),
-                    "prefix_group": 0,
-                    "priority": request.priority,
-                    "deadline": request.deadline,
-                    "max_new_tokens": request.max_new_tokens,
-                    "eos_token_id": request.eos_token_id,
-                    "sampling": {
-                        "temperature": sampling.temperature,
-                        "top_k": sampling.top_k,
-                        "top_p": sampling.top_p,
-                    },
-                })
-            except Exception:
+                self._append(payload)
+            except OSError as exc:
                 # an accept we cannot make durable must not exist: the
-                # frontend admission is withdrawn before the error
-                # surfaces, so no un-journaled request keeps generating
-                # and no dedupe entry vouches for it
+                # frontend admission is withdrawn (so no un-journaled
+                # request keeps generating and no dedupe entry vouches
+                # for it) and the refusal is TYPED — the append failure
+                # already counted toward the degraded threshold
                 self.frontend.cancel(
-                    request.request_id, reason="journal_error"
+                    request.request_id, reason=REJECT_JOURNAL
+                )
+                record["status"] = REJECTED
+                record["finish_reason"] = REJECT_JOURNAL
+                record["detail"] = repr(exc)
+                return record
+            except Exception:
+                self.frontend.cancel(
+                    request.request_id, reason=REJECT_JOURNAL
                 )
                 raise
             # registered only AFTER the durable append: a failed write
             # leaves no acknowledged-but-undurable state behind
+            dr.submit_rec = payload
             self._register(dr)
             self._open_count += 1
             self._m_accepted.inc()
@@ -499,31 +648,43 @@ class ServingDaemon:
     def _flush_dirty(self) -> None:
         """Journal this tick's deliveries: one TOKENS record per request
         with new tokens, then its TERMINAL record when it ended — order
-        within a request is what replay correctness rides on."""
-        for rid in self._dirty:
+        within a request is what replay correctness rides on.  An IO
+        failure mid-flush keeps the unflushed remainder staged (the
+        failed append left nothing in the journal, so the next tick
+        retries exactly the missing records — token records fold by
+        index, so even an overlap would be idempotent)."""
+        rids = list(self._dirty)
+        self._dirty = {}
+        for i, rid in enumerate(rids):
             dr = self._requests.get(rid)
             if dr is None:
                 continue
-            if dr.staged:
-                self._append({
-                    "record": REC_TOKENS,
-                    "request_id": rid,
-                    "index": dr.staged_index,
-                    "tokens": dr.staged,
-                })
-                dr.staged_index += len(dr.staged)
-                dr.staged = []
-            if dr.terminal_staged:
-                rec = dr.record
-                self._append({
-                    "record": REC_TERMINAL,
-                    "request_id": rid,
-                    "status": rec["status"],
-                    "finish_reason": rec["finish_reason"],
-                    "n_tokens": len(rec["tokens"]),
-                })
-                dr.terminal_staged = False
-        self._dirty = {}
+            try:
+                if dr.staged:
+                    self._append({
+                        "record": REC_TOKENS,
+                        "request_id": rid,
+                        "index": dr.staged_index,
+                        "tokens": dr.staged,
+                    })
+                    dr.staged_index += len(dr.staged)
+                    dr.staged = []
+                if dr.terminal_staged:
+                    rec = dr.record
+                    self._append({
+                        "record": REC_TERMINAL,
+                        "request_id": rid,
+                        "status": rec["status"],
+                        "finish_reason": rec["finish_reason"],
+                        "n_tokens": len(rec["tokens"]),
+                    })
+                    dr.terminal_staged = False
+            except OSError:
+                # this record and everything after it stays dirty; the
+                # error already counted toward the degraded threshold
+                for rest in rids[i:]:
+                    self._dirty[rest] = None
+                return
 
     def _terminal_now(
         self, dr: _DaemonRequest, status: str, reason: Optional[str],
@@ -545,6 +706,47 @@ class ServingDaemon:
             "n_tokens": len(rec["tokens"]),
         })
 
+    def _compact(self) -> None:
+        """Journal segment rotation: snapshot the live state (every
+        retained request's submit payload, durable token prefix, and
+        terminal when it has one — all record kinds replay already
+        understands) into a fresh segment and retire the old one.
+        Restart replay after a long uptime reads O(open + retained)
+        records instead of O(lifetime).  Only called with the tick's
+        journal flushed (nothing staged), so the snapshot is exactly
+        the durable state."""
+        snapshot: List[Dict] = []
+        for rid, dr in self._requests.items():
+            if dr.submit_rec is None:
+                continue  # defensive: nothing replayable without it
+            snapshot.append(dict(dr.submit_rec))
+            toks = [int(t) for t in dr.record["tokens"]]
+            if toks:
+                snapshot.append({
+                    "record": REC_TOKENS, "request_id": rid,
+                    "index": 0, "tokens": toks,
+                })
+            if dr.out is None:  # terminal (finished/rejected/cancelled)
+                snapshot.append({
+                    "record": REC_TERMINAL, "request_id": rid,
+                    "status": dr.record["status"],
+                    "finish_reason": dr.record["finish_reason"],
+                    "n_tokens": len(toks),
+                })
+        try:
+            written = self.journal.rotate(snapshot)
+        except OSError as exc:
+            self._note_io_error(repr(exc))
+            return
+        self._io_errors = 0
+        self._m_compactions.inc()
+        self._m_records.inc(written)
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "compact", track=DAEMON_TRACK,
+                snapshot_records=written, open=self._open_count,
+            )
+
     # -- the pump ----------------------------------------------------------
 
     def tick(self) -> List[StreamEvent]:
@@ -555,6 +757,14 @@ class ServingDaemon:
             self._flush_dirty()
             self._sync()
             self._enforce_retention()
+            ci = self.config.compact_interval_records
+            if (
+                ci
+                and not self._dirty
+                and self._degraded_reason is None
+                and self.journal.records_since_rotate >= ci
+            ):
+                self._compact()
             self.ticks += 1
             self._m_ticks.inc()
             self.registry.gauge("daemon_open_requests").set(
@@ -562,6 +772,9 @@ class ServingDaemon:
             )
             self.registry.gauge("daemon_draining").set(
                 1.0 if self._draining else 0.0
+            )
+            self.registry.gauge("daemon_degraded").set(
+                0.0 if self._degraded_reason is None else 1.0
             )
             return events
 
@@ -612,18 +825,23 @@ class ServingDaemon:
         path = self.config.reload_path
 
         def decide(verdict, **extra):
-            # under the lock: HTTP submit threads append concurrently
+            # under the lock: HTTP submit threads append concurrently.
+            # Best effort — a reload verdict on failing media must not
+            # kill the pump (the failure still counts via _append).
             with self._lock:
-                self._append({
-                    "record": REC_DECISION, "kind": "reload",
-                    "verdict": verdict, **extra,
-                })
+                try:
+                    self._append({
+                        "record": REC_DECISION, "kind": "reload",
+                        "verdict": verdict, **extra,
+                    })
+                except OSError:
+                    pass
 
         if path is None:
             return decide("no_reload_path")
         import json as _json
         try:
-            with open(path, encoding="utf-8") as fh:
+            with iofaults.open_file(path, encoding="utf-8") as fh:
                 spec = _json.load(fh)
         except (OSError, ValueError) as exc:
             return decide("unreadable", detail=repr(exc))
@@ -635,21 +853,32 @@ class ServingDaemon:
                 step=spec.get("step"),
                 version=spec.get("version"),
             )
-            self._append({
-                "record": REC_DECISION, "kind": "reload",
-                "verdict": status.get("verdict") or status.get("state"),
-            })
+            try:
+                self._append({
+                    "record": REC_DECISION, "kind": "reload",
+                    "verdict": (
+                        status.get("verdict") or status.get("state")
+                    ),
+                })
+            except OSError:
+                pass
 
     def _shutdown(self, clean: bool) -> int:
         with self._lock:
             self._stopped = True
             open_req = self._open_count
+            # a degraded (dead-disk) exit must still honor the signal
+            # contract: the exit CODE is the promise, the shutdown
+            # record is best-effort on media that may refuse it
             self._flush_dirty()
-            self._append({
-                "record": REC_SHUTDOWN, "clean": clean,
-                "open_requests": open_req,
-            })
-            self.journal.close()
+            try:
+                self._append({
+                    "record": REC_SHUTDOWN, "clean": clean,
+                    "open_requests": open_req,
+                })
+                self.journal.close()
+            except OSError:
+                pass
         if self.tracer.enabled:
             self.tracer.instant(
                 "shutdown", track=DAEMON_TRACK, clean=clean,
@@ -692,6 +921,7 @@ class ServingDaemon:
             return {
                 "draining": self._draining,
                 "stopped": self._stopped,
+                "degraded_reason": self._degraded_reason,
                 "ticks": self.ticks,
                 "open_requests": open_req,
                 "requests": len(self._requests),
@@ -701,5 +931,8 @@ class ServingDaemon:
                     "records": self.journal.records,
                     "fsyncs": self.journal.fsyncs,
                     "next_seq": self.journal.next_seq,
+                    "rotations": self.journal.rotations,
+                    "io_errors": int(self._m_io_errors.value),
+                    "wedged": self.journal.wedged,
                 },
             }

@@ -12,10 +12,13 @@ Endpoints (docs/13_daemon.md is the reference):
   "dedupe_token", "priority", "deadline", "client_id", "temperature",
   "top_k", "top_p", "eos_token_id"}``.  200 with the request record on
   accept (the submit is journal-durable before the response); typed
-  rejections map to 503 (``draining``) / 429 (everything else) with the
-  same record shape.  Dedupe-token replays return the existing record —
-  acknowledged work is idempotent across client retries and daemon
-  restarts.
+  rejections map to 503 (``draining`` / ``degraded`` /
+  ``journal_error`` — route elsewhere) / 429 (everything else) with
+  the same record shape.  Bodies over ``max_body_bytes`` are refused
+  413 WITHOUT reading them (a proxy misconfiguration or a hostile
+  client cannot make a handler thread buffer an unbounded payload).
+  Dedupe-token replays return the existing record — acknowledged work
+  is idempotent across client retries and daemon restarts.
 - ``GET /v1/stream/<id>`` — SSE: every already-delivered token replays
   first (``index`` continues across daemon restarts), then live events;
   the final event carries ``finished`` + the typed ``finish_reason``.
@@ -40,6 +43,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from tpu_parallel.daemon.daemon import REJECT_DEGRADED, REJECT_JOURNAL
 from tpu_parallel.obs.exporters import prometheus_text
 from tpu_parallel.serving.request import (
     REJECT_DRAINING,
@@ -49,8 +53,23 @@ from tpu_parallel.serving.request import (
 )
 
 # SSE subscriber poll period: how often a quiet stream wakes to emit a
-# heartbeat comment (which is also how a dead client is detected)
+# keep-alive comment — which keeps idle streams alive through proxies
+# that kill silent connections, AND bounds how long a disconnected
+# client can hold a stream before the write fails and cancels the
+# request (the default; DaemonHTTPServer's ``sse_keepalive_seconds``
+# overrides per server)
 _STREAM_POLL_SECONDS = 2.0
+
+# submit-body cap default: prompts are token-id lists, so even a
+# seq_len-8k prompt with maximal ids is far below this — anything
+# bigger is a misdirected upload, not a request
+_MAX_BODY_BYTES = 1 << 20
+
+# typed finish_reasons that map to 503 (route elsewhere / retry later)
+# rather than 429 (client-side backpressure)
+_UNAVAILABLE_REASONS = frozenset(
+    {REJECT_DRAINING, REJECT_DEGRADED, REJECT_JOURNAL}
+)
 
 
 def build_request(body: dict) -> Request:
@@ -82,6 +101,8 @@ def build_request(body: dict) -> Request:
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     daemon = None  # set by DaemonHTTPServer
+    max_body_bytes = _MAX_BODY_BYTES
+    keepalive_seconds = _STREAM_POLL_SECONDS
 
     # -- plumbing ----------------------------------------------------------
 
@@ -118,6 +139,20 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self):
         d = self.daemon
         if self.path == "/v1/submit":
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+            except ValueError:
+                length = -1
+            if length < 0 or length > self.max_body_bytes:
+                # refused WITHOUT reading the body: the unread bytes
+                # mean this connection cannot be reused
+                self.close_connection = True
+                return self._json(413, {
+                    "error": (
+                        f"body of {length} bytes exceeds the "
+                        f"{self.max_body_bytes}-byte submit limit"
+                    ),
+                })
             body = self._read_body()
             if body is None:
                 return self._json(400, {"error": "malformed JSON body"})
@@ -128,7 +163,8 @@ class _Handler(BaseHTTPRequestHandler):
             record = d.submit(req, dedupe_token=body.get("dedupe_token"))
             if record["status"] == REJECTED:
                 code = (
-                    503 if record["finish_reason"] == REJECT_DRAINING
+                    503
+                    if record["finish_reason"] in _UNAVAILABLE_REASONS
                     else 429
                 )
                 return self._json(code, record)
@@ -144,10 +180,16 @@ class _Handler(BaseHTTPRequestHandler):
         d = self.daemon
         if self.path == "/healthz":
             status = d.status()
-            code = 503 if status["draining"] or status["stopped"] else 200
+            unavailable = (
+                status["draining"]
+                or status["stopped"]
+                or status["degraded_reason"] is not None
+            )
+            code = 503 if unavailable else 200
             return self._json(code, {
                 "ok": code == 200,
                 "draining": status["draining"],
+                "degraded_reason": status["degraded_reason"],
                 "ticks": status["ticks"],
                 "recoveries": status["recoveries"],
             })
@@ -199,7 +241,7 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             while True:
                 try:
-                    ev = q.get(timeout=_STREAM_POLL_SECONDS)
+                    ev = q.get(timeout=self.keepalive_seconds)
                 except _queue.Empty:
                     # heartbeat: also probes whether the client is gone
                     self.wfile.write(b": keepalive\n\n")
@@ -232,8 +274,25 @@ class DaemonHTTPServer:
     served from a background thread so the daemon's ``run()`` pump owns
     the main thread (where the signal handlers live)."""
 
-    def __init__(self, daemon, host: str = "127.0.0.1", port: int = 0):
-        handler = type("_BoundHandler", (_Handler,), {"daemon": daemon})
+    def __init__(
+        self,
+        daemon,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        max_body_bytes: int = _MAX_BODY_BYTES,
+        sse_keepalive_seconds: float = _STREAM_POLL_SECONDS,
+    ):
+        if max_body_bytes < 1:
+            raise ValueError(f"max_body_bytes={max_body_bytes} < 1")
+        if sse_keepalive_seconds <= 0:
+            raise ValueError(
+                f"sse_keepalive_seconds={sse_keepalive_seconds} <= 0"
+            )
+        handler = type("_BoundHandler", (_Handler,), {
+            "daemon": daemon,
+            "max_body_bytes": max_body_bytes,
+            "keepalive_seconds": sse_keepalive_seconds,
+        })
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.httpd.daemon_threads = True
         self.host, self.port = self.httpd.server_address[:2]

@@ -45,6 +45,7 @@ Read-side ops (``extract``, ``stack_prefix``) copy and may be held.
 from __future__ import annotations
 
 import heapq
+import zlib
 from typing import List, Optional
 
 import jax
@@ -52,6 +53,37 @@ import jax.numpy as jnp
 from jax import lax
 
 from tpu_parallel.models.generate import beam_cache_batch_axis
+
+
+class KVIntegrityError(ValueError):
+    """Exported KV block bytes failed their checksum — the payload was
+    corrupted somewhere between ``export_blocks`` and the import (host
+    RAM rot, a truncated spill, a mangled wire transfer).  Serving such
+    blocks would be silently wrong attention for EVERY request sharing
+    the prefix; callers catch this, count a typed refusal, and fall
+    back to the bitwise recompute path."""
+
+
+def block_checksums(rows, count: int):
+    """Per-block CRC32 over exported host rows (the
+    :meth:`PagedCachePool.export_blocks` layout: one array per
+    block-axis leaf, block dim at axis 0).  Block ``b``'s checksum
+    chains every leaf's row bytes in flatten order, so any flipped bit
+    in any payload, position table or int8 scale changes it.  Computed
+    at EXPORT time (spill / migration capture) and verified at IMPORT
+    time (:meth:`PagedCachePool.import_stored`) — the
+    verify-or-recompute rule's cheap half."""
+    import numpy as np
+
+    out = []
+    for b in range(count):
+        crc = 0
+        for leaf in rows:
+            crc = zlib.crc32(
+                np.ascontiguousarray(leaf[b]).tobytes(), crc
+            )
+        out.append(crc)
+    return tuple(out)
 
 
 def _leaf_name(path) -> str:
@@ -923,7 +955,7 @@ class PagedCachePool:
                 jnp.asarray(idx),
             )
 
-    def import_stored(self, rows, count: int):
+    def import_stored(self, rows, count: int, checksums=None):
         """Allocate ``count`` fresh blocks — each with refcount 1, the
         STORE's reference, exactly like :meth:`snapshot_blocks`'s bumps —
         and land exported host rows in them via one batched upload +
@@ -931,10 +963,29 @@ class PagedCachePool:
         ``count`` blocks are available beyond in-flight slots'
         entitlements (the caller counts a typed restore/migration
         fallback instead of stealing blocks admission already promised).
-        The imported entry participates in normal sharing from here:
+
+        ``checksums`` (per-block CRC32s recorded at export time,
+        :func:`block_checksums`) are verified BEFORE any allocation or
+        device write: a mismatch raises :class:`KVIntegrityError` —
+        never lands unverified bytes — and the caller counts a typed
+        ``restore_failure``/``integrity`` refusal and recomputes.  The
+        imported entry participates in normal sharing from here:
         ``map_prefix`` bumps it per hit, ``free_stored`` releases it."""
         if count < 1:
             return ()
+        if checksums is not None:
+            got = block_checksums(rows, count)
+            want = tuple(int(c) for c in checksums[:count])
+            if len(want) < count or got != want:
+                bad = [
+                    i for i, (g, w) in enumerate(zip(got, want))
+                    if g != w
+                ] or list(range(len(want), count))
+                raise KVIntegrityError(
+                    f"KV import refused: block(s) {bad} of {count} fail "
+                    "their export checksum — corrupted bytes must "
+                    "recompute, never serve"
+                )
         if self.blocks_available() < count:
             return None
         blocks = tuple(self.allocator.alloc() for _ in range(count))

@@ -143,7 +143,9 @@ from tpu_parallel.obs.registry import MetricRegistry
 from tpu_parallel.obs.tracer import NULL_TRACER, Tracer
 from tpu_parallel.serving.cache_pool import (
     CachePool,
+    KVIntegrityError,
     PagedCachePool,
+    block_checksums,
     cache_partition_specs,
     default_block_fns,
     default_row_fns,
@@ -160,6 +162,7 @@ from tpu_parallel.serving.kv_hierarchy import (
     MIGRATE_ALREADY_CACHED,
     MIGRATE_IMPORTED,
     MIGRATE_INCOMPATIBLE,
+    MIGRATE_INTEGRITY,
     MIGRATE_NO_BLOCKS,
     MIGRATE_NO_KEY,
     MIGRATE_NO_PREFIX_CACHE,
@@ -171,6 +174,8 @@ from tpu_parallel.serving.kv_hierarchy import (
 from tpu_parallel.serving.prefix_cache import PrefixCache
 from tpu_parallel.serving.request import (
     CANCELLED,
+    FAIL_INTEGRITY,
+    FAILED,
     FINISHED,
     REJECT_CAPACITY,
     REJECTED,
@@ -218,6 +223,14 @@ def validate_same_shapes(old, new) -> None:
             )
 
 
+# device-side integrity sentinel: a sampled "token" of this value means
+# the row's logits contained NaN/Inf — the host fails the request typed
+# (``FAIL_INTEGRITY``) instead of streaming garbage.  Rides the existing
+# tick outputs at zero extra transfer cost (an int is an int); -2 can
+# never collide with real ids (>= 0) or the parked/pad value (-1).
+NON_FINITE_TOKEN = -2
+
+
 def sample_tokens(
     logits: jax.Array,
     rng: jax.Array,
@@ -236,14 +249,22 @@ def sample_tokens(
     filter math lives in ``spec_decode.filter_logits`` — the speculative
     rejection rule needs the SAME target distribution this sampler draws
     from, or spec-vs-nonspec would silently drift.
-    """
+
+    Integrity sentinel: a row whose logits contain ANY non-finite value
+    returns ``NON_FINITE_TOKEN`` instead of a sample — ``argmax`` over
+    NaN logits would otherwise return an arbitrary-but-valid token id
+    and the stream would continue as confident garbage.  One
+    ``isfinite`` reduce per row is noise next to the lm_head matmul
+    that produced the logits; finite rows are bitwise unchanged."""
     lf = logits.astype(jnp.float32)
     greedy = jnp.argmax(lf, axis=-1).astype(jnp.int32)
     # greedy rows take the argmax branch of the final where, so their
     # filtered (guard-divided) logits are never read
     x = filter_logits(lf, temperature, top_k, top_p)
     sampled = jax.random.categorical(rng, x, axis=-1).astype(jnp.int32)
-    return jnp.where(temperature > 0.0, sampled, greedy)
+    out = jnp.where(temperature > 0.0, sampled, greedy)
+    finite = jnp.isfinite(lf).all(axis=-1)
+    return jnp.where(finite, out, jnp.int32(NON_FINITE_TOKEN))
 
 
 def _full_last_logits(cfg, params, hidden, last_idx=None):
@@ -372,7 +393,12 @@ def _fused_decode_core(
         nxt = sample_tokens(logits, step_rng, temp, topk, topp)
         emitted = jnp.where(live, nxt, -1)
         budget = budget - live.astype(budget.dtype)
-        done = live & ((nxt == eos) | (budget <= 0))
+        # the NaN/Inf sentinel stops the slot exactly like EOS: steps
+        # past non-finite logits are garbage, so the slot parks and the
+        # emitted sentinel (counted below) lets the host fail it typed
+        done = live & (
+            (nxt == eos) | (budget <= 0) | (nxt == NON_FINITE_TOKEN)
+        )
         adv = live.astype(pos.dtype)
         pos = pos + adv
         widx = widx + adv
@@ -384,7 +410,10 @@ def _fused_decode_core(
         body, (tok, pos, widx, live, budget, cache),
         jax.random.split(rng, steps),
     )
-    counts = (block >= 0).sum(axis=0).astype(jnp.int32)
+    # every live-emitted position counts — including the sentinel (-2),
+    # which is a PROGRESS signal (the typed failure) even though it is
+    # not a token; parked steps emit -1 and live steps never do
+    counts = (block != -1).sum(axis=0).astype(jnp.int32)
     return block, counts, (tok, pos, widx, live, budget), cache
 
 
@@ -420,6 +449,18 @@ def _verify_core(
     logits = _full_logits(model.config, params, hidden)
     out_tokens, accepted = verify_tokens(
         drafts, draft_len, logits, rng, temperature, top_k, top_p
+    )
+    # integrity sentinel, spec edition: argmax over NaN logits returns
+    # an arbitrary-but-valid token — screen every offset the acceptance
+    # rule can reach (<= draft_len; pad offsets carry garbage BY DESIGN
+    # and must not trip it) and emit the sentinel row instead
+    finite = jnp.where(
+        offs <= draft_len[:, None],
+        jnp.isfinite(logits).all(axis=-1),
+        True,
+    ).all(axis=1)
+    out_tokens = jnp.where(
+        finite[:, None], out_tokens, jnp.int32(NON_FINITE_TOKEN)
     )
     return out_tokens, accepted, cache
 
@@ -569,6 +610,17 @@ def _fused_spec_core(
         out_tokens, accepted = verify_tokens(
             drafts, dlen, logits, step_rng, temp, topk, topp
         )
+        # integrity sentinel (same screen as _verify_core): a row whose
+        # reachable verify logits went non-finite emits the sentinel and
+        # stops — blocks after NaN are garbage by definition
+        finite = jnp.where(
+            offs <= dlen[:, None],
+            jnp.isfinite(logits).all(axis=-1),
+            True,
+        ).all(axis=1)
+        out_tokens = jnp.where(
+            finite[:, None], out_tokens, jnp.int32(NON_FINITE_TOKEN)
+        )
         # delivery truncation, the per-step host loop's law: accepted + 1
         # tokens, cut at the first EOS; a length finish only ever lands
         # on the block's last token (draft_for_row's budget clamp)
@@ -583,7 +635,7 @@ def _fused_spec_core(
         e = jnp.where(live, e, 0)
         emitted = jnp.where(offs < e[:, None], out_tokens, -1)
         new_budget = budget - e
-        done = live & (is_eos.any(axis=1) | (new_budget <= 0))
+        done = live & (is_eos.any(axis=1) | (new_budget <= 0) | ~finite)
         # history gains the block's accepted + bonus tokens at columns
         # pos + 1 + j (out-of-range targets for dead rows drop)
         for j in range(k + 1):
@@ -1193,6 +1245,9 @@ class ServingEngine:
         else:
             self.metrics = ServingMetrics(registry=registry)
         self.registry = self.metrics.registry
+        # NaN/Inf sentinel trips (typed per-request integrity failures);
+        # the cluster's ReplicaHandle watches this for DEGRADED health
+        self.integrity_trips = 0
         if isinstance(scheduler, FIFOScheduler):
             self.scheduler = scheduler
             if self.scheduler.registry is None:
@@ -2041,13 +2096,15 @@ class ServingEngine:
         blocks = [int(self.pool.block_table[slot, j]) for j in range(n)]
         if any(b < 0 for b in blocks):
             return None  # belt and braces: written columns are mapped
+        leaves = tuple(self.pool.export_blocks(blocks))
         return KVPrefixExport(
             tokens=ctx[: n * bt],
             length=n * bt,
             block_tokens=bt,
             weights_version=self.weights_version,
             meta=self.pool.export_meta,
-            leaves=tuple(self.pool.export_blocks(blocks)),
+            leaves=leaves,
+            checksums=block_checksums(list(leaves), n),
         )
 
     def export_hot_prefixes(
@@ -2061,6 +2118,7 @@ class ServingEngine:
         out = []
         meta = self.pool.export_meta
         for tokens, blocks in self._radix.hottest_chains(max_blocks):
+            leaves = tuple(self.pool.export_blocks(list(blocks)))
             out.append(
                 KVPrefixExport(
                     tokens=tokens,
@@ -2068,7 +2126,8 @@ class ServingEngine:
                     block_tokens=self.pool.block_tokens,
                     weights_version=self.weights_version,
                     meta=meta,
-                    leaves=tuple(self.pool.export_blocks(list(blocks))),
+                    leaves=leaves,
+                    checksums=block_checksums(list(leaves), len(blocks)),
                 )
             )
         return out
@@ -2099,9 +2158,16 @@ class ServingEngine:
         if self._radix is not None:
             if self._radix.covers(tokens, export.length):
                 return MIGRATE_ALREADY_CACHED
-            blocks = self.pool.import_stored(
-                list(export.leaves), export.n_blocks
-            )
+            try:
+                blocks = self.pool.import_stored(
+                    list(export.leaves), export.n_blocks,
+                    checksums=export.checksums or None,
+                )
+            except KVIntegrityError:
+                # the export's bytes rotted in transit/at rest: typed
+                # refusal — the replay recomputes bitwise instead of
+                # serving corrupted attention to every sharer
+                return MIGRATE_INTEGRITY
             if blocks is None:
                 return MIGRATE_NO_BLOCKS
             dupes = self._radix.insert(tokens, blocks)
@@ -2120,9 +2186,15 @@ class ServingEngine:
         if key in self._prefix:
             return MIGRATE_ALREADY_CACHED
         need = self.pool.blocks_needed(width)
-        blocks = self.pool.import_stored(
-            [leaf[:need] for leaf in export.leaves], need
-        )
+        try:
+            blocks = self.pool.import_stored(
+                [leaf[:need] for leaf in export.leaves], need,
+                checksums=(
+                    export.checksums[:need] if export.checksums else None
+                ),
+            )
+        except KVIntegrityError:
+            return MIGRATE_INTEGRITY
         if blocks is None:
             return MIGRATE_NO_BLOCKS
         if not self._prefix.store_one(key, width, blocks):
@@ -3109,6 +3181,11 @@ class ServingEngine:
             for t in range(c):
                 event = self._deliver(int(slot), int(block[t, slot]))
                 events.append(event)
+                if event.finish_reason == FAIL_INTEGRITY:
+                    # the sentinel tripped mid-block: the scan kept
+                    # running (liveness is in-carry), but everything
+                    # after non-finite logits is garbage by definition
+                    break
                 if event.finished and t != c - 1:
                     # the scan stopped emitting AT the finish: the
                     # device's EOS/budget logic and _deliver's must agree
@@ -3442,6 +3519,8 @@ class ServingEngine:
                     event = self._deliver(slot, int(tok))
                     events.append(event)
                     delivered += 1
+                    if event.finish_reason == FAIL_INTEGRITY:
+                        break  # the sentinel: surplus is garbage
                     if event.finished:
                         if delivered != e:
                             # the scan truncated AT the finish: its
@@ -3477,9 +3556,48 @@ class ServingEngine:
             self.metrics.record_unified_tick(p.chunk_tokens + delivered)
         return events
 
+    def _fail_integrity(self, slot: int) -> StreamEvent:
+        """The device sampled the NaN/Inf sentinel for this slot: fail
+        the request TYPED (``FAIL_INTEGRITY``) and release the slot —
+        the one thing this path must never do is deliver a token.  The
+        cluster's :class:`ReplicaHandle` escalates the replica to
+        DEGRADED health on the trip, so routers deprioritize an engine
+        producing non-finite logits while its in-flight peers finish."""
+        out = self._slot_out[slot]
+        req = out.request
+        self.release_slot(slot)
+        out.status = FAILED
+        out.finish_reason = FAIL_INTEGRITY
+        out.detail = (
+            "non-finite logits (NaN/Inf) at sampling — refusing to "
+            "stream garbage tokens"
+        )
+        out.finish_time = self.clock()
+        self.integrity_trips += 1
+        self.metrics.record_integrity_trip()
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "integrity_trip", track=f"slot {slot}",
+                request_id=req.request_id,
+            )
+        event = StreamEvent(
+            request_id=req.request_id,
+            token=-1,
+            index=-1,
+            finished=True,
+            finish_reason=FAIL_INTEGRITY,
+        )
+        if req.on_token is not None:
+            req.on_token(event)
+        return event
+
     def _deliver(self, slot: int, token: int) -> StreamEvent:
         """Record one generated token for the request in ``slot``; retire
-        the slot when the token finishes the request (EOS or length)."""
+        the slot when the token finishes the request (EOS or length).
+        The device-side sentinel (``NON_FINITE_TOKEN``) never counts as
+        a token: it reroutes to the typed integrity failure."""
+        if token == NON_FINITE_TOKEN:
+            return self._fail_integrity(slot)
         out = self._slot_out[slot]
         req = out.request
         now = self.clock()

@@ -73,6 +73,11 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from tpu_parallel.serving.cache_pool import (
+    KVIntegrityError,
+    block_checksums,
+)
+
 # typed verdicts for an export landing in an engine
 # (``ServingEngine.import_prefix``); the cluster frontend counts one
 # ``cluster_kv_migrations_total{status=...}`` per attempt.  Everything
@@ -86,6 +91,7 @@ MIGRATE_NO_BLOCKS = "no_blocks"  # target pool too tight right now
 MIGRATE_NO_KEY = "no_key"  # no bucket key fits (aligned-LRU target)
 MIGRATE_INCOMPATIBLE = "incompatible"  # block size / leaf shapes differ
 MIGRATE_WEIGHTS_VERSION = "weights_version"  # KV from other weights
+MIGRATE_INTEGRITY = "integrity"  # payload failed its export checksum
 MIGRATION_STATUSES = (
     MIGRATE_IMPORTED,
     MIGRATE_ALREADY_CACHED,
@@ -95,6 +101,7 @@ MIGRATION_STATUSES = (
     MIGRATE_NO_KEY,
     MIGRATE_INCOMPATIBLE,
     MIGRATE_WEIGHTS_VERSION,
+    MIGRATE_INTEGRITY,
 )
 
 
@@ -112,7 +119,12 @@ class KVPrefixExport:
     shape signature and ``weights_version`` the weight set the K/V was
     computed under — importers refuse on either mismatch, because a
     shape-compatible import under different weights would CONTINUE the
-    stream with silently wrong attention reads."""
+    stream with silently wrong attention reads.  ``checksums`` is one
+    CRC32 per block, computed over the leaves AT EXPORT (:func:`~tpu_
+    parallel.serving.cache_pool.block_checksums`): a bit flipped in
+    transit or at rest is a typed ``integrity`` refusal at import, not
+    wrong attention for every request sharing the prefix (empty = a
+    legacy export; verified when present)."""
 
     tokens: Tuple[int, ...]
     length: int
@@ -120,21 +132,34 @@ class KVPrefixExport:
     weights_version: str
     meta: tuple
     leaves: tuple
+    checksums: Tuple[int, ...] = ()
 
     @property
     def n_blocks(self) -> int:
         return self.length // self.block_tokens
+
+    def verified(self) -> bool:
+        """Recompute the leaf checksums against ``checksums`` — True
+        when absent (legacy export) or matching."""
+        if not self.checksums:
+            return True
+        return (
+            block_checksums(list(self.leaves), self.n_blocks)
+            == tuple(self.checksums)
+        )
 
 
 class _Node:
     """One radix-tree node == one KV block.  ``run`` is the
     ``block_tokens``-id edge from ``parent``; exactly one of ``block``
     (device-resident, holds one allocator reference) or ``host``
-    (offloaded leaf arrays, the export layout at k=1) is set."""
+    (offloaded leaf arrays, the export layout at k=1, with
+    ``host_crc`` recorded at spill time and verified before any
+    restore) is set."""
 
     __slots__ = (
-        "run", "parent", "children", "block", "host", "hits", "last_use",
-        "born",
+        "run", "parent", "children", "block", "host", "host_crc",
+        "hits", "last_use", "born",
     )
 
     def __init__(self, run, parent, born: int):
@@ -143,6 +168,7 @@ class _Node:
         self.children: Dict[tuple, "_Node"] = {}
         self.block: Optional[int] = None
         self.host: Optional[list] = None
+        self.host_crc: Optional[int] = None
         self.hits = 0
         self.last_use = born
         self.born = born
@@ -170,6 +196,8 @@ class RadixPrefixCache:
         max_device_blocks: int,
         host_capacity_blocks: int = 0,
         hit_recency_bonus: int = 8,
+        breaker_failures: int = 4,
+        breaker_probe_ops: int = 64,
     ):
         if max_device_blocks < 1:
             raise ValueError(
@@ -179,6 +207,10 @@ class RadixPrefixCache:
             raise ValueError(
                 f"host_capacity_blocks={host_capacity_blocks} < 0"
             )
+        if breaker_failures < 1:
+            raise ValueError(f"breaker_failures={breaker_failures} < 1")
+        if breaker_probe_ops < 1:
+            raise ValueError(f"breaker_probe_ops={breaker_probe_ops} < 1")
         self.pool = pool
         self.block_tokens = int(pool.block_tokens)
         self.max_device_blocks = int(max_device_blocks)
@@ -186,6 +218,17 @@ class RadixPrefixCache:
         # each hit is worth this many lookup/insert ops of recency in the
         # eviction score — the "frequency-aware" dial (0 = pure recency)
         self.hit_recency_bonus = int(hit_recency_bonus)
+        # host-tier circuit breaker: this many CONSECUTIVE restore
+        # failures (no blocks, or checksum-failed bytes) take the
+        # offload tier DOWN — no spills, no restores, device-only
+        # serving continues bitwise via recompute.  After
+        # ``breaker_probe_ops`` further cache ops the next host hit is
+        # a half-open PROBE: success closes the breaker, failure
+        # re-arms the timer.
+        self.breaker_failures = int(breaker_failures)
+        self.breaker_probe_ops = int(breaker_probe_ops)
+        self._consec_restore_failures = 0
+        self._tier_down_since: Optional[int] = None  # _seq at trip
         self._seq = 0  # monotone op counter: the deterministic recency axis
         self._root = _Node(None, None, 0)
         self.device_blocks = 0  # resident nodes == device refs held
@@ -199,6 +242,8 @@ class RadixPrefixCache:
         self.restored_blocks = 0  # host -> device restores (blocks)
         self.host_evictions = 0  # host copies dropped for good
         self.restore_failures = 0  # host hit unrestorable (no blocks)
+        self.integrity_failures = 0  # checksum-failed host bytes dropped
+        self.breaker_trips = 0  # times the host tier went down
 
     # -- PrefixCache-compatible surface ------------------------------------
 
@@ -213,6 +258,40 @@ class RadixPrefixCache:
         self.hits = self.misses = self.evictions = 0
         self.offloads = self.restored_blocks = 0
         self.host_evictions = self.restore_failures = 0
+        self.integrity_failures = self.breaker_trips = 0
+
+    # -- host-tier breaker -------------------------------------------------
+
+    @property
+    def host_tier_up(self) -> bool:
+        return self._tier_down_since is None
+
+    @property
+    def breaker_state(self) -> int:
+        """0 = closed (tier serving), 1 = open (tier down), 2 =
+        half-open (down, but the next host hit probes) — the
+        ``serving_kv_host_breaker_state`` gauge's encoding."""
+        if self._tier_down_since is None:
+            return 0
+        if self._seq - self._tier_down_since >= self.breaker_probe_ops:
+            return 2
+        return 1
+
+    def _restore_failed(self) -> None:
+        """One restore failure: counted, and past ``breaker_failures``
+        consecutive ones the host tier goes DOWN (a probe failure while
+        down re-arms the half-open timer)."""
+        self.restore_failures += 1
+        self._consec_restore_failures += 1
+        if self._tier_down_since is not None:
+            self._tier_down_since = self._seq  # failed probe: re-arm
+        elif self._consec_restore_failures >= self.breaker_failures:
+            self._tier_down_since = self._seq
+            self.breaker_trips += 1
+
+    def _restore_succeeded(self) -> None:
+        self._consec_restore_failures = 0
+        self._tier_down_since = None  # a successful probe closes it
 
     def lookup(
         self,
@@ -297,6 +376,7 @@ class RadixPrefixCache:
                 # copy is now redundant)
                 child.block = int(blocks[j])
                 child.host = None
+                child.host_crc = None
                 self.host_blocks_in_use -= 1
                 self.device_blocks += 1
             else:
@@ -397,31 +477,76 @@ class RadixPrefixCache:
 
     def _restore(self, chain, host_nodes, reserve: int = 0) -> int:
         """Restore the leading run of ``host_nodes`` to fresh device
-        blocks: one batched upload + scatter through the pool.  Restores
-        only what fits beyond ``reserve`` and the slots' entitlements —
-        a partial restore still extends the hit; a zero restore counts
-        one typed fallback.  Returns restored block count."""
+        blocks: checksum-verify each node's spilled bytes, then one
+        batched upload + scatter through the pool.  Restores only what
+        fits beyond ``reserve`` and the slots' entitlements — a partial
+        restore still extends the hit; a zero restore counts one typed
+        fallback.  A checksum-failed node is an ``integrity`` refusal:
+        its (unreachable-without-it) subtree drops and the lookup falls
+        back to the recompute path — corrupted bytes NEVER reach the
+        device.  While the breaker has the tier down, restores refuse
+        outright until the half-open window opens, and then admit one
+        probe.  Returns restored block count."""
+        state = self.breaker_state
+        if state == 1:
+            return 0  # tier down, probe window not open: recompute
+        if state == 2:
+            host_nodes = host_nodes[:1]  # half-open: ONE probe block
+        # verify the leading run BEFORE touching the pool: truncate at
+        # the first checksum-failed node (everything below it is
+        # unreachable without it anyway)
+        verified = []
+        corrupt = None
+        for node in host_nodes:
+            if node.host_crc is not None and (
+                block_checksums(node.host, 1)[0] != node.host_crc
+            ):
+                corrupt = node
+                break
+            verified.append(node)
+        if corrupt is not None:
+            self.integrity_failures += 1
+            self._drop_subtree(corrupt)
+            if not verified:
+                self._restore_failed()
+                return 0
         avail = self.pool.blocks_available() - int(reserve)
-        k = min(len(host_nodes), max(0, avail))
+        k = min(len(verified), max(0, avail))
         if k == 0:
-            self.restore_failures += 1
+            self._restore_failed()
             return 0
-        take = host_nodes[:k]
+        take = verified[:k]
         rows = [
             np.concatenate([n.host[i] for n in take], axis=0)
             for i in range(len(take[0].host))
         ]
-        blocks = self.pool.import_stored(rows, k)
+        try:
+            blocks = self.pool.import_stored(
+                rows, k,
+                checksums=[
+                    n.host_crc for n in take
+                ] if all(n.host_crc is not None for n in take) else None,
+            )
+        except KVIntegrityError:
+            # belt and braces: the pool's own verify disagreed (bytes
+            # rotted between our check and the upload staging).  The
+            # whole run drops — take[0]'s subtree contains the rest.
+            self.integrity_failures += 1
+            self._drop_subtree(take[0])
+            self._restore_failed()
+            return 0
         if blocks is None:
-            self.restore_failures += 1
+            self._restore_failed()
             return 0
         for node, blk in zip(take, blocks):
             node.block = int(blk)
             node.host = None
+            node.host_crc = None
             self.host_blocks_in_use -= 1
             self.device_blocks += 1
             node.last_use = self._seq
         self.restored_blocks += k
+        self._restore_succeeded()
         # restoring may overshoot the device budget: evict cold nodes,
         # never the chain the caller is about to map
         self._enforce_device(
@@ -455,12 +580,24 @@ class RadixPrefixCache:
         # only evicted-but-WARM blocks spill: a node nothing ever hit
         # (the typical case — a prompt's one-off suffix blocks) drops
         # outright, so the host tier holds reusable prefixes instead of
-        # churning PCIe copies on bytes no lookup will ever want back
-        spill = self.host_capacity > 0 and victim.hits > 0
+        # churning PCIe copies on bytes no lookup will ever want back.
+        # An OPEN breaker stops spills too — a tier that cannot restore
+        # is pure PCIe waste; the HALF-OPEN state re-admits them so the
+        # tier can repopulate and the next lookup's probe can prove it
+        # (corrupted host copies were dropped at detection, so the tier
+        # may be empty by the time the probe window opens).
+        spill = (
+            self.host_capacity > 0
+            and victim.hits > 0
+            and self.breaker_state != 1
+        )
         if spill and self.host_blocks_in_use >= self.host_capacity:
             spill = self._evict_host_one(colder_than=victim)
         if spill:
             victim.host = self.pool.export_blocks([victim.block])
+            # checksum at spill time: restore verifies against it, so
+            # host-RAM rot is a typed refusal, never wrong attention
+            victim.host_crc = block_checksums(victim.host, 1)[0]
             self.host_blocks_in_use += 1
             self.offloads += 1
         self.pool.free_stored((victim.block,))

@@ -118,6 +118,25 @@ class ServingMetrics:
         self._kv_restore_failures = r.gauge(
             "serving_kv_host_restore_failures"
         )
+        # transfer-integrity accounting (PR 15): checksum-failed
+        # spill/restore/import payloads (each one a typed refusal that
+        # fell back to recompute — NEVER served), plus the host-tier
+        # circuit breaker (K consecutive restore failures take the
+        # offload tier down; half-open re-probe restores it)
+        self._kv_integrity_failures = r.gauge(
+            "serving_kv_integrity_failures"
+        )
+        self._kv_breaker_state = r.gauge(
+            "serving_kv_host_breaker_state"
+        )
+        self._kv_breaker_trips = r.gauge(
+            "serving_kv_host_breaker_trips"
+        )
+        # device-side NaN/Inf sentinel trips: per-request typed
+        # integrity failures instead of streamed garbage
+        self._integrity_trips = r.counter(
+            "serving_integrity_trips_total"
+        )
         # speculative decode: drafted vs accepted tokens (acceptance rate
         # = the drafter's hit quality), and verify positions computed but
         # not delivered (pads + rejected drafts + post-finish surplus —
@@ -343,6 +362,11 @@ class ServingMetrics:
     def record_cancelled(self) -> None:
         self._cancelled.inc()
 
+    def record_integrity_trip(self) -> None:
+        """One device-side NaN/Inf sentinel trip: a request FAILED
+        typed ``integrity`` instead of streaming garbage tokens."""
+        self._integrity_trips.inc()
+
     def record_prefill_call(self, chunks: int = 0) -> None:
         """One batched prefill device call (``chunks`` counts any chunk
         continuations it was split into).  Every prefill call is also a
@@ -435,6 +459,9 @@ class ServingMetrics:
         self._kv_host_restored.set(radix.restored_blocks)
         self._kv_host_evictions.set(radix.host_evictions)
         self._kv_restore_failures.set(radix.restore_failures)
+        self._kv_integrity_failures.set(radix.integrity_failures)
+        self._kv_breaker_state.set(radix.breaker_state)
+        self._kv_breaker_trips.set(radix.breaker_trips)
 
     def seed_block_pool(self, pool) -> None:
         """Watermark a paged pool's CUMULATIVE COW/share tallies so this
@@ -512,6 +539,12 @@ class ServingMetrics:
             "kv_host_restore_failures": int(
                 self._kv_restore_failures.value
             ),
+            "kv_integrity_failures": int(
+                self._kv_integrity_failures.value
+            ),
+            "kv_host_breaker_state": int(self._kv_breaker_state.value),
+            "kv_host_breaker_trips": int(self._kv_breaker_trips.value),
+            "integrity_trips": int(self._integrity_trips.value),
             "finished": self.finished,
             "rejected": self.rejected,
             "expired": self.expired,

@@ -61,6 +61,11 @@ REJECT_CLIENT_LIMIT = "client_limit"  # per-client concurrency cap
 # unmeetable cancelled — while the fleet is past its SLO targets.  Shed
 # early and loudly beats missing every deadline silently.
 REJECT_SHED = "shed"
+# device-side integrity sentinel (engine ``sample_tokens``): a request's
+# logits went non-finite (NaN/Inf — corrupted weights, a numerics bug,
+# bad hardware).  The request FAILS typed instead of streaming garbage
+# tokens, and the replica escalates to DEGRADED health.
+FAIL_INTEGRITY = "integrity"
 
 
 @dataclasses.dataclass
