@@ -204,7 +204,7 @@ def _git_head():
         return "unknown"
 
 
-def _fail(phase, detail, elapsed):
+def _fail(phase, detail, elapsed, last_good_path=None):
     payload = {
         "metric": "tokens/sec/chip",
         "value": 0,
@@ -218,7 +218,7 @@ def _fail(phase, detail, elapsed):
     # fields say when/what was measured — it may predate the current code
     # state, so it documents hardware reachability, not current throughput.
     try:
-        with open(_LAST_GOOD) as f:
+        with open(last_good_path or _LAST_GOOD) as f:
             payload["last_good"] = json.load(f)
     except (OSError, ValueError):
         pass
@@ -226,9 +226,15 @@ def _fail(phase, detail, elapsed):
     sys.exit(3)
 
 
-def parent_main():
+def parent_main(run=_run, monotonic=time.monotonic, sleep=time.sleep,
+                last_good_path=None):
+    """Probe → bench → report.  ``run``/``monotonic``/``sleep`` are
+    injectable so the wedge paths are testable WITHOUT racing a wall
+    clock: the old subprocess test assumed a 1s probe timeout could
+    never be met, which a warm page cache disproves.  Production callers
+    pass nothing and get real time and real subprocesses."""
     budget = float(os.environ.get("BENCH_WATCHDOG_SECS", "1800"))
-    t_start = time.monotonic()
+    t_start = monotonic()
     py = sys.executable
 
     # Phase 1: probe.  Healthy first touch is seconds; 300s of silence means
@@ -236,31 +242,31 @@ def parent_main():
     # working run would need (the claim is already orphaned).
     probe_timeout = min(300.0, budget / 3)
     retry_pause = float(os.environ.get("BENCH_RETRY_PAUSE_SECS", "60"))
-    rc, out, wedged = _run([py, "-c", _PROBE_SRC], probe_timeout)
+    rc, out, wedged = run([py, "-c", _PROBE_SRC], probe_timeout)
     if wedged or rc != 0 or "BENCH-PROBE-OK" not in (out or ""):
         # One retry after a pause: transient relay hiccups (mid-handoff
         # claims) clear in under a minute; a real wedge does not.
-        time.sleep(retry_pause)
-        rc, out, wedged = _run([py, "-c", _PROBE_SRC], probe_timeout)
+        sleep(retry_pause)
+        rc, out, wedged = run([py, "-c", _PROBE_SRC], probe_timeout)
         if wedged or rc != 0 or "BENCH-PROBE-OK" not in (out or ""):
             detail = (
                 "transport wedged (probe hung)"
                 if wedged
                 else f"probe failed rc={rc}: {(out or '').strip()[-200:]}"
             )
-            _fail("probe", detail, time.monotonic() - t_start)
+            _fail("probe", detail, monotonic() - t_start, last_good_path)
 
     # Phase 2: the measurement, with one respawn.  Attempt 1 gets the bulk
     # of the budget (covers a fresh compile); the retry runs against a warm
     # persistent compile cache and needs far less.
     env = dict(os.environ, BENCH_CHILD="1")
     for attempt in (1, 2):
-        remaining = budget - (time.monotonic() - t_start)
+        remaining = budget - (monotonic() - t_start)
         if remaining < 60:
             _fail("bench", "budget exhausted before attempt "
-                  f"{attempt}", time.monotonic() - t_start)
+                  f"{attempt}", monotonic() - t_start, last_good_path)
         timeout = remaining * (0.7 if attempt == 1 else 1.0)
-        rc, out, wedged = _run([py, _SELF], timeout, env=env)
+        rc, out, wedged = run([py, _SELF], timeout, env=env)
         # Honor a result even when the child wedged AFTER printing it
         # (interpreter teardown can hang on the dead relay) — the
         # measurement itself is complete and valid.
@@ -280,7 +286,7 @@ def parent_main():
                     result["ts"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
                     result["commit"] = _git_head()
                     try:
-                        with open(_LAST_GOOD, "w") as f:
+                        with open(last_good_path or _LAST_GOOD, "w") as f:
                             json.dump(result, f, indent=1)
                     except OSError:
                         pass
@@ -288,14 +294,14 @@ def parent_main():
                 return
         if attempt == 1:
             # let a killed child's claim settle before respawn
-            time.sleep(min(30.0, retry_pause))
+            sleep(min(30.0, retry_pause))
     if wedged:
         detail = "child wedged (watchdog)"
     elif rc == 0:
         detail = f"child exited 0 but printed no usable result JSON: {(out or '').strip()[-200:]}"
     else:
         detail = f"child failed rc={rc}: {(out or '').strip()[-200:]}"
-    _fail("bench", detail, time.monotonic() - t_start)
+    _fail("bench", detail, monotonic() - t_start, last_good_path)
 
 
 if __name__ == "__main__":

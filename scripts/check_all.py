@@ -4,8 +4,8 @@ The repo grew four static checkers, one per PR, each wired into tier-1
 through its own copy of the same plumbing (import-from-scripts, run
 ``check_paths``, assert empty, self-test the catch path):
 
-- ``check_clock``  — serving/cluster code never reads wall time directly
-  (the injectable-clock contract).
+- ``check_clock``  — serving/cluster/daemon/fleet code never reads wall
+  time directly (the injectable-clock contract).
 - ``check_scopes`` — every collective in parallel/ + ops/ sits inside a
   ``jax.named_scope`` (labelable accelerator traces).
 - ``check_host_sync`` — no per-slot device sync inside a host loop under
@@ -54,8 +54,9 @@ CHECKERS: Dict[str, str] = {
         "hold references)"
     ),
     "check_io": (
-        "durability-critical file IO under daemon/ and checkpoint/ "
-        "routes through the iofaults shim (seeded disk-fault coverage)"
+        "durability-critical file IO under daemon/, checkpoint/ and "
+        "fleet/ routes through the iofaults shim (seeded disk-fault "
+        "coverage)"
     ),
 }
 
@@ -68,6 +69,11 @@ RUNTIME_CHECKS: Dict[str, str] = {
         "SIGTERM and exits 0 with a clean journal — and recovers a "
         "seeded disk-fault trial (tail corruption typed-detected, "
         "streams bitwise)"
+    ),
+    "check_fleet": (
+        "a fleet (router + 2 daemon processes) serves the daemon's "
+        "client contract, survives one seeded SIGKILL with bitwise "
+        "handoff, and lands at least one remote KV import"
     ),
 }
 

@@ -50,6 +50,7 @@ DEFAULT_PATHS = (
     "tpu_parallel/serving",
     "tpu_parallel/cluster",
     "tpu_parallel/daemon",
+    "tpu_parallel/fleet",
 )
 
 # the ONE file allowed to read wall time: the daemon's WallClock

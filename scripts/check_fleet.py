@@ -1,0 +1,53 @@
+"""Runtime gate: a multi-process fleet serves, survives a kill, warms.
+
+Like ``check_daemon`` this checker RUNS the product: it delegates to
+``scripts/fleet_bench.py``'s ``run_smoke()`` — one fleet router over two
+real daemon subprocesses on loopback ports, client traffic through the
+router's daemon-identical HTTP/SSE contract, one seeded SIGKILL of a
+daemon mid-stream (the victim's streams must continue bitwise on the
+survivor via forced-prefix handoff), and at least one remote KV
+migration landing with a typed ``imported`` verdict — so ``python
+scripts/check_all.py`` catches a fleet that cannot complete its own
+failure story, not just one whose modules parse clean.
+
+Registered in ``check_all.RUNTIME_CHECKS`` (not ``CHECKERS``): the AST
+gates stay instant for ``tests/test_checkers.py::test_all_ast_gates``,
+while this one runs as its own tier-1 entry
+(``tests/test_fleet.py::test_fleet_smoke_subprocess``) and in the
+``check_all`` CLI.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from typing import List, Sequence
+
+SCRIPTS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+DEFAULT_PATHS: Sequence[str] = ()  # runtime check: no tree to walk
+
+
+def check_paths(paths: Sequence[str] = DEFAULT_PATHS) -> List[str]:
+    spec = importlib.util.spec_from_file_location(
+        "fleet_bench", os.path.join(SCRIPTS_DIR, "fleet_bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return [f"fleet smoke: {p}" for p in mod.run_smoke()]
+
+
+def main(argv: List[str]) -> int:
+    problems = check_paths()
+    for problem in problems:
+        print(problem, file=sys.stderr)
+    if problems:
+        print(f"check_fleet: {len(problems)} problem(s)", file=sys.stderr)
+        return 1
+    print("check_fleet: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -51,7 +51,7 @@ SYNC_ATTRS = frozenset({"asarray", "array"})
 SYNC_MODULES = frozenset({"np", "numpy"})
 FENCE_ATTRS = frozenset({"block_until_ready", "device_get"})
 
-DEFAULT_PATHS = ("tpu_parallel/serving",)
+DEFAULT_PATHS = ("tpu_parallel/serving", "tpu_parallel/fleet")
 
 WHITELIST_MARK = "# host-sync:"
 

@@ -32,7 +32,11 @@ import os
 import sys
 from typing import List
 
-DEFAULT_PATHS = ("tpu_parallel/daemon", "tpu_parallel/checkpoint")
+DEFAULT_PATHS = (
+    "tpu_parallel/daemon",
+    "tpu_parallel/checkpoint",
+    "tpu_parallel/fleet",
+)
 
 # the one module allowed to spell raw IO: the shim itself
 SHIM_FILENAME = "iofaults.py"

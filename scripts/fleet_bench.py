@@ -1,0 +1,1070 @@
+"""Fleet soak: one wire-level router over N daemon PROCESSES, under
+seeded host kills — the cross-host acceptance gate (docs/14_fleet.md).
+
+``daemon_bench`` proves one process survives its own death through the
+journal.  This bench proves the FLEET survives a host's death through
+the router: clients talk only to the router (the daemon's exact
+HTTP/SSE contract re-served by ``tpu_parallel/fleet/http.py``), daemons
+are killed -9 at seeded points mid-traffic, and the invariants are
+judged fleet-wide:
+
+1. **zero lost accepted requests** — every submission the router
+   acknowledged reaches exactly one ``finished`` terminal, even when
+   its backing daemon was SIGKILLed mid-stream (cross-host handoff:
+   prompt + delivered tokens replayed onto a survivor as a forced
+   prefix);
+2. **zero duplicate completions** — the router's dedupe ledger answers
+   client retries with the original request id across host deaths
+   (the dead host's journal is unreachable; the ledger is the
+   fleet-wide authority);
+3. **bitwise token parity** — every completed stream, including every
+   handed-off one, equals the static greedy reference: the host death
+   changed NOTHING about the output;
+4. **remote KV migration lands** — a killed daemon restarted on its
+   port is warm-started by the router from a healthy donor over the
+   ``kv_wire`` codec, with at least one typed ``imported`` verdict;
+   and the corrupt-injection leg (one seeded bit flipped in an
+   exported wire blob) is refused TYPED by the importer — corrupt
+   bytes never land, recompute covers the miss;
+5. **graceful exits** — SIGTERM drains the router and every daemon to
+   exit 0.
+
+Entry modes:
+
+- ``--smoke``: the fast CI gate (``scripts/check_fleet.py`` and tier-1
+  via ``tests/test_fleet.py``): router + 2 daemons on loopback ports,
+  one SIGKILL mid-stream, one recovery warm start, one corrupt-import
+  refusal.  Bounded wall time; one model build in the parent (the
+  greedy reference) plus one per child.
+- ``--soak SEED``: the acceptance soak — per seeded trial: router + 3
+  daemons, a seeded request schedule, a seeded victim SIGKILLed at a
+  seeded point, full invariant sweep, restart + warm start, corrupt
+  leg, graceful stop.  ``--record FLEET_r01.json`` writes the
+  per-trial evidence.
+- ``--serve``: INTERNAL daemon child — the ``daemon_bench`` child with
+  radix-cached engines (``kv_block_tokens=4`` + ``kv_radix_cache``) so
+  peer KV export/import has chains to ship.
+- ``--route``: INTERNAL router child — a :class:`FleetRouter` on the
+  WallClock + urllib transport, its probe pump on the main thread,
+  SIGTERM -> stop -> exit 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+DEFAULT_NEW_TOKENS = 8
+# long enough that a seeded kill lands mid-stream, while prompt +
+# budget stays inside the tiny_test model's seq_len of 32
+HANDOFF_NEW_TOKENS = 20
+READY_TIMEOUT = 300.0  # cold jax import + compile on a 1-core box
+BLOCK_TOKENS = 4  # the children's paged-KV block size
+TERMINAL = ("finished", "failed", "cancelled", "rejected", "expired")
+
+
+# -- small plumbing ----------------------------------------------------------
+
+
+def pick_ports(n):
+    """Reserve n distinct loopback ports (portpicker when available,
+    else bind-to-0 probing — daemons need FIXED ports so a restarted
+    victim comes back at the address the router knows it by)."""
+    try:
+        import portpicker
+
+        return [portpicker.pick_unused_port() for _ in range(n)]
+    except ImportError:
+        socks, ports = [], []
+        for _ in range(n):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            ports.append(s.getsockname()[1])
+            socks.append(s)
+        for s in socks:
+            s.close()
+        return ports
+
+
+def http_json(method, url, body=None, timeout=120.0):
+    """One JSON request; returns (status_code, payload) and never
+    raises on HTTP error codes (connection errors DO raise)."""
+    data = None if body is None else json.dumps(body).encode()
+    req = urllib.request.Request(url, data=data, method=method)
+    if data is not None:
+        req.add_header("Content-Type", "application/json")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read() or b"{}")
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read() or b"{}")
+
+
+def http_bytes(method, url, data=None, timeout=120.0):
+    """Binary-bodied sibling: returns (status_code, raw_bytes)."""
+    req = urllib.request.Request(url, data=data, method=method)
+    if data is not None:
+        req.add_header("Content-Type", "application/octet-stream")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read()
+
+
+def wait_ready(ready_file, proc, timeout=READY_TIMEOUT):
+    """Poll for a child's ready file; returns its payload dict."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"child exited rc={proc.returncode} before ready"
+            )
+        if os.path.exists(ready_file):
+            try:
+                with open(ready_file) as fh:
+                    info = json.load(fh)
+                if "port" in info:
+                    return info
+            except (ValueError, OSError):
+                pass  # mid-write
+        time.sleep(0.05)
+    raise RuntimeError(f"child not ready within {timeout}s")
+
+
+class Peer:
+    """One daemon child the parent manages: fixed port, its journal,
+    its ready file, and the live Popen handle (replaced on restart)."""
+
+    def __init__(self, tmpdir, name, port):
+        self.name = name
+        self.port = port
+        self.addr = f"127.0.0.1:{port}"
+        self.journal = os.path.join(tmpdir, f"{name}.jsonl")
+        self.ready = os.path.join(tmpdir, f"{name}.ready.json")
+        self.proc = None
+        self.pid = None
+
+    def spawn(self, grace=60.0):
+        if os.path.exists(self.ready):
+            os.remove(self.ready)
+        cmd = [
+            sys.executable, os.path.abspath(__file__), "--serve",
+            "--journal", self.journal, "--ready-file", self.ready,
+            "--port", str(self.port), "--grace", str(grace),
+        ]
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        self.proc = subprocess.Popen(cmd, env=env)
+        return self
+
+    def wait_ready(self):
+        info = wait_ready(self.ready, self.proc)
+        self.pid = info["pid"]
+        return info
+
+    def sigkill(self):
+        self.proc.send_signal(signal.SIGKILL)
+        self.proc.wait(timeout=30)
+
+
+def spawn_router(tmpdir, peer_addrs, warm_blocks=64):
+    ready = os.path.join(tmpdir, "router.ready.json")
+    if os.path.exists(ready):
+        os.remove(ready)
+    cmd = [
+        sys.executable, os.path.abspath(__file__), "--route",
+        "--peers", ",".join(peer_addrs), "--ready-file", ready,
+        "--warm-blocks", str(warm_blocks),
+    ]
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.Popen(cmd, env=env), ready
+
+
+def stop_gracefully(proc, problems, label, grace=120.0):
+    proc.send_signal(signal.SIGTERM)
+    try:
+        rc = proc.wait(timeout=grace)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        problems.append(f"{label}: SIGTERM did not exit within grace")
+        return
+    if rc != 0:
+        problems.append(f"{label}: drain exit code {rc} != 0")
+
+
+# -- schedule + references ---------------------------------------------------
+
+
+def make_schedule(seed, n_requests, new_tokens, prefix=()):
+    """Seeded prompts + dedupe tokens.  ``prefix`` makes a group of
+    prompts share a block-aligned head — the hot chains the radix
+    caches build and the KV migration legs ship."""
+    rnd = random.Random(seed)
+    schedule = []
+    for i in range(n_requests):
+        tail = rnd.randrange(3, 10)
+        prompt = list(prefix) + [
+            rnd.randrange(1, 250) for _ in range(tail)
+        ]
+        schedule.append({
+            "dedupe_token": f"fleet-{seed}-{i}",
+            "prompt": prompt,
+            "max_new_tokens": new_tokens,
+        })
+    return schedule
+
+
+def shared_prefix(seed, blocks=2):
+    rnd = random.Random(seed ^ 0x9E1F)
+    return [
+        rnd.randrange(1, 250) for _ in range(blocks * BLOCK_TOKENS)
+    ]
+
+
+def greedy_references(schedule):
+    """Static-generate the greedy continuation for every prompt — the
+    parity oracle every fleet stream must match bitwise, through any
+    number of host deaths."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_parallel.models import GPTLM, tiny_test
+    from tpu_parallel.models.generate import generate
+
+    cfg = tiny_test(remat=False)
+    model = GPTLM(cfg)
+    probe = jnp.zeros((1, 16), jnp.int32)
+    params = model.init(
+        {"params": jax.random.PRNGKey(1)}, probe, train=False
+    )["params"]
+    refs = {}
+    for entry in schedule:
+        cont = np.asarray(generate(
+            model, params,
+            jnp.asarray(entry["prompt"], jnp.int32)[None, :],
+            max_new_tokens=entry["max_new_tokens"],
+        ))[0]
+        refs[entry["dedupe_token"]] = [int(t) for t in cont]
+    return refs
+
+
+# -- the children ------------------------------------------------------------
+
+
+def serve(args):
+    """Daemon child: daemon_bench's serve with radix-cached engines so
+    ``/v1/kv/export`` has hot chains to ship."""
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(REPO_ROOT, ".pytest_xla_cache"),
+    )
+    from tpu_parallel.cluster import Frontend, FrontendConfig
+    from tpu_parallel.daemon import (
+        DaemonConfig,
+        DaemonHTTPServer,
+        ServingDaemon,
+    )
+    from tpu_parallel.models import GPTLM, tiny_test
+    from tpu_parallel.obs.registry import MetricRegistry
+    from tpu_parallel.serving import SchedulerConfig, ServingEngine
+
+    cfg = tiny_test(remat=False)
+    model = GPTLM(cfg)
+    probe = jax.numpy.zeros((1, 16), jax.numpy.int32)
+    params = model.init(
+        {"params": jax.random.PRNGKey(1)}, probe, train=False
+    )["params"]
+
+    def frontend_factory(clock):
+        engines = [
+            ServingEngine(
+                model, params, n_slots=args.slots,
+                scheduler=SchedulerConfig(max_prefills_per_tick=2),
+                kv_block_tokens=BLOCK_TOKENS, prefix_cache_size=64,
+                kv_radix_cache=True,
+            )
+            for _ in range(args.replicas)
+        ]
+        return Frontend(
+            engines, router="least",
+            config=FrontendConfig(restart=None),
+            clock=clock, registry=MetricRegistry(),
+        )
+
+    daemon = ServingDaemon(
+        frontend_factory, args.journal,
+        config=DaemonConfig(
+            grace_seconds=args.grace, fsync_batch=args.fsync_batch,
+        ),
+    )
+    server = DaemonHTTPServer(daemon, port=args.port).start()
+    daemon.install_signals()
+    with open(args.ready_file + ".tmp", "w") as fh:
+        json.dump({"port": server.port, "pid": os.getpid()}, fh)
+    os.replace(args.ready_file + ".tmp", args.ready_file)
+    rc = daemon.run()
+    server.stop()
+    return rc
+
+
+def route(args):
+    """Router child: FleetRouter + FleetHTTPServer; the probe pump owns
+    the main thread; SIGTERM stops it for a clean exit 0."""
+    from tpu_parallel.daemon.wallclock import WallClock
+    from tpu_parallel.fleet import (
+        FleetHTTPServer,
+        FleetRouter,
+        HTTPFleetTransport,
+        PeerPolicy,
+    )
+    from tpu_parallel.obs.registry import MetricRegistry
+
+    peers = [p for p in args.peers.split(",") if p]
+    router = FleetRouter(
+        peers,
+        clock=WallClock(),
+        transport=HTTPFleetTransport(),
+        # key placement on the shared-prefix head (2 KV blocks = 8
+        # tokens): every request of a shared_prefix() group lands on
+        # the same daemon, which is what makes its radix chains hot
+        # and the kill leg's filler backlog actually pin one host
+        buckets=(2 * BLOCK_TOKENS, 4 * BLOCK_TOKENS),
+        # bench-paced breaker: detect a dead host in ~1s of probes and
+        # readmit a rebooted one within 2s of it answering
+        policy=PeerPolicy(
+            probe_interval_seconds=0.5,
+            degraded_after=1,
+            dead_after=2,
+            reprobe_backoff_seconds=0.5,
+            reprobe_backoff_factor=2.0,
+            reprobe_backoff_max=2.0,
+            connect_timeout_seconds=5.0,
+            request_timeout_seconds=120.0,
+            stream_idle_timeout_seconds=15.0,
+        ),
+        registry=MetricRegistry(),
+        warm_start_blocks=args.warm_blocks,
+    )
+    server = FleetHTTPServer(router, port=args.port).start()
+    signal.signal(signal.SIGTERM, lambda *_: router.stop())
+    with open(args.ready_file + ".tmp", "w") as fh:
+        json.dump({"port": server.port, "pid": os.getpid()}, fh)
+    os.replace(args.ready_file + ".tmp", args.ready_file)
+    router.run(poll_seconds=0.1)
+    server.stop()
+    return 0
+
+
+# -- fleet-side helpers ------------------------------------------------------
+
+
+class StreamReader(threading.Thread):
+    """Consume one router SSE stream to its terminal event."""
+
+    def __init__(self, base, rid):
+        super().__init__(daemon=True)
+        self.url = f"{base}/v1/stream/{rid}"
+        self.rid = rid
+        self.events = []
+        self.error = None
+
+    def run(self):
+        try:
+            req = urllib.request.Request(self.url)
+            # generous per-read timeout: the router does not forward
+            # keepalives, and a handoff can sit out a probe interval
+            with urllib.request.urlopen(req, timeout=600) as resp:
+                for raw in resp:
+                    line = raw.strip()
+                    if not line.startswith(b"data:"):
+                        continue
+                    ev = json.loads(line[len(b"data:"):].strip())
+                    self.events.append(ev)
+                    if ev.get("finished"):
+                        return
+        except Exception as exc:  # judged by the parent, not raised
+            self.error = repr(exc)
+
+    def tokens(self):
+        return [e["token"] for e in self.events if "token" in e]
+
+    def indices(self):
+        return [e["index"] for e in self.events if "token" in e]
+
+
+def wait_finished(base, rids, refs, problems, timeout=240.0, label=""):
+    """Poll router results until every rid is terminal; judge lost
+    work and bitwise parity.  Returns token -> final record."""
+    deadline = time.monotonic() + timeout
+    pending = dict(rids)
+    finished = {}
+    while pending and time.monotonic() < deadline:
+        for tok, rid in list(pending.items()):
+            code, rec = http_json("GET", f"{base}/v1/result/{rid}")
+            if code == 200 and rec.get("status") in TERMINAL:
+                finished[tok] = rec
+                del pending[tok]
+        time.sleep(0.05)
+    for tok, rid in pending.items():
+        problems.append(f"{label}{tok} ({rid}): never terminal")
+    for tok, rec in finished.items():
+        if rec["status"] != "finished":
+            problems.append(
+                f"{label}{tok}: status {rec['status']} "
+                f"({rec['finish_reason']}) — lost accepted work"
+            )
+        elif refs is not None and rec["tokens"] != refs[tok]:
+            problems.append(
+                f"{label}{tok}: tokens diverge from the greedy "
+                "reference through the fleet (SILENT WRONG TOKENS)"
+            )
+    return finished
+
+
+def kill_when_mid_flight(reader, victim, problems, timeout=120.0):
+    """Spin on the target's OWN relayed SSE events until its first
+    token arrives, then SIGKILL the backing daemon — the only trigger
+    fast enough when the tiny model decodes a whole slot's budget in
+    milliseconds (a fixed sleep overshoots the stream; an HTTP result
+    poll's router roundtrip can be slower than the stream itself).
+    Returns True when the kill landed mid-flight."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        events = reader.events
+        if events:
+            if events[-1].get("finished"):
+                break  # drained before the kill could land
+            victim.sigkill()
+            return True
+        if not reader.is_alive():
+            break
+        time.sleep(0.0005)
+    victim.sigkill()
+    problems.append(
+        "kill leg: target never observed mid-flight before the kill "
+        f"(events={len(reader.events)}, alive={reader.is_alive()})"
+    )
+    return False
+
+
+def read_metric(base, line_prefix):
+    """Read one series value from the router's /metricsz text."""
+    with urllib.request.urlopen(f"{base}/metricsz", timeout=30) as resp:
+        text = resp.read().decode()
+    for line in text.splitlines():
+        if line.startswith(line_prefix + " "):
+            return float(line.rsplit(" ", 1)[1])
+    return 0.0
+
+
+def wait_metric(base, line_prefix, minimum, timeout=90.0):
+    deadline = time.monotonic() + timeout
+    value = 0.0
+    while time.monotonic() < deadline:
+        value = read_metric(base, line_prefix)
+        if value >= minimum:
+            return value
+        time.sleep(0.25)
+    return value
+
+
+def corrupt_import_leg(donor_addr, target_addr, seed, problems):
+    """Export real KV from ``donor``, flip ONE seeded bit, import into
+    ``target``: the importer must refuse TYPED (a ``kv_wire`` reason),
+    never land garbage.  Returns the typed reason (or None)."""
+    from tpu_parallel.serving.kv_wire import WIRE_REASONS
+
+    code, blob = http_bytes(
+        "GET", f"http://{donor_addr}/v1/kv/export?max_blocks=16"
+    )
+    if code != 200:
+        problems.append(f"corrupt leg: donor export -> {code}")
+        return None
+    if not blob:
+        problems.append(
+            "corrupt leg: donor exported no hot KV — nothing proved"
+        )
+        return None
+    rnd = random.Random(seed ^ 0xB17)
+    bit = rnd.randrange(len(blob) * 8)
+    flipped = bytearray(blob)
+    flipped[bit // 8] ^= 1 << (bit % 8)
+    code, body = http_bytes(
+        "POST", f"http://{target_addr}/v1/kv/import", bytes(flipped)
+    )
+    try:
+        payload = json.loads(body or b"{}")
+    except ValueError:
+        payload = {}
+    reason = payload.get("reason")
+    if code != 400 or reason not in WIRE_REASONS:
+        problems.append(
+            f"corrupt leg: flipped-bit import answered {code} "
+            f"{payload} — want a typed 400 refusal"
+        )
+        return None
+    # the INTACT blob lands (or typed-falls-back) — the refusal above
+    # was about the damage, not the transfer
+    code, body = http_bytes(
+        "POST", f"http://{target_addr}/v1/kv/import", blob
+    )
+    if code != 200:
+        problems.append(f"corrupt leg: intact import -> {code} {body!r}")
+    return reason
+
+
+def direct_import_leg(donor_addrs, victim_addr, problems):
+    """Deterministic warm-start freight: export hot chains from a
+    daemon that served traffic while the victim was dead — chains the
+    victim's own journal replay cannot have recovered — and land them
+    directly.  Returns the count of typed ``imported`` verdicts."""
+    for addr in sorted(donor_addrs):
+        code, blob = http_bytes(
+            "GET", f"http://{addr}/v1/kv/export?max_blocks=64"
+        )
+        if code != 200 or not blob:
+            continue
+        code, body = http_bytes(
+            "POST", f"http://{victim_addr}/v1/kv/import", blob
+        )
+        if code != 200:
+            problems.append(
+                f"direct import into the recovered victim -> {code}"
+            )
+            continue
+        try:
+            payload = json.loads(body or b"{}")
+        except ValueError:
+            payload = {}
+        if payload.get("imported", 0) >= 1:
+            return payload["imported"]
+    problems.append(
+        "no remote KV import landed a typed `imported` verdict, even "
+        "shipping downtime chains the victim provably never saw"
+    )
+    return 0
+
+
+# -- modes -------------------------------------------------------------------
+
+
+def run_smoke(tmpdir=None, keep=False):
+    """router + 2 daemons -> traffic -> one SIGKILL mid-stream (bitwise
+    handoff) -> victim restart (remote KV warm start) -> corrupt-import
+    refusal -> graceful stop.  The gate check_fleet and tier-1 run.
+    Returns a problem list."""
+    import tempfile
+
+    problems = []
+    tmpdir = tmpdir or tempfile.mkdtemp(prefix="fleet_smoke_")
+    ports = pick_ports(2)
+    peers = [Peer(tmpdir, f"d{i}", p) for i, p in enumerate(ports)]
+    by_addr = {p.addr: p for p in peers}
+    router_proc = None
+    try:
+        for p in peers:
+            p.spawn()
+        for p in peers:
+            p.wait_ready()
+        router_proc, rready = spawn_router(
+            tmpdir, [p.addr for p in peers]
+        )
+        rport = wait_ready(rready, router_proc)["port"]
+        base = f"http://127.0.0.1:{rport}"
+
+        code, payload = http_json("GET", f"{base}/healthz")
+        if code != 200 or not payload.get("ok"):
+            problems.append(f"router healthz {code}: {payload}")
+
+        # ---- warm traffic: shared-prefix group A, plus the kill-leg
+        # entries (fillers pin the victim's slots so the target request
+        # is guaranteed still mid-flight when the host dies)
+        prefix_a = shared_prefix(31)
+        sched = make_schedule(
+            41, 2, DEFAULT_NEW_TOKENS, prefix=prefix_a
+        )
+        # the kill leg runs on a FRESH prefix: warm-cached chains would
+        # make every prefill a radix hit and the whole backlog drains
+        # in milliseconds — too fast to ever catch the target mid-flight
+        rnd = random.Random(43)
+        prefix_k = shared_prefix(33)
+        fillers = [
+            {
+                "dedupe_token": f"fleet-fill-{i}",
+                "prompt": prefix_k + [
+                    rnd.randrange(1, 250) for _ in range(3)
+                ],
+                "max_new_tokens": HANDOFF_NEW_TOKENS,
+            }
+            for i in range(6)
+        ]
+        long_entry = {
+            "dedupe_token": "fleet-long-0",
+            "prompt": prefix_k + [7, 11, 13],
+            "max_new_tokens": HANDOFF_NEW_TOKENS,
+        }
+        refs = greedy_references(sched + fillers + [long_entry])
+        rids = {}
+        for entry in sched:
+            code, rec = http_json(
+                "POST", f"{base}/v1/submit", entry
+            )
+            if code != 200:
+                problems.append(f"submit {code}: {rec}")
+                continue
+            rids[entry["dedupe_token"]] = rec["request_id"]
+        # fleet-wide idempotence: a retry answers the original record
+        if rids:
+            code, rec = http_json("POST", f"{base}/v1/submit", sched[0])
+            first = rids[sched[0]["dedupe_token"]]
+            if code != 200 or rec["request_id"] != first:
+                problems.append(
+                    f"fleet dedupe resubmit mismatched: {code} {rec}"
+                )
+        wait_finished(base, rids, refs, problems, label="warm: ")
+
+        # ---- the kill leg: pin the victim's slots with filler work,
+        # then SIGKILL the daemon backing the live target stream
+        fill_rids = {}
+        for entry in fillers:
+            code, rec = http_json("POST", f"{base}/v1/submit", entry)
+            if code != 200:
+                problems.append(f"filler submit {code}: {rec}")
+                continue
+            fill_rids[entry["dedupe_token"]] = rec["request_id"]
+        code, rec = http_json("POST", f"{base}/v1/submit", long_entry)
+        if code != 200:
+            problems.append(f"long submit {code}: {rec}")
+            return problems
+        rid_long = rec["request_id"]
+        victim = by_addr[rec["peer"]]
+        reader = StreamReader(base, rid_long)
+        reader.start()
+        if not kill_when_mid_flight(reader, victim, problems):
+            return problems
+        reader.join(timeout=420)
+        if reader.is_alive():
+            problems.append("kill leg: relay stream never terminated")
+        elif reader.error:
+            problems.append(f"kill leg: relay stream tore: {reader.error}")
+        else:
+            idxs = reader.indices()
+            if idxs != list(range(len(idxs))):
+                problems.append(
+                    f"kill leg: client indices not contiguous: {idxs}"
+                )
+            if reader.tokens() != refs["fleet-long-0"]:
+                problems.append(
+                    "kill leg: handed-off stream diverges from the "
+                    "greedy reference (NOT BITWISE)"
+                )
+            tail = reader.events[-1] if reader.events else {}
+            if not tail.get("finished") or tail.get("status") != "finished":
+                problems.append(f"kill leg: bad terminal event {tail}")
+        code, rec = http_json("GET", f"{base}/v1/result/{rid_long}")
+        if code != 200 or rec.get("handoffs", 0) < 1:
+            problems.append(
+                f"kill leg: no handoff recorded on the request: {rec}"
+            )
+        # the fillers shared the victim's slots: they hand off too, and
+        # must finish bitwise on the survivor like any accepted work
+        wait_finished(base, fill_rids, refs, problems, label="filler: ")
+        survivor = next(p for p in peers if p is not victim)
+
+        # ---- hot chains the victim never saw, then the corrupt leg
+        prefix_b = shared_prefix(32)
+        sched_b = make_schedule(
+            42, 2, DEFAULT_NEW_TOKENS, prefix=prefix_b
+        )
+        refs_b = greedy_references(sched_b)
+        rids_b = {}
+        for entry in sched_b:
+            code, rec = http_json("POST", f"{base}/v1/submit", entry)
+            if code == 200:
+                rids_b[entry["dedupe_token"]] = rec["request_id"]
+            else:
+                problems.append(f"post-kill submit {code}: {rec}")
+        wait_finished(base, rids_b, refs_b, problems, label="post-kill: ")
+        corrupt_import_leg(survivor.addr, survivor.addr, 5, problems)
+
+        # ---- restart the victim: the router must warm-start it from
+        # the survivor over the wire (>= 1 typed `imported` verdict)
+        victim.spawn()
+        victim.wait_ready()
+        imported = wait_metric(
+            base, 'fleet_kv_imports_total{status="imported"}', 1
+        )
+        if imported < 1:
+            problems.append(
+                "no remote KV import landed after the victim recovered "
+                f"(imported={imported})"
+            )
+        # the recovered peer serves through the router again
+        code, payload = http_json("GET", f"{base}/healthz")
+        if code != 200:
+            problems.append(f"post-recovery healthz {code}: {payload}")
+
+        # ---- graceful stop: router first, then the daemons
+        stop_gracefully(router_proc, problems, "router")
+        router_proc = None
+        for p in peers:
+            stop_gracefully(p.proc, problems, p.name)
+    finally:
+        for proc in [router_proc] + [p.proc for p in peers]:
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+        if not keep and not problems:
+            import shutil
+
+            shutil.rmtree(tmpdir, ignore_errors=True)
+    return problems
+
+
+def run_trial(args, seed):
+    """One seeded soak trial (see the module docstring).  Returns
+    (trial_record, problems)."""
+    rnd = random.Random(seed ^ 0xF1EE7)
+    problems = []
+    tmpdir = os.path.join(
+        args.workdir or "/tmp", f"fleet_soak_{os.getpid()}_{seed}"
+    )
+    os.makedirs(tmpdir, exist_ok=True)
+    ports = pick_ports(args.daemons)
+    peers = [Peer(tmpdir, f"d{i}", p) for i, p in enumerate(ports)]
+    by_addr = {p.addr: p for p in peers}
+    router_proc = None
+    try:
+        for p in peers:
+            if os.path.exists(p.journal):
+                os.remove(p.journal)
+            p.spawn(grace=args.grace)
+        for p in peers:
+            p.wait_ready()
+        router_proc, rready = spawn_router(
+            tmpdir, [p.addr for p in peers],
+            warm_blocks=args.warm_blocks,
+        )
+        rport = wait_ready(rready, router_proc)["port"]
+        base = f"http://127.0.0.1:{rport}"
+
+        # every schedule this trial runs, referenced in one pass: two
+        # shared-prefix traffic groups, the kill leg (fillers pin the
+        # victim's slots behind one long target), and a downtime group
+        # served while the victim is dead (warm-start freight its own
+        # journal replay provably cannot recover)
+        prefix_a = shared_prefix(seed)
+        prefix_b = shared_prefix(seed + 1)
+        prefix_c = shared_prefix(seed + 2)
+        prefix_d = shared_prefix(seed + 3)
+        half = args.requests // 2
+        schedule = (
+            make_schedule(seed, half, args.new, prefix=prefix_a)
+            + make_schedule(
+                seed + 1000, args.requests - half, args.new,
+                prefix=prefix_b,
+            )
+        )
+        fillers = [
+            {
+                "dedupe_token": f"fleet-{seed}-fill-{i}",
+                "prompt": prefix_c + [
+                    rnd.randrange(1, 250) for _ in range(2)
+                ],
+                "max_new_tokens": HANDOFF_NEW_TOKENS,
+            }
+            for i in range(5)
+        ]
+        target = {
+            "dedupe_token": f"fleet-{seed}-target",
+            "prompt": prefix_c + [
+                rnd.randrange(1, 250) for _ in range(2)
+            ],
+            "max_new_tokens": HANDOFF_NEW_TOKENS,
+        }
+        downtime = make_schedule(
+            seed + 2000, 2, args.new, prefix=prefix_d
+        )
+        refs = greedy_references(
+            schedule + fillers + [target] + downtime
+        )
+
+        # ---- phase 1: streamed traffic through a healthy fleet
+        rids = {}
+        readers = {}
+        for entry in schedule:
+            code, rec = http_json("POST", f"{base}/v1/submit", entry)
+            if code != 200:
+                problems.append(f"submit {code}: {rec}")
+                continue
+            tok = entry["dedupe_token"]
+            rids[tok] = rec["request_id"]
+            readers[tok] = StreamReader(base, rec["request_id"])
+            readers[tok].start()
+        accepted = len(rids)
+        for tok, reader in readers.items():
+            reader.join(timeout=420)
+            if reader.is_alive():
+                problems.append(f"{tok}: relay stream never terminated")
+            elif reader.error:
+                problems.append(f"{tok}: relay tore: {reader.error}")
+            elif reader.tokens() != refs[tok]:
+                problems.append(
+                    f"{tok}: stream diverges from the greedy reference"
+                )
+        wait_finished(base, rids, refs, problems)
+
+        # ---- the seeded kill: the fillers share the target's prefix,
+        # so the ring packs them onto one daemon and keeps the target
+        # mid-flight behind them; that daemon is the victim
+        fill_rids = {}
+        for entry in fillers:
+            code, rec = http_json("POST", f"{base}/v1/submit", entry)
+            if code != 200:
+                problems.append(f"filler submit {code}: {rec}")
+                continue
+            fill_rids[entry["dedupe_token"]] = rec["request_id"]
+        code, rec = http_json("POST", f"{base}/v1/submit", target)
+        if code != 200:
+            problems.append(f"target submit {code}: {rec}")
+            raise RuntimeError("kill-leg target never admitted")
+        rid_target = rec["request_id"]
+        accepted += len(fill_rids) + 1
+        victim = by_addr[rec["peer"]]
+        reader = StreamReader(base, rid_target)
+        reader.start()
+        kill_when_mid_flight(reader, victim, problems)
+        kill_at = time.monotonic()
+        reader.join(timeout=420)
+        if reader.is_alive():
+            problems.append("kill leg: relay stream never terminated")
+        elif reader.error:
+            problems.append(f"kill leg: relay tore: {reader.error}")
+        else:
+            idxs = reader.indices()
+            if idxs != list(range(len(idxs))):
+                problems.append(
+                    f"kill leg: client indices not contiguous: {idxs}"
+                )
+            if reader.tokens() != refs[target["dedupe_token"]]:
+                problems.append(
+                    "kill leg: handed-off stream diverges from the "
+                    "greedy reference (NOT BITWISE)"
+                )
+        code, target_rec = http_json(
+            "GET", f"{base}/v1/result/{rid_target}"
+        )
+        if code != 200 or target_rec.get("handoffs", 0) < 1:
+            problems.append(
+                f"kill leg: no handoff recorded on the target: "
+                f"{target_rec}"
+            )
+        kill_finished = wait_finished(
+            base, fill_rids, refs, problems, label="filler: "
+        )
+        handoffs = sum(
+            r.get("handoffs", 0)
+            for r in [target_rec] + list(kill_finished.values())
+            if isinstance(r, dict)
+        )
+        kill_to_done = round(time.monotonic() - kill_at, 3)
+
+        # ---- fleet-wide idempotency: a full client retry sweep maps
+        # every dedupe token back to its original request id, across
+        # the host death
+        all_rids = dict(rids)
+        all_rids.update(fill_rids)
+        all_rids[target["dedupe_token"]] = rid_target
+        dedupe_hits = 0
+        for entry in schedule + fillers + [target]:
+            tok = entry["dedupe_token"]
+            if tok not in all_rids:
+                continue
+            code, rec = http_json("POST", f"{base}/v1/submit", entry)
+            if code == 200 and rec["request_id"] == all_rids[tok]:
+                dedupe_hits += 1
+            else:
+                problems.append(
+                    f"{tok}: retry re-admitted as {rec.get('request_id')}"
+                    f" != {all_rids[tok]} — duplicate completion path"
+                )
+
+        # ---- downtime traffic: hot chains the dead victim never saw
+        d_rids = {}
+        d_peers = set()
+        for entry in downtime:
+            code, rec = http_json("POST", f"{base}/v1/submit", entry)
+            if code != 200:
+                problems.append(f"downtime submit {code}: {rec}")
+                continue
+            d_rids[entry["dedupe_token"]] = rec["request_id"]
+            d_peers.add(rec["peer"])
+        wait_finished(base, d_rids, refs, problems, label="downtime: ")
+        accepted += len(d_rids)
+
+        # ---- corrupt-injection leg against a survivor
+        survivor = next(p for p in peers if p is not victim)
+        wire_reason = corrupt_import_leg(
+            survivor.addr, survivor.addr, seed, problems
+        )
+
+        # ---- restart the victim; the router warm-starts it remotely.
+        # The router's donor pick (the newcomer's ring successor) may
+        # hold only chains the victim's own journal replay already
+        # recovered — then every verdict is `already_cached` and the
+        # deterministic fallback ships the downtime peer's chains
+        # directly instead.
+        victim.spawn(grace=args.grace)
+        victim.wait_ready()
+        imported = wait_metric(
+            base, 'fleet_kv_imports_total{status="imported"}', 1,
+            timeout=20,
+        )
+        if imported < 1:
+            imported = direct_import_leg(
+                d_peers, victim.addr, problems
+            )
+
+        # ---- graceful stop
+        stop_gracefully(router_proc, problems, "router")
+        router_proc = None
+        for p in peers:
+            stop_gracefully(p.proc, problems, p.name, grace=args.grace + 60)
+        trial = {
+            "seed": seed,
+            "victim": victim.addr,
+            "accepted": accepted,
+            "requests": args.requests,
+            "finished": len(all_rids) + len(d_rids) - sum(
+                1 for p in problems if "lost accepted work" in p
+            ),
+            "handoffs": handoffs,
+            "dedupe_hits_on_retry": dedupe_hits,
+            "kv_imported": imported,
+            "corrupt_refusal_reason": wire_reason,
+            "kill_to_done_seconds": kill_to_done,
+        }
+    finally:
+        for proc in [router_proc] + [p.proc for p in peers]:
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+        if not problems:
+            import shutil
+
+            shutil.rmtree(tmpdir, ignore_errors=True)
+    return trial, problems
+
+
+def run_soak(args):
+    """The seeded host-kill acceptance soak (>= 3 seeds)."""
+    record = {"bench": "fleet_soak", "trials": []}
+    problems = []
+    total_handoffs = 0
+    for trial in range(args.trials):
+        seed = args.soak + trial
+        trial_rec, trial_problems = run_trial(args, seed)
+        trial_rec["problems"] = list(trial_problems)
+        record["trials"].append(trial_rec)
+        problems.extend(trial_problems)
+        total_handoffs += trial_rec.get("handoffs", 0)
+        print(
+            f"trial {trial} (seed {seed}): victim={trial_rec['victim']} "
+            f"finished={trial_rec['finished']}/{trial_rec['accepted']} "
+            f"handoffs={trial_rec['handoffs']} "
+            f"kv_imported={trial_rec['kv_imported']} "
+            f"corrupt_refusal={trial_rec['corrupt_refusal_reason']} "
+            f"problems={len(trial_problems)}"
+        )
+    if total_handoffs == 0:
+        problems.append(
+            "no trial handed a request across hosts — the soak proved "
+            "nothing about cross-host continuation; lengthen --new or "
+            "add trials"
+        )
+    record["handoffs_total"] = total_handoffs
+    record["ok"] = not problems
+    if args.record:
+        with open(args.record, "w") as fh:
+            json.dump(record, fh, indent=2)
+            fh.write("\n")
+        print(f"record: {args.record}")
+    return problems
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--serve", action="store_true",
+                    help="INTERNAL: run one daemon child")
+    ap.add_argument("--route", action="store_true",
+                    help="INTERNAL: run the fleet router child")
+    ap.add_argument("--smoke", action="store_true",
+                    help="fast gate: router + 2 daemons, one SIGKILL, "
+                         "bitwise handoff, one warm start, one corrupt "
+                         "refusal")
+    ap.add_argument("--soak", type=int, default=None, metavar="SEED",
+                    help="seeded host-kill soak: trials use seeds "
+                         "SEED..SEED+trials-1")
+    ap.add_argument("--peers", type=str, default="")
+    ap.add_argument("--journal", type=str, default="")
+    ap.add_argument("--ready-file", type=str, default="")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--replicas", type=int, default=1)
+    ap.add_argument("--slots", type=int, default=2)
+    ap.add_argument("--grace", type=float, default=60.0)
+    ap.add_argument("--fsync-batch", type=int, default=8)
+    ap.add_argument("--warm-blocks", type=int, default=64)
+    ap.add_argument("--daemons", type=int, default=3)
+    ap.add_argument("--requests", type=int, default=6)
+    ap.add_argument("--new", type=int, default=12)
+    ap.add_argument("--trials", type=int, default=3)
+    ap.add_argument("--workdir", type=str, default="")
+    ap.add_argument("--record", type=str, default="")
+    args = ap.parse_args()
+
+    if args.serve:
+        if not args.journal or not args.ready_file:
+            ap.error("--serve needs --journal and --ready-file")
+        sys.exit(serve(args))
+    if args.route:
+        if not args.peers or not args.ready_file:
+            ap.error("--route needs --peers and --ready-file")
+        sys.exit(route(args))
+    if args.smoke:
+        problems = run_smoke()
+    elif args.soak is not None:
+        problems = run_soak(args)
+    else:
+        ap.error("pick a mode: --smoke or --soak SEED")
+        return
+    for problem in problems:
+        print(problem, file=sys.stderr)
+    if problems:
+        print(
+            f"fleet_bench: {len(problems)} INVARIANT VIOLATION(S)",
+            file=sys.stderr,
+        )
+        sys.exit(1)
+    print("fleet_bench: OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -71,6 +71,7 @@ _SLOW_FILES = {
     "test_cluster.py",
     "test_swap.py",
     "test_daemon.py",
+    "test_fleet.py",
 }
 _SLOW_TESTS = {
     "test_pp_aux_gradient_invariance",

@@ -296,6 +296,49 @@ def test_check_clock_daemon_walk_and_wallclock_exemption():
     assert cc.check_source(bad, "tpu_parallel/daemon/daemon.py")
 
 
+def test_checkers_cover_fleet_tree():
+    """The fleet extension (PR 16): all three behavioral gates walk
+    ``tpu_parallel/fleet/`` — the live tree passes, and a planted
+    violation in a fleet-path filename is flagged by each gate, proving
+    the registration is load-bearing."""
+    fleet_dir = os.path.join(REPO_ROOT, "tpu_parallel", "fleet")
+    assert os.path.isdir(fleet_dir)
+
+    cc = _load("check_clock")
+    assert "tpu_parallel/fleet" in cc.DEFAULT_PATHS
+    assert cc.check_paths((fleet_dir,)) == []
+    planted = "import time\ndef probe():\n    return time.monotonic()\n"
+    assert cc.check_source(planted, "tpu_parallel/fleet/router.py")
+
+    ci = _load("check_io")
+    assert "tpu_parallel/fleet" in ci.DEFAULT_PATHS
+    assert ci.check_paths((fleet_dir,)) == []
+    planted = "def dump(path, blob):\n    open(path, 'wb').write(blob)\n"
+    assert ci.check_source(planted, "tpu_parallel/fleet/router.py")
+
+    chs = _load("check_host_sync")
+    assert "tpu_parallel/fleet" in chs.DEFAULT_PATHS
+    assert chs.check_paths((fleet_dir,)) == []
+    planted = (
+        "import numpy as np\n"
+        "def relay(evs, fetch):\n"
+        "    for ev in evs:\n"
+        "        yield np.asarray(fetch(ev))\n"
+    )
+    assert chs.check_source(planted, "tpu_parallel/fleet/router.py")
+
+
+def test_check_fleet_registered_as_runtime_gate():
+    """``check_fleet`` (the multi-process fleet smoke) rides the
+    RUNTIME_CHECKS registry like ``check_daemon``: resolvable by name,
+    excluded from the instant AST sweep; the smoke itself runs as its
+    own tier-1 entry in tests/test_fleet.py."""
+    assert "check_fleet" in check_all.RUNTIME_CHECKS
+    assert "check_fleet" not in check_all.CHECKERS
+    mod = check_all.load_checker("check_fleet")
+    assert callable(mod.check_paths)
+
+
 def test_runtime_checks_registered_separately():
     """``check_daemon`` (the start/submit/SIGTERM-drain smoke) lives in
     the RUNTIME_CHECKS registry: resolvable by name like the AST gates,

@@ -1,0 +1,648 @@
+"""Fleet tests: the kv_wire codec (bitwise round-trips, typed refusal
+of every damage shape, version skew preserved over the wire), the
+transport-agnostic FleetRouter on a fake clock + scripted in-memory
+daemons (breaker transitions, retry-with-exclusion, bitwise cross-host
+handoff, the fleet-wide dedupe ledger, KV warm-start accounting), and
+the real-subprocess fleet smoke that ``scripts/check_all.py`` also
+runs (router + 2 daemon processes, one SIGKILL, one remote import)."""
+
+import dataclasses
+import os
+import random
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_parallel.daemon import IOFaultPlan, iofaults
+from tpu_parallel.fleet import (
+    DEAD,
+    DEGRADED,
+    HEALTHY,
+    REJECT_NO_PEER,
+    FleetRouter,
+    FleetTransport,
+    PeerPolicy,
+    PeerSet,
+    TransportError,
+)
+from tpu_parallel.models import GPTLM, tiny_test
+from tpu_parallel.serving import (
+    Request,
+    SchedulerConfig,
+    ServingEngine,
+    block_checksums,
+)
+from tpu_parallel.serving.kv_hierarchy import (
+    MIGRATE_IMPORTED,
+    MIGRATE_WEIGHTS_VERSION,
+    KVPrefixExport,
+)
+from tpu_parallel.serving.kv_wire import (
+    WIRE_MAGIC,
+    WIRE_REASONS,
+    WireFormatError,
+    decode_export,
+    decode_exports,
+    encode_export,
+    encode_exports,
+    read_export_file,
+    write_export_file,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- wire codec --------------------------------------------------------------
+
+
+def _synthetic_export(dtype, seed=0, n_blocks=2, block_tokens=4):
+    """A hand-built export whose checksums are real (computed by the
+    same ``block_checksums`` the pool uses), so ``verify=True`` decode
+    paths exercise the genuine integrity check."""
+    rnd = np.random.default_rng(seed)
+    length = n_blocks * block_tokens
+    shape_a = (n_blocks, block_tokens, 3)
+    shape_b = (n_blocks, 2, block_tokens, 2)
+    if np.dtype(dtype).kind in "iu":
+        leaves = (
+            rnd.integers(-100, 100, shape_a).astype(dtype),
+            rnd.integers(-100, 100, shape_b).astype(dtype),
+        )
+    else:
+        leaves = (
+            rnd.standard_normal(shape_a).astype(dtype),
+            rnd.standard_normal(shape_b).astype(dtype),
+        )
+    return KVPrefixExport(
+        tokens=tuple(int(t) for t in rnd.integers(1, 250, length)),
+        length=length,
+        block_tokens=block_tokens,
+        weights_version="initial",
+        meta=(("leaf_a", (block_tokens, 3)), ("leaf_b", (2, block_tokens, 2))),
+        leaves=leaves,
+        checksums=block_checksums(list(leaves), n_blocks),
+    )
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16", "int8"])
+def test_wire_roundtrip_bitwise(dtype):
+    """encode -> decode is the identity: every field equal, every leaf
+    byte-identical (dtype included), and re-encoding the decoded export
+    reproduces the original frame byte-for-byte (canonical headers)."""
+    if dtype == "bfloat16":
+        np_dtype = np.dtype(jnp.bfloat16)
+    else:
+        np_dtype = np.dtype(dtype)
+    export = _synthetic_export(np_dtype, seed=3)
+    blob = encode_export(export)
+    back = decode_export(blob)
+    assert back.tokens == export.tokens
+    assert back.length == export.length
+    assert back.block_tokens == export.block_tokens
+    assert back.weights_version == export.weights_version
+    assert back.meta == export.meta
+    assert back.checksums == export.checksums
+    assert len(back.leaves) == len(export.leaves)
+    for got, want in zip(back.leaves, export.leaves):
+        assert got.dtype == want.dtype
+        assert got.shape == want.shape
+        assert got.tobytes() == want.tobytes()
+    assert encode_export(back) == blob
+
+
+def test_wire_multi_frame_stream():
+    """Concatenated frames (the /v1/kv/export body) decode back to the
+    same list, and an empty stream is a valid empty answer."""
+    exports = [
+        _synthetic_export(np.float32, seed=1),
+        _synthetic_export(np.int8, seed=2, n_blocks=3),
+    ]
+    blob = encode_exports(exports)
+    back = decode_exports(blob)
+    assert len(back) == 2
+    for got, want in zip(back, exports):
+        assert got.tokens == want.tokens
+        for g, w in zip(got.leaves, want.leaves):
+            assert g.tobytes() == w.tobytes()
+    assert decode_exports(b"") == []
+
+
+def test_wire_truncation_refuses_typed():
+    """Every prefix truncation refuses with a typed reason — never a
+    stray struct/json/numpy exception, never a partial export."""
+    blob = encode_export(_synthetic_export(np.float32, seed=4))
+    cuts = {0, 2, 4, 7, 11, 40, len(blob) // 2, len(blob) - 1}
+    for cut in sorted(cuts):
+        with pytest.raises(WireFormatError) as exc:
+            decode_export(blob[:cut])
+        assert exc.value.reason in WIRE_REASONS
+    # trailing garbage after a whole frame is damage too, not data
+    with pytest.raises(WireFormatError):
+        decode_export(blob + b"\x00")
+    # a mid-stream truncation refuses the WHOLE multi-frame body
+    stream = encode_exports(
+        [_synthetic_export(np.float32, seed=5)] * 2
+    )
+    with pytest.raises(WireFormatError):
+        decode_exports(stream[:-3])
+
+
+def test_wire_bad_magic_typed():
+    blob = bytearray(encode_export(_synthetic_export(np.float32)))
+    blob[0] ^= 0xFF
+    with pytest.raises(WireFormatError) as exc:
+        decode_export(bytes(blob))
+    assert exc.value.reason == WIRE_MAGIC
+
+
+def test_wire_single_bit_flips_refuse_typed():
+    """Seeded single-bit flips anywhere in the frame — magic, length
+    words, header JSON, payload — ALWAYS refuse typed: there is no bit
+    whose flip decodes into a silently different export."""
+    blob = encode_export(_synthetic_export(np.float32, seed=6))
+    rnd = random.Random(1234)
+    for _ in range(64):
+        bit = rnd.randrange(len(blob) * 8)
+        flipped = bytearray(blob)
+        flipped[bit // 8] ^= 1 << (bit % 8)
+        with pytest.raises(WireFormatError) as exc:
+            decode_export(bytes(flipped))
+        assert exc.value.reason in WIRE_REASONS, bit
+
+
+def test_wire_file_roundtrip_and_read_rot():
+    """The file helpers ride the iofaults read gate: a clean read is
+    bitwise, an armed read-side bit flip surfaces as the same typed
+    refusal the wire path gives — never garbage K/V off disk."""
+    import tempfile
+
+    export = _synthetic_export(np.float32, seed=7)
+    with tempfile.TemporaryDirectory() as tmp:
+        path = write_export_file(
+            os.path.join(tmp, "kv.wire"), [export]
+        )
+        back = read_export_file(path)
+        assert len(back) == 1
+        assert back[0].tokens == export.tokens
+        with iofaults.inject(
+            IOFaultPlan(flip_read_at=0, flip_read_bit=31337)
+        ) as inj:
+            with pytest.raises(WireFormatError) as exc:
+                read_export_file(path)
+            assert exc.value.reason in WIRE_REASONS
+            assert inj.injected["bit_flip"] == 1
+
+
+@pytest.fixture(scope="module")
+def env():
+    cfg = tiny_test(dtype=jnp.float32, remat=False)
+    model = GPTLM(cfg)
+    rng = jax.random.PRNGKey(11)
+    prompt = [
+        int(t)
+        for t in np.asarray(
+            jax.random.randint(rng, (17,), 1, cfg.vocab_size)
+        )
+    ]
+    probe = jax.random.randint(rng, (1, 20), 1, cfg.vocab_size)
+    params = model.init(
+        {"params": jax.random.PRNGKey(1)}, probe, train=False
+    )["params"]
+    return cfg, model, params, prompt
+
+
+def _mk_engine(env):
+    cfg, model, params, _prompt = env
+    return ServingEngine(
+        model, params, n_slots=2, decode_steps_per_tick=1,
+        scheduler=SchedulerConfig(max_prefills_per_tick=2),
+        kv_block_tokens=4, prefix_cache_size=16, kv_radix_cache=True,
+    )
+
+
+def test_wire_preserves_version_skew_refusal(env):
+    """A REAL engine export survives the wire bitwise (import verdict
+    ``imported``), and a version-skewed export still refuses typed
+    AFTER an encode/decode round trip — the wire carries exactly the
+    values the version gate judges."""
+    _cfg, _model, _params, prompt = env
+    a = _mk_engine(env)
+    a.add_request(
+        Request(request_id="mid", prompt=prompt, max_new_tokens=10)
+    )
+    for _ in range(5):
+        a.step()
+    export = a.export_prefix("mid")
+    assert export is not None and export.checksums
+
+    b = _mk_engine(env)
+    assert b.import_prefix(
+        decode_export(encode_export(export))
+    ) == MIGRATE_IMPORTED
+
+    skewed = dataclasses.replace(export, weights_version="v9")
+    c = _mk_engine(env)
+    assert c.import_prefix(
+        decode_export(encode_export(skewed))
+    ) == MIGRATE_WEIGHTS_VERSION
+
+
+# -- the router on a fake clock + scripted daemons ---------------------------
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+        self.sleeps = []
+
+    def __call__(self) -> float:
+        return self.t
+
+    def sleep(self, seconds: float) -> None:
+        self.sleeps.append(seconds)
+        self.t += seconds
+
+
+class FakeDaemon:
+    """One scripted in-memory daemon.  A submission consumes the next
+    script: ``tokens`` is the daemon-local generation; ``die_after=k``
+    makes its stream tear (TransportError) after yielding k token
+    events, and its record report ``running`` with k tokens."""
+
+    def __init__(self, addr):
+        self.addr = addr
+        self.alive = True
+        self.scripts = []
+        self.requests = {}
+        self.submissions = []
+        self.cancels = []
+        self.seq = 0
+        self.kv_blob = b""
+        self.kv_import_response = (200, {"verdicts": {}})
+        self.kv_imports = []
+
+
+class FakeTransport(FleetTransport):
+    def __init__(self, daemons):
+        self.daemons = {d.addr: d for d in daemons}
+
+    def _d(self, addr):
+        d = self.daemons.get(addr)
+        if d is None or not d.alive:
+            raise TransportError(addr, "connection refused")
+        return d
+
+    def healthz(self, addr, timeout):
+        self._d(addr)
+        return 200, {"ok": True}
+
+    def submit(self, addr, body, timeout):
+        d = self._d(addr)
+        d.submissions.append(dict(body))
+        rid = f"{addr}/r{d.seq}"
+        d.seq += 1
+        script = d.scripts.pop(0) if d.scripts else {"tokens": []}
+        d.requests[rid] = script
+        return 200, {"request_id": rid, "status": "queued"}
+
+    def result(self, addr, rid, timeout):
+        d = self._d(addr)
+        script = d.requests.get(rid)
+        if script is None:
+            return 404, {"error": f"unknown request {rid}"}
+        if script.get("die_after") is not None:
+            return 200, {
+                "request_id": rid, "status": "running",
+                "tokens": script["tokens"][:script["die_after"]],
+                "finish_reason": None,
+            }
+        return 200, {
+            "request_id": rid, "status": "finished",
+            "tokens": list(script["tokens"]), "finish_reason": "length",
+        }
+
+    def cancel(self, addr, rid, timeout):
+        d = self._d(addr)
+        d.cancels.append(rid)
+        return 200, {"cancelled": rid}
+
+    def stream(self, addr, rid, idle_timeout):
+        d = self._d(addr)
+        script = d.requests.get(rid)
+        if script is None:
+            raise TransportError(addr, f"stream {rid}: HTTP 404")
+
+        def events():
+            die = script.get("die_after")
+            for i, tok in enumerate(script["tokens"]):
+                if die is not None and i == die:
+                    raise TransportError(addr, "stream torn")
+                if not d.alive:
+                    raise TransportError(addr, "stream torn: killed")
+                yield {"request_id": rid, "token": tok, "index": i}
+            if die is not None:
+                raise TransportError(addr, "stream torn")
+            yield {
+                "request_id": rid, "finished": True,
+                "status": "finished", "finish_reason": "length",
+            }
+
+        return events()
+
+    def kv_export(self, addr, max_blocks, timeout):
+        return self._d(addr).kv_blob
+
+    def kv_import(self, addr, blob, timeout):
+        d = self._d(addr)
+        d.kv_imports.append(blob)
+        return d.kv_import_response
+
+
+def _fleet(n=2, **router_kw):
+    clock = FakeClock()
+    daemons = [FakeDaemon(f"h{i}:80") for i in range(n)]
+    transport = FakeTransport(daemons)
+    kw = dict(
+        policy=PeerPolicy(
+            probe_interval_seconds=1.0, degraded_after=1, dead_after=2,
+            reprobe_backoff_seconds=4.0, reprobe_backoff_max=8.0,
+        ),
+    )
+    kw.update(router_kw)
+    router = FleetRouter(
+        [d.addr for d in daemons], clock=clock, transport=transport, **kw
+    )
+    return router, clock, daemons
+
+
+def _ring_order(router, prompt):
+    """The health-blind placement order for ``prompt`` — tests script
+    'the first ring choice' without assuming which address hashes
+    first."""
+    seen = []
+    for addr in router._walk(prompt):
+        if addr not in seen:
+            seen.append(addr)
+        if len(seen) == len(router.transport.daemons):
+            break
+    return [router.transport.daemons[a] for a in seen]
+
+
+def test_peer_breaker_transitions_and_backoff():
+    """HEALTHY -> DEGRADED on the first failure, -> DEAD after
+    ``dead_after`` consecutive, backoff-scheduled re-probe, and the
+    half-open recovery: a DEAD peer's first success earns DEGRADED,
+    the second HEALTHY."""
+    clock = FakeClock()
+    policy = PeerPolicy(
+        probe_interval_seconds=1.0, degraded_after=1, dead_after=3,
+        reprobe_backoff_seconds=2.0, reprobe_backoff_factor=2.0,
+        reprobe_backoff_max=8.0,
+    )
+    ps = PeerSet(["a:1"], clock, policy)
+    st = ps.get("a:1")
+    assert st.state == HEALTHY
+    assert ps.note_failure("a:1") == DEGRADED
+    assert st.next_probe_at == clock.t  # verify a shaky peer promptly
+    assert ps.note_failure("a:1") == DEGRADED
+    assert ps.note_failure("a:1") == DEAD
+    assert st.deaths == 1
+    assert st.next_probe_at == clock.t + 2.0  # first-death backoff
+    assert ps.probe_due() == []
+    assert ps.routable() == []  # DEAD is never routable
+    clock.t += 2.0
+    assert ps.probe_due() == ["a:1"]
+    # half-open: one success readmits at DEGRADED, not HEALTHY
+    assert ps.note_success("a:1") == DEGRADED
+    assert ps.routable() == ["a:1"]
+    assert ps.note_success("a:1") == HEALTHY
+    # one flaky probe later must not jump straight back to DEAD
+    assert ps.note_failure("a:1") == DEGRADED
+    assert ps.note_success("a:1") == HEALTHY
+
+
+def test_submit_retries_with_exclusion():
+    """The ring's first choice refusing connections costs the CLIENT
+    nothing: the submission lands on the next successor, typed 200."""
+    router, _clock, _daemons = _fleet()
+    prompt = [1, 2, 3, 4, 5]
+    first, second = _ring_order(router, prompt)[:2]
+    first.alive = False
+    second.scripts.append({"tokens": [7]})
+    code, rec = router.submit(
+        {"prompt": prompt, "max_new_tokens": 1}
+    )
+    assert code == 200
+    assert rec["peer"] == second.addr
+    assert len(second.submissions) == 1
+    assert not first.submissions
+    # the failure fed the breaker
+    assert router.peers.get(first.addr).failures >= 1
+
+
+def test_submit_no_peer_is_typed_503():
+    router, _clock, daemons = _fleet()
+    for d in daemons:
+        d.alive = False
+    code, rec = router.submit({"prompt": [1, 2], "max_new_tokens": 4})
+    assert code == 503
+    assert rec["finish_reason"] == REJECT_NO_PEER
+    assert router.registry.counter(
+        "fleet_rejects_total", reason=REJECT_NO_PEER
+    ).value == 1
+    # malformed prompts are the client's problem, not a retry loop
+    assert router.submit({"prompt": []})[0] == 400
+    assert router.submit({"prompt": "abc"})[0] == 400
+
+
+def test_stream_handoff_is_bitwise_and_index_stable():
+    """The core fleet story: the backing daemon tears its stream after
+    3 tokens; the router replays prompt+delivered onto the survivor as
+    a forced prefix and the CLIENT sees one uninterrupted stream —
+    contiguous indices, the full token sequence, one terminal."""
+    router, _clock, _daemons = _fleet()
+    prompt = [5, 4, 3, 2, 1]
+    first, second = _ring_order(router, prompt)[:2]
+    full = [11, 12, 13, 14, 15, 16]
+    first.scripts.append({"tokens": full, "die_after": 3})
+    second.scripts.append({"tokens": full[3:]})
+    code, rec = router.submit(
+        {"prompt": prompt, "max_new_tokens": len(full)}
+    )
+    assert code == 200
+    rid = rec["request_id"]
+    events = list(router.stream(rid))
+    tokens = [e["token"] for e in events if "token" in e]
+    indices = [e["index"] for e in events if "token" in e]
+    assert tokens == full, "handed-off stream is not bitwise"
+    assert indices == list(range(len(full)))
+    assert events[-1] == {
+        "request_id": rid, "finished": True,
+        "status": "finished", "finish_reason": "length",
+    }
+    # the survivor was asked for EXACTLY the remainder, via a forced
+    # prefix and a derived (never client-colliding) dedupe token
+    replay = second.submissions[-1]
+    assert replay["prompt"] == prompt + full[:3]
+    assert replay["max_new_tokens"] == len(full) - 3
+    assert replay["dedupe_token"] == f"fleet:{rid}:h1"
+    code, final = router.result(rid)
+    assert final["handoffs"] == 1 and final["peer"] == second.addr
+    assert router.registry.counter("fleet_handoffs_total").value == 1
+
+
+def test_result_poll_survives_host_death():
+    """A client that only polls still cannot lose its request: the
+    failed refresh hands off, the next poll reads the survivor."""
+    router, _clock, _daemons = _fleet()
+    prompt = [9, 8, 7]
+    first, second = _ring_order(router, prompt)[:2]
+    first.scripts.append({"tokens": [1, 2], "die_after": 0})
+    second.scripts.append({"tokens": [1, 2, 3, 4]})
+    code, rec = router.submit({"prompt": prompt, "max_new_tokens": 4})
+    rid = rec["request_id"]
+    first.alive = False
+    code, rec = router.result(rid)
+    assert code == 200 and rec["peer"] == second.addr
+    code, rec = router.result(rid)
+    assert rec["status"] == "finished" and rec["tokens"] == [1, 2, 3, 4]
+
+
+def test_dedupe_ledger_is_fleet_wide():
+    router, _clock, _daemons = _fleet()
+    body = {
+        "prompt": [3, 1, 4], "max_new_tokens": 2,
+        "dedupe_token": "client-42",
+    }
+    code, rec = router.submit(body)
+    assert code == 200
+    code, again = router.submit(dict(body))
+    assert code == 200
+    assert again["request_id"] == rec["request_id"]
+    assert router.registry.counter("fleet_dedupe_hits_total").value == 1
+    # one daemon submission total: the retry never re-entered the ring
+    total = sum(
+        len(d.submissions) for d in router.transport.daemons.values()
+    )
+    assert total == 1
+
+
+def test_probe_tick_kills_hands_off_and_recovers():
+    """The pump path end to end: probes demote a silent peer to DEAD
+    (handing its open request off), the backoff gates re-probes, and
+    the recovered peer gets its stale daemon request cancelled plus a
+    KV warm start from the survivor."""
+    router, clock, _daemons = _fleet(
+        warm_start_blocks=8, warm_on_recovery=True
+    )
+    prompt = [2, 7, 1, 8]
+    first, second = _ring_order(router, prompt)[:2]
+    first.scripts.append({"tokens": [5, 5, 5], "die_after": 1})
+    second.scripts.append({"tokens": [5, 5, 5]})
+    second.kv_blob = b"hot-chains"
+    first.kv_import_response = (200, {"verdicts": {"imported": 2}})
+    code, rec = router.submit({"prompt": prompt, "max_new_tokens": 3})
+    rid = rec["request_id"]
+    stale_daemon_rid = router._requests[rid].daemon_rid
+
+    first.alive = False
+    clock.t += 1.0  # the submit's success pushed its next probe out
+    router.probe_tick()  # failure 1 -> DEGRADED (re-probe immediately)
+    assert router.peers.get(first.addr).state == DEGRADED
+    router.probe_tick()  # failure 2 -> DEAD: hand off its open request
+    assert router.peers.get(first.addr).state == DEAD
+    assert router.registry.counter("fleet_peer_deaths_total").value == 1
+    assert router._requests[rid].addr == second.addr
+    assert router.registry.gauge(
+        "fleet_peer_state", peer=first.addr
+    ).value == 2.0
+
+    first.alive = True
+    router.probe_tick()  # backoff not elapsed: DEAD stays untouched
+    assert router.peers.get(first.addr).state == DEAD
+    clock.t += 4.0  # past reprobe_backoff_seconds
+    router.probe_tick()  # half-open: answers -> DEGRADED + reconcile
+    state = router.peers.get(first.addr)
+    # half-open, never a straight DEAD->HEALTHY jump — the successful
+    # warm-start import inside the same tick then completes recovery
+    assert "dead->degraded" in state.transitions
+    assert state.state == HEALTHY
+    # the revived journal's copy was cancelled (compute hygiene) …
+    assert stale_daemon_rid in first.cancels
+    # … and the recovery warm-started it from the survivor's chains
+    assert first.kv_imports == [b"hot-chains"]
+    assert router.registry.counter(
+        "fleet_kv_imports_total", status="imported"
+    ).value == 2
+    assert router.registry.counter(
+        "fleet_kv_export_bytes_total"
+    ).value == len(b"hot-chains")
+
+
+def test_warm_start_counts_wire_refusals():
+    """A refused import (the peer's typed 400) lands in the refusal
+    counter under the wire reason — the fleet can SEE corruption."""
+    router, _clock, daemons = _fleet()
+    donor, newcomer = daemons[0], daemons[1]
+    donor.kv_blob = b"\x00" * 32
+    newcomer.kv_import_response = (400, {"reason": "integrity"})
+    router.warm_start(newcomer.addr, donor=donor.addr)
+    assert newcomer.kv_imports, "blob never shipped"
+    assert router.registry.counter(
+        "fleet_kv_wire_refusals_total", reason="integrity"
+    ).value == 1
+    assert router.registry.counter(
+        "fleet_kv_imports_total", status="imported"
+    ).value == 0
+
+
+def test_cancel_is_terminal_and_best_effort():
+    router, _clock, _daemons = _fleet()
+    code, rec = router.submit({"prompt": [6, 6], "max_new_tokens": 8})
+    rid = rec["request_id"]
+    code, _payload = router.cancel(rid)
+    assert code == 200
+    code, rec = router.result(rid)
+    assert rec["status"] == "cancelled"
+    assert router.cancel(rid)[0] == 404  # already terminal
+
+
+# -- the real thing: subprocess smoke + soak ---------------------------------
+
+
+def test_fleet_smoke_subprocess():
+    """The check_fleet gate inline: router + 2 daemon subprocesses on
+    loopback ports, one seeded SIGKILL mid-stream (bitwise handoff to
+    the survivor), one victim restart with a remote KV warm start, one
+    corrupt-import typed refusal, graceful SIGTERM exits."""
+    scripts = os.path.join(REPO_ROOT, "scripts")
+    sys.path.insert(0, scripts)
+    try:
+        import check_fleet
+    finally:
+        sys.path.pop(0)
+    problems = check_fleet.check_paths()
+    assert problems == []
+
+
+@pytest.mark.slow
+def test_fleet_soak_three_seeds(tmp_path):
+    """The acceptance soak: 3 seeded trials of router + 3 daemons under
+    a seeded SIGKILL each — zero lost accepted requests, zero duplicate
+    completions, bitwise handoffs, >= 1 remote import per trial."""
+    record = tmp_path / "FLEET_soak.json"
+    proc = subprocess.run(
+        [
+            sys.executable,
+            os.path.join(REPO_ROOT, "scripts", "fleet_bench.py"),
+            "--soak", "7", "--trials", "3", "--requests", "4",
+            "--record", str(record),
+        ],
+        capture_output=True, text=True, timeout=3600,
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    assert record.exists()

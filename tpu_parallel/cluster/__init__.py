@@ -78,16 +78,19 @@ from tpu_parallel.cluster.replica import (
     RestartPolicy,
 )
 from tpu_parallel.cluster.migration import (
+    land_exports,
     MIGRATION_STATUSES,
     capture_kv,
     install_kv,
     warm_start,
 )
 from tpu_parallel.cluster.router import (
+    HashRing,
     LeastLoadedRouter,
     PrefixAffinityRouter,
     RoundRobinRouter,
     Router,
+    hash_prompt_key,
     least_loaded,
     make_router,
     prefix_route_key,
@@ -147,12 +150,15 @@ __all__ = [
     "RoundRobinRouter",
     "LeastLoadedRouter",
     "PrefixAffinityRouter",
+    "HashRing",
+    "hash_prompt_key",
     "least_loaded",
     "make_router",
     "prefix_route_key",
     "MIGRATION_STATUSES",
     "capture_kv",
     "install_kv",
+    "land_exports",
     "warm_start",
     "SwapController",
     "SwapPolicy",

@@ -37,7 +37,7 @@ every failure mode degrades to the replay's proven bitwise story.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Dict, Iterable, Optional
 
 from tpu_parallel.serving.kv_hierarchy import (
     MIGRATE_ALREADY_CACHED,
@@ -52,6 +52,7 @@ __all__ = [
     "MIGRATE_ALREADY_CACHED",
     "capture_kv",
     "install_kv",
+    "land_exports",
     "warm_start",
 ]
 
@@ -72,6 +73,24 @@ def install_kv(handle, export: KVPrefixExport) -> str:
     recomputing ``export.length`` tokens; any other verdict leaves the
     replay recomputing exactly as before migration existed."""
     return handle.engine.import_prefix(export)
+
+
+def land_exports(
+    engine, exports: Iterable[KVPrefixExport]
+) -> Dict[str, int]:
+    """Land a batch of exports in ``engine``'s prefix cache, counting
+    typed verdicts — the transport-agnostic half every import path
+    shares: the in-process :func:`warm_start` below, the daemon's
+    ``/v1/kv/import`` peer endpoint, and the fleet router's donor-to-
+    newcomer push all reduce to this loop.  Nothing here knows where
+    the exports came from; the caller already decoded (and the engine
+    re-verifies) them, so a corrupt export is a counted ``integrity``
+    verdict, never a partial landing."""
+    counts: Dict[str, int] = {}
+    for export in exports:
+        verdict = engine.import_prefix(export)
+        counts[verdict] = counts.get(verdict, 0) + 1
+    return counts
 
 
 def warm_start(donor, newcomer, max_blocks: int) -> int:

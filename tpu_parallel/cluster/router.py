@@ -72,6 +72,116 @@ def _stable_hash(data: bytes) -> int:
     return int.from_bytes(hashlib.sha1(data).digest()[:8], "big")
 
 
+def hash_prompt_key(
+    prompt: Sequence[int], buckets: Optional[Sequence[int]]
+) -> int:
+    """Ring position of a prompt: the stable hash of its bucket-aligned
+    prefix key.  One function because TWO ring users must agree on it —
+    the in-process :class:`PrefixAffinityRouter` and the fleet router
+    placing the same prompt onto daemon processes (a disagreement would
+    send a prefix to one replica's cache and its retries to another's)."""
+    key = prefix_route_key(prompt, buckets)
+    return _stable_hash(
+        b"".join(int(t).to_bytes(8, "big", signed=True) for t in key)
+    )
+
+
+class HashRing:
+    """The consistent-hash ring itself, transport-agnostic: members are
+    any stable ids (in-process replica ints, fleet daemon ``host:port``
+    strings), positions come from ``sha1(f"{member}:{vnode}")``, and
+    lookups take a precomputed key hash — the ring neither knows nor
+    cares what a member or a key IS.
+
+    Extracted from :class:`PrefixAffinityRouter` (which now delegates)
+    so the fleet router reuses the exact placement function, weighted
+    membership and all: the stability argument — only a joining/leaving
+    member's keys move, a down-weighted member keeps its LOWEST vnode
+    indices so restored weight restores exactly the keys that left — is
+    proven once and inherited everywhere.
+    """
+
+    def __init__(self, members, vnodes: int = 64):
+        if not members:
+            raise ValueError("HashRing needs at least 1 member")
+        if vnodes < 1:
+            raise ValueError(f"vnodes={vnodes} < 1")
+        self.vnodes = vnodes
+        self._weights = {m: 1.0 for m in members}
+        if len(self._weights) != len(members):
+            raise ValueError(f"duplicate ring members in {members!r}")
+        self._rebuild()
+
+    def _rebuild(self) -> None:
+        ring = []
+        for member in sorted(self._weights):
+            # a weighted member keeps its LOWEST vnode indices, so
+            # raising the weight back restores exactly the keys that
+            # left (placement stays a pure function of the weight map)
+            n = max(1, int(round(self.vnodes * self._weights[member])))
+            for v in range(n):
+                ring.append((_stable_hash(f"{member}:{v}".encode()), member))
+        ring.sort()
+        self._ring_points = [p for p, _ in ring]
+        self._ring_members = [m for _, m in ring]
+
+    @property
+    def weights(self) -> dict:
+        """Current per-member ring weights (1.0 = full vnode share)."""
+        return dict(self._weights)
+
+    def __contains__(self, member) -> bool:
+        return member in self._weights
+
+    def __len__(self) -> int:
+        return len(self._weights)
+
+    def set_weight(self, member, weight: float) -> None:
+        """Rebalance: scale one member's share of the ring (0 < w <= 1)."""
+        if not 0.0 < weight <= 1.0:
+            raise ValueError(f"ring weight {weight} outside (0, 1]")
+        if member not in self._weights:
+            raise ValueError(f"{member!r} not on the ring")
+        self._weights[member] = weight
+        self._rebuild()
+
+    def add_member(self, member, weight: float = 1.0) -> None:
+        """Join the ring (no-op when already a member) — only keys whose
+        nearest point is one of the NEW vnodes move."""
+        if not 0.0 < weight <= 1.0:
+            raise ValueError(f"ring weight {weight} outside (0, 1]")
+        self._weights.setdefault(member, weight)
+        self._rebuild()
+
+    def remove_member(self, member) -> None:
+        """Leave the ring; the retiree's keys slide to their ring
+        successors, everyone else keeps a warm cache."""
+        if len(self._weights) <= 1:
+            raise ValueError("cannot remove the last ring member")
+        self._weights.pop(member, None)
+        self._rebuild()
+
+    def owner(self, key_hash: int):
+        """The member owning ``key_hash``, ignoring health — the stable
+        answer to "where does this key live?"."""
+        i = bisect.bisect_right(self._ring_points, key_hash)
+        return self._ring_members[i % len(self._ring_members)]
+
+    def walk(self, key_hash: int):
+        """Yield DISTINCT members in ring order starting at the key's
+        owner — the retry-with-exclusion order: callers take the first
+        member that is routable/not excluded, so keys of dead members
+        slide to their successors while every other key keeps its home."""
+        start = bisect.bisect_right(self._ring_points, key_hash)
+        n = len(self._ring_members)
+        seen = set()
+        for off in range(n):
+            member = self._ring_members[(start + off) % n]
+            if member not in seen:
+                seen.add(member)
+                yield member
+
+
 class Router:
     """Routing-policy contract (and registry of the built-in names)."""
 
@@ -148,87 +258,55 @@ class PrefixAffinityRouter(Router):
     ):
         if not replica_ids:
             raise ValueError("PrefixAffinityRouter needs at least 1 replica")
-        if vnodes < 1:
-            raise ValueError(f"vnodes={vnodes} < 1")
         self.buckets = tuple(buckets) if buckets else None
         self.overload_queue_depth = overload_queue_depth
         self.vnodes = vnodes
         self.fallbacks = 0  # affinity target overloaded -> least-loaded
-        self._weights = {int(rid): 1.0 for rid in replica_ids}
-        self._rebuild()
-
-    def _rebuild(self) -> None:
-        ring = []
-        for rid in sorted(self._weights):
-            # a weighted replica keeps its LOWEST vnode indices, so
-            # raising the weight back restores exactly the keys that
-            # left (placement stays a pure function of the weight map)
-            n = max(1, int(round(self.vnodes * self._weights[rid])))
-            for v in range(n):
-                ring.append((_stable_hash(f"{rid}:{v}".encode()), rid))
-        ring.sort()
-        self._ring_points = [p for p, _ in ring]
-        self._ring_ids = [rid for _, rid in ring]
+        self.ring = HashRing([int(rid) for rid in replica_ids], vnodes)
 
     @property
     def weights(self) -> dict:
         """Current per-replica ring weights (1.0 = full vnode share)."""
-        return dict(self._weights)
+        return self.ring.weights
 
     def set_weight(self, replica_id: int, weight: float) -> None:
         """Rebalance: scale one replica's share of the ring (0 < w <= 1).
         The autopilot halves a hot replica's weight when its load runs
         past ``imbalance_factor`` x the fleet mean, and restores it once
         the fleet is balanced again."""
-        if not 0.0 < weight <= 1.0:
-            raise ValueError(f"ring weight {weight} outside (0, 1]")
-        if replica_id not in self._weights:
-            raise ValueError(f"replica {replica_id} not on the ring")
-        self._weights[replica_id] = weight
-        self._rebuild()
+        try:
+            self.ring.set_weight(int(replica_id), weight)
+        except ValueError as exc:
+            if "not on the ring" in str(exc):
+                raise ValueError(
+                    f"replica {replica_id} not on the ring"
+                ) from None
+            raise
 
     def add_replica(self, replica_id: int, weight: float = 1.0) -> None:
         """Scale-up: join the ring (no-op when already a member) — only
         keys whose nearest point is one of the NEW vnodes move."""
-        if not 0.0 < weight <= 1.0:
-            raise ValueError(f"ring weight {weight} outside (0, 1]")
-        self._weights.setdefault(int(replica_id), weight)
-        self._rebuild()
+        self.ring.add_member(int(replica_id), weight)
 
     def remove_replica(self, replica_id: int) -> None:
         """Scale-down: leave the ring; the retiree's keys slide to their
         ring successors, everyone else keeps a warm cache."""
-        if len(self._weights) <= 1:
-            raise ValueError("cannot remove the last ring member")
-        self._weights.pop(int(replica_id), None)
-        self._rebuild()
+        self.ring.remove_member(int(replica_id))
 
     def owner(self, prompt: Sequence[int]) -> int:
         """The ring owner of this prompt's prefix key, ignoring health —
         the stable answer to "where does this prefix live?"."""
-        key = prefix_route_key(prompt, self.buckets)
-        h = _stable_hash(
-            b"".join(int(t).to_bytes(8, "big", signed=True) for t in key)
-        )
-        i = bisect.bisect_right(self._ring_points, h) % len(self._ring_points)
-        return self._ring_ids[i]
+        return self.ring.owner(hash_prompt_key(prompt, self.buckets))
 
     def route(self, prompt, candidates):
         if not candidates:
             return None
-        key = prefix_route_key(prompt, self.buckets)
-        h = _stable_hash(
-            b"".join(int(t).to_bytes(8, "big", signed=True) for t in key)
-        )
         # walk the ring clockwise; first ROUTABLE owner wins, so keys of
         # dead/excluded replicas slide to their successors while every
         # other key keeps its home
         by_id = {c.replica_id: c for c in candidates}
-        start = bisect.bisect_right(self._ring_points, h)
         pick = None
-        n = len(self._ring_ids)
-        for off in range(n):
-            rid = self._ring_ids[(start + off) % n]
+        for rid in self.ring.walk(hash_prompt_key(prompt, self.buckets)):
             if rid in by_id:
                 pick = by_id[rid]
                 break

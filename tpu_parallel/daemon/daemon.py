@@ -236,6 +236,7 @@ class ServingDaemon:
         self._m_degraded_rejects = r.counter(
             "daemon_degraded_rejects_total"
         )
+        self._m_kv_peer_exports = r.counter("daemon_kv_peer_exports_total")
         # observed swap/autopilot decisions flow through the frontend's
         # journal hook into REC_DECISION records
         self.frontend.set_journal(self._frontend_note)
@@ -912,6 +913,58 @@ class ServingDaemon:
             elif not self.frontend.has_work():
                 self.clock.sleep(self.config.idle_sleep_seconds)
         return EXIT_FORCED  # max_ticks exhausted with the daemon still up
+
+    # -- peer KV exchange (fleet) ------------------------------------------
+
+    def export_hot_kv(self, max_blocks: int = 16) -> List:
+        """Snapshot the hottest radix-cached prefixes from the first
+        live replica that pages any, for shipment to a fleet peer
+        (warm-start on join/restart, drain-forward on leave — see
+        ``fleet/router.py`` and docs/14_fleet.md).  Returns a list of
+        :class:`~tpu_parallel.serving.kv_hierarchy.KVPrefixExport`;
+        empty when no replica runs a radix cache or nothing is hot."""
+        from tpu_parallel.cluster.replica import DEAD as _REPLICA_DEAD
+
+        with self._lock:
+            if self._stopped:
+                return []
+            for handle in self.frontend.replicas:
+                if handle.health == _REPLICA_DEAD:
+                    continue
+                exporter = getattr(
+                    handle.engine, "export_hot_prefixes", None
+                )
+                if exporter is None:
+                    continue
+                exports = exporter(max_blocks=max_blocks)
+                if exports:
+                    self._m_kv_peer_exports.inc(len(exports))
+                    return list(exports)
+            return []
+
+    def import_peer_kv(self, exports) -> Dict[str, int]:
+        """Land already-decoded peer exports into every live replica's
+        prefix cache, inheriting the migration layer's verify-or-refuse
+        contract — corrupt or incompatible blocks land as typed refusal
+        verdicts, never as served bytes.  Returns verdict counts
+        (``imported`` / ``integrity`` / ``weights_version`` / ...)."""
+        from tpu_parallel.cluster.migration import land_exports
+        from tpu_parallel.cluster.replica import DEAD as _REPLICA_DEAD
+
+        with self._lock:
+            counts: Dict[str, int] = {}
+            for handle in self.frontend.replicas:
+                if handle.health == _REPLICA_DEAD:
+                    continue
+                for verdict, n in land_exports(
+                    handle.engine, exports
+                ).items():
+                    counts[verdict] = counts.get(verdict, 0) + n
+            for verdict, n in counts.items():
+                self.registry.counter(
+                    "daemon_kv_peer_imports_total", status=verdict
+                ).inc(n)
+            return counts
 
     # -- introspection -----------------------------------------------------
 

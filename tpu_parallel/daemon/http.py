@@ -45,6 +45,11 @@ from typing import Optional
 
 from tpu_parallel.daemon.daemon import REJECT_DEGRADED, REJECT_JOURNAL
 from tpu_parallel.obs.exporters import prometheus_text
+from tpu_parallel.serving.kv_wire import (
+    WireFormatError,
+    decode_exports,
+    encode_exports,
+)
 from tpu_parallel.serving.request import (
     REJECT_DRAINING,
     REJECTED,
@@ -64,6 +69,11 @@ _STREAM_POLL_SECONDS = 2.0
 # seq_len-8k prompt with maximal ids is far below this — anything
 # bigger is a misdirected upload, not a request
 _MAX_BODY_BYTES = 1 << 20
+
+# peer-KV import cap: KV payloads are raw block tensors, orders of
+# magnitude above any submit body, but still bounded — a peer shipping
+# more than this per transfer should chunk its exports
+_MAX_KV_BODY_BYTES = 1 << 27
 
 # typed finish_reasons that map to 503 (route elsewhere / retry later)
 # rather than 429 (client-side backpressure)
@@ -102,6 +112,7 @@ class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     daemon = None  # set by DaemonHTTPServer
     max_body_bytes = _MAX_BODY_BYTES
+    max_kv_body_bytes = _MAX_KV_BODY_BYTES
     keepalive_seconds = _STREAM_POLL_SECONDS
 
     # -- plumbing ----------------------------------------------------------
@@ -174,7 +185,45 @@ class _Handler(BaseHTTPRequestHandler):
             if d.cancel(rid, reason="cancelled"):
                 return self._json(200, {"cancelled": rid})
             return self._json(404, {"error": f"unknown/done request {rid}"})
+        if self.path == "/v1/kv/import":
+            return self._kv_import()
         return self._json(404, {"error": f"no route {self.path}"})
+
+    def _kv_import(self) -> None:
+        """Peer KV landing: a length-prefixed ``kv_wire`` stream in the
+        body, verdict counts out.  Damaged frames are a typed 400 — the
+        decode refusal IS the response; nothing partially lands."""
+        d = self.daemon
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+        except ValueError:
+            length = -1
+        if length < 0 or length > self.max_kv_body_bytes:
+            self.close_connection = True
+            return self._json(413, {
+                "error": (
+                    f"KV payload of {length} bytes exceeds the "
+                    f"{self.max_kv_body_bytes}-byte import limit"
+                ),
+            })
+        try:
+            raw = self.rfile.read(length) if length else b""
+        except OSError:
+            return self._json(400, {"error": "truncated KV payload"})
+        try:
+            exports = decode_exports(raw)
+        except WireFormatError as exc:
+            d.registry.counter(
+                "daemon_kv_wire_refusals_total", reason=exc.reason
+            ).inc()
+            return self._json(400, {
+                "error": str(exc), "reason": exc.reason,
+            })
+        verdicts = d.import_peer_kv(exports)
+        return self._json(200, {
+            "verdicts": verdicts,
+            "imported": verdicts.get("imported", 0),
+        })
 
     def do_GET(self):
         d = self.daemon
@@ -203,6 +252,24 @@ class _Handler(BaseHTTPRequestHandler):
                 200, prometheus_text(d.registry),
                 "text/plain; version=0.0.4",
             )
+        if self.path.startswith("/v1/kv/export"):
+            max_blocks = 16
+            if "?" in self.path:
+                for part in self.path.split("?", 1)[1].split("&"):
+                    if part.startswith("max_blocks="):
+                        try:
+                            max_blocks = int(part[len("max_blocks="):])
+                        except ValueError:
+                            return self._json(400, {
+                                "error": "max_blocks must be an integer",
+                            })
+            blob = encode_exports(d.export_hot_kv(max_blocks=max_blocks))
+            self.send_response(200)
+            self.send_header("Content-Type", "application/octet-stream")
+            self.send_header("Content-Length", str(len(blob)))
+            self.end_headers()
+            self.wfile.write(blob)
+            return
         if self.path.startswith("/v1/result/"):
             rid = self.path[len("/v1/result/"):]
             record = d.result(rid)
@@ -280,10 +347,13 @@ class DaemonHTTPServer:
         host: str = "127.0.0.1",
         port: int = 0,
         max_body_bytes: int = _MAX_BODY_BYTES,
+        max_kv_body_bytes: int = _MAX_KV_BODY_BYTES,
         sse_keepalive_seconds: float = _STREAM_POLL_SECONDS,
     ):
         if max_body_bytes < 1:
             raise ValueError(f"max_body_bytes={max_body_bytes} < 1")
+        if max_kv_body_bytes < 1:
+            raise ValueError(f"max_kv_body_bytes={max_kv_body_bytes} < 1")
         if sse_keepalive_seconds <= 0:
             raise ValueError(
                 f"sse_keepalive_seconds={sse_keepalive_seconds} <= 0"
@@ -291,6 +361,7 @@ class DaemonHTTPServer:
         handler = type("_BoundHandler", (_Handler,), {
             "daemon": daemon,
             "max_body_bytes": max_body_bytes,
+            "max_kv_body_bytes": max_kv_body_bytes,
             "keepalive_seconds": sse_keepalive_seconds,
         })
         self.httpd = ThreadingHTTPServer((host, port), handler)

@@ -250,14 +250,22 @@ def fsync_file(fh) -> None:
     os.fsync(fh.fileno())  # raw-io: the shim IS the door
 
 
-def read_text(path: str) -> str:
-    """Read a whole text file through the injector's read gate: the
-    active plan may XOR one bit into the payload (decoded with
-    ``errors="replace"`` so a flip inside a multi-byte sequence still
-    yields a string — and a CRC mismatch — instead of an exception)."""
+def read_bytes(path: str) -> bytes:
+    """Read a whole file as bytes through the injector's read gate: the
+    active plan may XOR one bit into the payload.  The binary sibling of
+    :func:`read_text` — KV wire blobs (``serving/kv_wire.py``) come off
+    disk through here so the fault soak's rot leg covers them too."""
     with open_file(path, "rb") as fh:
         payload = fh.read()
     inj = _ACTIVE
     if inj is not None:
         payload = inj.on_read(payload)
-    return payload.decode("utf-8", errors="replace")
+    return payload
+
+
+def read_text(path: str) -> str:
+    """Read a whole text file through the injector's read gate: the
+    active plan may XOR one bit into the payload (decoded with
+    ``errors="replace"`` so a flip inside a multi-byte sequence still
+    yields a string — and a CRC mismatch — instead of an exception)."""
+    return read_bytes(path).decode("utf-8", errors="replace")

@@ -1,0 +1,58 @@
+"""Multi-process serving fleet: N independent daemon processes behind
+one wire-level router (docs/14_fleet.md).
+
+Everything the stack does in-process — prefix-affinity routing,
+breaker-guarded health, KV migration, forced-prefix replay — exists
+here a second time ACROSS process and host boundaries, built from the
+same primitives: the router reuses the cluster's consistent-hash ring
+over daemon addresses, peer health reuses the replica breaker's state
+vocabulary, remote KV migration ships the CRC-checksummed
+:class:`KVPrefixExport` through the ``serving/kv_wire.py`` codec, and
+cross-host handoff replays a dead host's streams onto survivors via
+the same forced-prefix mechanism daemon crash recovery uses — so
+greedy continuations stay bitwise across a host death.
+
+- :mod:`tpu_parallel.fleet.peers` — peer health (HEALTHY → DEGRADED →
+  DEAD with backoff re-probe) on the injectable clock.
+- :mod:`tpu_parallel.fleet.router` — the transport-agnostic router
+  core: typed admission, retry-with-exclusion, the fleet-wide dedupe
+  ledger, handoff, and KV warm-start/drain-forward orchestration.
+- :mod:`tpu_parallel.fleet.http` — the urllib transport + the
+  client-facing server re-serving the daemon's exact HTTP/SSE
+  contract.
+"""
+
+from tpu_parallel.fleet.http import FleetHTTPServer, HTTPFleetTransport
+from tpu_parallel.fleet.peers import (
+    DEAD,
+    DEGRADED,
+    HEALTHY,
+    PeerPolicy,
+    PeerSet,
+    PeerState,
+)
+from tpu_parallel.fleet.router import (
+    FLEET_TRACK,
+    REJECT_HANDOFFS,
+    REJECT_NO_PEER,
+    FleetRouter,
+    FleetTransport,
+    TransportError,
+)
+
+__all__ = [
+    "HEALTHY",
+    "DEGRADED",
+    "DEAD",
+    "PeerPolicy",
+    "PeerState",
+    "PeerSet",
+    "FLEET_TRACK",
+    "REJECT_NO_PEER",
+    "REJECT_HANDOFFS",
+    "FleetRouter",
+    "FleetTransport",
+    "TransportError",
+    "HTTPFleetTransport",
+    "FleetHTTPServer",
+]

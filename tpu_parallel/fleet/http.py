@@ -1,0 +1,296 @@
+"""The fleet's wire layer: an urllib transport speaking to daemon HTTP
+servers, and the client-facing fleet HTTP server.
+
+Both halves are deliberately thin.  :class:`HTTPFleetTransport` maps
+the :class:`~tpu_parallel.fleet.router.FleetTransport` contract onto
+the daemon endpoints (``daemon/http.py``) — an HTTP status code is a
+RESPONSE (returned typed), failing to get one is a
+:class:`TransportError` (fed to the breaker).  :class:`FleetHTTPServer`
+re-serves the daemon's exact client contract (``/v1/submit``,
+``/v1/stream``, ``/v1/result``, ``/v1/cancel``, ``/healthz``,
+``/statez``, ``/metricsz``) over a :class:`FleetRouter`, so a client
+pointed at one daemon can be re-pointed at a whole fleet without
+changing a line — the ISSUE's client-contract-unchanged requirement.
+
+Timeouts come from the router's :class:`PeerPolicy` via the caller; the
+only timing primitive here is the socket timeout urllib applies, so the
+module stays clean under ``scripts/check_clock.py``'s fleet walk.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Iterator, Optional, Tuple
+
+from tpu_parallel.fleet.router import (
+    FleetRouter,
+    FleetTransport,
+    TransportError,
+)
+from tpu_parallel.obs.exporters import prometheus_text
+
+_MAX_BODY_BYTES = 1 << 20  # same submit cap as the daemon server
+
+__all__ = ["HTTPFleetTransport", "FleetHTTPServer"]
+
+
+class HTTPFleetTransport(FleetTransport):
+    """The production transport: plain urllib against daemon servers.
+    Stateless — every call names its peer ``addr`` (``host:port``)."""
+
+    def _request(
+        self,
+        addr: str,
+        method: str,
+        path: str,
+        timeout: float,
+        data: Optional[bytes] = None,
+        content_type: str = "application/json",
+        binary_response: bool = False,
+    ):
+        req = urllib.request.Request(
+            f"http://{addr}{path}", data=data, method=method,
+            headers={"Content-Type": content_type} if data else {},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                code, payload = resp.status, resp.read()
+        except urllib.error.HTTPError as exc:
+            code, payload = exc.code, exc.read()
+        except (urllib.error.URLError, OSError) as exc:
+            raise TransportError(addr, f"{method} {path}: {exc}") from None
+        if binary_response:
+            return code, payload
+        try:
+            return code, json.loads(payload or b"{}")
+        except ValueError:
+            raise TransportError(
+                addr, f"{method} {path}: non-JSON {code} response"
+            ) from None
+
+    def healthz(self, addr: str, timeout: float) -> Tuple[int, dict]:
+        return self._request(addr, "GET", "/healthz", timeout)
+
+    def submit(
+        self, addr: str, body: dict, timeout: float
+    ) -> Tuple[int, dict]:
+        return self._request(
+            addr, "POST", "/v1/submit", timeout,
+            data=json.dumps(body).encode(),
+        )
+
+    def result(
+        self, addr: str, request_id: str, timeout: float
+    ) -> Tuple[int, dict]:
+        return self._request(
+            addr, "GET", f"/v1/result/{request_id}", timeout
+        )
+
+    def cancel(
+        self, addr: str, request_id: str, timeout: float
+    ) -> Tuple[int, dict]:
+        return self._request(
+            addr, "POST", f"/v1/cancel/{request_id}", timeout, data=b"{}"
+        )
+
+    def stream(
+        self, addr: str, request_id: str, idle_timeout: float
+    ) -> Iterator[dict]:
+        """Attach to the daemon's SSE stream; ``idle_timeout`` is the
+        per-read socket timeout — the daemon's keepalive comments (which
+        we skip) reset it, so only a genuinely wedged or dead peer trips
+        it.  Any tear mid-iteration raises :class:`TransportError`: the
+        router's handoff trigger."""
+        req = urllib.request.Request(
+            f"http://{addr}/v1/stream/{request_id}"
+        )
+        try:
+            resp = urllib.request.urlopen(req, timeout=idle_timeout)
+        except urllib.error.HTTPError as exc:
+            exc.read()
+            raise TransportError(
+                addr, f"stream {request_id}: HTTP {exc.code}"
+            ) from None
+        except (urllib.error.URLError, OSError) as exc:
+            raise TransportError(
+                addr, f"stream {request_id}: {exc}"
+            ) from None
+
+        def events() -> Iterator[dict]:
+            try:
+                with resp:
+                    for raw in resp:
+                        line = raw.strip()
+                        if not line.startswith(b"data:"):
+                            continue  # keepalive comment / separator
+                        try:
+                            yield json.loads(line[len(b"data:"):].strip())
+                        except ValueError:
+                            raise TransportError(
+                                addr, "stream: malformed SSE data"
+                            ) from None
+            except TransportError:
+                raise
+            except (OSError, ValueError) as exc:
+                raise TransportError(
+                    addr, f"stream torn: {exc}"
+                ) from None
+
+        return events()
+
+    def kv_export(
+        self, addr: str, max_blocks: int, timeout: float
+    ) -> bytes:
+        code, payload = self._request(
+            addr, "GET", f"/v1/kv/export?max_blocks={int(max_blocks)}",
+            timeout, binary_response=True,
+        )
+        if code != 200:
+            raise TransportError(addr, f"kv export: HTTP {code}")
+        return payload
+
+    def kv_import(
+        self, addr: str, blob: bytes, timeout: float
+    ) -> Tuple[int, dict]:
+        return self._request(
+            addr, "POST", "/v1/kv/import", timeout, data=blob,
+            content_type="application/octet-stream",
+        )
+
+
+class _FleetHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    router: FleetRouter = None  # bound by FleetHTTPServer
+    max_body_bytes = _MAX_BODY_BYTES
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+    def _json(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        r = self.router
+        if self.path == "/v1/submit":
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+            except ValueError:
+                length = -1
+            if length < 0 or length > self.max_body_bytes:
+                self.close_connection = True
+                return self._json(413, {
+                    "error": (
+                        f"body of {length} bytes exceeds the "
+                        f"{self.max_body_bytes}-byte submit limit"
+                    ),
+                })
+            try:
+                body = json.loads(self.rfile.read(length) or b"{}")
+            except (ValueError, OSError):
+                body = None
+            if not isinstance(body, dict):
+                return self._json(400, {"error": "malformed JSON body"})
+            code, record = r.submit(body)
+            return self._json(code, record)
+        if self.path.startswith("/v1/cancel/"):
+            rid = self.path[len("/v1/cancel/"):]
+            code, payload = r.cancel(rid)
+            return self._json(code, payload)
+        return self._json(404, {"error": f"no route {self.path}"})
+
+    def do_GET(self):
+        r = self.router
+        if self.path == "/healthz":
+            routable = r.peers.routable()
+            code = 200 if routable else 503
+            return self._json(code, {
+                "ok": code == 200,
+                "peers": r.peers.states(),
+            })
+        if self.path == "/statez":
+            return self._json(200, r.status())
+        if self.path == "/metricsz":
+            body = prometheus_text(r.registry).encode()
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4"
+            )
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if self.path.startswith("/v1/result/"):
+            rid = self.path[len("/v1/result/"):]
+            code, record = r.result(rid)
+            return self._json(code, record)
+        if self.path.startswith("/v1/stream/"):
+            return self._stream(self.path[len("/v1/stream/"):])
+        return self._json(404, {"error": f"no route {self.path}"})
+
+    def _stream(self, rid: str) -> None:
+        r = self.router
+        code, _record = r.result(rid)
+        if code != 200:
+            return self._json(code, {"error": f"unknown request {rid}"})
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        try:
+            for ev in r.stream(rid):
+                self.wfile.write(
+                    f"data: {json.dumps(ev)}\n\n".encode()
+                )
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            # the client hung up mid-stream: stop generating for it,
+            # fleet-wide — same semantics as the single-daemon server
+            r.cancel(rid)
+
+
+class FleetHTTPServer:
+    """The fleet's client face: a threading HTTP server over one
+    :class:`FleetRouter`, started on a background thread so the
+    router's probe pump (``router.run()``) owns the main thread."""
+
+    def __init__(
+        self,
+        router: FleetRouter,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        max_body_bytes: int = _MAX_BODY_BYTES,
+    ):
+        if max_body_bytes < 1:
+            raise ValueError(f"max_body_bytes={max_body_bytes} < 1")
+        handler = type("_BoundFleetHandler", (_FleetHandler,), {
+            "router": router,
+            "max_body_bytes": max_body_bytes,
+        })
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.httpd.daemon_threads = True
+        self.host, self.port = self.httpd.server_address[:2]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "FleetHTTPServer":
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever,
+            kwargs={"poll_interval": 0.1}, daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

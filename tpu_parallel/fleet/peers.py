@@ -1,0 +1,238 @@
+"""Peer health for the fleet router: the PR 8 replica breaker,
+generalized to daemons it can only observe over a wire.
+
+The in-process frontend KNOWS when a replica died — the engine call
+raised.  The fleet router only ever sees symptoms: a refused
+connection, a request timeout, a 503.  So health is a per-peer state
+machine fed by two evidence streams — periodic ``/healthz`` probes and
+the outcome of every real request — and the states deliberately reuse
+the cluster's vocabulary (docs/12_cluster.md):
+
+- ``HEALTHY``  — routable, preferred.
+- ``DEGRADED`` — recent failures (or a half-open recovery); routable
+  only when no HEALTHY peer can take the key.  New evidence resolves it
+  quickly in either direction.
+- ``DEAD``     — ``dead_after`` consecutive failures; never routable.
+  Re-probed on an exponential backoff (``reprobe_backoff_*``) so a
+  rebooting host is re-admitted in seconds while a truly gone one
+  costs one cheap probe per backoff cap.  A DEAD peer that answers a
+  probe re-enters at DEGRADED — half-open, exactly like the replica
+  breaker's probation — and earns HEALTHY with one more success.
+
+Everything is measured on the INJECTABLE clock the constructor takes
+(``scripts/check_clock.py`` walks ``tpu_parallel/fleet`` too), so the
+whole fleet failure story unit-tests deterministically: tests advance a
+fake clock and feed scripted probe outcomes; only ``scripts/
+fleet_bench.py`` ever wires in wall time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence
+
+from tpu_parallel.cluster.replica import DEAD, DEGRADED, HEALTHY
+
+__all__ = [
+    "HEALTHY",
+    "DEGRADED",
+    "DEAD",
+    "PeerPolicy",
+    "PeerState",
+    "PeerSet",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class PeerPolicy:
+    """The fleet breaker knobs (all seconds are on the injected clock).
+
+    - ``probe_interval_seconds``: how often a live peer's ``/healthz``
+      is polled.
+    - ``degraded_after`` / ``dead_after``: consecutive failures that
+      demote HEALTHY→DEGRADED and →DEAD.  A single success resets the
+      count — one flaky probe must not start a death spiral.
+    - ``reprobe_backoff_seconds`` * ``factor`` ** (deaths in a row),
+      capped at ``reprobe_backoff_max``: the DEAD re-probe schedule.
+    - ``connect_timeout_seconds`` / ``request_timeout_seconds``: what
+      the transport should allow a probe / a unary request before
+      declaring the peer unresponsive (carried here so the router and
+      its transport agree without a second config object).
+    - ``stream_idle_timeout_seconds``: max silence mid-stream before
+      the relay treats the daemon as wedged — must comfortably exceed
+      the daemon's SSE keepalive period or healthy idle streams would
+      be executed for the crime of thinking.
+    """
+
+    probe_interval_seconds: float = 2.0
+    degraded_after: int = 1
+    dead_after: int = 3
+    reprobe_backoff_seconds: float = 1.0
+    reprobe_backoff_factor: float = 2.0
+    reprobe_backoff_max: float = 30.0
+    connect_timeout_seconds: float = 5.0
+    request_timeout_seconds: float = 30.0
+    stream_idle_timeout_seconds: float = 15.0
+
+    def __post_init__(self):
+        if self.degraded_after < 1:
+            raise ValueError(f"degraded_after={self.degraded_after} < 1")
+        if self.dead_after < self.degraded_after:
+            raise ValueError(
+                f"dead_after={self.dead_after} < "
+                f"degraded_after={self.degraded_after}"
+            )
+        if self.probe_interval_seconds <= 0:
+            raise ValueError("probe_interval_seconds must be positive")
+
+
+class PeerState:
+    """One daemon address's breaker state.  Pure bookkeeping — the
+    PeerSet feeds it evidence, the router reads ``state``."""
+
+    __slots__ = (
+        "addr", "state", "failures", "consecutive_deaths", "deaths",
+        "last_probe", "next_probe_at", "last_ok", "transitions",
+    )
+
+    def __init__(self, addr: str):
+        self.addr = addr
+        self.state = HEALTHY
+        self.failures = 0  # consecutive, reset by any success
+        self.consecutive_deaths = 0  # backoff escalation level
+        self.deaths = 0  # lifetime DEAD transitions (metrics)
+        self.last_probe = float("-inf")
+        self.next_probe_at = 0.0
+        self.last_ok: Optional[float] = None
+        self.transitions: List[str] = []
+
+    def routable(self) -> bool:
+        return self.state != DEAD
+
+    def note_success(self, now: float, policy: PeerPolicy) -> str:
+        """Fold one success (probe or served request).  Returns the
+        resulting state.  DEAD answers half-open into DEGRADED; a
+        DEGRADED success completes recovery to HEALTHY."""
+        self.failures = 0
+        self.last_ok = now
+        self.next_probe_at = now + policy.probe_interval_seconds
+        if self.state == DEAD:
+            self._transition(DEGRADED)
+            self.consecutive_deaths = 0
+        elif self.state == DEGRADED:
+            self._transition(HEALTHY)
+        return self.state
+
+    def note_failure(self, now: float, policy: PeerPolicy) -> str:
+        """Fold one failure (refused/timeout/transport error).  Returns
+        the resulting state; entering DEAD schedules the backoff
+        re-probe."""
+        self.failures += 1
+        if self.failures >= policy.dead_after:
+            if self.state != DEAD:
+                self._transition(DEAD)
+                self.deaths += 1
+                self.consecutive_deaths += 1
+            backoff = min(
+                policy.reprobe_backoff_max,
+                policy.reprobe_backoff_seconds
+                * policy.reprobe_backoff_factor
+                ** max(0, self.consecutive_deaths - 1),
+            )
+            self.next_probe_at = now + backoff
+        elif self.failures >= policy.degraded_after:
+            if self.state == HEALTHY:
+                self._transition(DEGRADED)
+            self.next_probe_at = now  # verify a shaky peer promptly
+        return self.state
+
+    def probe_due(self, now: float) -> bool:
+        return now >= self.next_probe_at
+
+    def _transition(self, state: str) -> None:
+        self.transitions.append(f"{self.state}->{state}")
+        self.state = state
+
+    def summary(self) -> dict:
+        return {
+            "addr": self.addr,
+            "state": self.state,
+            "failures": self.failures,
+            "deaths": self.deaths,
+            "last_ok": self.last_ok,
+        }
+
+
+class PeerSet:
+    """The router's membership + health view over daemon addresses.
+
+    Not thread-safe by itself — the FleetRouter serializes access under
+    its own lock; probes happen in the router's pump thread, evidence
+    from request outcomes arrives from handler threads through the
+    router."""
+
+    def __init__(
+        self,
+        addrs: Sequence[str],
+        clock: Callable[[], float],
+        policy: Optional[PeerPolicy] = None,
+    ):
+        if not addrs:
+            raise ValueError("PeerSet needs at least 1 peer address")
+        self.clock = clock
+        self.policy = policy or PeerPolicy()
+        self.peers: Dict[str, PeerState] = {
+            addr: PeerState(addr) for addr in addrs
+        }
+        if len(self.peers) != len(addrs):
+            raise ValueError(f"duplicate peer addresses in {addrs!r}")
+
+    def add(self, addr: str) -> PeerState:
+        """Join (idempotent).  A joining peer starts DEGRADED, not
+        HEALTHY: it becomes preferred only after its first good probe —
+        the router must not aim traffic at an address it has never
+        seen answer."""
+        state = self.peers.get(addr)
+        if state is None:
+            state = PeerState(addr)
+            state.state = DEGRADED
+            self.peers[addr] = state
+        return state
+
+    def remove(self, addr: str) -> None:
+        self.peers.pop(addr, None)
+
+    def get(self, addr: str) -> Optional[PeerState]:
+        return self.peers.get(addr)
+
+    def note_success(self, addr: str) -> str:
+        state = self.peers.get(addr)
+        if state is None:
+            return DEAD
+        return state.note_success(self.clock(), self.policy)
+
+    def note_failure(self, addr: str) -> str:
+        state = self.peers.get(addr)
+        if state is None:
+            return DEAD
+        return state.note_failure(self.clock(), self.policy)
+
+    def routable(self) -> List[str]:
+        """Addresses a new request may target, HEALTHY before DEGRADED
+        (the caller applies ring order within each class)."""
+        return [a for a, s in self.peers.items() if s.state == HEALTHY] + [
+            a for a, s in self.peers.items() if s.state == DEGRADED
+        ]
+
+    def healthy(self) -> List[str]:
+        return [a for a, s in self.peers.items() if s.state == HEALTHY]
+
+    def probe_due(self) -> List[str]:
+        now = self.clock()
+        return [a for a, s in self.peers.items() if s.probe_due(now)]
+
+    def states(self) -> Dict[str, str]:
+        return {a: s.state for a, s in self.peers.items()}
+
+    def summary(self) -> dict:
+        return {a: s.summary() for a, s in self.peers.items()}

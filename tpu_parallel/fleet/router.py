@@ -1,0 +1,770 @@
+"""The fleet router core: one client-facing serving surface over N
+daemon processes.
+
+This is the transport-agnostic half of the fleet (docs/14_fleet.md):
+everything here is driven through two injected seams — a ``clock``
+(``scripts/check_clock.py`` keeps wall time out of this package) and a
+:class:`FleetTransport` (the wire; ``fleet/http.py`` implements it over
+urllib, unit tests implement it over scripted in-memory daemons).  The
+router owns four pieces of state and nothing else:
+
+- the **consistent-hash ring** (:class:`~tpu_parallel.cluster.router.
+  HashRing` over daemon addresses) — the same placement function the
+  in-process :class:`PrefixAffinityRouter` uses over replica ids, so a
+  prompt's bucket-aligned prefix lands on the daemon whose radix cache
+  already holds it, and only a dead daemon's keys slide to successors;
+- the **peer breaker** (:class:`~tpu_parallel.fleet.peers.PeerSet`) —
+  HEALTHY→DEGRADED→DEAD from probe + request evidence, backoff
+  re-probe, half-open recovery;
+- the **request table** — every accepted request's client-visible
+  tokens and its current backing ``(addr, daemon request id)``.  The
+  tokens the router has relayed are what make cross-host handoff
+  possible: when a daemon dies mid-stream, the request is resubmitted
+  to a survivor as ``prompt + delivered`` with the remaining token
+  budget — the same forced-prefix mechanism daemon crash recovery
+  replays through, so greedy continuations stay bitwise;
+- the **dedupe ledger** — client ``dedupe_token`` → router request id.
+  The daemon's journal makes retries idempotent per host; the ledger
+  makes them idempotent fleet-wide, because after a handoff the
+  original token only exists on a dead host.
+
+Admission is typed end to end: a daemon's 503 (draining / degraded /
+journal error) excludes that peer and tries the next ring successor; a
+transport failure feeds the breaker and does the same; running out of
+peers is a typed ``no_peer`` 503, never a hang.  Remote KV migration
+rides two transport calls (``kv_export`` → ``kv_import``): recovered
+and newly joined peers warm-start their hottest chains from a donor,
+and a draining peer ships live prefixes forward — imports re-verify
+per-block CRCs engine-side, so corrupt bytes are a counted typed
+refusal, never served K/V.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from tpu_parallel.cluster.replica import DEAD, HEALTHY
+from tpu_parallel.cluster.router import HashRing, hash_prompt_key, _stable_hash
+from tpu_parallel.fleet.peers import PeerPolicy, PeerSet
+from tpu_parallel.obs.registry import MetricRegistry
+from tpu_parallel.obs.tracer import NULL_TRACER
+from tpu_parallel.serving.request import (
+    CANCELLED,
+    EXPIRED,
+    FAILED,
+    FINISHED,
+    REJECTED,
+)
+
+FLEET_TRACK = "fleet"  # the router's tracer track
+
+# fleet-level typed rejection reasons (the daemon's reasons pass through)
+REJECT_NO_PEER = "no_peer"
+REJECT_HANDOFFS = "handoff_limit"
+
+_TERMINAL = frozenset({FINISHED, FAILED, CANCELLED, EXPIRED, REJECTED})
+# daemon response codes that are the CLIENT's problem — no retry helps
+_CLIENT_ERROR_CODES = frozenset({400, 404, 413})
+# consecutive no-peer handoff attempts a stream relay tolerates (one
+# probe-interval wait apiece) before failing the request typed
+_STREAM_RETRY_LIMIT = 40
+
+__all__ = [
+    "FLEET_TRACK",
+    "REJECT_NO_PEER",
+    "REJECT_HANDOFFS",
+    "FleetRouter",
+    "FleetTransport",
+    "TransportError",
+]
+
+
+class TransportError(Exception):
+    """Any wire-level failure talking to one peer — refused connection,
+    timeout, torn stream.  One exception type because the breaker does
+    not care WHICH symptom a dead host shows."""
+
+    def __init__(self, addr: str, detail: str):
+        super().__init__(f"{addr}: {detail}")
+        self.addr = addr
+        self.detail = detail
+
+
+class FleetTransport:
+    """The wire contract the router drives (duck-typed; this class just
+    documents it).  Every method either returns the peer's typed
+    response — ``(status_code, parsed body)`` — or raises
+    :class:`TransportError`; an HTTP error code is a RESPONSE (the peer
+    is alive and saying something typed), only failing to get one is
+    transport failure."""
+
+    def healthz(self, addr: str, timeout: float) -> Tuple[int, dict]:
+        raise NotImplementedError
+
+    def submit(
+        self, addr: str, body: dict, timeout: float
+    ) -> Tuple[int, dict]:
+        raise NotImplementedError
+
+    def result(
+        self, addr: str, request_id: str, timeout: float
+    ) -> Tuple[int, dict]:
+        raise NotImplementedError
+
+    def cancel(
+        self, addr: str, request_id: str, timeout: float
+    ) -> Tuple[int, dict]:
+        raise NotImplementedError
+
+    def stream(
+        self, addr: str, request_id: str, idle_timeout: float
+    ) -> Iterator[dict]:
+        """Yield the daemon's SSE events as dicts; raise
+        :class:`TransportError` on disconnect/idle-timeout (including
+        MID-iteration — that is the handoff trigger)."""
+        raise NotImplementedError
+
+    def kv_export(
+        self, addr: str, max_blocks: int, timeout: float
+    ) -> bytes:
+        raise NotImplementedError
+
+    def kv_import(
+        self, addr: str, blob: bytes, timeout: float
+    ) -> Tuple[int, dict]:
+        raise NotImplementedError
+
+
+class _FleetRequest:
+    """One accepted client request: its client-visible token stream and
+    which daemon currently computes it."""
+
+    __slots__ = (
+        "rid", "body", "prompt", "max_new", "dedupe_token", "addr",
+        "daemon_rid", "base", "tokens", "status", "finish_reason",
+        "detail", "handoffs",
+    )
+
+    def __init__(self, rid: str, body: dict, addr: str, daemon_rid: str,
+                 status: str):
+        self.rid = rid
+        self.body = body
+        self.prompt = [int(t) for t in body["prompt"]]
+        self.max_new = int(body.get("max_new_tokens", 32))
+        self.dedupe_token = body.get("dedupe_token")
+        self.addr = addr
+        self.daemon_rid = daemon_rid
+        self.base = 0  # tokens generated by PREVIOUS incarnations
+        self.tokens: List[int] = []  # full client-visible generation
+        self.status = status
+        self.finish_reason: Optional[str] = None
+        self.detail: Optional[str] = None
+        self.handoffs = 0
+
+    @property
+    def terminal(self) -> bool:
+        return self.status in _TERMINAL
+
+    def record(self) -> dict:
+        """The client-facing record — same shape the daemon returns, so
+        swapping a single daemon for a fleet does not change a client."""
+        return {
+            "request_id": self.rid,
+            "status": self.status,
+            "finish_reason": self.finish_reason,
+            "detail": self.detail,
+            "tokens": list(self.tokens),
+            "handoffs": self.handoffs,
+            "peer": self.addr,
+        }
+
+
+class FleetRouter:
+    """See the module docstring.  Thread-safety: handler threads call
+    ``submit`` / ``result`` / ``stream`` / ``cancel``; the pump thread
+    calls ``probe_tick``.  All shared state mutates under one lock;
+    long-lived network reads (streams) run outside it."""
+
+    def __init__(
+        self,
+        peer_addrs: Sequence[str],
+        *,
+        clock,
+        transport: FleetTransport,
+        buckets: Optional[Sequence[int]] = None,
+        policy: Optional[PeerPolicy] = None,
+        registry: Optional[MetricRegistry] = None,
+        tracer=None,
+        vnodes: int = 64,
+        max_handoffs: int = 8,
+        warm_start_blocks: int = 16,
+        warm_on_recovery: bool = True,
+    ):
+        self.clock = clock
+        self.transport = transport
+        self.buckets = tuple(buckets) if buckets else None
+        self.policy = policy or PeerPolicy()
+        self.registry = registry if registry is not None else MetricRegistry()
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        self.ring = HashRing(list(peer_addrs), vnodes)
+        self.peers = PeerSet(peer_addrs, clock, self.policy)
+        self.max_handoffs = max_handoffs
+        self.warm_start_blocks = warm_start_blocks
+        self.warm_on_recovery = warm_on_recovery
+        self._lock = threading.RLock()
+        self._requests: Dict[str, _FleetRequest] = {}
+        self._ledger: Dict[str, str] = {}  # dedupe_token -> rid
+        self._stale: Dict[str, List[str]] = {}  # addr -> handed-off rids
+        self._seq = itertools.count()
+        self._stop = threading.Event()
+        self._m_submits = self.registry.counter("fleet_submissions_total")
+        self._m_dedupe = self.registry.counter("fleet_dedupe_hits_total")
+        self._m_handoffs = self.registry.counter("fleet_handoffs_total")
+        self._m_completions = self.registry.counter("fleet_completions_total")
+        self._m_probes = self.registry.counter("fleet_probes_total")
+        self._m_probe_failures = self.registry.counter(
+            "fleet_probe_failures_total"
+        )
+        self._m_peer_deaths = self.registry.counter("fleet_peer_deaths_total")
+        self._m_kv_export_bytes = self.registry.counter(
+            "fleet_kv_export_bytes_total"
+        )
+
+    # -- placement ---------------------------------------------------------
+
+    def _walk(self, prompt: Sequence[int]) -> Iterator[str]:
+        return self.ring.walk(hash_prompt_key(prompt, self.buckets))
+
+    def _pick(
+        self, prompt: Sequence[int], exclude: Set[str]
+    ) -> Optional[str]:
+        """Ring-ordered placement honoring health: the first HEALTHY
+        ring successor of the prompt's prefix key, else the first
+        DEGRADED one (a shaky peer beats a typed no_peer), else None."""
+        states = self.peers.states()
+        fallback = None
+        for addr in self._walk(prompt):
+            if addr in exclude:
+                continue
+            state = states.get(addr)
+            if state == HEALTHY:
+                return addr
+            if state is not None and state != DEAD and fallback is None:
+                fallback = addr
+        return fallback
+
+    # -- client surface ----------------------------------------------------
+
+    def submit(self, body: dict) -> Tuple[int, dict]:
+        """Route one client submission; returns ``(http_code, record)``.
+        Retries with exclusion across ring successors on transport
+        failure or a typed 503/429 from the daemon; the accepted record
+        is the ROUTER's (its request id outlives any one daemon)."""
+        prompt = body.get("prompt")
+        if (
+            not isinstance(prompt, list)
+            or not prompt
+            or not all(isinstance(t, int) for t in prompt)
+        ):
+            return 400, {
+                "error": "'prompt' must be a non-empty list of token ids"
+            }
+        dedupe = body.get("dedupe_token")
+        with self._lock:
+            if dedupe is not None and dedupe in self._ledger:
+                self._m_dedupe.inc()
+                req = self._requests[self._ledger[dedupe]]
+                return 200, req.record()
+            exclude: Set[str] = set()
+            last: Tuple[int, dict] = (503, {
+                "error": "no routable peer",
+                "status": REJECTED,
+                "finish_reason": REJECT_NO_PEER,
+            })
+            for _ in range(len(self.ring)):
+                addr = self._pick(prompt, exclude)
+                if addr is None:
+                    break
+                try:
+                    code, rec = self.transport.submit(
+                        addr, body, self.policy.request_timeout_seconds
+                    )
+                except TransportError:
+                    self.peers.note_failure(addr)
+                    exclude.add(addr)
+                    continue
+                self.peers.note_success(addr)
+                if code == 200:
+                    rid = f"f{next(self._seq):06d}"
+                    req = _FleetRequest(
+                        rid, dict(body), addr, rec["request_id"],
+                        rec.get("status", "queued"),
+                    )
+                    self._requests[rid] = req
+                    if dedupe is not None:
+                        self._ledger[dedupe] = rid
+                    self._m_submits.inc()
+                    self.registry.counter(
+                        "fleet_routed_total", peer=addr
+                    ).inc()
+                    if self.tracer.enabled:
+                        self.tracer.instant(
+                            "route", track=FLEET_TRACK, rid=rid, peer=addr,
+                        )
+                    return 200, req.record()
+                if code in _CLIENT_ERROR_CODES:
+                    return code, rec
+                # typed decline (503 draining/degraded/journal, 429
+                # backpressure): this peer is out for THIS request;
+                # the ring successor gets it
+                self.registry.counter(
+                    "fleet_rejects_total",
+                    reason=str(rec.get("finish_reason") or code),
+                ).inc()
+                exclude.add(addr)
+                last = (code, rec)
+            if last[0] == 503:
+                self.registry.counter(
+                    "fleet_rejects_total", reason=REJECT_NO_PEER
+                ).inc()
+            return last
+
+    def result(self, rid: str) -> Tuple[int, dict]:
+        """The request's current client-visible record, refreshed from
+        its backing daemon when still live.  A transport failure on the
+        refresh feeds the breaker and triggers handoff — a client that
+        only ever POLLS still cannot lose an accepted request to a host
+        death."""
+        with self._lock:
+            req = self._requests.get(rid)
+            if req is None:
+                return 404, {"error": f"unknown request {rid}"}
+            if req.terminal:
+                return 200, req.record()
+            addr, daemon_rid, base = req.addr, req.daemon_rid, req.base
+        try:
+            code, rec = self.transport.result(
+                addr, daemon_rid, self.policy.request_timeout_seconds
+            )
+        except TransportError:
+            self.peers.note_failure(addr)
+            with self._lock:
+                if not req.terminal and req.addr == addr:
+                    self._handoff_locked(req, {addr})
+                return 200, req.record()
+        self.peers.note_success(addr)
+        with self._lock:
+            if req.terminal or req.addr != addr:
+                return 200, req.record()  # a stream/handoff won the race
+            if code == 200:
+                self._merge_locked(req, base, rec)
+            else:
+                # the daemon answered but disowned the request (journal
+                # lost / restarted empty): recompute it elsewhere
+                self._handoff_locked(req, {addr})
+            return 200, req.record()
+
+    def cancel(self, rid: str) -> Tuple[int, dict]:
+        with self._lock:
+            req = self._requests.get(rid)
+            if req is None or req.terminal:
+                return 404, {"error": f"unknown/done request {rid}"}
+            addr, daemon_rid = req.addr, req.daemon_rid
+            self._finalize_locked(req, CANCELLED, "cancelled")
+        try:
+            self.transport.cancel(
+                addr, daemon_rid, self.policy.request_timeout_seconds
+            )
+        except TransportError:
+            self.peers.note_failure(addr)  # best effort; record stands
+        return 200, {"cancelled": rid}
+
+    def stream(self, rid: str) -> Iterator[dict]:
+        """Relay the request's event stream with CLIENT-STABLE indices:
+        already-known tokens replay first, then live daemon events.  A
+        torn daemon stream hands the request off and the relay resumes
+        on the survivor — the client sees one uninterrupted stream whose
+        token sequence is bitwise what the original daemon would have
+        produced."""
+        with self._lock:
+            req = self._requests.get(rid)
+            if req is None:
+                yield {"error": f"unknown request {rid}"}
+                return
+            replay = list(req.tokens)
+        sent = 0
+        for tok in replay:
+            yield {"request_id": rid, "token": tok, "index": sent}
+            sent += 1
+        misses = 0  # consecutive failed handoff attempts (no progress)
+        while True:
+            with self._lock:
+                if req.terminal:
+                    for tok in req.tokens[sent:]:
+                        yield {"request_id": rid, "token": tok,
+                               "index": sent}
+                        sent += 1
+                    yield {
+                        "request_id": rid, "finished": True,
+                        "status": req.status,
+                        "finish_reason": req.finish_reason,
+                    }
+                    return
+                addr, daemon_rid, base = req.addr, req.daemon_rid, req.base
+            try:
+                for ev in self.transport.stream(
+                    addr, daemon_rid,
+                    self.policy.stream_idle_timeout_seconds,
+                ):
+                    if "token" in ev and "index" in ev:
+                        idx = base + int(ev["index"])
+                        with self._lock:
+                            if idx == len(req.tokens):
+                                req.tokens.append(int(ev["token"]))
+                        if idx == sent:
+                            yield {
+                                "request_id": rid,
+                                "token": int(ev["token"]), "index": idx,
+                            }
+                            sent += 1
+                    if ev.get("finished"):
+                        with self._lock:
+                            self._finalize_locked(
+                                req,
+                                ev.get("status") or FINISHED,
+                                ev.get("finish_reason"),
+                            )
+                        yield {
+                            "request_id": rid, "finished": True,
+                            "status": req.status,
+                            "finish_reason": req.finish_reason,
+                        }
+                        return
+                # the daemon closed the stream cleanly without a
+                # terminal event (drain): refresh the record — the
+                # request may have finished between events — then
+                # re-attach
+                self.peers.note_success(addr)
+                self.result(rid)
+                misses = 0
+                sleep = getattr(self.clock, "sleep", None)
+                if sleep is not None:
+                    sleep(self.policy.probe_interval_seconds)
+            except TransportError:
+                self.peers.note_failure(addr)
+                with self._lock:
+                    if req.terminal or req.addr != addr:
+                        continue  # someone else already resolved it
+                    if self._handoff_locked(req, {addr}):
+                        misses = 0
+                        continue
+                    if req.terminal:
+                        continue  # handoff budget exhausted: typed fail
+                misses += 1
+                if misses > _STREAM_RETRY_LIMIT:
+                    with self._lock:
+                        self._finalize_locked(req, FAILED, REJECT_NO_PEER)
+                    continue
+                # no peer can take it RIGHT NOW (fleet-wide outage):
+                # wait one probe interval for the breaker to readmit
+                # someone instead of spinning on dead sockets
+                sleep = getattr(self.clock, "sleep", None)
+                if sleep is not None:
+                    sleep(self.policy.probe_interval_seconds)
+
+    # -- request bookkeeping ----------------------------------------------
+
+    def _merge_locked(self, req: _FleetRequest, base: int, rec: dict):
+        """Fold a daemon record (tokens are DAEMON-local, starting at
+        ``base`` client tokens) into the router's view."""
+        tokens = rec.get("tokens") or []
+        full = req.tokens[:base] + [int(t) for t in tokens]
+        if len(full) >= len(req.tokens):
+            req.tokens = full
+        status = rec.get("status")
+        if status in _TERMINAL:
+            self._finalize_locked(req, status, rec.get("finish_reason"))
+        elif status:
+            req.status = status
+
+    def _finalize_locked(
+        self, req: _FleetRequest, status: str, finish_reason
+    ) -> None:
+        if req.terminal:
+            return
+        req.status = status
+        req.finish_reason = finish_reason
+        self._m_completions.inc()
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "complete", track=FLEET_TRACK, rid=req.rid,
+                status=status, reason=str(finish_reason),
+            )
+
+    def _handoff_locked(
+        self, req: _FleetRequest, exclude: Set[str]
+    ) -> bool:
+        """Replay ``req`` onto a surviving peer via forced prefix:
+        prompt + every token the router has relayed, with the remaining
+        token budget.  Greedy continuations are bitwise — this is the
+        same mechanism daemon crash recovery replays through, driven
+        from the other side of the wire.  Returns False when no peer
+        can take it (the request FAILS typed if the handoff budget is
+        exhausted, else stays pointed at its dead peer for the next
+        probe/poll to retry)."""
+        if req.terminal:
+            return True
+        if req.handoffs >= self.max_handoffs:
+            self._finalize_locked(req, FAILED, REJECT_HANDOFFS)
+            return False
+        remaining = req.max_new - len(req.tokens)
+        if remaining <= 0:
+            # every budgeted token was relayed before the host died —
+            # the stream just never saw its terminal event
+            self._finalize_locked(req, FINISHED, "length")
+            return True
+        old_addr, old_rid = req.addr, req.daemon_rid
+        body = dict(req.body)
+        body["prompt"] = req.prompt + list(req.tokens)
+        body["max_new_tokens"] = remaining
+        # a DERIVED dedupe token: idempotent if this same handoff is
+        # retried, never colliding with the client's token (which lives
+        # in the dead daemon's journal)
+        body["dedupe_token"] = f"fleet:{req.rid}:h{req.handoffs + 1}"
+        exclude = set(exclude) | {old_addr}
+        for _ in range(len(self.ring)):
+            addr = self._pick(body["prompt"], exclude)
+            if addr is None:
+                return False
+            try:
+                code, rec = self.transport.submit(
+                    addr, body, self.policy.request_timeout_seconds
+                )
+            except TransportError:
+                self.peers.note_failure(addr)
+                exclude.add(addr)
+                continue
+            self.peers.note_success(addr)
+            if code != 200:
+                exclude.add(addr)
+                continue
+            self._stale.setdefault(old_addr, []).append(old_rid)
+            req.addr = addr
+            req.daemon_rid = rec["request_id"]
+            req.base = len(req.tokens)
+            req.handoffs += 1
+            self._m_handoffs.inc()
+            if self.tracer.enabled:
+                self.tracer.instant(
+                    "handoff", track=FLEET_TRACK, rid=req.rid,
+                    src=old_addr, dst=addr, delivered=req.base,
+                )
+            return True
+        return False
+
+    # -- health ------------------------------------------------------------
+
+    def probe_tick(self) -> None:
+        """Poll due peers' ``/healthz``, fold the evidence, and act on
+        transitions: a peer going DEAD gets its open requests handed
+        off; a DEAD peer answering again gets its stale (already
+        handed-off) daemon requests cancelled and, when enabled, a
+        KV warm start from a healthy donor."""
+        for addr in self.peers.probe_due():
+            state = self.peers.get(addr)
+            if state is None:
+                continue
+            was = state.state
+            self._m_probes.inc()
+            state.last_probe = self.clock()
+            try:
+                code, _body = self.transport.healthz(
+                    addr, self.policy.connect_timeout_seconds
+                )
+                ok = code == 200
+            except TransportError:
+                ok = False
+            if ok:
+                self.peers.note_success(addr)
+                if was == DEAD:
+                    if self.tracer.enabled:
+                        self.tracer.instant(
+                            "peer_recovered", track=FLEET_TRACK, peer=addr
+                        )
+                    self._reconcile_recovered(addr)
+            else:
+                self._m_probe_failures.inc()
+                now_state = self.peers.note_failure(addr)
+                if was != DEAD and now_state == DEAD:
+                    self._m_peer_deaths.inc()
+                    if self.tracer.enabled:
+                        self.tracer.instant(
+                            "peer_dead", track=FLEET_TRACK, peer=addr
+                        )
+                    self._handoff_open(addr)
+        for addr, state in self.peers.states().items():
+            self.registry.gauge("fleet_peer_state", peer=addr).set(
+                {HEALTHY: 0.0, DEAD: 2.0}.get(state, 1.0)
+            )
+
+    def _handoff_open(self, dead_addr: str) -> None:
+        """Move every open request off a peer the breaker just declared
+        DEAD — streams find out on their own (torn socket), but a
+        request nobody is streaming would otherwise wait for its next
+        client poll."""
+        with self._lock:
+            for req in list(self._requests.values()):
+                if not req.terminal and req.addr == dead_addr:
+                    self._handoff_locked(req, {dead_addr})
+
+    def _reconcile_recovered(self, addr: str) -> None:
+        """A daemon came back from DEAD: its journal faithfully revived
+        requests the router already moved elsewhere.  Cancel those so
+        the host does not burn ticks computing answers nobody will
+        read (the router's ledger is the only client-visible authority
+        — this is compute hygiene, not correctness)."""
+        with self._lock:
+            stale = self._stale.pop(addr, [])
+        for daemon_rid in stale:
+            try:
+                self.transport.cancel(
+                    addr, daemon_rid, self.policy.request_timeout_seconds
+                )
+            except TransportError:
+                self.peers.note_failure(addr)
+                break
+        if self.warm_on_recovery:
+            self.warm_start(addr)
+
+    # -- remote KV migration ----------------------------------------------
+
+    def warm_start(
+        self,
+        newcomer: str,
+        donor: Optional[str] = None,
+        max_blocks: Optional[int] = None,
+    ) -> dict:
+        """Pre-seed ``newcomer``'s radix cache from a donor's hottest
+        chains over the wire.  Returns the import response body (its
+        ``verdicts`` map counts typed migration statuses); every
+        verdict and refusal is counted under ``fleet_kv_*``.  Best
+        effort: no donor, an empty export, or a refusal leaves the
+        newcomer merely cold, never wrong."""
+        if donor is None:
+            healthy = [a for a in self.peers.healthy() if a != newcomer]
+            if not healthy:
+                return {}
+            # deterministic donor choice: the newcomer's ring successor
+            donor = next(
+                (a for a in self.ring.walk(_stable_hash(newcomer.encode()))
+                 if a in healthy),
+                healthy[0],
+            )
+        return self._ship_kv(donor, newcomer, max_blocks)
+
+    def drain_peer(
+        self, addr: str, target: Optional[str] = None
+    ) -> dict:
+        """Forward a draining peer's live prefixes to a survivor (its
+        ring successor by default) so the keys that are about to slide
+        to it arrive with their K/V already warm."""
+        if target is None:
+            target = next(
+                (a for a in self.ring.walk(_stable_hash(addr.encode()))
+                 if a != addr and a in self.peers.healthy()),
+                None,
+            )
+            if target is None:
+                return {}
+        return self._ship_kv(addr, target, None)
+
+    def _ship_kv(
+        self, src: str, dst: str, max_blocks: Optional[int]
+    ) -> dict:
+        blocks = max_blocks if max_blocks is not None \
+            else self.warm_start_blocks
+        try:
+            blob = self.transport.kv_export(
+                src, blocks, self.policy.request_timeout_seconds
+            )
+        except TransportError:
+            self.peers.note_failure(src)
+            return {}
+        self.peers.note_success(src)
+        if not blob:
+            return {"verdicts": {}}
+        self._m_kv_export_bytes.inc(len(blob))
+        try:
+            code, body = self.transport.kv_import(
+                dst, blob, self.policy.request_timeout_seconds
+            )
+        except TransportError:
+            self.peers.note_failure(dst)
+            return {}
+        self.peers.note_success(dst)
+        if code == 200:
+            for verdict, n in (body.get("verdicts") or {}).items():
+                self.registry.counter(
+                    "fleet_kv_imports_total", status=str(verdict)
+                ).inc(int(n))
+        else:
+            self.registry.counter(
+                "fleet_kv_wire_refusals_total",
+                reason=str(body.get("reason", code)),
+            ).inc()
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "kv_migrate", track=FLEET_TRACK, src=src, dst=dst,
+                bytes=len(blob), code=code,
+            )
+        return body
+
+    # -- membership / lifecycle -------------------------------------------
+
+    def add_peer(self, addr: str, warm: bool = True) -> None:
+        """Join a daemon to the fleet: on the ring (only its keys move),
+        in the breaker (DEGRADED until its first good probe), and —
+        when ``warm`` — KV warm-started from a donor."""
+        with self._lock:
+            self.ring.add_member(addr)
+            self.peers.add(addr)
+        if warm:
+            self.warm_start(addr)
+
+    def remove_peer(self, addr: str) -> None:
+        """Leave: drain-forward its prefixes, then drop it from ring
+        and breaker; its open requests hand off immediately."""
+        self.drain_peer(addr)
+        with self._lock:
+            if len(self.ring) > 1:
+                self.ring.remove_member(addr)
+            self.peers.remove(addr)
+        self._handoff_open(addr)
+
+    def status(self) -> dict:
+        with self._lock:
+            open_reqs = [
+                r.rid for r in self._requests.values() if not r.terminal
+            ]
+            return {
+                "peers": self.peers.summary(),
+                "requests": len(self._requests),
+                "open": len(open_reqs),
+                "open_ids": open_reqs,
+                "ledger": len(self._ledger),
+                "stale": {a: len(v) for a, v in self._stale.items()},
+            }
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def run(self, poll_seconds: float = 0.25) -> None:
+        """The router pump: probe peers until :meth:`stop`.  Paced on
+        the injected clock's ``sleep`` — the process entry point hands
+        in a WallClock, tests never call this at all (they call
+        ``probe_tick`` directly)."""
+        while not self._stop.is_set():
+            self.probe_tick()
+            self.clock.sleep(poll_seconds)

@@ -1,0 +1,282 @@
+"""Length-prefixed binary wire codec for :class:`KVPrefixExport`.
+
+PR 15 made the export a self-verifying exchange unit (one CRC32 per
+block, recomputed before any import lands).  This module makes it a
+WIRE format: ``encode_export`` flattens one export into a single frame
+of bytes, ``decode_export`` rebuilds it bitwise, and concatenated
+frames (``encode_exports`` / ``decode_exports``) are the body of the
+fleet's ``/v1/kv/export`` → ``/v1/kv/import`` exchange
+(docs/14_fleet.md).
+
+Frame layout (all integers big-endian)::
+
+    magic   b"KVW1"                       4 bytes
+    hlen    uint32  header length         4 bytes
+    hcrc    uint32  CRC32 of header       4 bytes
+    header  canonical JSON (utf-8)        hlen bytes
+    payload leaf arrays, C-order bytes    sum(leaf nbytes)
+
+The header carries everything except the raw K/V bytes — tokens,
+block geometry, ``weights_version``, the exporter's ``meta`` shape
+signature, the per-block checksums, and each leaf's dtype/shape (which
+is what makes the payload self-describing: leaf byte extents are
+derived, never trusted from a length field that could disagree).
+
+Decoding REFUSES, never guesses: every way a frame can be damaged maps
+to a typed :class:`WireFormatError` reason (``truncated``, ``magic``,
+``header_crc``, ``header_schema``, ``integrity``).  A bit flipped in
+the payload trips the per-block CRC (``integrity``); a bit flipped in
+the header trips ``hcrc`` before the JSON is even parsed — so version
+skew and shape compatibility are still judged by
+:meth:`ServingEngine.import_prefix` on exactly the values the exporter
+wrote, and corrupt bytes never serve (the importer recomputes from
+tokens instead).
+
+The codec is pure bytes-in/bytes-out; only the file helpers at the
+bottom touch the filesystem, and they go through the
+``daemon.iofaults`` read gate so the seeded-rot soak covers blobs at
+rest the same way it covers the journal.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+from typing import List, Tuple
+
+import numpy as np
+
+from tpu_parallel.serving.kv_hierarchy import KVPrefixExport
+
+MAGIC = b"KVW1"
+_HEADER_STRUCT = struct.Struct(">II")  # hlen, hcrc
+_FRAME_OVERHEAD = len(MAGIC) + _HEADER_STRUCT.size
+
+# a header is small (tokens + shapes); anything claiming more is damage,
+# not data — refuse before allocating
+MAX_HEADER_BYTES = 1 << 24
+
+WIRE_TRUNCATED = "truncated"
+WIRE_MAGIC = "magic"
+WIRE_HEADER_CRC = "header_crc"
+WIRE_HEADER_SCHEMA = "header_schema"
+WIRE_INTEGRITY = "integrity"
+
+WIRE_REASONS = (
+    WIRE_TRUNCATED,
+    WIRE_MAGIC,
+    WIRE_HEADER_CRC,
+    WIRE_HEADER_SCHEMA,
+    WIRE_INTEGRITY,
+)
+
+
+class WireFormatError(ValueError):
+    """A frame that cannot be decoded — carries the typed ``reason``
+    (one of :data:`WIRE_REASONS`) the refusing side reports, so the
+    import endpoint's 400 and the fleet's ``fleet_kv_wire_refusals``
+    counter speak the same vocabulary as the migration verdicts."""
+
+    def __init__(self, reason: str, detail: str):
+        assert reason in WIRE_REASONS, reason
+        super().__init__(f"{reason}: {detail}")
+        self.reason = reason
+        self.detail = detail
+
+
+def _dtype(name: str) -> np.dtype:
+    """Resolve a dtype name recorded at encode time.  Plain numpy names
+    resolve directly; the ml_dtypes extensions jax caches use
+    (bfloat16, float8 variants) resolve through the registered scalar
+    types."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        try:
+            return np.dtype(getattr(ml_dtypes, name))
+        except (AttributeError, TypeError):
+            raise WireFormatError(
+                WIRE_HEADER_SCHEMA, f"unknown leaf dtype {name!r}"
+            ) from None
+
+
+def _tuplize(obj):
+    """JSON loses tuple-ness; ``meta`` equality at import compares
+    against the pool's tuple-of-tuples signature, so rebuild it."""
+    if isinstance(obj, list):
+        return tuple(_tuplize(x) for x in obj)
+    return obj
+
+
+def encode_export(export: KVPrefixExport) -> bytes:
+    """One export → one frame of bytes (see the module docstring for
+    the layout).  Leaves are shipped as C-order raw bytes; the header's
+    per-leaf dtype/shape entries are what decode uses to carve the
+    payload back up, and the canonical-JSON header keeps equal exports
+    byte-identical on the wire."""
+    leaves = [np.ascontiguousarray(leaf) for leaf in export.leaves]
+    header = {
+        "tokens": [int(t) for t in export.tokens],
+        "length": int(export.length),
+        "block_tokens": int(export.block_tokens),
+        "weights_version": str(export.weights_version),
+        "meta": export.meta,
+        "checksums": [int(c) for c in export.checksums],
+        "leaves": [
+            {"dtype": str(leaf.dtype), "shape": list(leaf.shape)}
+            for leaf in leaves
+        ],
+    }
+    hbytes = json.dumps(
+        header, sort_keys=True, separators=(",", ":"), default=list
+    ).encode("utf-8")
+    frame = [
+        MAGIC,
+        _HEADER_STRUCT.pack(len(hbytes), zlib.crc32(hbytes) & 0xFFFFFFFF),
+        hbytes,
+    ]
+    frame.extend(leaf.tobytes(order="C") for leaf in leaves)
+    return b"".join(frame)
+
+
+def _decode_frame(
+    buf: bytes, off: int, verify: bool
+) -> Tuple[KVPrefixExport, int]:
+    """Decode one frame starting at ``off``; returns the export and the
+    offset just past it.  Raises :class:`WireFormatError` — typed,
+    never a stray struct/json/numpy exception."""
+    if len(buf) - off < _FRAME_OVERHEAD:
+        raise WireFormatError(
+            WIRE_TRUNCATED,
+            f"{len(buf) - off} bytes at offset {off}, "
+            f"frame prelude needs {_FRAME_OVERHEAD}",
+        )
+    if buf[off:off + len(MAGIC)] != MAGIC:
+        raise WireFormatError(
+            WIRE_MAGIC,
+            f"bad magic {buf[off:off + len(MAGIC)]!r} at offset {off}",
+        )
+    hlen, hcrc = _HEADER_STRUCT.unpack_from(buf, off + len(MAGIC))
+    if hlen > MAX_HEADER_BYTES:
+        raise WireFormatError(
+            WIRE_HEADER_SCHEMA, f"header claims {hlen} bytes"
+        )
+    hstart = off + _FRAME_OVERHEAD
+    if len(buf) - hstart < hlen:
+        raise WireFormatError(
+            WIRE_TRUNCATED,
+            f"header needs {hlen} bytes, {len(buf) - hstart} remain",
+        )
+    hbytes = buf[hstart:hstart + hlen]
+    if (zlib.crc32(hbytes) & 0xFFFFFFFF) != hcrc:
+        raise WireFormatError(
+            WIRE_HEADER_CRC, "header CRC mismatch (damaged in transit)"
+        )
+    try:
+        header = json.loads(hbytes.decode("utf-8"))
+        tokens = tuple(int(t) for t in header["tokens"])
+        length = int(header["length"])
+        block_tokens = int(header["block_tokens"])
+        weights_version = str(header["weights_version"])
+        meta = _tuplize(header["meta"])
+        checksums = tuple(int(c) for c in header["checksums"])
+        leaf_specs = [
+            (_dtype(spec["dtype"]), tuple(int(d) for d in spec["shape"]))
+            for spec in header["leaves"]
+        ]
+    except WireFormatError:
+        raise
+    except (KeyError, TypeError, ValueError) as exc:
+        raise WireFormatError(
+            WIRE_HEADER_SCHEMA, f"malformed header: {exc}"
+        ) from None
+    pos = hstart + hlen
+    leaves = []
+    for dtype, shape in leaf_specs:
+        count = int(np.prod(shape, dtype=np.int64))
+        nbytes = int(dtype.itemsize * count)
+        if len(buf) - pos < nbytes:
+            raise WireFormatError(
+                WIRE_TRUNCATED,
+                f"leaf needs {nbytes} bytes, {len(buf) - pos} remain",
+            )
+        arr = np.frombuffer(buf, dtype=dtype, count=count, offset=pos)
+        leaves.append(arr.reshape(shape).copy())
+        pos += nbytes
+    export = KVPrefixExport(
+        tokens=tokens,
+        length=length,
+        block_tokens=block_tokens,
+        weights_version=weights_version,
+        meta=meta,
+        leaves=tuple(leaves),
+        checksums=checksums,
+    )
+    if verify and not export.verified():
+        raise WireFormatError(
+            WIRE_INTEGRITY,
+            "per-block CRC mismatch — payload damaged in transit",
+        )
+    return export, pos
+
+
+def decode_export(buf: bytes, *, verify: bool = True) -> KVPrefixExport:
+    """Decode exactly one frame; trailing bytes are damage, not data.
+    ``verify=True`` (the default) recomputes the per-block CRCs so
+    corrupt payloads refuse HERE — importers may pass ``verify=False``
+    when they run the same check themselves via
+    :meth:`ServingEngine.import_prefix`."""
+    export, end = _decode_frame(buf, 0, verify)
+    if end != len(buf):
+        raise WireFormatError(
+            WIRE_TRUNCATED,
+            f"{len(buf) - end} trailing bytes after one frame",
+        )
+    return export
+
+
+def encode_exports(exports) -> bytes:
+    """Concatenated frames — the ``/v1/kv/export`` response body.  An
+    empty list is an empty body (a donor with nothing hot is a valid
+    answer, not an error)."""
+    return b"".join(encode_export(e) for e in exports)
+
+
+def decode_exports(
+    buf: bytes, *, verify: bool = True
+) -> List[KVPrefixExport]:
+    """Decode a stream of concatenated frames until the buffer is
+    exactly consumed.  Any damage — mid-frame truncation included —
+    refuses the WHOLE stream: a partial import would leave the receiver
+    believing it warm-started chains it only half holds."""
+    out: List[KVPrefixExport] = []
+    off = 0
+    while off < len(buf):
+        export, off = _decode_frame(buf, off, verify)
+        out.append(export)
+    return out
+
+
+def write_export_file(path: str, exports) -> str:
+    """Spill a stream of exports to ``path`` (the bench's corpus /
+    corrupt-injection legs).  Plain binary write — durability barriers
+    are the journal's business, not a bench artifact's."""
+    from tpu_parallel.daemon import iofaults
+
+    with iofaults.open_file(path, "wb") as fh:
+        fh.write(encode_exports(exports))
+    return path
+
+
+def read_export_file(
+    path: str, *, verify: bool = True
+) -> List[KVPrefixExport]:
+    """Read a spilled stream back through the ``iofaults`` read gate —
+    an armed flip plan rots the blob exactly as it would the journal,
+    and the typed refusal surfaces here instead of garbage K/V."""
+    from tpu_parallel.daemon import iofaults
+
+    return decode_exports(iofaults.read_bytes(path), verify=verify)
