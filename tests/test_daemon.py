@@ -498,6 +498,14 @@ def test_http_endpoints_and_sse_stream(env, tmp_path):
         assert code == 200
         assert "inflight_tokens" in state["cluster"]
         assert state["daemon"]["degraded_reason"] is None
+        # the KV export route matches its path EXACTLY and refuses
+        # nonsense parameters typed instead of passing them through
+        code, err = call("GET", "/v1/kv/exportfoo")
+        assert code == 404
+        code, err = call("GET", "/v1/kv/export?max_blocks=-5")
+        assert code == 400 and "max_blocks" in err["error"]
+        code, err = call("GET", "/v1/kv/export?max_blocks=abc")
+        assert code == 400
         # bounded body read: an oversized submit refuses 413 WITHOUT
         # buffering the payload (a second server on the same daemon,
         # with a tiny cap, proves the knob)

@@ -42,6 +42,7 @@ from tpu_parallel.serving.kv_hierarchy import (
     KVPrefixExport,
 )
 from tpu_parallel.serving.kv_wire import (
+    WIRE_HEADER_SCHEMA,
     WIRE_MAGIC,
     WIRE_REASONS,
     WireFormatError,
@@ -149,6 +150,56 @@ def test_wire_truncation_refuses_typed():
     )
     with pytest.raises(WireFormatError):
         decode_exports(stream[:-3])
+
+
+def _tamper_header(blob, mutate):
+    """Rewrite a frame's JSON header through ``mutate`` with a VALID
+    CRC, so the tampered values reach the schema checks instead of
+    tripping ``header_crc`` first."""
+    import json
+    import struct
+    import zlib
+
+    hlen, _hcrc = struct.unpack_from(">II", blob, 4)
+    header = json.loads(blob[12:12 + hlen])
+    mutate(header)
+    hbytes = json.dumps(
+        header, sort_keys=True, separators=(",", ":")
+    ).encode("utf-8")
+    return (
+        blob[:4]
+        + struct.pack(">II", len(hbytes), zlib.crc32(hbytes) & 0xFFFFFFFF)
+        + hbytes
+        + blob[12 + hlen:]
+    )
+
+
+def test_wire_negative_dim_refuses_typed():
+    """A crafted header claiming a negative leaf dim refuses typed
+    (``header_schema``) — a negative element count would otherwise read
+    the whole remaining buffer and walk the stream offset BACKWARDS,
+    turning ``decode_exports`` into an unbounded loop."""
+    blob = encode_export(_synthetic_export(np.float32, seed=8))
+
+    def negate(header):
+        header["leaves"][0]["shape"][0] *= -1
+
+    bad = _tamper_header(blob, negate)
+    with pytest.raises(WireFormatError) as exc:
+        decode_export(bad)
+    assert exc.value.reason == WIRE_HEADER_SCHEMA
+    # the multi-frame decoder refuses (terminates) on the same damage
+    with pytest.raises(WireFormatError):
+        decode_exports(bad + blob)
+
+    # an absurdly huge dim must land in "bigger than the buffer", not
+    # wrap through fixed-width arithmetic into something plausible
+    def huge(header):
+        header["leaves"][0]["shape"][0] = 1 << 62
+
+    with pytest.raises(WireFormatError) as exc:
+        decode_export(_tamper_header(blob, huge))
+    assert exc.value.reason in WIRE_REASONS
 
 
 def test_wire_bad_magic_typed():
@@ -282,6 +333,7 @@ class FakeDaemon:
         self.cancels = []
         self.seq = 0
         self.kv_blob = b""
+        self.kv_export_code = 200
         self.kv_import_response = (200, {"verdicts": {}})
         self.kv_imports = []
 
@@ -354,7 +406,8 @@ class FakeTransport(FleetTransport):
         return events()
 
     def kv_export(self, addr, max_blocks, timeout):
-        return self._d(addr).kv_blob
+        d = self._d(addr)
+        return d.kv_export_code, d.kv_blob
 
     def kv_import(self, addr, blob, timeout):
         d = self._d(addr)
@@ -598,6 +651,51 @@ def test_warm_start_counts_wire_refusals():
     assert router.registry.counter(
         "fleet_kv_imports_total", status="imported"
     ).value == 0
+
+
+def test_kv_export_refusal_is_typed_not_breaker_evidence():
+    """A live donor answering ``/v1/kv/export`` with an HTTP error is a
+    RESPONSE: counted as a typed wire refusal, never breaker failure
+    credit — repeated warm-start attempts must not demote a responsive
+    peer toward DEAD."""
+    router, _clock, daemons = _fleet()
+    donor, newcomer = daemons[0], daemons[1]
+    donor.kv_export_code = 503
+    donor.kv_blob = b"never-shipped"
+    assert router.warm_start(newcomer.addr, donor=donor.addr) == {}
+    assert router.peers.get(donor.addr).failures == 0
+    assert router.peers.get(donor.addr).state == HEALTHY
+    assert router.registry.counter(
+        "fleet_kv_wire_refusals_total", reason="export_http_503"
+    ).value == 1
+    assert not newcomer.kv_imports
+
+
+def test_terminal_requests_evicted_after_ttl():
+    """Fleet-level retention (the daemon side has journal compaction):
+    terminal requests and their dedupe-ledger entries are TTL-evicted
+    by the probe pump, so a long-running router does not leak every
+    request it ever served."""
+    router, clock, _daemons = _fleet(terminal_ttl_seconds=10.0)
+    prompt = [1, 2, 3]
+    first = _ring_order(router, prompt)[0]
+    first.scripts.append({"tokens": [4]})
+    code, rec = router.submit({
+        "prompt": prompt, "max_new_tokens": 1, "dedupe_token": "c-1",
+    })
+    assert code == 200
+    rid = rec["request_id"]
+    router.result(rid)  # folds the scripted finished record: terminal
+    assert router._requests[rid].terminal
+    clock.t += 5.0
+    router.probe_tick()
+    assert rid in router._requests  # within TTL: late polls still work
+    clock.t += 10.0
+    router.probe_tick()
+    assert rid not in router._requests
+    assert "c-1" not in router._ledger
+    assert router.result(rid)[0] == 404
+    assert router.registry.counter("fleet_evictions_total").value == 1
 
 
 def test_cancel_is_terminal_and_best_effort():

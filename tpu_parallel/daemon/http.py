@@ -40,6 +40,7 @@ from __future__ import annotations
 import json
 import queue as _queue
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -252,17 +253,21 @@ class _Handler(BaseHTTPRequestHandler):
                 200, prometheus_text(d.registry),
                 "text/plain; version=0.0.4",
             )
-        if self.path.startswith("/v1/kv/export"):
+        parts = urllib.parse.urlsplit(self.path)
+        if parts.path == "/v1/kv/export":
             max_blocks = 16
-            if "?" in self.path:
-                for part in self.path.split("?", 1)[1].split("&"):
-                    if part.startswith("max_blocks="):
-                        try:
-                            max_blocks = int(part[len("max_blocks="):])
-                        except ValueError:
-                            return self._json(400, {
-                                "error": "max_blocks must be an integer",
-                            })
+            qs = urllib.parse.parse_qs(parts.query)
+            if "max_blocks" in qs:
+                try:
+                    max_blocks = int(qs["max_blocks"][-1])
+                except ValueError:
+                    return self._json(400, {
+                        "error": "max_blocks must be an integer",
+                    })
+                if max_blocks < 0:
+                    return self._json(400, {
+                        "error": "max_blocks must be >= 0",
+                    })
             blob = encode_exports(d.export_hot_kv(max_blocks=max_blocks))
             self.send_response(200)
             self.send_header("Content-Type", "application/octet-stream")

@@ -144,14 +144,11 @@ class HTTPFleetTransport(FleetTransport):
 
     def kv_export(
         self, addr: str, max_blocks: int, timeout: float
-    ) -> bytes:
-        code, payload = self._request(
+    ) -> Tuple[int, bytes]:
+        return self._request(
             addr, "GET", f"/v1/kv/export?max_blocks={int(max_blocks)}",
             timeout, binary_response=True,
         )
-        if code != 200:
-            raise TransportError(addr, f"kv export: HTTP {code}")
-        return payload
 
     def kv_import(
         self, addr: str, blob: bytes, timeout: float

@@ -128,7 +128,7 @@ class FleetTransport:
 
     def kv_export(
         self, addr: str, max_blocks: int, timeout: float
-    ) -> bytes:
+    ) -> Tuple[int, bytes]:
         raise NotImplementedError
 
     def kv_import(
@@ -144,7 +144,7 @@ class _FleetRequest:
     __slots__ = (
         "rid", "body", "prompt", "max_new", "dedupe_token", "addr",
         "daemon_rid", "base", "tokens", "status", "finish_reason",
-        "detail", "handoffs",
+        "detail", "handoffs", "inflight", "done_at",
     )
 
     def __init__(self, rid: str, body: dict, addr: str, daemon_rid: str,
@@ -162,6 +162,8 @@ class _FleetRequest:
         self.finish_reason: Optional[str] = None
         self.detail: Optional[str] = None
         self.handoffs = 0
+        self.inflight = False  # a handoff submit is on the wire
+        self.done_at: Optional[float] = None  # clock time of terminal
 
     @property
     def terminal(self) -> bool:
@@ -184,8 +186,12 @@ class _FleetRequest:
 class FleetRouter:
     """See the module docstring.  Thread-safety: handler threads call
     ``submit`` / ``result`` / ``stream`` / ``cancel``; the pump thread
-    calls ``probe_tick``.  All shared state mutates under one lock;
-    long-lived network reads (streams) run outside it."""
+    calls ``probe_tick``.  All shared state mutates under one lock, and
+    NO network I/O ever runs under it — every transport call (including
+    the blocking reads of a stream relay and the writes back to a slow
+    client) happens with the lock released, with state re-checked on
+    re-acquire, so one wedged peer or client can never stall the
+    fleet's other requests."""
 
     def __init__(
         self,
@@ -201,6 +207,7 @@ class FleetRouter:
         max_handoffs: int = 8,
         warm_start_blocks: int = 16,
         warm_on_recovery: bool = True,
+        terminal_ttl_seconds: float = 600.0,
     ):
         self.clock = clock
         self.transport = transport
@@ -213,6 +220,7 @@ class FleetRouter:
         self.max_handoffs = max_handoffs
         self.warm_start_blocks = warm_start_blocks
         self.warm_on_recovery = warm_on_recovery
+        self.terminal_ttl_seconds = terminal_ttl_seconds
         self._lock = threading.RLock()
         self._requests: Dict[str, _FleetRequest] = {}
         self._ledger: Dict[str, str] = {}  # dedupe_token -> rid
@@ -277,59 +285,81 @@ class FleetRouter:
                 self._m_dedupe.inc()
                 req = self._requests[self._ledger[dedupe]]
                 return 200, req.record()
-            exclude: Set[str] = set()
-            last: Tuple[int, dict] = (503, {
-                "error": "no routable peer",
-                "status": REJECTED,
-                "finish_reason": REJECT_NO_PEER,
-            })
-            for _ in range(len(self.ring)):
+            attempts = len(self.ring)
+        exclude: Set[str] = set()
+        last: Tuple[int, dict] = (503, {
+            "error": "no routable peer",
+            "status": REJECTED,
+            "finish_reason": REJECT_NO_PEER,
+        })
+        for _ in range(attempts):
+            with self._lock:
                 addr = self._pick(prompt, exclude)
-                if addr is None:
-                    break
-                try:
-                    code, rec = self.transport.submit(
-                        addr, body, self.policy.request_timeout_seconds
-                    )
-                except TransportError:
-                    self.peers.note_failure(addr)
-                    exclude.add(addr)
-                    continue
-                self.peers.note_success(addr)
-                if code == 200:
-                    rid = f"f{next(self._seq):06d}"
-                    req = _FleetRequest(
-                        rid, dict(body), addr, rec["request_id"],
-                        rec.get("status", "queued"),
-                    )
-                    self._requests[rid] = req
-                    if dedupe is not None:
-                        self._ledger[dedupe] = rid
-                    self._m_submits.inc()
-                    self.registry.counter(
-                        "fleet_routed_total", peer=addr
-                    ).inc()
-                    if self.tracer.enabled:
-                        self.tracer.instant(
-                            "route", track=FLEET_TRACK, rid=rid, peer=addr,
-                        )
-                    return 200, req.record()
-                if code in _CLIENT_ERROR_CODES:
-                    return code, rec
-                # typed decline (503 draining/degraded/journal, 429
-                # backpressure): this peer is out for THIS request;
-                # the ring successor gets it
-                self.registry.counter(
-                    "fleet_rejects_total",
-                    reason=str(rec.get("finish_reason") or code),
-                ).inc()
+            if addr is None:
+                break
+            try:
+                code, rec = self.transport.submit(
+                    addr, body, self.policy.request_timeout_seconds
+                )
+            except TransportError:
+                self.peers.note_failure(addr)
                 exclude.add(addr)
-                last = (code, rec)
-            if last[0] == 503:
-                self.registry.counter(
-                    "fleet_rejects_total", reason=REJECT_NO_PEER
-                ).inc()
-            return last
+                continue
+            self.peers.note_success(addr)
+            if code == 200:
+                redundant = None
+                with self._lock:
+                    if dedupe is not None and dedupe in self._ledger:
+                        # a concurrent retry committed while our submit
+                        # was on the wire: theirs is the record, ours is
+                        # redundant daemon work to reap best-effort
+                        self._m_dedupe.inc()
+                        req = self._requests[self._ledger[dedupe]]
+                        redundant = (addr, rec["request_id"])
+                    else:
+                        rid = f"f{next(self._seq):06d}"
+                        req = _FleetRequest(
+                            rid, dict(body), addr, rec["request_id"],
+                            rec.get("status", "queued"),
+                        )
+                        self._requests[rid] = req
+                        if dedupe is not None:
+                            self._ledger[dedupe] = rid
+                        self._m_submits.inc()
+                        self.registry.counter(
+                            "fleet_routed_total", peer=addr
+                        ).inc()
+                        if self.tracer.enabled:
+                            self.tracer.instant(
+                                "route", track=FLEET_TRACK, rid=rid,
+                                peer=addr,
+                            )
+                    record = req.record()
+                if redundant is not None:
+                    try:
+                        self.transport.cancel(
+                            redundant[0], redundant[1],
+                            self.policy.request_timeout_seconds,
+                        )
+                    except TransportError:
+                        pass
+                return 200, record
+            if code in _CLIENT_ERROR_CODES:
+                return code, rec
+            # typed decline (503 draining/degraded/journal, 429
+            # backpressure): this peer is out for THIS request;
+            # the ring successor gets it
+            self.registry.counter(
+                "fleet_rejects_total",
+                reason=str(rec.get("finish_reason") or code),
+            ).inc()
+            exclude.add(addr)
+            last = (code, rec)
+        if last[0] == 503:
+            self.registry.counter(
+                "fleet_rejects_total", reason=REJECT_NO_PEER
+            ).inc()
+        return last
 
     def result(self, rid: str) -> Tuple[int, dict]:
         """The request's current client-visible record, refreshed from
@@ -351,10 +381,13 @@ class FleetRouter:
         except TransportError:
             self.peers.note_failure(addr)
             with self._lock:
-                if not req.terminal and req.addr == addr:
-                    self._handoff_locked(req, {addr})
+                stranded = not req.terminal and req.addr == addr
+            if stranded:
+                self._handoff(req, {addr})
+            with self._lock:
                 return 200, req.record()
         self.peers.note_success(addr)
+        disowned = False
         with self._lock:
             if req.terminal or req.addr != addr:
                 return 200, req.record()  # a stream/handoff won the race
@@ -363,7 +396,10 @@ class FleetRouter:
             else:
                 # the daemon answered but disowned the request (journal
                 # lost / restarted empty): recompute it elsewhere
-                self._handoff_locked(req, {addr})
+                disowned = True
+        if disowned:
+            self._handoff(req, {addr})
+        with self._lock:
             return 200, req.record()
 
     def cancel(self, rid: str) -> Tuple[int, dict]:
@@ -400,19 +436,28 @@ class FleetRouter:
             sent += 1
         misses = 0  # consecutive failed handoff attempts (no progress)
         while True:
+            # snapshot under the lock, YIELD outside it — a generator
+            # suspended mid-yield into a slow client socket must never
+            # hold the router hostage
             with self._lock:
                 if req.terminal:
-                    for tok in req.tokens[sent:]:
-                        yield {"request_id": rid, "token": tok,
-                               "index": sent}
-                        sent += 1
-                    yield {
+                    pending = list(req.tokens[sent:])
+                    final = {
                         "request_id": rid, "finished": True,
                         "status": req.status,
                         "finish_reason": req.finish_reason,
                     }
-                    return
-                addr, daemon_rid, base = req.addr, req.daemon_rid, req.base
+                else:
+                    pending = None
+                    addr, daemon_rid, base = (
+                        req.addr, req.daemon_rid, req.base
+                    )
+            if pending is not None:
+                for tok in pending:
+                    yield {"request_id": rid, "token": tok, "index": sent}
+                    sent += 1
+                yield final
+                return
             try:
                 for ev in self.transport.stream(
                     addr, daemon_rid,
@@ -436,11 +481,12 @@ class FleetRouter:
                                 ev.get("status") or FINISHED,
                                 ev.get("finish_reason"),
                             )
-                        yield {
-                            "request_id": rid, "finished": True,
-                            "status": req.status,
-                            "finish_reason": req.finish_reason,
-                        }
+                            final = {
+                                "request_id": rid, "finished": True,
+                                "status": req.status,
+                                "finish_reason": req.finish_reason,
+                            }
+                        yield final
                         return
                 # the daemon closed the stream cleanly without a
                 # terminal event (drain): refresh the record — the
@@ -455,11 +501,13 @@ class FleetRouter:
             except TransportError:
                 self.peers.note_failure(addr)
                 with self._lock:
-                    if req.terminal or req.addr != addr:
-                        continue  # someone else already resolved it
-                    if self._handoff_locked(req, {addr}):
-                        misses = 0
-                        continue
+                    resolved = req.terminal or req.addr != addr
+                if resolved:
+                    continue  # someone else already resolved it
+                if self._handoff(req, {addr}):
+                    misses = 0
+                    continue
+                with self._lock:
                     if req.terminal:
                         continue  # handoff budget exhausted: typed fail
                 misses += 1
@@ -496,6 +544,7 @@ class FleetRouter:
             return
         req.status = status
         req.finish_reason = finish_reason
+        req.done_at = self.clock()
         self._m_completions.inc()
         if self.tracer.enabled:
             self.tracer.instant(
@@ -503,7 +552,7 @@ class FleetRouter:
                 status=status, reason=str(finish_reason),
             )
 
-    def _handoff_locked(
+    def _handoff(
         self, req: _FleetRequest, exclude: Set[str]
     ) -> bool:
         """Replay ``req`` onto a surviving peer via forced prefix:
@@ -513,56 +562,91 @@ class FleetRouter:
         from the other side of the wire.  Returns False when no peer
         can take it (the request FAILS typed if the handoff budget is
         exhausted, else stays pointed at its dead peer for the next
-        probe/poll to retry)."""
-        if req.terminal:
-            return True
-        if req.handoffs >= self.max_handoffs:
-            self._finalize_locked(req, FAILED, REJECT_HANDOFFS)
-            return False
-        remaining = req.max_new - len(req.tokens)
-        if remaining <= 0:
-            # every budgeted token was relayed before the host died —
-            # the stream just never saw its terminal event
-            self._finalize_locked(req, FINISHED, "length")
-            return True
-        old_addr, old_rid = req.addr, req.daemon_rid
-        body = dict(req.body)
-        body["prompt"] = req.prompt + list(req.tokens)
-        body["max_new_tokens"] = remaining
-        # a DERIVED dedupe token: idempotent if this same handoff is
-        # retried, never colliding with the client's token (which lives
-        # in the dead daemon's journal)
-        body["dedupe_token"] = f"fleet:{req.rid}:h{req.handoffs + 1}"
-        exclude = set(exclude) | {old_addr}
-        for _ in range(len(self.ring)):
-            addr = self._pick(body["prompt"], exclude)
-            if addr is None:
+        probe/poll to retry).
+
+        Called WITHOUT the lock held: state is snapshotted under the
+        lock, the replacement submit runs on the wire with the lock
+        released, and the re-point is committed under the lock again
+        (``req.inflight`` keeps concurrent callers — a poll, a stream,
+        the probe pump — from double-submitting the same request)."""
+        with self._lock:
+            if req.terminal:
+                return True
+            if req.inflight:
+                return False  # another thread is already moving it
+            if req.handoffs >= self.max_handoffs:
+                self._finalize_locked(req, FAILED, REJECT_HANDOFFS)
                 return False
-            try:
-                code, rec = self.transport.submit(
-                    addr, body, self.policy.request_timeout_seconds
-                )
-            except TransportError:
-                self.peers.note_failure(addr)
-                exclude.add(addr)
-                continue
-            self.peers.note_success(addr)
-            if code != 200:
-                exclude.add(addr)
-                continue
-            self._stale.setdefault(old_addr, []).append(old_rid)
-            req.addr = addr
-            req.daemon_rid = rec["request_id"]
-            req.base = len(req.tokens)
-            req.handoffs += 1
-            self._m_handoffs.inc()
-            if self.tracer.enabled:
-                self.tracer.instant(
-                    "handoff", track=FLEET_TRACK, rid=req.rid,
-                    src=old_addr, dst=addr, delivered=req.base,
-                )
-            return True
-        return False
+            remaining = req.max_new - len(req.tokens)
+            if remaining <= 0:
+                # every budgeted token was relayed before the host died
+                # — the stream just never saw its terminal event
+                self._finalize_locked(req, FINISHED, "length")
+                return True
+            req.inflight = True
+            old_addr, old_rid = req.addr, req.daemon_rid
+            delivered = list(req.tokens)
+            body = dict(req.body)
+            body["prompt"] = req.prompt + delivered
+            body["max_new_tokens"] = remaining
+            # a DERIVED dedupe token: idempotent if this same handoff
+            # is retried, never colliding with the client's token
+            # (which lives in the dead daemon's journal)
+            body["dedupe_token"] = f"fleet:{req.rid}:h{req.handoffs + 1}"
+            exclude = set(exclude) | {old_addr}
+            attempts = len(self.ring)
+        try:
+            for _ in range(attempts):
+                with self._lock:
+                    if req.terminal:
+                        return True  # cancelled under us: nothing to do
+                    addr = self._pick(body["prompt"], exclude)
+                if addr is None:
+                    return False
+                try:
+                    code, rec = self.transport.submit(
+                        addr, body, self.policy.request_timeout_seconds
+                    )
+                except TransportError:
+                    self.peers.note_failure(addr)
+                    exclude.add(addr)
+                    continue
+                self.peers.note_success(addr)
+                if code != 200:
+                    exclude.add(addr)
+                    continue
+                orphan = False
+                with self._lock:
+                    if req.terminal:
+                        orphan = True  # finalized while on the wire
+                    else:
+                        self._stale.setdefault(
+                            old_addr, []
+                        ).append(old_rid)
+                        req.addr = addr
+                        req.daemon_rid = rec["request_id"]
+                        req.base = len(delivered)
+                        req.handoffs += 1
+                        self._m_handoffs.inc()
+                        if self.tracer.enabled:
+                            self.tracer.instant(
+                                "handoff", track=FLEET_TRACK,
+                                rid=req.rid, src=old_addr, dst=addr,
+                                delivered=len(delivered),
+                            )
+                if orphan:
+                    try:
+                        self.transport.cancel(
+                            addr, rec["request_id"],
+                            self.policy.request_timeout_seconds,
+                        )
+                    except TransportError:
+                        pass
+                return True
+            return False
+        finally:
+            with self._lock:
+                req.inflight = False
 
     # -- health ------------------------------------------------------------
 
@@ -571,7 +655,9 @@ class FleetRouter:
         transitions: a peer going DEAD gets its open requests handed
         off; a DEAD peer answering again gets its stale (already
         handed-off) daemon requests cancelled and, when enabled, a
-        KV warm start from a healthy donor."""
+        KV warm start from a healthy donor.  Each tick also runs the
+        TTL eviction of long-terminal requests."""
+        self._evict_expired()
         for addr in self.peers.probe_due():
             state = self.peers.get(addr)
             if state is None:
@@ -615,9 +701,41 @@ class FleetRouter:
         request nobody is streaming would otherwise wait for its next
         client poll."""
         with self._lock:
-            for req in list(self._requests.values()):
-                if not req.terminal and req.addr == dead_addr:
-                    self._handoff_locked(req, {dead_addr})
+            stranded = [
+                req for req in self._requests.values()
+                if not req.terminal and req.addr == dead_addr
+            ]
+        for req in stranded:
+            self._handoff(req, {dead_addr})
+
+    def _evict_expired(self) -> None:
+        """The fleet counterpart of the daemon's journal retention: a
+        terminal request (and its dedupe-ledger entry) is kept for
+        ``terminal_ttl_seconds`` of late polls, then dropped; stale
+        handoff records for peers no longer in the fleet go with them.
+        Without this a long-lived router leaks every request it ever
+        served."""
+        now = self.clock()
+        with self._lock:
+            expired = [
+                rid for rid, req in self._requests.items()
+                if req.terminal and req.done_at is not None
+                and now - req.done_at >= self.terminal_ttl_seconds
+            ]
+            for rid in expired:
+                req = self._requests.pop(rid)
+                if (
+                    req.dedupe_token is not None
+                    and self._ledger.get(req.dedupe_token) == rid
+                ):
+                    del self._ledger[req.dedupe_token]
+            for addr in list(self._stale):
+                if self.peers.get(addr) is None:
+                    del self._stale[addr]
+        if expired:
+            self.registry.counter("fleet_evictions_total").inc(
+                len(expired)
+            )
 
     def _reconcile_recovered(self, addr: str) -> None:
         """A daemon came back from DEAD: its journal faithfully revived
@@ -686,13 +804,22 @@ class FleetRouter:
         blocks = max_blocks if max_blocks is not None \
             else self.warm_start_blocks
         try:
-            blob = self.transport.kv_export(
+            code, blob = self.transport.kv_export(
                 src, blocks, self.policy.request_timeout_seconds
             )
         except TransportError:
             self.peers.note_failure(src)
             return {}
         self.peers.note_success(src)
+        if code != 200:
+            # a typed refusal from a LIVE donor (draining, bad params):
+            # counted, never breaker evidence — warm starts are best
+            # effort and must not demote a responsive peer
+            self.registry.counter(
+                "fleet_kv_wire_refusals_total",
+                reason=f"export_http_{code}",
+            ).inc()
+            return {}
         if not blob:
             return {"verdicts": {}}
         self._m_kv_export_bytes.inc(len(blob))

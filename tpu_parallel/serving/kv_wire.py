@@ -41,6 +41,7 @@ rest the same way it covers the journal.
 from __future__ import annotations
 
 import json
+import math
 import struct
 import zlib
 from typing import List, Tuple
@@ -183,10 +184,16 @@ def _decode_frame(
         weights_version = str(header["weights_version"])
         meta = _tuplize(header["meta"])
         checksums = tuple(int(c) for c in header["checksums"])
-        leaf_specs = [
-            (_dtype(spec["dtype"]), tuple(int(d) for d in spec["shape"]))
-            for spec in header["leaves"]
-        ]
+        leaf_specs = []
+        for spec in header["leaves"]:
+            shape = tuple(int(d) for d in spec["shape"])
+            if any(d < 0 for d in shape):
+                # a negative dim would make the extent arithmetic lie
+                # (count<0 reads the whole buffer, pos walks backwards)
+                raise WireFormatError(
+                    WIRE_HEADER_SCHEMA, f"negative leaf dim in {shape}"
+                )
+            leaf_specs.append((_dtype(spec["dtype"]), shape))
     except WireFormatError:
         raise
     except (KeyError, TypeError, ValueError) as exc:
@@ -196,15 +203,22 @@ def _decode_frame(
     pos = hstart + hlen
     leaves = []
     for dtype, shape in leaf_specs:
-        count = int(np.prod(shape, dtype=np.int64))
-        nbytes = int(dtype.itemsize * count)
-        if len(buf) - pos < nbytes:
+        # Python-int arithmetic: a huge claimed dim must overflow into
+        # "bigger than the buffer" (truncated), never wrap negative
+        count = math.prod(shape)
+        nbytes = dtype.itemsize * count
+        if nbytes > len(buf) - pos:
             raise WireFormatError(
                 WIRE_TRUNCATED,
                 f"leaf needs {nbytes} bytes, {len(buf) - pos} remain",
             )
-        arr = np.frombuffer(buf, dtype=dtype, count=count, offset=pos)
-        leaves.append(arr.reshape(shape).copy())
+        try:
+            arr = np.frombuffer(buf, dtype=dtype, count=count, offset=pos)
+            leaves.append(arr.reshape(shape).copy())
+        except (ValueError, TypeError) as exc:
+            raise WireFormatError(
+                WIRE_HEADER_SCHEMA, f"leaf does not carve: {exc}"
+            ) from None
         pos += nbytes
     export = KVPrefixExport(
         tokens=tokens,
