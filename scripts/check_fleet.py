@@ -15,21 +15,118 @@ gates stay instant for ``tests/test_checkers.py::test_all_ast_gates``,
 while this one runs as its own tier-1 entry
 (``tests/test_fleet.py::test_fleet_smoke_subprocess``) and in the
 ``check_all`` CLI.
+
+Since the disaggregation PR it also carries a small STATIC layer
+(``check_static`` / ``check_source``), run before the smoke: the role
+vocabulary module must cover prefill/decode/mixed, and no function that
+ships KV over ``kv_import()`` may skip the import-verdict accounting
+(``fleet_kv_imports_total`` / ``fleet_kv_wire_refusals_total``) — a
+handoff path must verify-or-recompute, never assume the blocks landed.
 """
 
 from __future__ import annotations
 
+import ast
 import importlib.util
 import os
 import sys
 from typing import List, Sequence
 
 SCRIPTS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(SCRIPTS_DIR)
 
 DEFAULT_PATHS: Sequence[str] = ()  # runtime check: no tree to walk
 
+DEFAULT_STATIC_PATHS: Sequence[str] = (
+    os.path.join("tpu_parallel", "fleet", "roles.py"),
+    os.path.join("tpu_parallel", "fleet", "router.py"),
+)
+
+_REQUIRED_ROLES = frozenset({"prefill", "decode", "mixed"})
+_VERDICT_COUNTERS = ("fleet_kv_imports_total", "fleet_kv_wire_refusals_total")
+
+
+def check_source(source: str, path: str) -> List[str]:
+    """The static contracts, on one module's source."""
+    problems: List[str] = []
+    tree = ast.parse(source, filename=path)
+    if os.path.basename(path) == "roles.py":
+        # module-level string constants first (ROLES is a tuple of
+        # ROLE_* names, not literals), then the vocabulary itself
+        consts = {}
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Name)
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)
+                ):
+                    consts[tgt.id] = node.value.value
+        roles = None
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "ROLES":
+                    roles = set()
+                    for elt in getattr(node.value, "elts", ()):
+                        if isinstance(elt, ast.Constant):
+                            roles.add(elt.value)
+                        elif isinstance(elt, ast.Name):
+                            roles.add(consts.get(elt.id))
+        if roles is None:
+            problems.append(f"{path}: no ROLES vocabulary defined")
+        elif not _REQUIRED_ROLES <= roles:
+            problems.append(
+                f"{path}: ROLES must cover "
+                f"{sorted(_REQUIRED_ROLES)}, got {sorted(roles)}"
+            )
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        ships = any(
+            isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and n.func.attr == "kv_import"
+            for n in ast.walk(node)
+        )
+        if not ships:
+            continue
+        accounted = any(
+            isinstance(n, ast.Constant) and n.value in _VERDICT_COUNTERS
+            for n in ast.walk(node)
+        )
+        if not accounted:
+            problems.append(
+                f"{path}:{node.lineno}: {node.name}() ships KV over "
+                "kv_import() without accounting the import verdicts "
+                f"({' / '.join(_VERDICT_COUNTERS)}) — a handoff path "
+                "must verify-or-recompute, never assume the blocks "
+                "landed"
+            )
+    return problems
+
+
+def check_static(
+    paths: Sequence[str] = DEFAULT_STATIC_PATHS,
+) -> List[str]:
+    problems: List[str] = []
+    for rel in paths:
+        path = rel if os.path.isabs(rel) else os.path.join(REPO_ROOT, rel)
+        if not os.path.isfile(path):
+            raise FileNotFoundError(path)
+        with open(path, encoding="utf-8") as fh:
+            problems.extend(check_source(fh.read(), rel))
+    return problems
+
 
 def check_paths(paths: Sequence[str] = DEFAULT_PATHS) -> List[str]:
+    problems = check_static()
+    if problems:
+        # a tree failing its static contracts is not worth smoking
+        return problems
     spec = importlib.util.spec_from_file_location(
         "fleet_bench", os.path.join(SCRIPTS_DIR, "fleet_bench.py")
     )

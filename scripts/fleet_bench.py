@@ -34,13 +34,20 @@ Entry modes:
 - ``--smoke``: the fast CI gate (``scripts/check_fleet.py`` and tier-1
   via ``tests/test_fleet.py``): router + 2 daemons on loopback ports,
   one SIGKILL mid-stream, one recovery warm start, one corrupt-import
-  refusal.  Bounded wall time; one model build in the parent (the
-  greedy reference) plus one per child.
+  refusal, and a disagg leg (a second, role-pinned router over the
+  same daemons: bitwise prefill->decode handoff, then a dead decode
+  peer resolving as a typed fallback).  Bounded wall time; one model
+  build in the parent (the greedy reference) plus one per child.
 - ``--soak SEED``: the acceptance soak — per seeded trial: router + 3
   daemons, a seeded request schedule, a seeded victim SIGKILLed at a
   seeded point, full invariant sweep, restart + warm start, corrupt
   leg, graceful stop.  ``--record FLEET_r01.json`` writes the
   per-trial evidence.
+- ``--disagg SEED``: the disaggregation bench — 1-prefill/2-decode vs
+  3-mixed at equal hardware on one seeded schedule (a long-prefill
+  burst contending with decode-heavy probes); records decode ITL p95,
+  TTFT, and handoff bytes/latency per leg into ``FLEET_r02.json``,
+  failing on any lost/duplicated/non-bitwise stream.
 - ``--serve``: INTERNAL daemon child — the ``daemon_bench`` child with
   radix-cached engines (``kv_block_tokens=4`` + ``kv_radix_cache``) so
   peer KV export/import has chains to ship.
@@ -149,9 +156,11 @@ class Peer:
     """One daemon child the parent manages: fixed port, its journal,
     its ready file, and the live Popen handle (replaced on restart)."""
 
-    def __init__(self, tmpdir, name, port):
+    def __init__(self, tmpdir, name, port, role="mixed", tick_sleep=0.0):
         self.name = name
         self.port = port
+        self.role = role
+        self.tick_sleep = tick_sleep
         self.addr = f"127.0.0.1:{port}"
         self.journal = os.path.join(tmpdir, f"{name}.jsonl")
         self.ready = os.path.join(tmpdir, f"{name}.ready.json")
@@ -165,6 +174,7 @@ class Peer:
             sys.executable, os.path.abspath(__file__), "--serve",
             "--journal", self.journal, "--ready-file", self.ready,
             "--port", str(self.port), "--grace", str(grace),
+            "--role", self.role, "--tick-sleep", str(self.tick_sleep),
         ]
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
@@ -181,8 +191,9 @@ class Peer:
         self.proc.wait(timeout=30)
 
 
-def spawn_router(tmpdir, peer_addrs, warm_blocks=64):
-    ready = os.path.join(tmpdir, "router.ready.json")
+def spawn_router(tmpdir, peer_addrs, warm_blocks=64, roles=None,
+                 name="router"):
+    ready = os.path.join(tmpdir, f"{name}.ready.json")
     if os.path.exists(ready):
         os.remove(ready)
     cmd = [
@@ -190,6 +201,8 @@ def spawn_router(tmpdir, peer_addrs, warm_blocks=64):
         "--peers", ",".join(peer_addrs), "--ready-file", ready,
         "--warm-blocks", str(warm_blocks),
     ]
+    if roles:
+        cmd += ["--roles", ",".join(roles)]
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
     return subprocess.Popen(cmd, env=env), ready
@@ -300,19 +313,39 @@ def serve(args):
                 scheduler=SchedulerConfig(max_prefills_per_tick=2),
                 kv_block_tokens=BLOCK_TOKENS, prefix_cache_size=64,
                 kv_radix_cache=True,
+                # one decode token per paced tick: the fused-scan
+                # default drains a whole budget in ~3 ticks, which no
+                # tick pacing can stretch — and mid-flight legs (kills,
+                # disagg migrations) need requests that LIVE a while
+                decode_steps_per_tick=1 if args.tick_sleep > 0 else "auto",
             )
             for _ in range(args.replicas)
         ]
-        return Frontend(
+        fe = Frontend(
             engines, router="least",
             config=FrontendConfig(restart=None),
             clock=clock, registry=MetricRegistry(),
         )
+        if args.tick_sleep > 0:
+            # pace each pump tick like a realistically-sized model's
+            # decode step: the tiny CPU model otherwise drains a whole
+            # token budget faster than one KV-handoff round-trip, which
+            # makes mid-flight legs (kills, disagg migrations) a race
+            orig_step = fe.step
+
+            def paced_step(*a, **kw):
+                out = orig_step(*a, **kw)
+                time.sleep(args.tick_sleep)
+                return out
+
+            fe.step = paced_step
+        return fe
 
     daemon = ServingDaemon(
         frontend_factory, args.journal,
         config=DaemonConfig(
             grace_seconds=args.grace, fsync_batch=args.fsync_batch,
+            role=args.role,
         ),
     )
     server = DaemonHTTPServer(daemon, port=args.port).start()
@@ -338,10 +371,17 @@ def route(args):
     from tpu_parallel.obs.registry import MetricRegistry
 
     peers = [p for p in args.peers.split(",") if p]
+    roles = None
+    if args.roles:
+        parts = [r for r in args.roles.split(",") if r]
+        if len(parts) != len(peers):
+            raise SystemExit("--roles must align 1:1 with --peers")
+        roles = dict(zip(peers, parts))
     router = FleetRouter(
         peers,
         clock=WallClock(),
         transport=HTTPFleetTransport(),
+        roles=roles,
         # key placement on the shared-prefix head (2 KV blocks = 8
         # tokens): every request of a shared_prefix() group lands on
         # the same daemon, which is what makes its radix chains hot
@@ -384,9 +424,12 @@ class StreamReader(threading.Thread):
         self.url = f"{base}/v1/stream/{rid}"
         self.rid = rid
         self.events = []
+        self.times = []  # wall-clock arrival per event (TTFT / ITL)
+        self.t0 = None
         self.error = None
 
     def run(self):
+        self.t0 = time.monotonic()
         try:
             req = urllib.request.Request(self.url)
             # generous per-read timeout: the router does not forward
@@ -397,6 +440,7 @@ class StreamReader(threading.Thread):
                     if not line.startswith(b"data:"):
                         continue
                     ev = json.loads(line[len(b"data:"):].strip())
+                    self.times.append(time.monotonic())
                     self.events.append(ev)
                     if ev.get("finished"):
                         return
@@ -408,6 +452,21 @@ class StreamReader(threading.Thread):
 
     def indices(self):
         return [e["index"] for e in self.events if "token" in e]
+
+    def ttft(self):
+        """Stream-open to first relayed token, or None."""
+        for ev, at in zip(self.events, self.times):
+            if "token" in ev:
+                return at - self.t0
+        return None
+
+    def itls(self):
+        """Inter-token gaps over the relayed stream (decode latency as
+        the client experiences it, handoff stalls included)."""
+        arrivals = [
+            at for ev, at in zip(self.events, self.times) if "token" in ev
+        ]
+        return [b - a for a, b in zip(arrivals, arrivals[1:])]
 
 
 def wait_finished(base, rids, refs, problems, timeout=240.0, label=""):
@@ -473,6 +532,26 @@ def read_metric(base, line_prefix):
         if line.startswith(line_prefix + " "):
             return float(line.rsplit(" ", 1)[1])
     return 0.0
+
+
+def read_metric_sum(base, name):
+    """Sum every series of a labelled metric family (e.g. all
+    ``reason=`` legs of ``fleet_handoff_fallbacks_total``)."""
+    with urllib.request.urlopen(f"{base}/metricsz", timeout=30) as resp:
+        text = resp.read().decode()
+    total = 0.0
+    for line in text.splitlines():
+        if line.startswith(name + "{") or line.startswith(name + " "):
+            total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+def p95(samples):
+    if not samples:
+        return None
+    ordered = sorted(samples)
+    rank = max(1, -(-95 * len(ordered) // 100))  # ceil, 1-based
+    return ordered[rank - 1]
 
 
 def wait_metric(base, line_prefix, minimum, timeout=90.0):
@@ -576,7 +655,12 @@ def run_smoke(tmpdir=None, keep=False):
     problems = []
     tmpdir = tmpdir or tempfile.mkdtemp(prefix="fleet_smoke_")
     ports = pick_ports(2)
-    peers = [Peer(tmpdir, f"d{i}", p) for i, p in enumerate(ports)]
+    # paced ticks: the tiny model must not outrun the mid-flight legs
+    # (the kill and the disagg migration both race one HTTP round-trip)
+    peers = [
+        Peer(tmpdir, f"d{i}", p, tick_sleep=0.01)
+        for i, p in enumerate(ports)
+    ]
     by_addr = {p.addr: p for p in peers}
     router_proc = None
     try:
@@ -722,6 +806,124 @@ def run_smoke(tmpdir=None, keep=False):
         if code != 200:
             problems.append(f"post-recovery healthz {code}: {payload}")
 
+        # ---- disagg leg: a SECOND router over the same two daemons,
+        # roles pinned prefill/decode router-side.  Fresh prompts place
+        # on the prefill peer, migrate to the decode peer at first
+        # token, and the client stream must stay bitwise through the
+        # move; a dead decode peer must resolve as a TYPED fallback to
+        # colocated decode, never lost tokens.  (Router children are
+        # cheap — no model build — so this reuses the warm daemons.)
+        # DISTINCT cold prompts (a shared warm prefix makes every
+        # prefill a radix hit and the whole batch drains before the
+        # export round-trip can land), enough of them that the later
+        # requests queue behind the prefill peer's slots: a queued
+        # request's relay is attached before admission, so its first
+        # token fires the export with most of the budget still pending
+        rnd_d = random.Random(44)
+        d_entries = [
+            {
+                "dedupe_token": f"fleet-dg-{i}",
+                "prompt": [
+                    rnd_d.randrange(1, 250) for _ in range(11)
+                ],
+                "max_new_tokens": HANDOFF_NEW_TOKENS,
+            }
+            for i in range(8)
+        ]
+        kill_entry = {
+            "dedupe_token": "fleet-dg-kill",
+            "prompt": [rnd_d.randrange(1, 250) for _ in range(11)],
+            "max_new_tokens": HANDOFF_NEW_TOKENS,
+        }
+        refs_d = greedy_references(d_entries + [kill_entry])
+        router2, r2ready = spawn_router(
+            tmpdir, [p.addr for p in peers],
+            roles=["prefill", "decode"], name="router2",
+        )
+        try:
+            r2port = wait_ready(r2ready, router2)["port"]
+            base2 = f"http://127.0.0.1:{r2port}"
+            rids_d, readers_d = {}, {}
+            for entry in d_entries:
+                code, rec = http_json("POST", f"{base2}/v1/submit", entry)
+                if code != 200:
+                    problems.append(f"disagg submit {code}: {rec}")
+                    continue
+                tok = entry["dedupe_token"]
+                rids_d[tok] = rec["request_id"]
+                if rec.get("peer") != peers[0].addr:
+                    problems.append(
+                        f"disagg: {tok} placed on {rec.get('peer')}, "
+                        "not the prefill peer"
+                    )
+                readers_d[tok] = StreamReader(base2, rec["request_id"])
+                readers_d[tok].start()
+            for tok, reader in readers_d.items():
+                reader.join(timeout=420)
+                if reader.is_alive():
+                    problems.append(
+                        f"disagg: {tok} stream never terminated"
+                    )
+                elif reader.error:
+                    problems.append(
+                        f"disagg: {tok} stream tore: {reader.error}"
+                    )
+                else:
+                    if reader.tokens() != refs_d[tok]:
+                        problems.append(
+                            f"disagg: {tok} NOT BITWISE through the "
+                            "prefill->decode handoff"
+                        )
+                    idxs = reader.indices()
+                    if idxs != list(range(len(idxs))):
+                        problems.append(
+                            f"disagg: {tok} client indices not "
+                            f"contiguous: {idxs}"
+                        )
+            wait_finished(base2, rids_d, refs_d, problems, label="disagg: ")
+            migrated = read_metric(base2, "fleet_handoff_disagg_total")
+            if migrated < 1:
+                problems.append(
+                    "disagg: no prefill->decode migration landed "
+                    f"(disagg={migrated}, fallbacks="
+                    f"{read_metric_sum(base2, 'fleet_handoff_fallbacks_total')})"
+                )
+            # kill the decode peer; fresh work falls back TYPED
+            peers[1].sigkill()
+            code, rec = http_json("POST", f"{base2}/v1/submit", kill_entry)
+            if code != 200:
+                problems.append(f"disagg kill submit {code}: {rec}")
+            else:
+                reader = StreamReader(base2, rec["request_id"])
+                reader.start()
+                reader.join(timeout=420)
+                if reader.is_alive() or reader.error:
+                    problems.append(
+                        "disagg kill: stream did not survive the dead "
+                        f"decode peer (error={reader.error})"
+                    )
+                elif reader.tokens() != refs_d["fleet-dg-kill"]:
+                    problems.append(
+                        "disagg kill: colocated fallback NOT BITWISE"
+                    )
+            fallbacks = read_metric_sum(
+                base2, "fleet_handoff_fallbacks_total"
+            )
+            if fallbacks < 1:
+                problems.append(
+                    "disagg kill: dead decode peer produced no typed "
+                    f"fallback (fallbacks_total={fallbacks})"
+                )
+            stop_gracefully(router2, problems, "router2")
+            router2 = None
+        finally:
+            if router2 is not None and router2.poll() is None:
+                router2.kill()
+                router2.wait(timeout=30)
+        # bring the decode daemon back so the fleet drains gracefully
+        peers[1].spawn()
+        peers[1].wait_ready()
+
         # ---- graceful stop: router first, then the daemons
         stop_gracefully(router_proc, problems, "router")
         router_proc = None
@@ -736,6 +938,226 @@ def run_smoke(tmpdir=None, keep=False):
             import shutil
 
             shutil.rmtree(tmpdir, ignore_errors=True)
+    return problems
+
+
+def read_metric_family(base, name):
+    """All series of one metric family -> {label_suffix: value}."""
+    with urllib.request.urlopen(f"{base}/metricsz", timeout=30) as resp:
+        text = resp.read().decode()
+    family = {}
+    for line in text.splitlines():
+        if line.startswith(name + "{") or line.startswith(name + " "):
+            series, value = line.rsplit(" ", 1)
+            family[series[len(name):].strip() or "_"] = float(value)
+    return family
+
+
+def _disagg_leg(tmpdir, label, roles, refs, burst, measured):
+    """One disagg-bench leg: N daemons under the given roles, the
+    seeded long-prefill burst + measured decode-heavy probes, decode
+    ITL/TTFT from the probes' own relayed streams.  Returns
+    (stats, problems)."""
+    problems = []
+    ports = pick_ports(len(roles))
+    peers = [
+        Peer(tmpdir, f"{label}{i}", port, role=role, tick_sleep=0.01)
+        for i, (port, role) in enumerate(zip(ports, roles))
+    ]
+    router_proc = None
+    stats = {"label": label, "roles": list(roles)}
+    try:
+        for p in peers:
+            p.spawn()
+        for p in peers:
+            p.wait_ready()
+        router_proc, rready = spawn_router(
+            tmpdir, [p.addr for p in peers],
+            roles=list(roles), name=f"router_{label}",
+        )
+        rport = wait_ready(rready, router_proc)["port"]
+        base = f"http://127.0.0.1:{rport}"
+
+        # the burst first (it is the prefill contention), then the
+        # measured probes whose decode ITL the record judges
+        rids, readers = {}, {}
+        for entry in burst + measured:
+            code, rec = http_json("POST", f"{base}/v1/submit", entry)
+            if code != 200:
+                problems.append(f"{label}: submit {code}: {rec}")
+                continue
+            tok = entry["dedupe_token"]
+            rids[tok] = rec["request_id"]
+            readers[tok] = StreamReader(base, rec["request_id"])
+            readers[tok].start()
+
+        measured_toks = {e["dedupe_token"] for e in measured}
+        ttfts, gaps_all, gaps_steady = [], [], []
+        for tok, reader in readers.items():
+            reader.join(timeout=420)
+            if reader.is_alive():
+                problems.append(f"{label}: {tok} stream never terminated")
+                continue
+            if reader.error:
+                problems.append(
+                    f"{label}: {tok} stream tore: {reader.error}"
+                )
+                continue
+            if reader.tokens() != refs[tok]:
+                problems.append(
+                    f"{label}: {tok} diverges from the greedy "
+                    "reference (NOT BITWISE)"
+                )
+            idxs = reader.indices()
+            if idxs != list(range(len(idxs))):
+                problems.append(
+                    f"{label}: {tok} client indices not contiguous"
+                )
+            if tok in measured_toks:
+                if reader.ttft() is not None:
+                    ttfts.append(reader.ttft())
+                gaps = reader.itls()
+                gaps_all.extend(gaps)
+                # steady-state view: drop each stream's single largest
+                # gap (the disagg leg's one-time migration stall; the
+                # same trim applies to BOTH legs so the comparison
+                # stays symmetric).  The stall itself is reported via
+                # fleet_handoff_seconds_total.
+                if gaps:
+                    trimmed = sorted(gaps)[:-1]
+                    gaps_steady.extend(trimmed)
+
+        # every accepted request terminal + bitwise; retries answer the
+        # original record (zero lost, zero duplicated)
+        wait_finished(base, rids, refs, problems, label=f"{label}: ")
+        for entry in burst + measured:
+            tok = entry["dedupe_token"]
+            if tok not in rids:
+                continue
+            code, rec = http_json("POST", f"{base}/v1/submit", entry)
+            if code != 200 or rec.get("request_id") != rids[tok]:
+                problems.append(
+                    f"{label}: {tok} retry re-admitted — duplicate "
+                    f"work path ({code} {rec})"
+                )
+
+        stats.update(
+            requests=len(rids),
+            measured=len(measured_toks),
+            ttft_p95_seconds=p95(ttfts),
+            decode_itl_p95_seconds=p95(gaps_steady),
+            decode_itl_p95_all_gaps_seconds=p95(gaps_all),
+            decode_itl_samples=len(gaps_steady),
+            handoff_disagg=read_metric(
+                base, "fleet_handoff_disagg_total"
+            ),
+            handoff_bytes=read_metric(base, "fleet_handoff_bytes_total"),
+            handoff_seconds=read_metric(
+                base, "fleet_handoff_seconds_total"
+            ),
+            handoff_fallbacks=read_metric_family(
+                base, "fleet_handoff_fallbacks_total"
+            ),
+        )
+        stop_gracefully(router_proc, problems, f"{label}-router")
+        router_proc = None
+        for p in peers:
+            stop_gracefully(p.proc, problems, p.name)
+    finally:
+        for proc in [router_proc] + [p.proc for p in peers]:
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=30)
+    return stats, problems
+
+
+def run_disagg(args):
+    """1-prefill/2-decode vs 3-mixed at equal hardware, same seeded
+    schedule: a long-prefill burst contends with decode-heavy probes;
+    the record (FLEET_r02.json) captures decode ITL p95 / TTFT per leg
+    plus the handoff byte/latency cost, and any correctness problem
+    (lost, duplicated, or non-bitwise stream) fails the bench."""
+    import tempfile
+
+    seed = args.disagg
+    tmpdir = args.workdir or tempfile.mkdtemp(prefix="fleet_disagg_")
+    rnd = random.Random(seed ^ 0xD15A)
+    # long prompts near the tiny model's seq_len: prefill compute is
+    # the contention the decode pool escapes
+    burst = [
+        {
+            "dedupe_token": f"burst-{seed}-{i}",
+            "prompt": [rnd.randrange(1, 250) for _ in range(24)],
+            "max_new_tokens": 4,
+        }
+        for i in range(8)
+    ]
+    measured = [
+        {
+            "dedupe_token": f"probe-{seed}-{i}",
+            "prompt": [rnd.randrange(1, 250) for _ in range(8)],
+            "max_new_tokens": HANDOFF_NEW_TOKENS,
+        }
+        for i in range(6)
+    ]
+    refs = greedy_references(burst + measured)
+    baseline, problems = _disagg_leg(
+        tmpdir, "mixed", ("mixed", "mixed", "mixed"),
+        refs, burst, measured,
+    )
+    disagg, problems_b = _disagg_leg(
+        tmpdir, "disagg", ("prefill", "decode", "decode"),
+        refs, burst, measured,
+    )
+    problems += problems_b
+    if disagg.get("handoff_disagg", 0) < 1:
+        problems.append(
+            "disagg leg: no prefill->decode migration fired "
+            f"(fallbacks={disagg.get('handoff_fallbacks')})"
+        )
+    record = {
+        "bench": "fleet_disagg",
+        "seed": seed,
+        "config": {
+            "daemons": 3,
+            "burst_requests": len(burst),
+            "measured_requests": len(measured),
+            "burst_prompt_tokens": 24,
+            "probe_new_tokens": HANDOFF_NEW_TOKENS,
+            "baseline_roles": list(baseline["roles"]),
+            "disagg_roles": list(disagg["roles"]),
+            "itl_note": (
+                "decode_itl_p95_seconds drops each stream's single "
+                "largest gap (applied to both legs); the untrimmed "
+                "view is decode_itl_p95_all_gaps_seconds"
+            ),
+        },
+        "baseline": baseline,
+        "disagg": disagg,
+    }
+    b = baseline.get("decode_itl_p95_seconds")
+    d = disagg.get("decode_itl_p95_seconds")
+    if b and d:
+        record["itl_p95_ratio_disagg_over_baseline"] = round(d / b, 4)
+    record["problems"] = problems
+    record["ok"] = not problems
+    path = args.record or "FLEET_r02.json"
+    with open(path, "w") as fh:
+        json.dump(record, fh, indent=2)
+        fh.write("\n")
+    print(f"record: {path}")
+    for label, leg in (("baseline", baseline), ("disagg", disagg)):
+        print(
+            f"  {label}: decode ITL p95 "
+            f"{leg.get('decode_itl_p95_seconds')}s, TTFT p95 "
+            f"{leg.get('ttft_p95_seconds')}s, migrations "
+            f"{leg.get('handoff_disagg')}, handoff bytes "
+            f"{leg.get('handoff_bytes')}"
+        )
+    if not problems:
+        import shutil
+
+        shutil.rmtree(tmpdir, ignore_errors=True)
     return problems
 
 
@@ -1023,7 +1445,19 @@ def main():
     ap.add_argument("--soak", type=int, default=None, metavar="SEED",
                     help="seeded host-kill soak: trials use seeds "
                          "SEED..SEED+trials-1")
+    ap.add_argument("--disagg", type=int, default=None, metavar="SEED",
+                    help="prefill/decode disaggregation bench: "
+                         "1-prefill/2-decode vs 3-mixed at equal "
+                         "hardware, records FLEET_r02.json")
     ap.add_argument("--peers", type=str, default="")
+    ap.add_argument("--role", type=str, default="mixed",
+                    help="INTERNAL (--serve): this daemon's fleet role")
+    ap.add_argument("--tick-sleep", type=float, default=0.0,
+                    help="INTERNAL (--serve): seconds slept per pump "
+                         "tick — paces the tiny model like a real one")
+    ap.add_argument("--roles", type=str, default="",
+                    help="INTERNAL (--route): comma roles aligned "
+                         "with --peers")
     ap.add_argument("--journal", type=str, default="")
     ap.add_argument("--ready-file", type=str, default="")
     ap.add_argument("--port", type=int, default=0)
@@ -1052,8 +1486,10 @@ def main():
         problems = run_smoke()
     elif args.soak is not None:
         problems = run_soak(args)
+    elif args.disagg is not None:
+        problems = run_disagg(args)
     else:
-        ap.error("pick a mode: --smoke or --soak SEED")
+        ap.error("pick a mode: --smoke, --soak SEED, or --disagg SEED")
         return
     for problem in problems:
         print(problem, file=sys.stderr)

@@ -15,7 +15,10 @@ import pytest
 
 from tpu_parallel.cluster import (
     AP_REFUSED,
+    AP_REFUSED_NO_IDLE_PEER,
+    AP_REFUSED_NO_ROLE_CONTROLLER,
     AP_REFUSED_SWAP,
+    AP_REROLE,
     AP_SCALE_DOWN,
     AP_SCALE_UP,
     AP_SHED_CANCEL,
@@ -879,3 +882,125 @@ def test_production_soak_trace_swap_storm_autopilot(env):
     s = fe.summary()
     assert s["replica_deaths"] >= 1
     assert s["restarts"] >= 1
+
+# -- the fourth lever: the fleet's prefill:decode role ratio -----------------
+
+
+class _StubRoleController:
+    """The duck-typed slice of FleetRouter the autopilot steers:
+    ``role_counts()`` / ``pick_rerole(to_role)`` / ``set_role``."""
+
+    def __init__(self, roles):
+        self.roles = dict(roles)
+        self.set_calls = []
+
+    def role_counts(self):
+        counts = {}
+        for role in self.roles.values():
+            counts[role] = counts.get(role, 0) + 1
+        return counts
+
+    def pick_rerole(self, to_role):
+        for addr in sorted(self.roles):
+            if self.roles[addr] == "mixed":
+                return addr
+        return None
+
+    def set_role(self, addr, role):
+        self.set_calls.append((addr, role))
+        self.roles[addr] = role
+        return True
+
+
+def test_policy_validation_role_targets():
+    with pytest.raises(ValueError):
+        AutopilotPolicy(max_replicas=2, decode_itl_target=0.0)
+    with pytest.raises(ValueError):
+        AutopilotPolicy(max_replicas=2, prefill_backlog_target=-1.0)
+    with pytest.raises(ValueError):
+        AutopilotPolicy(max_replicas=2, role_cooldown_ticks=0)
+
+
+def test_rerole_hysteresis_and_cooldown(env):
+    """A sustained decode-ITL breach — never a single tick's spike —
+    re-roles exactly ONE idle mixed peer to decode, then the role
+    cooldown holds the ratio still until its window elapses."""
+    t = [0.0]
+    fe, _ = _fleet(env, lambda: t[0], n=1, slots=1)
+    rc = _StubRoleController(
+        {"h0:80": "mixed", "h1:80": "mixed", "h2:80": "mixed"}
+    )
+    ap = fe.enable_autopilot(AutopilotPolicy(
+        max_replicas=1, min_replicas=1, scale_down_idle_ticks=None,
+        window_ticks=8, breach_ticks=3, role_cooldown_ticks=4,
+        prefill_backlog_target=0.5, decode_itl_target=0.05,
+    ), role_controller=rc)
+    for tick in range(1, 3):
+        ap.observe_fleet(decode_itl_seconds=0.2)
+        t[0] += 0.01
+        fe.step()
+        assert not rc.set_calls, f"actuated below breach_ticks ({tick})"
+        assert ap.status()["role_breach_streak"] == tick
+        assert ap.status()["role_breach_dir"] == "decode_itl"
+    ap.observe_fleet(decode_itl_seconds=0.2)
+    t[0] += 0.01
+    fe.step()  # streak hits breach_ticks: actuate
+    assert rc.set_calls == [("h0:80", "decode")]
+    reroles = [a for a in ap.actions if a.kind == AP_REROLE]
+    assert len(reroles) == 1
+    assert reroles[0].reason == "decode_itl"
+    assert dict(reroles[0].detail)["to_role"] == "decode"
+    assert dict(reroles[0].detail)["role_mixed"] == 2
+    assert ap.status()["role_counts"] == {"decode": 1, "mixed": 2}
+    # still breaching: the cooldown (4 ticks) holds the ratio
+    for _ in range(3):
+        ap.observe_fleet(decode_itl_seconds=0.2)
+        t[0] += 0.01
+        fe.step()
+        assert len(rc.set_calls) == 1, "re-roled inside the cooldown"
+    ap.observe_fleet(decode_itl_seconds=0.2)
+    t[0] += 0.01
+    fe.step()  # cooldown elapsed, breach sustained: one more
+    assert rc.set_calls == [("h0:80", "decode"), ("h1:80", "decode")]
+
+
+def test_rerole_decode_wins_and_refusals_are_typed(env):
+    """When BOTH fleet signals breach, decode ITL (the client-visible
+    one) directs the flip; and when the lever cannot act — no role
+    controller armed, or no idle mixed peer left — the refusal is a
+    typed action, one per cooldown window, never silence."""
+    t = [0.0]
+    fe, _ = _fleet(env, lambda: t[0], n=1, slots=1)
+    ap = fe.enable_autopilot(AutopilotPolicy(
+        max_replicas=1, min_replicas=1, scale_down_idle_ticks=None,
+        window_ticks=8, breach_ticks=2, role_cooldown_ticks=3,
+        prefill_backlog_target=0.5, decode_itl_target=0.05,
+    ))  # role_controller=None: the lever is due but unarmed
+    for _ in range(4):
+        ap.observe_fleet(
+            prefill_backlog_seconds=2.0, decode_itl_seconds=0.2
+        )
+        t[0] += 0.01
+        fe.step()
+    refusals = [a for a in ap.actions if a.kind == AP_REFUSED]
+    assert len(refusals) == 1  # one per cooldown window, not per tick
+    assert refusals[0].reason == AP_REFUSED_NO_ROLE_CONTROLLER
+    assert ap.status()["role_breach_dir"] == "decode_itl"
+
+    t2 = [0.0]
+    fe2, _ = _fleet(env, lambda: t2[0], n=1, slots=1)
+    rc = _StubRoleController({"h0:80": "prefill", "h1:80": "decode"})
+    ap2 = fe2.enable_autopilot(AutopilotPolicy(
+        max_replicas=1, min_replicas=1, scale_down_idle_ticks=None,
+        window_ticks=8, breach_ticks=2, role_cooldown_ticks=3,
+        decode_itl_target=0.05,
+    ), role_controller=rc)
+    for _ in range(3):
+        ap2.observe_fleet(decode_itl_seconds=0.2)
+        t2[0] += 0.01
+        fe2.step()
+    assert not rc.set_calls  # nothing mixed left to flip
+    refusals = [a for a in ap2.actions if a.kind == AP_REFUSED]
+    assert len(refusals) == 1
+    assert refusals[0].reason == AP_REFUSED_NO_IDLE_PEER
+    assert dict(refusals[0].detail)["to_role"] == "decode"

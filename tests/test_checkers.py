@@ -339,6 +339,41 @@ def test_check_fleet_registered_as_runtime_gate():
     assert callable(mod.check_paths)
 
 
+def test_check_fleet_static_verdict_accounting():
+    """``check_fleet``'s static layer: the live tree passes (role
+    vocabulary present, every ``kv_import`` caller accounts its import
+    verdicts), a planted bypass — KV shipped with the verdicts dropped
+    on the floor — is flagged, and a gutted role vocabulary is too."""
+    cf = _load("check_fleet")
+    assert cf.check_static() == []
+    planted = (
+        "def sneak_handoff(self, dst, blob):\n"
+        "    code, body = self.transport.kv_import(dst, blob, 5.0)\n"
+        "    return code == 200\n"
+    )
+    found = cf.check_source(planted, "tpu_parallel/fleet/router.py")
+    assert len(found) == 1 and "sneak_handoff" in found[0]
+    assert "verdict" in found[0]
+    # the same shipping path WITH accounting passes
+    ok = (
+        "def handoff(self, dst, blob):\n"
+        "    code, body = self.transport.kv_import(dst, blob, 5.0)\n"
+        "    for v, n in body.get('verdicts', {}).items():\n"
+        "        self.registry.counter(\n"
+        "            'fleet_kv_imports_total', status=v).inc(n)\n"
+        "    return code == 200\n"
+    )
+    assert cf.check_source(ok, "tpu_parallel/fleet/router.py") == []
+    # the roles module must keep its full vocabulary
+    gutted = "ROLES = ('prefill', 'decode')\n"
+    found = cf.check_source(gutted, "tpu_parallel/fleet/roles.py")
+    assert len(found) == 1 and "mixed" in found[0]
+    found = cf.check_source("X = 1\n", "tpu_parallel/fleet/roles.py")
+    assert len(found) == 1 and "no ROLES" in found[0]
+    with pytest.raises(FileNotFoundError):
+        cf.check_static(("no/such/module.py",))
+
+
 def test_runtime_checks_registered_separately():
     """``check_daemon`` (the start/submit/SIGTERM-drain smoke) lives in
     the RUNTIME_CHECKS registry: resolvable by name like the AST gates,

@@ -23,6 +23,7 @@ from tpu_parallel.fleet import (
     DEGRADED,
     HEALTHY,
     REJECT_NO_PEER,
+    REJECT_ROLE,
     FleetRouter,
     FleetTransport,
     PeerPolicy,
@@ -42,14 +43,20 @@ from tpu_parallel.serving.kv_hierarchy import (
     KVPrefixExport,
 )
 from tpu_parallel.serving.kv_wire import (
+    SEGMENT_OVERHEAD,
     WIRE_HEADER_SCHEMA,
     WIRE_MAGIC,
     WIRE_REASONS,
+    WIRE_SEGMENT,
+    ChunkReassembler,
     WireFormatError,
     decode_export,
+    decode_export_chunks,
     decode_exports,
     encode_export,
+    encode_export_chunks,
     encode_exports,
+    is_chunk_stream,
     read_export_file,
     write_export_file,
 )
@@ -248,6 +255,91 @@ def test_wire_file_roundtrip_and_read_rot():
             assert inj.injected["bit_flip"] == 1
 
 
+def test_chunk_roundtrip_and_streaming_drain():
+    """The streaming framing: a multi-frame export body split into
+    bounded segments reassembles bitwise, whole frames surface EARLY
+    (before the terminal arrives — the Mooncake-style overlap), and an
+    empty export list still ships as one lone terminal so the receiver
+    can tell 'nothing hot' from 'transfer died'."""
+    exports = [
+        _synthetic_export(np.float32, seed=21),
+        _synthetic_export(np.int8, seed=22, n_blocks=3),
+    ]
+    segments = encode_export_chunks(exports, max_wire_bytes=128)
+    assert len(segments) > 3, "body never actually split"
+    for seg in segments:
+        assert is_chunk_stream(seg)
+        assert len(seg) <= 128 + SEGMENT_OVERHEAD
+    back = decode_export_chunks(b"".join(segments))
+    assert len(back) == 2
+    for got, want in zip(back, exports):
+        assert got.tokens == want.tokens
+        assert got.checksums == want.checksums
+        for g, w in zip(got.leaves, want.leaves):
+            assert g.tobytes() == w.tobytes()
+    # incremental receive: the first frame lands while later segments
+    # are still "in flight"
+    asm = ChunkReassembler()
+    landed = []
+    for seg in segments[:-1]:
+        asm.feed(seg)
+        landed.extend(asm.drain())
+    assert landed and not asm.finished
+    asm.feed(segments[-1])
+    landed.extend(asm.drain())
+    asm.close()
+    assert len(landed) == 2
+    lone = encode_export_chunks([], max_wire_bytes=128)
+    assert len(lone) == 1
+    assert decode_export_chunks(b"".join(lone)) == []
+
+
+def test_chunk_damage_matrix_refuses_typed():
+    """Every chunk-stream damage shape — lost segment, reordering, a
+    flipped payload bit, a corrupted terminal checksum, a missing
+    terminal (the mid-transfer death), bytes after the terminal,
+    truncated preludes — refuses with the typed ``segment`` reason;
+    none of them ever yields a partial decode."""
+    segments = encode_export_chunks(
+        [_synthetic_export(np.float32, seed=23)], max_wire_bytes=64
+    )
+    assert len(segments) >= 4
+    body = b"".join(segments)
+
+    def refused(buf):
+        with pytest.raises(WireFormatError) as exc:
+            decode_export_chunks(buf)
+        assert exc.value.reason == WIRE_SEGMENT
+
+    refused(b"".join(segments[:1] + segments[2:]))  # lost segment
+    refused(b"".join([segments[1], segments[0]] + segments[2:]))
+    flipped = bytearray(segments[1])
+    flipped[SEGMENT_OVERHEAD] ^= 1  # payload bit
+    refused(b"".join([segments[0], bytes(flipped)] + segments[2:]))
+    bad_term = bytearray(segments[-1])
+    bad_term[-1] ^= 1  # whole-stream CRC in the terminal
+    refused(b"".join(segments[:-1] + [bytes(bad_term)]))
+    refused(b"".join(segments[:-1]))  # stream ends without terminal
+    refused(body + segments[0])  # bytes after the terminal
+    refused(body[:-1])  # truncated terminal prelude
+    refused(body[:SEGMENT_OVERHEAD - 2])
+    # a reassembler poisoned by damage refuses every further feed, and
+    # an unterminated incremental stream refuses at close — the death
+    # of the sender is never mistaken for a complete transfer
+    asm = ChunkReassembler()
+    asm.feed(segments[0])
+    with pytest.raises(WireFormatError):
+        asm.feed(segments[0])  # seq replay
+    with pytest.raises(WireFormatError):
+        asm.feed(segments[1])  # poisoned
+    asm2 = ChunkReassembler()
+    for seg in segments[:-1]:
+        asm2.feed(seg)
+    with pytest.raises(WireFormatError) as exc:
+        asm2.close()
+    assert exc.value.reason == WIRE_SEGMENT
+
+
 @pytest.fixture(scope="module")
 def env():
     cfg = tiny_test(dtype=jnp.float32, remat=False)
@@ -327,6 +419,7 @@ class FakeDaemon:
     def __init__(self, addr):
         self.addr = addr
         self.alive = True
+        self.role = "mixed"
         self.scripts = []
         self.requests = {}
         self.submissions = []
@@ -336,6 +429,7 @@ class FakeDaemon:
         self.kv_export_code = 200
         self.kv_import_response = (200, {"verdicts": {}})
         self.kv_imports = []
+        self.kv_request_exports = []
 
 
 class FakeTransport(FleetTransport):
@@ -349,11 +443,25 @@ class FakeTransport(FleetTransport):
         return d
 
     def healthz(self, addr, timeout):
-        self._d(addr)
-        return 200, {"ok": True}
+        d = self._d(addr)
+        return 200, {
+            "ok": True, "role": d.role,
+            "kv": {
+                "device_blocks_used": 0, "device_blocks_total": 8,
+                "host_blocks_used": 0,
+            },
+        }
 
     def submit(self, addr, body, timeout):
         d = self._d(addr)
+        if d.role == "decode" and body.get("phase") != "decode":
+            # the real daemon's typed role gate: fresh work bounces,
+            # phase-marked continuations pass
+            return 503, {
+                "request_id": "", "status": "rejected",
+                "finish_reason": REJECT_ROLE, "tokens": [],
+                "detail": "decode-role daemon takes only continuations",
+            }
         d.submissions.append(dict(body))
         rid = f"{addr}/r{d.seq}"
         d.seq += 1
@@ -407,6 +515,11 @@ class FakeTransport(FleetTransport):
 
     def kv_export(self, addr, max_blocks, timeout):
         d = self._d(addr)
+        return d.kv_export_code, d.kv_blob
+
+    def kv_export_request(self, addr, rid, timeout):
+        d = self._d(addr)
+        d.kv_request_exports.append(rid)
         return d.kv_export_code, d.kv_blob
 
     def kv_import(self, addr, blob, timeout):
@@ -542,10 +655,52 @@ def test_stream_handoff_is_bitwise_and_index_stable():
     replay = second.submissions[-1]
     assert replay["prompt"] == prompt + full[:3]
     assert replay["max_new_tokens"] == len(full) - 3
-    assert replay["dedupe_token"] == f"fleet:{rid}:h1"
+    assert replay["dedupe_token"] == (
+        f"fleet:{router._instance}:{rid}:h1"
+    )
     code, final = router.result(rid)
     assert final["handoffs"] == 1 and final["peer"] == second.addr
     assert router.registry.counter("fleet_handoffs_total").value == 1
+
+
+def test_handoff_dedupe_tokens_are_globally_scoped():
+    """Two routers over the SAME daemons must never derive colliding
+    handoff dedupe tokens: router-local request ids restart at f000000
+    in every instance, and a daemon's dedupe table outlives any one
+    router — a collision answers a new router's handoff with some old
+    router's handed-off stream (silent wrong tokens).  Client-supplied
+    tokens seed the derivation (unique per logical request); tokenless
+    requests are scoped by the router's instance nonce."""
+    clock = FakeClock()
+    daemons = [FakeDaemon(f"h{i}:80") for i in range(2)]
+    prompt = [5, 4, 3, 2, 1]
+    full = [11, 12, 13, 14, 15, 16]
+    derived = []
+    for dedupe in (None, None, "client-tok"):
+        transport = FakeTransport(daemons)
+        router = FleetRouter(
+            [d.addr for d in daemons], clock=clock,
+            transport=transport,
+        )
+        first, second = _ring_order(router, prompt)[:2]
+        first.scripts.append({"tokens": full, "die_after": 3})
+        second.scripts.append({"tokens": full[3:]})
+        body = {"prompt": prompt, "max_new_tokens": len(full)}
+        if dedupe:
+            body["dedupe_token"] = dedupe
+        code, rec = router.submit(body)
+        assert code == 200
+        tokens = [
+            e["token"] for e in router.stream(rec["request_id"])
+            if "token" in e
+        ]
+        assert tokens == full
+        derived.append(second.submissions[-1]["dedupe_token"])
+    anon_a, anon_b, seeded = derived
+    assert anon_a != anon_b, (
+        "two router instances derived the same handoff dedupe token"
+    )
+    assert seeded == "fleet:client-tok:h1"
 
 
 def test_result_poll_survives_host_death():
@@ -707,6 +862,223 @@ def test_cancel_is_terminal_and_best_effort():
     code, rec = router.result(rid)
     assert rec["status"] == "cancelled"
     assert router.cancel(rid)[0] == 404  # already terminal
+
+
+# -- prefill/decode disaggregation on the fakes ------------------------------
+
+
+def _disagg_fleet(roles, **router_kw):
+    """A fleet whose peers carry explicit roles, both in the router's
+    config AND in the fake daemons' own behavior (role gate, healthz
+    advertising) — ``roles`` is a tuple aligned with peer order."""
+    clock = FakeClock()
+    daemons = [FakeDaemon(f"h{i}:80") for i in range(len(roles))]
+    for d, role in zip(daemons, roles):
+        d.role = role
+    transport = FakeTransport(daemons)
+    router = FleetRouter(
+        [d.addr for d in daemons], clock=clock, transport=transport,
+        policy=PeerPolicy(
+            probe_interval_seconds=1.0, degraded_after=1, dead_after=2,
+            reprobe_backoff_seconds=4.0, reprobe_backoff_max=8.0,
+        ),
+        roles={d.addr: role for d, role in zip(daemons, roles)},
+        **router_kw,
+    )
+    return router, clock, daemons
+
+
+def test_disagg_placement_only_prefill_capable():
+    """Under a disaggregated topology fresh submissions land only on
+    prefill-capable peers, whatever the ring order says — decode-role
+    peers never even see (so never 503) fresh work."""
+    router, _clock, daemons = _disagg_fleet(("decode", "prefill"))
+    decode_d, prefill_d = daemons
+    assert router.status()["disagg"] is True
+    for seed in range(6):  # prompts hashing all over the ring
+        prefill_d.scripts.append({"tokens": [1]})
+        code, rec = router.submit({
+            "prompt": [seed + 1, seed + 2, seed + 3],
+            "max_new_tokens": 1,
+        })
+        assert code == 200
+        assert rec["peer"] == prefill_d.addr
+    assert not decode_d.submissions
+
+
+def test_role_rejection_is_typed_not_breaker_evidence():
+    """A daemon that answers fresh work with its typed role 503 (config
+    drift the router has not yet probed) is a RESPONSE: the reject is
+    counted under the role reason, the ring successor takes the
+    request, and the breaker records ZERO failure evidence."""
+    router, _clock, _daemons = _disagg_fleet(("mixed", "mixed"))
+    prompt = [4, 4, 4]
+    first, second = _ring_order(router, prompt)[:2]
+    first.role = "decode"  # drifted; router still believes "mixed"
+    second.scripts.append({"tokens": [7]})
+    code, rec = router.submit({"prompt": prompt, "max_new_tokens": 1})
+    assert code == 200
+    assert rec["peer"] == second.addr
+    assert not first.submissions
+    assert router.peers.get(first.addr).failures == 0
+    assert router.peers.get(first.addr).state == HEALTHY
+    assert router.registry.counter(
+        "fleet_rejects_total", reason=REJECT_ROLE
+    ).value == 1
+
+
+def test_probe_tick_learns_advertised_roles():
+    """Probes fold each peer's advertised role into the routing table
+    (disaggregation becomes a topology fact, not static config), and an
+    explicit ``set_role`` pins the peer against re-advertising."""
+    router, clock, daemons = _fleet()
+    assert router.status()["disagg"] is False
+    daemons[0].role = "prefill"
+    daemons[1].role = "decode"
+    clock.t += 1.0
+    router.probe_tick()
+    assert router.status()["roles"] == {
+        daemons[0].addr: "prefill", daemons[1].addr: "decode",
+    }
+    assert router.status()["disagg"] is True
+    assert router.registry.gauge(
+        "fleet_role", peer=daemons[1].addr
+    ).value == 2.0
+    assert router.set_role(daemons[0].addr, "mixed")
+    daemons[0].role = "prefill"  # still advertises prefill …
+    clock.t += 1.0
+    router.probe_tick()
+    # … but the operator override is pinned
+    assert router.status()["roles"][daemons[0].addr] == "mixed"
+
+
+def test_disagg_handoff_is_bitwise_and_index_stable():
+    """The tentpole end to end on the fakes: the prompt prefills on the
+    prefill-role peer; at first-token time its KV blocks travel as a
+    bounded chunk stream into the decode peer, and the phase-marked
+    forced-prefix continuation produces the SAME token sequence — the
+    client's stream never blinks, the indices never reset, and the
+    prefill copy is actively reaped."""
+    router, _clock, daemons = _disagg_fleet(
+        ("prefill", "decode"), disagg_max_wire_bytes=128,
+    )
+    prefill_d, decode_d = daemons
+    full = [21, 22, 23, 24, 25]
+    prefill_d.scripts.append({"tokens": full})
+    prefill_d.kv_blob = encode_exports(
+        [_synthetic_export(np.float32, seed=31)]
+    )
+    decode_d.scripts.append({"tokens": full[1:]})
+    decode_d.kv_import_response = (200, {"verdicts": {"imported": 2}})
+    code, rec = router.submit(
+        {"prompt": [9, 9, 9], "max_new_tokens": len(full)}
+    )
+    assert code == 200 and rec["peer"] == prefill_d.addr
+    rid = rec["request_id"]
+    src_rid = router._requests[rid].daemon_rid
+    events = list(router.stream(rid))
+    tokens = [e["token"] for e in events if "token" in e]
+    indices = [e["index"] for e in events if "token" in e]
+    assert tokens == full, "disaggregated stream is not bitwise"
+    assert indices == list(range(len(full)))
+    assert events[-1]["finished"] and events[-1]["status"] == "finished"
+    # the KV travelled chunked and reassembles to the donor's bytes
+    assert prefill_d.kv_request_exports == [src_rid]
+    assert len(decode_d.kv_imports) == 1
+    wire = decode_d.kv_imports[0]
+    assert is_chunk_stream(wire)
+    assert len(decode_export_chunks(wire)) == 1
+    # the continuation: phase-marked, exact remainder, derived dedupe
+    cont = decode_d.submissions[-1]
+    assert cont["phase"] == "decode"
+    assert cont["prompt"] == [9, 9, 9] + full[:1]
+    assert cont["max_new_tokens"] == len(full) - 1
+    assert cont["dedupe_token"] == (
+        f"fleet:{router._instance}:{rid}:h1"
+    )
+    assert src_rid in prefill_d.cancels
+    assert router.registry.counter(
+        "fleet_handoff_disagg_total"
+    ).value == 1
+    assert router.registry.counter(
+        "fleet_handoff_bytes_total"
+    ).value == len(prefill_d.kv_blob)
+    assert router.registry.counter(
+        "fleet_kv_imports_total", status="imported"
+    ).value == 2
+    _code, final = router.result(rid)
+    assert final["handoffs"] == 1 and final["peer"] == decode_d.addr
+
+
+def test_disagg_fallback_decode_peer_death_mid_transfer():
+    """The decode peer dying mid-transfer costs the client NOTHING: the
+    import tear is breaker evidence plus a typed fallback, and the
+    stream completes colocated, bitwise, with zero handoffs."""
+    router, _clock, daemons = _disagg_fleet(("prefill", "decode"))
+    prefill_d, decode_d = daemons
+    full = [31, 32, 33]
+    prefill_d.scripts.append({"tokens": full})
+    prefill_d.kv_blob = b"prefix-blocks"
+    code, rec = router.submit({"prompt": [5, 5], "max_new_tokens": 3})
+    assert code == 200
+    decode_d.alive = False  # dies before the transfer lands
+    events = list(router.stream(rec["request_id"]))
+    assert [e["token"] for e in events if "token" in e] == full
+    assert events[-1]["finished"]
+    assert router.registry.counter(
+        "fleet_handoff_fallbacks_total", reason="decode_peer_dead"
+    ).value == 1
+    assert router.registry.counter(
+        "fleet_handoff_disagg_total"
+    ).value == 0
+    assert router.peers.get(decode_d.addr).failures >= 1
+    _code, final = router.result(rec["request_id"])
+    assert final["status"] == "finished" and final["handoffs"] == 0
+
+
+def test_disagg_fallback_version_skew_never_recomputes():
+    """Typed import verdicts that land nothing (weights_version skew)
+    mean a decode-side continuation would silently re-prefill the
+    prompt — the router refuses the move under the verdict's own name
+    and keeps decoding where the KV actually lives."""
+    router, _clock, daemons = _disagg_fleet(("prefill", "decode"))
+    prefill_d, decode_d = daemons
+    full = [41, 42]
+    prefill_d.scripts.append({"tokens": full})
+    prefill_d.kv_blob = b"skewed-blocks"
+    decode_d.kv_import_response = (
+        200, {"verdicts": {"weights_version": 2}}
+    )
+    code, rec = router.submit({"prompt": [7, 7, 7], "max_new_tokens": 2})
+    events = list(router.stream(rec["request_id"]))
+    assert [e["token"] for e in events if "token" in e] == full
+    assert router.registry.counter(
+        "fleet_handoff_fallbacks_total", reason="weights_version"
+    ).value == 1
+    assert router.registry.counter(
+        "fleet_kv_imports_total", status="weights_version"
+    ).value == 2
+    assert not decode_d.submissions, "continuation shipped anyway"
+
+
+def test_disagg_fallback_no_decode_peer():
+    """With the only decode-role peer DEAD there is no migration target
+    — a typed ``no_decode_peer`` fallback, and the stream completes on
+    the prefill peer untouched."""
+    router, _clock, daemons = _disagg_fleet(("prefill", "decode"))
+    prefill_d, decode_d = daemons
+    prefill_d.scripts.append({"tokens": [51]})
+    prefill_d.kv_blob = b"blocks"
+    router.peers.note_failure(decode_d.addr)
+    assert router.peers.note_failure(decode_d.addr) == DEAD
+    code, rec = router.submit({"prompt": [3, 3], "max_new_tokens": 1})
+    assert code == 200
+    events = list(router.stream(rec["request_id"]))
+    assert [e["token"] for e in events if "token" in e] == [51]
+    assert router.registry.counter(
+        "fleet_handoff_fallbacks_total", reason="no_decode_peer"
+    ).value == 1
+    assert not decode_d.kv_imports
 
 
 # -- the real thing: subprocess smoke + soak ---------------------------------

@@ -47,7 +47,10 @@ from tpu_parallel.cluster.autopilot import (
     AP_REFUSED,
     AP_REFUSED_MAX_REPLICAS,
     AP_REFUSED_NO_FACTORY,
+    AP_REFUSED_NO_IDLE_PEER,
+    AP_REFUSED_NO_ROLE_CONTROLLER,
     AP_REFUSED_SWAP,
+    AP_REROLE,
     AP_RETUNE_BUDGET,
     AP_RETUNE_PREFILL,
     AP_SCALE_DOWN,
@@ -132,6 +135,9 @@ __all__ = [
     "AP_REFUSED_SWAP",
     "AP_REFUSED_MAX_REPLICAS",
     "AP_REFUSED_NO_FACTORY",
+    "AP_REFUSED_NO_IDLE_PEER",
+    "AP_REFUSED_NO_ROLE_CONTROLLER",
+    "AP_REROLE",
     "AUTOPILOT_TRACK",
     "RETIRED",
     "Frontend",

@@ -95,10 +95,14 @@ AP_RETUNE_PREFILL = "retune_prefill"  # prefill tick share adjusted
 AP_REBALANCE = "rebalance"  # prefix-affinity ring weight shifted
 AP_REFUSED = "refused"  # an actuation was due but typed-refused
 
+AP_REROLE = "rerole"  # a fleet daemon's prefill/decode role flipped
+
 # typed refusal reasons (AutopilotAction.reason on AP_REFUSED)
 AP_REFUSED_SWAP = "swap_in_progress"  # no interleaving with cluster/swap.py
 AP_REFUSED_MAX_REPLICAS = "max_replicas"
 AP_REFUSED_NO_FACTORY = "no_engine_factory"
+AP_REFUSED_NO_ROLE_CONTROLLER = "no_role_controller"
+AP_REFUSED_NO_IDLE_PEER = "no_idle_peer"
 
 AUTOPILOT_TRACK = "autopilot"  # the tracer track every instant lands on
 
@@ -202,6 +206,20 @@ class AutopilotPolicy:
       max replica load > factor x fleet mean (None disables).
     - ``rebalance_cooldown_ticks`` and ``min_ring_weight`` bound how
       fast and how far a hot replica's ring share can shrink.
+
+    Re-role (the FLEET's fourth lever, docs/14_fleet.md):
+
+    - ``prefill_backlog_target`` / ``decode_itl_target``: seconds —
+      when the windowed p95 of the fleet signals fed through
+      ``observe_fleet`` breaches one of these for ``breach_ticks``
+      consecutive ticks, the autopilot asks its ``role_controller``
+      (a :class:`~tpu_parallel.fleet.router.FleetRouter`) to re-role
+      one idle mixed daemon toward the starved phase.  Both None (the
+      default) disables the lever entirely.
+    - ``role_cooldown_ticks``: minimum ticks between re-role actions
+      (and between typed re-role refusals) — a flipped daemon must
+      show up in the signals before the controller gets another
+      opinion.
     """
 
     queue_age_target: float = 1.0
@@ -222,6 +240,9 @@ class AutopilotPolicy:
     imbalance_factor: Optional[float] = None
     rebalance_cooldown_ticks: int = 32
     min_ring_weight: float = 0.25
+    prefill_backlog_target: Optional[float] = None
+    decode_itl_target: Optional[float] = None
+    role_cooldown_ticks: int = 16
 
     def __post_init__(self):
         if self.queue_age_target <= 0:
@@ -290,6 +311,21 @@ class AutopilotPolicy:
             raise ValueError(
                 f"min_ring_weight={self.min_ring_weight} outside (0, 1]"
             )
+        if (
+            self.prefill_backlog_target is not None
+            and self.prefill_backlog_target <= 0
+        ):
+            raise ValueError(
+                f"prefill_backlog_target={self.prefill_backlog_target} <= 0"
+            )
+        if self.decode_itl_target is not None and self.decode_itl_target <= 0:
+            raise ValueError(
+                f"decode_itl_target={self.decode_itl_target} <= 0"
+            )
+        if self.role_cooldown_ticks < 1:
+            raise ValueError(
+                f"role_cooldown_ticks={self.role_cooldown_ticks} < 1"
+            )
 
 
 class Autopilot:
@@ -302,9 +338,14 @@ class Autopilot:
         frontend,
         policy: AutopilotPolicy,
         engine_factory: Optional[Callable[[], object]] = None,
+        role_controller=None,
     ):
         self.fe = frontend
         self.policy = policy
+        # the re-role lever's actuator: duck-typed to FleetRouter's
+        # role surface (``role_counts()`` / ``pick_rerole(to_role)`` /
+        # ``set_role(addr, role)``).  None = the lever refuses typed.
+        self.role_controller = role_controller
         # scale-up builds engines through this factory; default to the
         # first replica's own (the caller said "this is how you build
         # one of me").  Without any factory, scale-up refuses typed.
@@ -335,6 +376,14 @@ class Autopilot:
         self._last_rebalance_tick: Optional[int] = None
         self._balanced_streak = 0
         self._idle_ticks: Dict[int, int] = {}
+        # re-role sensing: fleet signals arrive via observe_fleet (the
+        # router-side pump feeds them), windowed like queue age
+        self._backlog_samples: deque = deque(maxlen=policy.window_ticks)
+        self._itl_samples: deque = deque(maxlen=policy.window_ticks)
+        self._role_breach_streak = 0
+        self._role_breach_dir = ""  # "prefill_backlog" / "decode_itl"
+        self._last_rerole_tick: Optional[int] = None
+        self._last_role_refusal_tick: Optional[int] = None
         # per-tick shed floor (see admission_veto) and the retune
         # baselines — only settings the controller itself tightened are
         # ever relaxed, back to where the operator had them
@@ -372,6 +421,21 @@ class Autopilot:
             return 0.0
         rank = max(1, -(-95 * len(ordered) // 100))  # ceil(0.95 n)
         return ordered[rank - 1]
+
+    def observe_fleet(
+        self,
+        prefill_backlog_seconds: Optional[float] = None,
+        decode_itl_seconds: Optional[float] = None,
+    ) -> None:
+        """Feed one sample of the fleet's disaggregation signals: how
+        long fresh submissions wait for a prefill slot, and the decode
+        inter-token latency clients see.  The re-role lever senses the
+        windowed p95 of whatever arrives here — no samples, no lever
+        (a single-process cluster never feeds this)."""
+        if prefill_backlog_seconds is not None:
+            self._backlog_samples.append(float(prefill_backlog_seconds))
+        if decode_itl_seconds is not None:
+            self._itl_samples.append(float(decode_itl_seconds))
 
     def _breached(self) -> Optional[str]:
         """The breach signal this tick, or None when inside targets."""
@@ -436,6 +500,7 @@ class Autopilot:
         self._scale(now)
         self._retune(now)
         self._rebalance(now)
+        self._rerole(now)
         self._g_shedding.set(1.0 if self.shedding else 0.0)
         self._g_replicas.set(len(self.fe.replicas))
         budget = self.fe.config.max_inflight_tokens
@@ -792,6 +857,93 @@ class Autopilot:
             now, AP_REBALANCE, "restore", replica=rid, weight=new,
         )
 
+    # -- re-role (the fleet's prefill:decode ratio) --------------------------
+
+    def _refuse_role(self, now: float, reason: str, **detail) -> None:
+        # one typed refusal per role cooldown window, mirroring
+        # _refuse_scale: the log records that a re-role was due and why
+        # it could not run, without a refusal per tick
+        last = self._last_role_refusal_tick
+        if last is not None and (
+            self.ticks - last < self.policy.role_cooldown_ticks
+        ):
+            return
+        self._last_role_refusal_tick = self.ticks
+        self._refusals(reason).inc()
+        self._record(now, AP_REFUSED, reason, **detail)
+
+    def _rerole(self, now: float) -> None:
+        """The fourth lever: steer the fleet's prefill:decode role
+        ratio.  A sustained prefill-backlog breach re-roles an idle
+        mixed daemon to ``prefill``; a sustained decode-ITL breach
+        re-roles one to ``decode`` — same breach-streak hysteresis and
+        cooldown discipline as scaling, so the ratio cannot flap.  When
+        both signals breach, decode ITL wins: it is the client-visible
+        stream latency, and more decode capacity also drains the
+        prefill queue's downstream."""
+        pol = self.policy
+        if (
+            pol.prefill_backlog_target is None
+            and pol.decode_itl_target is None
+        ):
+            return
+        # local import: cluster must not import the fleet package at
+        # module scope (fleet imports cluster primitives)
+        from tpu_parallel.fleet.roles import ROLE_DECODE, ROLE_PREFILL
+
+        direction = None
+        if pol.prefill_backlog_target is not None:
+            backlog95 = self._windowed_p95(self._backlog_samples)
+            if self._backlog_samples and (
+                backlog95 > pol.prefill_backlog_target
+            ):
+                direction = ("prefill_backlog", ROLE_PREFILL, backlog95)
+        if pol.decode_itl_target is not None:
+            itl95 = self._windowed_p95(self._itl_samples)
+            if self._itl_samples and itl95 > pol.decode_itl_target:
+                direction = ("decode_itl", ROLE_DECODE, itl95)
+        if direction is None:
+            self._role_breach_streak = 0
+            self._role_breach_dir = ""
+            return
+        reason, to_role, p95 = direction
+        if reason != self._role_breach_dir:
+            # a flipped breach direction restarts the streak — two
+            # half-breaches in opposite directions must not actuate
+            self._role_breach_dir = reason
+            self._role_breach_streak = 1
+        else:
+            self._role_breach_streak += 1
+        if self._role_breach_streak < pol.breach_ticks:
+            return
+        last = self._last_rerole_tick
+        if last is not None and (
+            self.ticks - last < pol.role_cooldown_ticks
+        ):
+            return
+        rc = self.role_controller
+        if rc is None:
+            self._refuse_role(
+                now, AP_REFUSED_NO_ROLE_CONTROLLER, wanted=AP_REROLE,
+            )
+            return
+        addr = rc.pick_rerole(to_role)
+        if addr is None or not rc.set_role(addr, to_role):
+            # no idle mixed daemon to flip (all busy, none mixed, or
+            # the candidate vanished between pick and set)
+            self._refuse_role(
+                now, AP_REFUSED_NO_IDLE_PEER, wanted=AP_REROLE,
+                to_role=to_role,
+            )
+            return
+        self._last_rerole_tick = self.ticks
+        counts = rc.role_counts()
+        self._record(
+            now, AP_REROLE, reason, peer=addr, to_role=to_role,
+            p95=round(p95, 6),
+            **{f"role_{k}": v for k, v in sorted(counts.items())},
+        )
+
     # -- status --------------------------------------------------------------
 
     def status(self) -> dict:
@@ -816,6 +968,13 @@ class Autopilot:
             "ring_weights": (
                 fe.router.weights
                 if isinstance(fe.router, PrefixAffinityRouter)
+                else None
+            ),
+            "role_breach_streak": self._role_breach_streak,
+            "role_breach_dir": self._role_breach_dir or None,
+            "role_counts": (
+                self.role_controller.role_counts()
+                if self.role_controller is not None
                 else None
             ),
             "actions": len(self.actions),

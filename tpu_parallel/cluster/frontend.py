@@ -846,6 +846,7 @@ class Frontend:
         self,
         policy: Optional[AutopilotPolicy] = None,
         engine_factory=None,
+        role_controller=None,
     ) -> Autopilot:
         """Arm the closed-loop overload controller: once per ``step()``
         it senses the queue-age/TTFT windows and actuates bounded shed /
@@ -860,7 +861,9 @@ class Frontend:
         replicas)`` and scale-down disabled — arming the controller for
         graceful degradation must never quietly resize a fleet the
         operator sized by hand.  Scaling is opt-in via an explicit
-        policy."""
+        policy.  ``role_controller`` (a FleetRouter, or anything with
+        its role surface) arms the re-role lever — None leaves the
+        fleet's prefill:decode ratio alone."""
         if self._autopilot is not None:
             raise RuntimeError("autopilot already enabled")
         if policy is None:
@@ -869,7 +872,9 @@ class Frontend:
                 min_replicas=len(self.replicas),
                 scale_down_idle_ticks=None,
             )
-        self._autopilot = Autopilot(self, policy, engine_factory)
+        self._autopilot = Autopilot(
+            self, policy, engine_factory, role_controller=role_controller,
+        )
         return self._autopilot
 
     def autopilot_status(self) -> dict:
@@ -1023,6 +1028,25 @@ class Frontend:
                 self._cancel_state(st, reason, self.clock())
                 return True
         return False
+
+    def export_request_kv(self, request_id: str):
+        """Export ONE live request's written KV prefix from whichever
+        replica currently decodes it (by CLUSTER request id) — the donor
+        half of the fleet's prefill→decode handoff.  Only the frontend
+        can translate the client id into the attempt-scoped engine id
+        (``rid@attempt``), so this is the one seam the daemon shell gets.
+        None when the request is unknown, finished, still pending, or
+        its engine holds no full exportable block."""
+        for st in self._by_attempt.values():
+            if st.out.request.request_id != request_id or st.out.done:
+                continue
+            if st.handle is None or st.engine_rid is None:
+                return None
+            exporter = getattr(st.handle.engine, "export_prefix", None)
+            if exporter is None:
+                return None
+            return exporter(st.engine_rid)
+        return None
 
     # -- dispatch ----------------------------------------------------------
 

@@ -109,6 +109,13 @@ class DaemonConfig:
       submissions refuse typed ``degraded`` (503), in-flight work
       drains, ``/healthz`` flips 503 with the reason, and the process
       stays up for its balancer instead of dying mid-accept.
+    - ``role``: the daemon's fleet role (``prefill`` / ``decode`` /
+      ``mixed`` — :mod:`tpu_parallel.fleet.roles`), advertised on
+      ``/healthz``.  A ``decode``-role daemon typed-refuses fresh
+      client submissions (reason ``role``, 503 — a routing refusal,
+      not failure evidence) and accepts only the router's handoff
+      continuations; ``prefill`` and ``mixed`` accept everything
+      (colocated decode is the disaggregation fallback).
     """
 
     grace_seconds: float = 30.0
@@ -118,8 +125,12 @@ class DaemonConfig:
     completed_retention: int = 50_000
     compact_interval_records: int = 4096
     degrade_after_io_errors: int = 3
+    role: str = "mixed"
 
     def __post_init__(self):
+        from tpu_parallel.fleet.roles import validate_role
+
+        validate_role(self.role)
         if self.grace_seconds <= 0:
             raise ValueError(f"grace_seconds={self.grace_seconds} <= 0")
         if self.fsync_batch < 1:
@@ -482,13 +493,23 @@ class ServingDaemon:
     # -- admission ---------------------------------------------------------
 
     def submit(
-        self, request: Request, dedupe_token: Optional[str] = None
+        self, request: Request, dedupe_token: Optional[str] = None,
+        phase: Optional[str] = None,
     ) -> Dict:
         """Accept one request: dedupe first (an already-seen token
         returns the live/completed record instead of re-admitting —
         client retries across a daemon crash are idempotent), then the
-        frontend's typed admission gate, then the DURABLE accept — the
-        submit record is fsynced before this returns."""
+        role gate, then the frontend's typed admission gate, then the
+        DURABLE accept — the submit record is fsynced before this
+        returns.  ``phase="decode"`` marks a router-issued handoff
+        continuation, the only submissions a ``decode``-role daemon
+        takes."""
+        from tpu_parallel.fleet.roles import (
+            PHASE_DECODE,
+            REJECT_ROLE,
+            ROLE_DECODE,
+        )
+
         with self._lock:
             dedupe_token = dedupe_token or request.dedupe_token
             if dedupe_token and dedupe_token in self._dedupe:
@@ -504,6 +525,20 @@ class ServingDaemon:
                 "tokens": [],
                 "recovered": False,
             }
+            if (
+                self.config.role == ROLE_DECODE
+                and phase != PHASE_DECODE
+            ):
+                # a healthy daemon refusing on ROLE is routing policy,
+                # not sickness: typed 503 so the router excludes it for
+                # this request without feeding the breaker
+                self.registry.counter("daemon_role_rejects_total").inc()
+                record["status"] = REJECTED
+                record["finish_reason"] = REJECT_ROLE
+                record["detail"] = (
+                    "decode-role daemon takes only handoff continuations"
+                )
+                return record
             if self._degraded_reason is not None:
                 # the durability substrate is gone: refusing typed (the
                 # HTTP layer maps this to 503) beats acknowledging work
@@ -942,6 +977,55 @@ class ServingDaemon:
                     return list(exports)
             return []
 
+    def export_request_kv(self, request_id: str) -> List:
+        """Export ONE live request's written KV prefix — the donor half
+        of the prefill→decode disaggregation handoff: the router calls
+        this on the prefill daemon at first-token time and ships the
+        blocks to the chosen decode peer, so the forced-prefix
+        continuation admits against a warm radix tree instead of
+        re-prefilling.  Empty when the request is unknown, not paged,
+        or has less than one full block written — the router's typed
+        fallback (colocated decode) covers every empty answer."""
+        with self._lock:
+            if self._stopped:
+                return []
+            dr = self._requests.get(request_id)
+            if dr is None or dr.out is None:
+                return []
+            export = self.frontend.export_request_kv(request_id)
+            if export is None:
+                return []
+            self._m_kv_peer_exports.inc()
+            return [export]
+
+    def kv_occupancy(self) -> Dict[str, int]:
+        """Device/host KV-tier block occupancy summed over live
+        replicas — carried on ``/healthz`` so the fleet router's
+        placement and the autopilot's role lever see pressure, not just
+        liveness."""
+        from tpu_parallel.cluster.replica import DEAD as _REPLICA_DEAD
+
+        with self._lock:
+            device_used = device_total = host_used = 0
+            for handle in self.frontend.replicas:
+                if handle.health == _REPLICA_DEAD:
+                    continue
+                pool = getattr(handle.engine, "pool", None)
+                alloc = getattr(pool, "allocator", None)
+                if alloc is not None:
+                    device_total += int(alloc.n_blocks)
+                    device_used += int(alloc.n_blocks) - int(alloc.n_free)
+                radix = getattr(handle.engine, "_radix", None)
+                if radix is not None:
+                    host_used += int(
+                        getattr(radix, "host_blocks_in_use", 0)
+                    )
+            return {
+                "device_blocks_used": device_used,
+                "device_blocks_total": device_total,
+                "host_blocks_used": host_used,
+            }
+
     def import_peer_kv(self, exports) -> Dict[str, int]:
         """Land already-decoded peer exports into every live replica's
         prefix cache, inheriting the migration layer's verify-or-refuse
@@ -968,10 +1052,17 @@ class ServingDaemon:
 
     # -- introspection -----------------------------------------------------
 
+    @property
+    def role(self) -> str:
+        """This daemon's fleet role (``prefill``/``decode``/``mixed``) —
+        fixed at config time, advertised on ``/healthz``."""
+        return self.config.role
+
     def status(self) -> Dict:
         with self._lock:
             open_req = self._open_count
             return {
+                "role": self.config.role,
                 "draining": self._draining,
                 "stopped": self._stopped,
                 "degraded_reason": self._degraded_reason,

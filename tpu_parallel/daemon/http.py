@@ -45,11 +45,18 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from tpu_parallel.daemon.daemon import REJECT_DEGRADED, REJECT_JOURNAL
+from tpu_parallel.fleet.roles import REJECT_ROLE
 from tpu_parallel.obs.exporters import prometheus_text
 from tpu_parallel.serving.kv_wire import (
+    CHUNK_MAGIC,
+    SEGMENT_OVERHEAD,
+    WIRE_SEGMENT,
+    ChunkReassembler,
     WireFormatError,
     decode_exports,
     encode_exports,
+    is_chunk_stream,
+    segment_claimed_length,
 )
 from tpu_parallel.serving.request import (
     REJECT_DRAINING,
@@ -77,9 +84,12 @@ _MAX_BODY_BYTES = 1 << 20
 _MAX_KV_BODY_BYTES = 1 << 27
 
 # typed finish_reasons that map to 503 (route elsewhere / retry later)
-# rather than 429 (client-side backpressure)
+# rather than 429 (client-side backpressure).  ``role`` is here because
+# a decode-role daemon refusing fresh work is a routing fact, not
+# client backpressure: the fleet router excludes the peer and tries the
+# next ring successor without charging the breaker.
 _UNAVAILABLE_REASONS = frozenset(
-    {REJECT_DRAINING, REJECT_DEGRADED, REJECT_JOURNAL}
+    {REJECT_DRAINING, REJECT_DEGRADED, REJECT_JOURNAL, REJECT_ROLE}
 )
 
 
@@ -172,7 +182,11 @@ class _Handler(BaseHTTPRequestHandler):
                 req = build_request(body)
             except (ValueError, TypeError) as exc:
                 return self._json(400, {"error": str(exc)})
-            record = d.submit(req, dedupe_token=body.get("dedupe_token"))
+            record = d.submit(
+                req,
+                dedupe_token=body.get("dedupe_token"),
+                phase=body.get("phase"),
+            )
             if record["status"] == REJECTED:
                 code = (
                     503
@@ -190,10 +204,34 @@ class _Handler(BaseHTTPRequestHandler):
             return self._kv_import()
         return self._json(404, {"error": f"no route {self.path}"})
 
+    def _read_exact(self, n: int) -> bytes:
+        """Read exactly ``n`` body bytes or raise OSError — stdlib
+        ``rfile.read`` may return short on a socket boundary."""
+        chunks = []
+        while n > 0:
+            piece = self.rfile.read(min(n, 1 << 16))
+            if not piece:
+                raise OSError("short read")
+            chunks.append(piece)
+            n -= len(piece)
+        return b"".join(chunks)
+
     def _kv_import(self) -> None:
-        """Peer KV landing: a length-prefixed ``kv_wire`` stream in the
-        body, verdict counts out.  Damaged frames are a typed 400 — the
-        decode refusal IS the response; nothing partially lands."""
+        """Peer KV landing, verdict counts out.  Two body shapes:
+
+        - bare ``KVW1`` frame stream (warm-start / drain-forward):
+          decoded whole, landed whole;
+        - ``KVC1`` chunk stream (the disaggregation handoff hot path):
+          segments are read off the socket one at a time and whole
+          frames land AS THEY COMPLETE — blocks are already in the
+          radix tree while later segments are still in flight
+          (Mooncake-style overlap).
+
+        Damage is a typed 400 either way — the refusal IS the
+        response.  Frames that verified and landed before the damage
+        stay landed (each frame is atomic and self-verifying), the
+        damaged remainder never lands, and the refusing verdict tells
+        the router to fall back rather than trust the transfer."""
         d = self.daemon
         try:
             length = int(self.headers.get("Content-Length", 0))
@@ -207,23 +245,107 @@ class _Handler(BaseHTTPRequestHandler):
                     f"{self.max_kv_body_bytes}-byte import limit"
                 ),
             })
-        try:
-            raw = self.rfile.read(length) if length else b""
-        except OSError:
-            return self._json(400, {"error": "truncated KV payload"})
-        try:
-            exports = decode_exports(raw)
-        except WireFormatError as exc:
+
+        def refuse(exc: WireFormatError, verdicts=None) -> None:
             d.registry.counter(
                 "daemon_kv_wire_refusals_total", reason=exc.reason
             ).inc()
-            return self._json(400, {
-                "error": str(exc), "reason": exc.reason,
+            # unread body bytes may remain after an early refusal
+            self.close_connection = True
+            payload = {"error": str(exc), "reason": exc.reason}
+            if verdicts:
+                payload["verdicts"] = verdicts
+            return self._json(400, payload)
+
+        try:
+            head = self._read_exact(min(length, len(CHUNK_MAGIC)))
+        except OSError:
+            return self._json(400, {"error": "truncated KV payload"})
+
+        if not is_chunk_stream(head):
+            try:
+                raw = head + self._read_exact(length - len(head))
+            except OSError:
+                return self._json(400, {"error": "truncated KV payload"})
+            try:
+                exports = decode_exports(raw)
+            except WireFormatError as exc:
+                return refuse(exc)
+            verdicts = d.import_peer_kv(exports)
+            return self._json(200, {
+                "verdicts": verdicts,
+                "imported": verdicts.get("imported", 0),
             })
-        verdicts = d.import_peer_kv(exports)
+
+        # chunk stream: feed segment by segment, landing early
+        asm = ChunkReassembler()
+        verdicts: dict = {}
+        segments = 0
+        consumed = len(head)
+
+        def land(exports) -> None:
+            if not exports:
+                return
+            for verdict, n in d.import_peer_kv(exports).items():
+                verdicts[verdict] = verdicts.get(verdict, 0) + n
+
+        try:
+            # every read is bounded by the declared Content-Length so a
+            # lying prelude can never block the handler on the socket
+            if length < SEGMENT_OVERHEAD:
+                raise WireFormatError(
+                    WIRE_SEGMENT,
+                    f"{length}-byte body, segment prelude needs "
+                    f"{SEGMENT_OVERHEAD}",
+                )
+            prelude = head + self._read_exact(SEGMENT_OVERHEAD - len(head))
+            consumed = SEGMENT_OVERHEAD
+            while True:
+                slen = segment_claimed_length(prelude)
+                if slen > length - consumed:
+                    raise WireFormatError(
+                        WIRE_SEGMENT,
+                        f"segment claims {slen} payload bytes, "
+                        f"{length - consumed} remain in the body",
+                    )
+                payload = self._read_exact(slen)
+                consumed += slen
+                asm.feed(prelude + payload)
+                segments += 1
+                land(asm.drain())
+                if asm.finished:
+                    if consumed != length:
+                        raise WireFormatError(
+                            WIRE_SEGMENT,
+                            f"{length - consumed} body bytes after "
+                            "the terminal segment",
+                        )
+                    break
+                if consumed >= length:
+                    asm.close()  # unterminated: typed refusal
+                    break
+                if length - consumed < SEGMENT_OVERHEAD:
+                    raise WireFormatError(
+                        WIRE_SEGMENT,
+                        f"{length - consumed} trailing body bytes, "
+                        f"segment prelude needs {SEGMENT_OVERHEAD}",
+                    )
+                prelude = self._read_exact(SEGMENT_OVERHEAD)
+                consumed += SEGMENT_OVERHEAD
+        except WireFormatError as exc:
+            return refuse(exc, verdicts)
+        except OSError:
+            # the sender died mid-transfer: surface it as the same
+            # typed refusal the unterminated-stream close gives
+            try:
+                asm.close()
+            except WireFormatError as exc:
+                return refuse(exc, verdicts)
+            return self._json(400, {"error": "truncated KV payload"})
         return self._json(200, {
             "verdicts": verdicts,
             "imported": verdicts.get("imported", 0),
+            "segments": segments,
         })
 
     def do_GET(self):
@@ -238,10 +360,15 @@ class _Handler(BaseHTTPRequestHandler):
             code = 503 if unavailable else 200
             return self._json(code, {
                 "ok": code == 200,
+                "role": status["role"],
                 "draining": status["draining"],
                 "degraded_reason": status["degraded_reason"],
                 "ticks": status["ticks"],
                 "recoveries": status["recoveries"],
+                # KV-tier occupancy: the fleet router and the
+                # autopilot's role lever read pressure here instead of
+                # probing blind
+                "kv": d.kv_occupancy(),
             })
         if self.path == "/statez":
             return self._json(200, {
@@ -268,7 +395,14 @@ class _Handler(BaseHTTPRequestHandler):
                     return self._json(400, {
                         "error": "max_blocks must be >= 0",
                     })
-            blob = encode_exports(d.export_hot_kv(max_blocks=max_blocks))
+            if "request_id" in qs:
+                # per-request export: the prefill→decode handoff donor
+                # leg (one live request's written prefix, not the hot
+                # radix snapshot)
+                exports = d.export_request_kv(qs["request_id"][-1])
+            else:
+                exports = d.export_hot_kv(max_blocks=max_blocks)
+            blob = encode_exports(exports)
             self.send_response(200)
             self.send_header("Content-Type", "application/octet-stream")
             self.send_header("Content-Length", str(len(blob)))

@@ -31,6 +31,18 @@ from tpu_parallel.fleet.peers import (
     PeerSet,
     PeerState,
 )
+from tpu_parallel.fleet.roles import (
+    PHASE_DECODE,
+    REJECT_ROLE,
+    ROLE_DECODE,
+    ROLE_MIXED,
+    ROLE_PREFILL,
+    ROLES,
+    can_decode,
+    can_prefill,
+    disaggregated,
+    validate_role,
+)
 from tpu_parallel.fleet.router import (
     FLEET_TRACK,
     REJECT_HANDOFFS,
@@ -55,4 +67,14 @@ __all__ = [
     "TransportError",
     "HTTPFleetTransport",
     "FleetHTTPServer",
+    "ROLE_PREFILL",
+    "ROLE_DECODE",
+    "ROLE_MIXED",
+    "ROLES",
+    "REJECT_ROLE",
+    "PHASE_DECODE",
+    "validate_role",
+    "can_prefill",
+    "can_decode",
+    "disaggregated",
 ]

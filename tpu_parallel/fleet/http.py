@@ -22,6 +22,7 @@ from __future__ import annotations
 import json
 import threading
 import urllib.error
+import urllib.parse
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Iterator, Optional, Tuple
@@ -147,6 +148,15 @@ class HTTPFleetTransport(FleetTransport):
     ) -> Tuple[int, bytes]:
         return self._request(
             addr, "GET", f"/v1/kv/export?max_blocks={int(max_blocks)}",
+            timeout, binary_response=True,
+        )
+
+    def kv_export_request(
+        self, addr: str, request_id: str, timeout: float
+    ) -> Tuple[int, bytes]:
+        rid = urllib.parse.quote(request_id, safe="")
+        return self._request(
+            addr, "GET", f"/v1/kv/export?request_id={rid}",
             timeout, binary_response=True,
         )
 

@@ -29,27 +29,52 @@ router owns four pieces of state and nothing else:
   original token only exists on a dead host.
 
 Admission is typed end to end: a daemon's 503 (draining / degraded /
-journal error) excludes that peer and tries the next ring successor; a
-transport failure feeds the breaker and does the same; running out of
-peers is a typed ``no_peer`` 503, never a hang.  Remote KV migration
-rides two transport calls (``kv_export`` → ``kv_import``): recovered
-and newly joined peers warm-start their hottest chains from a donor,
-and a draining peer ships live prefixes forward — imports re-verify
-per-block CRCs engine-side, so corrupt bytes are a counted typed
-refusal, never served K/V.
+journal error / role) excludes that peer and tries the next ring
+successor; a transport failure feeds the breaker and does the same;
+running out of peers is a typed ``no_peer`` 503, never a hang.  Remote
+KV migration rides two transport calls (``kv_export`` → ``kv_import``):
+recovered and newly joined peers warm-start their hottest chains from a
+donor, and a draining peer ships live prefixes forward — imports
+re-verify per-block CRCs engine-side, so corrupt bytes are a counted
+typed refusal, never served K/V.
+
+Prefill/decode disaggregation (``fleet/roles.py``, docs/14_fleet.md)
+reuses all of the above as a HOT path: when the topology holds both
+prefill- and decode-role peers, fresh submissions place on
+prefill-capable peers only, and at first-token time the router ships
+the prompt's written KV blocks (``kv_export_request`` → chunked
+``kv_import``) to a decode-role peer picked by the same
+prefix-affinity ring, then re-points the stream there via the SAME
+forced-prefix handoff the death path uses — fired on success instead
+of death, bitwise for greedy, client-stable SSE indices.  Every way
+the migration can fail is a typed ``fleet_handoff_fallbacks_total``
+reason and the request keeps decoding colocated: disaggregation can
+lose latency, never tokens.
 """
 
 from __future__ import annotations
 
 import itertools
 import threading
+import uuid
 from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
 
 from tpu_parallel.cluster.replica import DEAD, HEALTHY
 from tpu_parallel.cluster.router import HashRing, hash_prompt_key, _stable_hash
 from tpu_parallel.fleet.peers import PeerPolicy, PeerSet
+from tpu_parallel.fleet.roles import (
+    PHASE_DECODE,
+    ROLE_DECODE,
+    ROLE_GAUGE,
+    ROLE_MIXED,
+    ROLES,
+    can_prefill,
+    disaggregated,
+    validate_role,
+)
 from tpu_parallel.obs.registry import MetricRegistry
 from tpu_parallel.obs.tracer import NULL_TRACER
+from tpu_parallel.serving.kv_wire import DEFAULT_MAX_WIRE_BYTES, chunk_body
 from tpu_parallel.serving.request import (
     CANCELLED,
     EXPIRED,
@@ -131,6 +156,13 @@ class FleetTransport:
     ) -> Tuple[int, bytes]:
         raise NotImplementedError
 
+    def kv_export_request(
+        self, addr: str, request_id: str, timeout: float
+    ) -> Tuple[int, bytes]:
+        """Export ONE live request's written KV prefix (the
+        prefill→decode handoff donor leg)."""
+        raise NotImplementedError
+
     def kv_import(
         self, addr: str, blob: bytes, timeout: float
     ) -> Tuple[int, dict]:
@@ -144,7 +176,7 @@ class _FleetRequest:
     __slots__ = (
         "rid", "body", "prompt", "max_new", "dedupe_token", "addr",
         "daemon_rid", "base", "tokens", "status", "finish_reason",
-        "detail", "handoffs", "inflight", "done_at",
+        "detail", "handoffs", "inflight", "done_at", "disagg_done",
     )
 
     def __init__(self, rid: str, body: dict, addr: str, daemon_rid: str,
@@ -164,6 +196,11 @@ class _FleetRequest:
         self.handoffs = 0
         self.inflight = False  # a handoff submit is on the wire
         self.done_at: Optional[float] = None  # clock time of terminal
+        # the prefill→decode migration is ONE-SHOT per request: fired
+        # (or typed-fallen-back) at first-token time, never retried —
+        # a request that already moved, or already failed to, decodes
+        # where it sits
+        self.disagg_done = False
 
     @property
     def terminal(self) -> bool:
@@ -208,6 +245,8 @@ class FleetRouter:
         warm_start_blocks: int = 16,
         warm_on_recovery: bool = True,
         terminal_ttl_seconds: float = 600.0,
+        roles: Optional[Dict[str, str]] = None,
+        disagg_max_wire_bytes: int = DEFAULT_MAX_WIRE_BYTES,
     ):
         self.clock = clock
         self.transport = transport
@@ -221,11 +260,29 @@ class FleetRouter:
         self.warm_start_blocks = warm_start_blocks
         self.warm_on_recovery = warm_on_recovery
         self.terminal_ttl_seconds = terminal_ttl_seconds
+        self.disagg_max_wire_bytes = disagg_max_wire_bytes
+        # addr -> fleet role.  Config-pinned entries (the ``roles``
+        # kwarg, later ``set_role`` calls) are OVERRIDES the probe loop
+        # never touches; everyone else starts mixed and updates from
+        # the role their /healthz advertises.
+        self._roles: Dict[str, str] = {
+            addr: ROLE_MIXED for addr in peer_addrs
+        }
+        self._role_overrides: Set[str] = set()
+        for addr, role in (roles or {}).items():
+            self._roles[addr] = validate_role(role)
+            self._role_overrides.add(addr)
         self._lock = threading.RLock()
         self._requests: Dict[str, _FleetRequest] = {}
         self._ledger: Dict[str, str] = {}  # dedupe_token -> rid
         self._stale: Dict[str, List[str]] = {}  # addr -> handed-off rids
         self._seq = itertools.count()
+        # disambiguates handoff dedupe tokens for requests the client
+        # submitted WITHOUT a token: local request ids restart at
+        # f000000 in every router instance, so two routers (or one
+        # restarted) over the same daemons would otherwise replay each
+        # other's handoff records out of the daemons' dedupe tables
+        self._instance = uuid.uuid4().hex[:8]
         self._stop = threading.Event()
         self._m_submits = self.registry.counter("fleet_submissions_total")
         self._m_dedupe = self.registry.counter("fleet_dedupe_hits_total")
@@ -239,6 +296,69 @@ class FleetRouter:
         self._m_kv_export_bytes = self.registry.counter(
             "fleet_kv_export_bytes_total"
         )
+        self._m_disagg = self.registry.counter("fleet_handoff_disagg_total")
+        self._m_handoff_bytes = self.registry.counter(
+            "fleet_handoff_bytes_total"
+        )
+        self._m_handoff_seconds = self.registry.counter(
+            "fleet_handoff_seconds_total"
+        )
+
+    # -- roles (prefill/decode disaggregation) -----------------------------
+
+    def set_role(self, addr: str, role: str) -> bool:
+        """Pin ``addr``'s fleet role (the autopilot's re-role lever and
+        the operator override).  The pin survives probe updates — a
+        re-roled daemon whose config still says mixed keeps routing as
+        its new role.  False for an unknown peer."""
+        validate_role(role)
+        with self._lock:
+            if self.peers.get(addr) is None:
+                return False
+            self._roles[addr] = role
+            self._role_overrides.add(addr)
+        self.registry.gauge("fleet_role", peer=addr).set(ROLE_GAUGE[role])
+        return True
+
+    def role_of(self, addr: str) -> str:
+        with self._lock:
+            return self._roles.get(addr, ROLE_MIXED)
+
+    def role_counts(self) -> Dict[str, int]:
+        """Current fleet role census (the autopilot's sense input)."""
+        with self._lock:
+            counts = {role: 0 for role in ROLES}
+            for addr in self.peers.states():
+                counts[self._roles.get(addr, ROLE_MIXED)] += 1
+            return counts
+
+    def pick_rerole(self, to_role: str) -> Optional[str]:
+        """A deterministic IDLE, HEALTHY, mixed-role peer the autopilot
+        may re-role toward ``to_role`` — idle because flipping a daemon
+        mid-stream would strand its open requests behind a role gate.
+        None when no such peer exists (the autopilot's typed refusal)."""
+        validate_role(to_role)
+        with self._lock:
+            busy: Set[str] = {
+                req.addr
+                for req in self._requests.values()
+                if not req.terminal
+            }
+            healthy = set(self.peers.healthy())
+            candidates = sorted(
+                addr
+                for addr, role in self._roles.items()
+                if role == ROLE_MIXED
+                and addr in healthy
+                and addr not in busy
+            )
+        return candidates[0] if candidates else None
+
+    def _disagg_active(self) -> bool:
+        """Disaggregation is a TOPOLOGY property: live iff the fleet
+        holds at least one prefill-role and one decode-role peer.
+        All-mixed fleets run the PR 16 colocated path untouched."""
+        return disaggregated(self._roles)
 
     # -- placement ---------------------------------------------------------
 
@@ -246,15 +366,27 @@ class FleetRouter:
         return self.ring.walk(hash_prompt_key(prompt, self.buckets))
 
     def _pick(
-        self, prompt: Sequence[int], exclude: Set[str]
+        self,
+        prompt: Sequence[int],
+        exclude: Set[str],
+        need: Optional[str] = None,
     ) -> Optional[str]:
-        """Ring-ordered placement honoring health: the first HEALTHY
-        ring successor of the prompt's prefix key, else the first
-        DEGRADED one (a shaky peer beats a typed no_peer), else None."""
+        """Ring-ordered placement honoring health AND role: the first
+        HEALTHY ring successor of the prompt's prefix key, else the
+        first DEGRADED one (a shaky peer beats a typed no_peer), else
+        None.  ``need="prefill"`` skips decode-only peers (fresh
+        submissions would bounce off their typed role gate);
+        ``need="decode"`` walks the same prefix-affinity ring but keeps
+        ONLY decode-role peers — the disaggregation target choice."""
         states = self.peers.states()
         fallback = None
         for addr in self._walk(prompt):
             if addr in exclude:
+                continue
+            role = self._roles.get(addr, ROLE_MIXED)
+            if need == "prefill" and not can_prefill(role):
+                continue
+            if need == "decode" and role != ROLE_DECODE:
                 continue
             state = states.get(addr)
             if state == HEALTHY:
@@ -294,7 +426,14 @@ class FleetRouter:
         })
         for _ in range(attempts):
             with self._lock:
-                addr = self._pick(prompt, exclude)
+                # under a disaggregated topology fresh work lands only
+                # on prefill-capable peers; decode-role daemons would
+                # answer with their typed role 503 anyway (this filter
+                # just saves the round trip)
+                addr = self._pick(
+                    prompt, exclude,
+                    need="prefill" if self._disagg_active() else None,
+                )
             if addr is None:
                 break
             try:
@@ -458,6 +597,7 @@ class FleetRouter:
                     sent += 1
                 yield final
                 return
+            moved = False
             try:
                 for ev in self.transport.stream(
                     addr, daemon_rid,
@@ -474,6 +614,13 @@ class FleetRouter:
                                 "token": int(ev["token"]), "index": idx,
                             }
                             sent += 1
+                        if self._maybe_disagg(req):
+                            # first token delivered and the request just
+                            # migrated to its decode peer: re-snapshot
+                            # and re-attach there — the client's stream
+                            # never blinks, the indices never reset
+                            moved = True
+                            break
                     if ev.get("finished"):
                         with self._lock:
                             self._finalize_locked(
@@ -488,6 +635,9 @@ class FleetRouter:
                             }
                         yield final
                         return
+                if moved:
+                    misses = 0
+                    continue  # re-attach to the decode peer NOW
                 # the daemon closed the stream cleanly without a
                 # terminal event (drain): refresh the record — the
                 # request may have finished between events — then
@@ -553,16 +703,27 @@ class FleetRouter:
             )
 
     def _handoff(
-        self, req: _FleetRequest, exclude: Set[str]
+        self,
+        req: _FleetRequest,
+        exclude: Set[str],
+        targets: Optional[List[str]] = None,
+        record_stale: bool = True,
     ) -> bool:
-        """Replay ``req`` onto a surviving peer via forced prefix:
-        prompt + every token the router has relayed, with the remaining
-        token budget.  Greedy continuations are bitwise — this is the
-        same mechanism daemon crash recovery replays through, driven
-        from the other side of the wire.  Returns False when no peer
-        can take it (the request FAILS typed if the handoff budget is
+        """Replay ``req`` onto another peer via forced prefix: prompt +
+        every token the router has relayed, with the remaining token
+        budget.  Greedy continuations are bitwise — this is the same
+        mechanism daemon crash recovery replays through, driven from
+        the other side of the wire.  Returns False when no peer can
+        take it (the request FAILS typed if the handoff budget is
         exhausted, else stays pointed at its dead peer for the next
         probe/poll to retry).
+
+        Two callers, one mechanism: the DEATH path walks the ring for
+        survivors and records the old daemon request as stale (its
+        journal may revive it); the DISAGGREGATION path passes
+        ``targets=[decode_peer]`` (exactly the peer whose radix tree
+        just imported the prompt's KV) with ``record_stale=False`` —
+        the source is alive, so the caller cancels it actively instead.
 
         Called WITHOUT the lock held: state is snapshotted under the
         lock, the replacement submit runs on the wire with the lock
@@ -589,18 +750,33 @@ class FleetRouter:
             body = dict(req.body)
             body["prompt"] = req.prompt + delivered
             body["max_new_tokens"] = remaining
+            # every handoff is a CONTINUATION — the phase marker is what
+            # lets a decode-role daemon accept it through its role gate
+            body["phase"] = PHASE_DECODE
             # a DERIVED dedupe token: idempotent if this same handoff
             # is retried, never colliding with the client's token
-            # (which lives in the dead daemon's journal)
-            body["dedupe_token"] = f"fleet:{req.rid}:h{req.handoffs + 1}"
+            # (which lives in the dead daemon's journal).  Seeded from
+            # the CLIENT's token because it is unique per LOGICAL
+            # request: router-local ids restart at f000000 per router
+            # instance, and a daemon outliving its router must not
+            # answer a new router's handoff with some old router's
+            # handed-off stream.  Tokenless requests fall back to the
+            # instance nonce, which scopes the local id the same way.
+            seed = req.dedupe_token or f"{self._instance}:{req.rid}"
+            body["dedupe_token"] = f"fleet:{seed}:h{req.handoffs + 1}"
             exclude = set(exclude) | {old_addr}
-            attempts = len(self.ring)
+            attempts = len(targets) if targets is not None \
+                else len(self.ring)
+        queue = list(targets) if targets is not None else None
         try:
             for _ in range(attempts):
                 with self._lock:
                     if req.terminal:
                         return True  # cancelled under us: nothing to do
-                    addr = self._pick(body["prompt"], exclude)
+                    addr = queue.pop(0) if queue else (
+                        None if queue is not None
+                        else self._pick(body["prompt"], exclude)
+                    )
                 if addr is None:
                     return False
                 try:
@@ -620,9 +796,10 @@ class FleetRouter:
                     if req.terminal:
                         orphan = True  # finalized while on the wire
                     else:
-                        self._stale.setdefault(
-                            old_addr, []
-                        ).append(old_rid)
+                        if record_stale:
+                            self._stale.setdefault(
+                                old_addr, []
+                            ).append(old_rid)
                         req.addr = addr
                         req.daemon_rid = rec["request_id"]
                         req.base = len(delivered)
@@ -648,6 +825,142 @@ class FleetRouter:
             with self._lock:
                 req.inflight = False
 
+    # -- prefill/decode disaggregation (the handoff hot path) --------------
+
+    def _disagg_fallback(self, req: _FleetRequest, reason: str) -> bool:
+        """Every way the disaggregated handoff can fail funnels here:
+        counted under its typed reason, traced, and the request simply
+        KEEPS DECODING WHERE IT IS — the colocated continuation is
+        always live, so disaggregation can lose latency but never
+        tokens, and never recomputes silently (the reason says exactly
+        what it fell back from)."""
+        self.registry.counter(
+            "fleet_handoff_fallbacks_total", reason=reason
+        ).inc()
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "disagg_fallback", track=FLEET_TRACK, rid=req.rid,
+                reason=reason,
+            )
+        return False
+
+    def _maybe_disagg(self, req: _FleetRequest) -> bool:
+        """Fire the prefill→decode migration for ``req`` at first-token
+        time: export the prompt's written KV blocks from the prefill
+        peer, stream them (bounded chunk segments) into the decode
+        peer's radix tree, then re-point the request there via the
+        forced-prefix handoff — the continuation admits against the
+        just-landed blocks and the greedy stream stays bitwise.  True
+        iff the request moved; every failure is a typed
+        ``_disagg_fallback`` and the request continues colocated.
+
+        One-shot per request (``disagg_done``), called from the stream
+        relay's own thread between events — so the relay re-attaches to
+        the decode peer immediately after, with no token gap: tokens
+        the prefill peer computes during the transfer overlap are part
+        of ``delivered`` when the handoff body is built."""
+        with self._lock:
+            if (
+                req.terminal
+                or req.disagg_done
+                or req.inflight
+                or not req.tokens
+                or not self._disagg_active()
+            ):
+                return False
+            if not can_prefill(self._roles.get(req.addr, ROLE_MIXED)):
+                return False  # already sitting on a decode peer
+            req.disagg_done = True  # one shot, success or fallback
+            src, src_rid = req.addr, req.daemon_rid
+            dst = self._pick(req.prompt, {src}, need="decode")
+        t0 = self.clock()
+        if dst is None:
+            return self._disagg_fallback(req, "no_decode_peer")
+        try:
+            code, blob = self.transport.kv_export_request(
+                src, src_rid, self.policy.request_timeout_seconds
+            )
+        except TransportError:
+            self.peers.note_failure(src)
+            return self._disagg_fallback(req, "export_transport")
+        self.peers.note_success(src)
+        if code != 200:
+            self.registry.counter(
+                "fleet_kv_wire_refusals_total",
+                reason=f"export_http_{code}",
+            ).inc()
+            return self._disagg_fallback(req, "export_refused")
+        if not blob:
+            # nothing block-aligned written yet (short prompt): moving
+            # the request would force a full re-prefill on the decode
+            # peer — worse than staying put
+            return self._disagg_fallback(req, "export_empty")
+        self._m_kv_export_bytes.inc(len(blob))
+        self._m_handoff_bytes.inc(len(blob))
+        # re-frame as the bounded chunk stream: the decode daemon lands
+        # whole frames as segments arrive (Mooncake-style overlap), and
+        # a transfer torn mid-stream is a typed ``segment`` refusal
+        # there, never a half-imported prefix
+        wire = b"".join(
+            chunk_body(blob, max_wire_bytes=self.disagg_max_wire_bytes)
+        )
+        try:
+            code, body = self.transport.kv_import(
+                dst, wire, self.policy.request_timeout_seconds
+            )
+        except TransportError:
+            # the decode peer died mid-transfer: breaker evidence AND
+            # typed fallback — the stream never left the prefill peer
+            self.peers.note_failure(dst)
+            return self._disagg_fallback(req, "decode_peer_dead")
+        self.peers.note_success(dst)
+        if code != 200:
+            self.registry.counter(
+                "fleet_kv_wire_refusals_total",
+                reason=str(body.get("reason", code)),
+            ).inc()
+            return self._disagg_fallback(req, "import_refused")
+        verdicts = body.get("verdicts") or {}
+        for verdict, n in verdicts.items():
+            self.registry.counter(
+                "fleet_kv_imports_total", status=str(verdict)
+            ).inc(int(n))
+        if self.tracer.enabled:
+            self.tracer.instant(
+                "kv_migrate", track=FLEET_TRACK, src=src, dst=dst,
+                bytes=len(blob), code=code,
+            )
+        landed = int(verdicts.get("imported", 0)) + int(
+            verdicts.get("already_cached", 0)
+        )
+        if landed <= 0:
+            # typed import verdicts (weights_version skew, shape
+            # incompatibility, no prefix cache): the blocks did NOT
+            # land, so a continuation there would recompute the prompt
+            # — fall back under the dominant verdict's name
+            reasons = sorted(
+                v for v in verdicts
+                if v not in ("imported", "already_cached")
+            )
+            return self._disagg_fallback(
+                req, reasons[0] if reasons else "nothing_landed"
+            )
+        if not self._handoff(
+            req, set(), targets=[dst], record_stale=False
+        ):
+            return self._disagg_fallback(req, "handoff_refused")
+        # the source is alive and still decoding the original: reap it
+        # actively (its record is disowned; this is compute hygiene)
+        try:
+            self.transport.cancel(
+                src, src_rid, self.policy.request_timeout_seconds
+            )
+        except TransportError:
+            self.peers.note_failure(src)
+        self._m_disagg.inc()
+        self._m_handoff_seconds.inc(max(0.0, self.clock() - t0))
+        return True
+
     # -- health ------------------------------------------------------------
 
     def probe_tick(self) -> None:
@@ -672,7 +985,16 @@ class FleetRouter:
                 ok = code == 200
             except TransportError:
                 ok = False
+                _body = {}
             if ok:
+                # fold the role the daemon ADVERTISES — unless pinned
+                # by config/set_role, the daemon's word is the truth
+                # (a restarted daemon may come back under a new role)
+                adv = _body.get("role") if isinstance(_body, dict) else None
+                if adv in ROLES:
+                    with self._lock:
+                        if addr not in self._role_overrides:
+                            self._roles[addr] = adv
                 self.peers.note_success(addr)
                 if was == DEAD:
                     if self.tracer.enabled:
@@ -693,6 +1015,11 @@ class FleetRouter:
         for addr, state in self.peers.states().items():
             self.registry.gauge("fleet_peer_state", peer=addr).set(
                 {HEALTHY: 0.0, DEAD: 2.0}.get(state, 1.0)
+            )
+            self.registry.gauge("fleet_role", peer=addr).set(
+                ROLE_GAUGE.get(
+                    self._roles.get(addr, ROLE_MIXED), 0.0
+                )
             )
 
     def _handoff_open(self, dead_addr: str) -> None:
@@ -857,6 +1184,7 @@ class FleetRouter:
         with self._lock:
             self.ring.add_member(addr)
             self.peers.add(addr)
+            self._roles.setdefault(addr, ROLE_MIXED)
         if warm:
             self.warm_start(addr)
 
@@ -868,6 +1196,8 @@ class FleetRouter:
             if len(self.ring) > 1:
                 self.ring.remove_member(addr)
             self.peers.remove(addr)
+            self._roles.pop(addr, None)
+            self._role_overrides.discard(addr)
         self._handoff_open(addr)
 
     def status(self) -> dict:
@@ -877,6 +1207,8 @@ class FleetRouter:
             ]
             return {
                 "peers": self.peers.summary(),
+                "roles": dict(self._roles),
+                "disagg": self._disagg_active(),
                 "requests": len(self._requests),
                 "open": len(open_reqs),
                 "open_ids": open_reqs,

@@ -138,6 +138,14 @@ class KVPrefixExport:
     def n_blocks(self) -> int:
         return self.length // self.block_tokens
 
+    @property
+    def payload_bytes(self) -> int:
+        """Raw K/V bytes this export ships on the wire (leaf payloads
+        only, excluding the frame header) — what the fleet's chunk
+        planner sizes segments against and the handoff byte counters
+        report."""
+        return sum(int(leaf.nbytes) for leaf in self.leaves)
+
     def verified(self) -> bool:
         """Recompute the leaf checksums against ``checksums`` — True
         when absent (legacy export) or matching."""

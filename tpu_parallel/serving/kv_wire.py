@@ -63,6 +63,7 @@ WIRE_MAGIC = "magic"
 WIRE_HEADER_CRC = "header_crc"
 WIRE_HEADER_SCHEMA = "header_schema"
 WIRE_INTEGRITY = "integrity"
+WIRE_SEGMENT = "segment"  # chunk-stream framing damage (see below)
 
 WIRE_REASONS = (
     WIRE_TRUNCATED,
@@ -70,7 +71,39 @@ WIRE_REASONS = (
     WIRE_HEADER_CRC,
     WIRE_HEADER_SCHEMA,
     WIRE_INTEGRITY,
+    WIRE_SEGMENT,
 )
+
+# -- streaming-chunk framing (the disaggregation hot path) -------------------
+#
+# A KV handoff can be far larger than a sane single message, so exports
+# over ``max_wire_bytes`` ship as a CHUNK STREAM: the concatenated-frame
+# body is sliced into self-checksummed segments, closed by a terminal
+# segment that carries the whole-body CRC.  Each segment::
+#
+#     smagic  b"KVC1"                      4 bytes
+#     seq     uint32 (0-based)             4 bytes
+#     slen    uint32 payload length        4 bytes
+#     scrc    uint32 CRC32(payload)        4 bytes
+#     payload slen bytes of the frame body
+#
+# The terminal segment has ``slen == 0``, ``seq == n_data_segments`` and
+# ``scrc == CRC32(full body)``.  The receiver imports nothing from a
+# stream it cannot finish verifying PER FRAME: whole KVW1 frames that
+# complete inside the received prefix may land early (each frame is
+# already self-verifying — Mooncake-style overlap), but a missing,
+# reordered, damaged or unterminated segment is a typed ``segment``
+# refusal and the partially-received remainder never lands — no
+# half-imported prefix.
+
+CHUNK_MAGIC = b"KVC1"
+_SEGMENT_STRUCT = struct.Struct(">III")  # seq, slen, scrc
+SEGMENT_OVERHEAD = len(CHUNK_MAGIC) + _SEGMENT_STRUCT.size
+
+# default per-message bound for chunked shipment: large enough that a
+# warm-start blob rarely chunks, small enough that a handoff's transfer
+# pipelines instead of arriving as one multi-hundred-MB message
+DEFAULT_MAX_WIRE_BYTES = 1 << 20
 
 
 class WireFormatError(ValueError):
@@ -271,6 +304,239 @@ def decode_exports(
     while off < len(buf):
         export, off = _decode_frame(buf, off, verify)
         out.append(export)
+    return out
+
+
+def _segment(seq: int, payload: bytes) -> bytes:
+    return b"".join((
+        CHUNK_MAGIC,
+        _SEGMENT_STRUCT.pack(
+            seq, len(payload), zlib.crc32(payload) & 0xFFFFFFFF
+        ),
+        payload,
+    ))
+
+
+def encode_export_chunks(
+    exports, *, max_wire_bytes: int = DEFAULT_MAX_WIRE_BYTES
+) -> List[bytes]:
+    """Slice a stream of exports into bounded, self-checksummed chunk
+    segments (see the layout comment above).  Always ends with the
+    terminal segment — even an empty export list ships as one terminal
+    (so the receiver can tell "nothing hot" from "transfer died").
+    Concatenating the returned segments is a valid single-message body;
+    sending them one write at a time is the streaming hot path."""
+    return chunk_body(encode_exports(exports), max_wire_bytes=max_wire_bytes)
+
+
+def chunk_body(
+    body: bytes, *, max_wire_bytes: int = DEFAULT_MAX_WIRE_BYTES
+) -> List[bytes]:
+    """Chunk an ALREADY-ENCODED frame-stream body — the router's relay
+    leg, which holds the donor's encoded bytes and must not decode K/V
+    it merely forwards.  Same segment layout and terminal as
+    :func:`encode_export_chunks`."""
+    if max_wire_bytes < 1:
+        raise ValueError(f"max_wire_bytes={max_wire_bytes} < 1")
+    segments = [
+        _segment(seq, body[off:off + max_wire_bytes])
+        for seq, off in enumerate(range(0, len(body), max_wire_bytes))
+    ]
+    terminal = b"".join((
+        CHUNK_MAGIC,
+        _SEGMENT_STRUCT.pack(
+            len(segments), 0, zlib.crc32(body) & 0xFFFFFFFF
+        ),
+    ))
+    segments.append(terminal)
+    return segments
+
+
+def segment_claimed_length(prelude: bytes) -> int:
+    """Payload length a segment prelude claims — the incremental
+    receiver's read-ahead (how many payload bytes to pull off the
+    socket before feeding).  Typed ``segment`` refusal on a short
+    prelude or wrong magic, so a receiver never sizes a read from
+    garbage."""
+    if len(prelude) < SEGMENT_OVERHEAD:
+        raise WireFormatError(
+            WIRE_SEGMENT,
+            f"prelude of {len(prelude)} bytes, needs {SEGMENT_OVERHEAD}",
+        )
+    if prelude[: len(CHUNK_MAGIC)] != CHUNK_MAGIC:
+        raise WireFormatError(
+            WIRE_SEGMENT,
+            f"bad segment magic {prelude[:len(CHUNK_MAGIC)]!r}",
+        )
+    _seq, slen, _scrc = _SEGMENT_STRUCT.unpack_from(
+        prelude, len(CHUNK_MAGIC)
+    )
+    return slen
+
+
+def is_chunk_stream(buf: bytes) -> bool:
+    """Whether a body starts as a chunk stream (KVC1) rather than a
+    bare frame stream (KVW1) — the import endpoint's dispatch test."""
+    return buf[: len(CHUNK_MAGIC)] == CHUNK_MAGIC
+
+
+class ChunkReassembler:
+    """Rebuild a chunk stream segment by segment, surfacing whole
+    frames EARLY (``drain``) while refusing damage typed.
+
+    Feed order is the wire order; every damage shape — wrong magic,
+    out-of-order ``seq``, payload CRC mismatch, bytes after the
+    terminal, or a final body whose whole-stream CRC disagrees — raises
+    :class:`WireFormatError` with reason ``segment`` and poisons the
+    reassembler (further feeds refuse).  ``drain`` decodes any frames
+    that are COMPLETE in the verified prefix received so far; a frame
+    still straddling the incoming edge stays buffered.  A receiver that
+    lands drained frames as they appear and treats any raised refusal
+    as "stop, import nothing further" can never half-import a prefix:
+    frames are atomic and each one re-verifies its own per-block CRCs.
+    """
+
+    def __init__(self, *, verify: bool = True):
+        self.verify = verify
+        self._buf = bytearray()
+        self._next_seq = 0
+        self._decoded_off = 0  # bytes already returned via drain()
+        self._finished = False
+        self._failed = False
+
+    @property
+    def finished(self) -> bool:
+        """True once the terminal segment verified the whole body."""
+        return self._finished
+
+    def _fail(self, detail: str) -> "WireFormatError":
+        self._failed = True
+        return WireFormatError(WIRE_SEGMENT, detail)
+
+    def feed(self, segment: bytes) -> None:
+        """Fold one wire segment in.  Typed ``segment`` refusal on any
+        framing damage; the terminal segment closes and verifies the
+        stream."""
+        if self._failed:
+            raise self._fail("stream already refused")
+        if self._finished:
+            raise self._fail("segment after terminal")
+        if len(segment) < SEGMENT_OVERHEAD:
+            raise self._fail(
+                f"segment of {len(segment)} bytes, prelude needs "
+                f"{SEGMENT_OVERHEAD}"
+            )
+        if segment[: len(CHUNK_MAGIC)] != CHUNK_MAGIC:
+            raise self._fail(
+                f"bad segment magic {segment[:len(CHUNK_MAGIC)]!r}"
+            )
+        seq, slen, scrc = _SEGMENT_STRUCT.unpack_from(
+            segment, len(CHUNK_MAGIC)
+        )
+        payload = segment[SEGMENT_OVERHEAD:]
+        if seq != self._next_seq:
+            raise self._fail(
+                f"segment seq {seq}, expected {self._next_seq} "
+                "(lost or reordered in transit)"
+            )
+        if slen == 0:
+            # terminal: scrc covers the WHOLE reassembled body
+            if payload:
+                raise self._fail(
+                    f"{len(payload)} bytes after the terminal segment"
+                )
+            if (zlib.crc32(bytes(self._buf)) & 0xFFFFFFFF) != scrc:
+                raise self._fail(
+                    "whole-stream CRC mismatch at terminal"
+                )
+            self._finished = True
+            return
+        if len(payload) != slen:
+            raise self._fail(
+                f"segment claims {slen} payload bytes, "
+                f"{len(payload)} present"
+            )
+        if (zlib.crc32(payload) & 0xFFFFFFFF) != scrc:
+            raise self._fail("segment CRC mismatch (damaged in transit)")
+        self._buf.extend(payload)
+        self._next_seq += 1
+
+    def drain(self) -> List[KVPrefixExport]:
+        """Decode every frame COMPLETE in the verified bytes received
+        so far and not yet returned — the early-overlap surface: the
+        importer lands these while later segments are still in flight.
+        Frame-level damage refuses typed exactly as
+        :func:`decode_exports` would."""
+        out: List[KVPrefixExport] = []
+        buf = bytes(self._buf)
+        while self._decoded_off < len(buf):
+            try:
+                export, end = _decode_frame(
+                    buf, self._decoded_off, self.verify
+                )
+            except WireFormatError as exc:
+                if exc.reason == WIRE_TRUNCATED and not self._finished:
+                    break  # frame straddles the incoming edge: wait
+                self._failed = True
+                raise
+            out.append(export)
+            self._decoded_off = end
+        if self._finished and self._decoded_off != len(buf):
+            raise self._fail(
+                f"{len(buf) - self._decoded_off} trailing bytes after "
+                "the last whole frame"
+            )
+        return out
+
+    def close(self) -> None:
+        """Assert the stream terminated — call when the sender's
+        connection ends.  An unterminated stream (the mid-transfer
+        death case) is a typed ``segment`` refusal here, so the caller
+        records it instead of mistaking the silence for success."""
+        if self._failed:
+            raise self._fail("stream already refused")
+        if not self._finished:
+            raise self._fail(
+                f"stream ended after {self._next_seq} segment(s) "
+                "without a terminal"
+            )
+
+
+def decode_export_chunks(
+    buf: bytes, *, verify: bool = True
+) -> List[KVPrefixExport]:
+    """One-shot decode of a concatenated chunk-stream body (the
+    non-streaming receiver).  Walks segment framing first, then the
+    frames — every damage shape is the same typed refusal the
+    incremental :class:`ChunkReassembler` raises."""
+    asm = ChunkReassembler(verify=verify)
+    out: List[KVPrefixExport] = []
+    off = 0
+    while off < len(buf) and not asm.finished:
+        if len(buf) - off < SEGMENT_OVERHEAD:
+            raise WireFormatError(
+                WIRE_SEGMENT,
+                f"{len(buf) - off} bytes at offset {off}, segment "
+                f"prelude needs {SEGMENT_OVERHEAD}",
+            )
+        _seq, slen, _scrc = _SEGMENT_STRUCT.unpack_from(
+            buf, off + len(CHUNK_MAGIC)
+        )
+        end = off + SEGMENT_OVERHEAD + slen
+        if end > len(buf):
+            raise WireFormatError(
+                WIRE_SEGMENT,
+                f"segment claims {slen} payload bytes, "
+                f"{len(buf) - off - SEGMENT_OVERHEAD} remain",
+            )
+        asm.feed(buf[off:end])
+        out.extend(asm.drain())
+        off = end
+    if off != len(buf):
+        raise WireFormatError(
+            WIRE_SEGMENT, f"{len(buf) - off} bytes after the terminal"
+        )
+    asm.close()
     return out
 
 
