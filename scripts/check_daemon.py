@@ -38,6 +38,10 @@ def check_paths(paths: Sequence[str] = DEFAULT_PATHS) -> List[str]:
     # kill mid-stream, one-bit journal rot, restart must typed-detect
     # the damage and recover every stream bitwise
     problems += [f"disk-fault smoke: {p}" for p in mod.run_disk_smoke()]
+    # the SSD-tier third (PR 18): spill a warm set through the
+    # hierarchy, kill -9, warm-start from the disk manifest, and
+    # replay through typed disk restores bitwise
+    problems += [f"kv-disk smoke: {p}" for p in mod.run_kv_disk_smoke()]
     return problems
 
 

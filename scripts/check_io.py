@@ -36,6 +36,10 @@ DEFAULT_PATHS = (
     "tpu_parallel/daemon",
     "tpu_parallel/checkpoint",
     "tpu_parallel/fleet",
+    # the SSD KV tier persists payloads + manifest: every byte it
+    # writes must route through the iofaults shim so seeded rot and
+    # EIO/ENOSPC land on the typed verify-or-recompute path
+    "tpu_parallel/serving/kv_disk.py",
 )
 
 # the one module allowed to spell raw IO: the shim itself

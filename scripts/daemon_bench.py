@@ -55,12 +55,32 @@ Entry modes:
   is its integrity sibling (one reduced ``--disk-faults`` trial, no
   degraded leg) — ``check_daemon`` runs both.
 
+- ``--kv-disk SEED``: the SSD-KV-tier acceptance bench
+  (``KVDISK_r01.json``).  Life 1 builds a warm set of long shared
+  headers through a tight radix+host hierarchy backed by a disk tier
+  (``--kv-disk-dir``), forcing cold host evictions to SPILL block
+  payloads to per-block-CRC'd files, then is SIGKILLed.  Three
+  restart legs on the same schedule: **warm** (same disk directory —
+  the manifest must seed prefix chains, every replayed header must
+  hydrate through typed disk restores, zero failures, bitwise tokens,
+  and TTFT p95 strictly below the **cold** leg, which restarts on an
+  EMPTY disk directory with the identical engine shape) and **rot**
+  (one seeded bit flipped in every spilled blob — every planted
+  corruption must be typed-detected while the replay recomputes
+  bitwise; silent wrong tokens are the only failure).  A fourth leg
+  delegates the hit-rate comparison (disk-backed vs RAM-only
+  hierarchy at a working set far above ``kv_host_blocks``) to
+  ``serve_bench.run_kv_disk_bench``.  ``--kv-disk-smoke`` is the
+  reduced warm-restart trial ``check_daemon`` runs.
+
 - ``--serve``: INTERNAL child mode — build the tiny-model fleet, wrap
   it in :class:`~tpu_parallel.daemon.ServingDaemon` + HTTP server,
   write the ready file, install signals, pump until shut down, exit
   with ``daemon.run()``'s code.  ``--io-fsync-eio N`` arms the IO
   fault shim with a persistent fsync-``EIO`` plan starting at fsync
-  index N.  The parent modes spawn this.
+  index N.  ``--kv-disk-dir D`` attaches the radix + host + SSD KV
+  hierarchy (one subdirectory per replica).  The parent modes spawn
+  this.
 """
 
 from __future__ import annotations
@@ -82,6 +102,24 @@ sys.path.insert(0, REPO_ROOT)
 DEFAULT_NEW_TOKENS = 8
 SOAK_NEW_TOKENS = 20  # long enough that a seeded kill lands mid-stream
 READY_TIMEOUT = 300.0  # cold jax import + compile on a 1-core box
+
+# --kv-disk geometry: the soak/crash modes keep the 32-token toy model
+# (prefill there is pure dispatch), but the SSD tier's TTFT claim needs
+# prefill COMPUTE to save — so its legs run a small-but-real model
+# (serve_bench's hierarchy-bench trick) with 3-block shared headers and
+# a hierarchy tight enough that the working set can only live on disk.
+# d_model=512 puts a 96-token prefill at ~30 ms of CPU compute while a
+# 3-blob chain restore is a few ms of IO — the warm/cold gap must be
+# compute, not scheduler noise; disk capacity holds every soak chain
+# (20 headers + warmup + flushers, 3 blocks each) with headroom so the
+# warm leg never loses a chain to disk-tier eviction
+KV_DISK_MODEL = dict(d_model=512, n_layers=4, n_heads=4, seq_len=128)
+KV_DISK_ENGINE = dict(
+    kv_block_tokens=32, kv_pool_blocks=24, prefix_cache_size=4,
+    kv_radix_cache=True, kv_host_blocks=4, kv_disk_blocks=160,
+)
+KV_DISK_HEADER_TOKENS = 96  # 3 full blocks of reusable tenant header
+KV_DISK_NEW_TOKENS = 6
 
 
 # -- HTTP client helpers -----------------------------------------------------
@@ -155,9 +193,11 @@ def make_schedule(seed, n_requests, new_tokens):
     return schedule
 
 
-def greedy_references(schedule):
+def greedy_references(schedule, cfg_overrides=None):
     """Static-generate greedy continuation for every prompt — the
-    parity oracle the daemon's crash+replay output must match."""
+    parity oracle the daemon's crash+replay output must match.
+    ``cfg_overrides`` must mirror what the ``--serve`` child builds
+    (the ``--kv-disk`` legs use :data:`KV_DISK_MODEL`)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -165,7 +205,7 @@ def greedy_references(schedule):
     from tpu_parallel.models import GPTLM, tiny_test
     from tpu_parallel.models.generate import generate
 
-    cfg = tiny_test(remat=False)
+    cfg = tiny_test(remat=False, **(cfg_overrides or {}))
     model = GPTLM(cfg)
     probe = jnp.zeros((1, 16), jnp.int32)
     params = model.init(
@@ -212,7 +252,9 @@ def serve(args):
     from tpu_parallel.obs.registry import MetricRegistry
     from tpu_parallel.serving import SchedulerConfig, ServingEngine
 
-    cfg = tiny_test(remat=False)
+    cfg = tiny_test(
+        remat=False, **(KV_DISK_MODEL if args.kv_disk_dir else {})
+    )
     model = GPTLM(cfg)
     probe = jax.numpy.zeros((1, 16), jax.numpy.int32)
     params = model.init(
@@ -220,13 +262,21 @@ def serve(args):
     )["params"]
 
     def frontend_factory(clock):
-        engines = [
-            ServingEngine(
+        engines = []
+        for i in range(args.replicas):
+            extra_kw = {}
+            if args.kv_disk_dir:
+                # one store per replica: the manifest journal is a
+                # single-writer file, so replicas must not share a root
+                extra_kw = dict(
+                    KV_DISK_ENGINE,
+                    kv_disk_dir=os.path.join(args.kv_disk_dir, f"r{i}"),
+                )
+            engines.append(ServingEngine(
                 model, params, n_slots=args.slots,
                 scheduler=SchedulerConfig(max_prefills_per_tick=2),
-            )
-            for _ in range(args.replicas)
-        ]
+                **extra_kw,
+            ))
         return Frontend(
             engines, router="least",
             config=FrontendConfig(restart=None),
@@ -724,6 +774,375 @@ def run_disk_smoke():
     return problems
 
 
+# -- SSD KV tier legs (--kv-disk) --------------------------------------------
+
+
+def make_kv_disk_schedule(seed, n_headers, life,
+                          new_tokens=KV_DISK_NEW_TOKENS):
+    """Seeded long-header replay schedule.  Prompts are a pure function
+    of ``(seed, i)`` — identical across process lives — while the
+    dedupe token carries the ``life`` tag, so a restarted daemon
+    re-admits the replay as FRESH work (restore or recompute, never a
+    journal dedupe hit that would hide the KV path entirely)."""
+    rnd = random.Random(seed ^ 0x55D)
+    schedule = []
+    for i in range(n_headers):
+        header = [
+            rnd.randrange(1, 250) for _ in range(KV_DISK_HEADER_TOKENS)
+        ]
+        suffix = [rnd.randrange(1, 250) for _ in range(2)]
+        schedule.append({
+            "dedupe_token": f"kvd-{seed}-{i}-{life}",
+            "prompt": header + suffix,
+            "max_new_tokens": new_tokens,
+        })
+    return schedule
+
+
+def kv_disk_references(seed, n_headers):
+    """Greedy reference continuations indexed by header number (the
+    prompts are life-invariant, so one oracle serves every leg)."""
+    sched = make_kv_disk_schedule(seed, n_headers, "ref")
+    refs = greedy_references(sched, cfg_overrides=KV_DISK_MODEL)
+    return [refs[entry["dedupe_token"]] for entry in sched]
+
+
+def timed_submit(port, entry):
+    """Submit one request and ride its LIVE SSE stream to the end:
+    returns ``(ttft_seconds, tokens, status)`` where TTFT is measured
+    from just before the submit POST to the first streamed token — the
+    client-observed latency the warm/cold legs compare.  The stream is
+    drained to the terminal event on purpose: hanging up mid-stream
+    would CANCEL the request."""
+    t0 = time.monotonic()
+    code, rec = http_json(
+        "POST", f"http://127.0.0.1:{port}/v1/submit", entry
+    )
+    if code != 200:
+        raise RuntimeError(f"submit {code}: {rec}")
+    rid = rec["request_id"]
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/stream/{rid}"
+    )
+    ttft, tokens = None, []
+    with urllib.request.urlopen(req, timeout=180) as resp:
+        for raw in resp:
+            if not raw.startswith(b"data: "):
+                continue
+            ev = json.loads(raw[len(b"data: "):])
+            if "token" in ev:
+                if ttft is None:
+                    ttft = time.monotonic() - t0
+                tokens.append(ev["token"])
+            if ev.get("finished"):
+                return ttft, tokens, ev.get("status")
+    raise RuntimeError(f"stream for {rid} closed before the terminal")
+
+
+def healthz_kv(port):
+    code, payload = http_json("GET", f"http://127.0.0.1:{port}/healthz")
+    return (payload.get("kv") or {}) if isinstance(payload, dict) else {}
+
+
+def p95(xs):
+    xs = sorted(xs)
+    return xs[min(len(xs) - 1, int(round(0.95 * (len(xs) - 1))))]
+
+
+def corrupt_blob_files(disk_root, rnd):
+    """Flip one seeded bit inside the payload region of EVERY spilled
+    ``.kvw`` blob under ``disk_root`` — post-fsync SSD rot.  The frame
+    CRC + manifest cross-check must type every one; returns the count
+    planted."""
+    flipped = 0
+    for root, _, names in os.walk(disk_root):
+        for name in sorted(names):
+            if not name.endswith(".kvw"):
+                continue
+            path = os.path.join(root, name)
+            with open(path, "rb") as fh:
+                data = bytearray(fh.read())
+            if len(data) < 8:
+                continue
+            pos = rnd.randrange(len(data) // 4, 3 * len(data) // 4)
+            data[pos] ^= 1 << rnd.randrange(8)
+            with open(path, "wb") as fh:
+                fh.write(bytes(data))
+            flipped += 1
+    return flipped
+
+
+def run_kv_disk_trial(args, seed, refs, *, timing=True, rot_leg=True):
+    """One SSD-tier restart trial (see the module docstring's
+    ``--kv-disk`` contract).  Returns ``(trial_record, problems)``."""
+    import shutil
+
+    n_headers = len(refs)
+    problems = []
+    tmpdir = os.path.join(
+        args.workdir or "/tmp", f"daemon_kvdisk_{os.getpid()}_{seed}"
+    )
+    if os.path.exists(tmpdir):
+        shutil.rmtree(tmpdir)
+    os.makedirs(tmpdir)
+    journal = os.path.join(tmpdir, "journal.jsonl")
+    ready = os.path.join(tmpdir, "ready.json")
+    warm_disk = os.path.join(tmpdir, "disk")
+    warm_extra = ("--kv-disk-dir", warm_disk)
+
+    def replay(port, life):
+        # compile warm-up OUTSIDE the timed window, both paths: the
+        # first dummy submit compiles the full-length prefill bucket
+        # (the cold path), the immediate second submit HITS the
+        # still-resident chain and compiles the short-tail
+        # prefix-hit prefill (the warm path) — so no timed request in
+        # either leg pays jit, and the legs compare compute, not
+        # compilation
+        for rep in range(2):
+            timed_submit(port, {
+                "dedupe_token": f"kvd-{seed}-warmup-{life}-{rep}",
+                "prompt": [3] * (KV_DISK_HEADER_TOKENS + 2),
+                "max_new_tokens": KV_DISK_NEW_TOKENS,
+            })
+        ttfts = []
+        for i, entry in enumerate(
+            make_kv_disk_schedule(seed, n_headers, life)
+        ):
+            ttft, tokens, status = timed_submit(port, entry)
+            if status != "finished":
+                problems.append(f"{life}: header {i} status {status}")
+            elif tokens != refs[i]:
+                problems.append(
+                    f"{life}: header {i} tokens diverge from the "
+                    "greedy reference (SILENT WRONG TOKENS)"
+                )
+            ttfts.append(ttft)
+        return ttfts
+
+    # ---- life 1: build the warm set through the spill path, kill -9.
+    # Each header is submitted TWICE back to back: the second submission
+    # hits the still-resident chain, which is what marks its blocks WARM
+    # — only evicted-but-warm blocks spill (a cold one-off drops
+    # outright), so without the double-take nothing would ever reach
+    # disk.  Then a train of warm FLUSHER prompts (disjoint token space)
+    # cycles the device and host tiers, pushing every header block
+    # through the cold-host-eviction path — whose prefix-closure spill
+    # persists each header's whole chain — before the kill lands.
+    proc = spawn_daemon(args, journal, ready, extra=warm_extra)
+    info = wait_ready(ready, proc)
+    port = info["port"]
+    # the warmup header is submitted twice so its blocks go WARM and
+    # ride the flusher cascade to disk with everything else — the warm
+    # leg's (untimed) warmup submits then exercise the disk-restore
+    # machinery's first-use costs OUTSIDE the timed window, exactly as
+    # they pre-pay compile for the prefill buckets
+    for rep in range(2):
+        timed_submit(port, {
+            "dedupe_token": f"kvd-{seed}-warmup-a-{rep}",
+            "prompt": [3] * (KV_DISK_HEADER_TOKENS + 2),
+            "max_new_tokens": KV_DISK_NEW_TOKENS,
+        })
+    build = [
+        make_kv_disk_schedule(seed, n_headers, life)
+        for life in ("a0", "a1")
+    ]
+    for i in range(n_headers):
+        for sched in build:  # back to back: the second take must HIT
+            _, tokens, status = timed_submit(port, sched[i])
+            if status != "finished":
+                problems.append(f"life1: header {i} status {status}")
+            elif tokens != refs[i]:
+                problems.append(
+                    f"life1: header {i} tokens diverge from the greedy "
+                    "reference"
+                )
+    frnd = random.Random(seed ^ 0xF1)
+    for i in range(4):
+        flusher = [250] + [
+            frnd.randrange(1, 250)
+            for _ in range(KV_DISK_HEADER_TOKENS + 1)
+        ]
+        for rep in range(2):
+            timed_submit(port, {
+                "dedupe_token": f"kvd-{seed}-flush-{i}-{rep}",
+                "prompt": flusher,
+                "max_new_tokens": KV_DISK_NEW_TOKENS,
+            })
+    kv_life1 = healthz_kv(port)
+    if kv_life1.get("disk_blocks_used", 0) < n_headers:
+        problems.append(
+            f"life1: {kv_life1.get('disk_blocks_used', 0)} disk blocks "
+            f"< {n_headers} headers — the warm set never reached the "
+            f"disk tier (healthz kv: {kv_life1})"
+        )
+    proc.send_signal(signal.SIGKILL)
+    proc.wait(timeout=30)
+
+    if rot_leg:
+        # snapshot the on-disk tier BEFORE the warm leg mutates it
+        rot_disk = os.path.join(tmpdir, "disk_rot")
+        shutil.copytree(warm_disk, rot_disk)
+
+    # ---- warm leg: restart on the SAME journal + SAME disk directory
+    proc = spawn_daemon(args, journal, ready, extra=warm_extra)
+    info = wait_ready(ready, proc)
+    port = info["port"]
+    kv_seeded = healthz_kv(port)
+    if not kv_seeded.get("disk_seeded_chains"):
+        problems.append(
+            "warm: restart seeded no prefix chains from the manifest "
+            f"(healthz kv: {kv_seeded})"
+        )
+    warm_ttfts = replay(port, "w")
+    kv_warm = healthz_kv(port)
+    if kv_warm.get("disk_restores", 0) < n_headers:
+        problems.append(
+            f"warm: {kv_warm.get('disk_restores', 0)} disk restores < "
+            f"{n_headers} replayed warm chains — warm hits recomputed"
+        )
+    if kv_warm.get("disk_restore_failures", 0):
+        problems.append(
+            f"warm: {kv_warm['disk_restore_failures']} restore "
+            "failures on an uncorrupted disk"
+        )
+    stop_gracefully(proc, args.grace, problems, f"kvdisk-warm{seed}")
+
+    trial = {
+        "seed": seed,
+        "headers": n_headers,
+        "header_tokens": KV_DISK_HEADER_TOKENS,
+        "engine": dict(KV_DISK_ENGINE),
+        "life1_kv": kv_life1,
+        "warm": {
+            "kv": kv_warm,
+            "seeded_chains": kv_seeded.get("disk_seeded_chains", 0),
+            "ttft_ms": [round(t * 1000, 2) for t in warm_ttfts],
+        },
+    }
+
+    # ---- cold leg: identical engine shape, EMPTY disk directory —
+    # the restart-TTFT baseline the warm leg must beat
+    if timing:
+        cold_journal = os.path.join(tmpdir, "journal_cold.jsonl")
+        cold_disk = os.path.join(tmpdir, "disk_cold")
+        proc = spawn_daemon(
+            args, cold_journal, ready,
+            extra=("--kv-disk-dir", cold_disk),
+        )
+        info = wait_ready(ready, proc)
+        port = info["port"]
+        cold_ttfts = replay(port, "c")
+        stop_gracefully(
+            proc, args.grace, problems, f"kvdisk-cold{seed}"
+        )
+        warm_p95, cold_p95 = p95(warm_ttfts), p95(cold_ttfts)
+        if warm_p95 >= cold_p95:
+            problems.append(
+                f"warm-restart TTFT p95 {warm_p95 * 1000:.1f}ms is not "
+                f"below the cold restart's {cold_p95 * 1000:.1f}ms"
+            )
+        trial["warm"]["ttft_ms_p95"] = round(warm_p95 * 1000, 2)
+        trial["cold"] = {
+            "ttft_ms": [round(t * 1000, 2) for t in cold_ttfts],
+            "ttft_ms_p95": round(cold_p95 * 1000, 2),
+        }
+
+    # ---- rot leg: one seeded bit in every spilled blob; every planted
+    # corruption must surface as a TYPED restore failure while the
+    # replay recomputes bitwise — never as served wrong tokens
+    if rot_leg:
+        rnd = random.Random(seed ^ 0xB07)
+        n_flipped = corrupt_blob_files(rot_disk, rnd)
+        rot_journal = os.path.join(tmpdir, "journal_rot.jsonl")
+        proc = spawn_daemon(
+            args, rot_journal, ready, extra=("--kv-disk-dir", rot_disk),
+        )
+        info = wait_ready(ready, proc)
+        port = info["port"]
+        replay(port, "r")
+        kv_rot = healthz_kv(port)
+        if n_flipped and not kv_rot.get("disk_restore_failures"):
+            problems.append(
+                f"rot: {n_flipped} planted blob corruptions, none "
+                "typed-detected"
+            )
+        stop_gracefully(proc, args.grace, problems, f"kvdisk-rot{seed}")
+        trial["rot"] = {"flipped_blobs": n_flipped, "kv": kv_rot}
+
+    if not problems:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+    return trial, problems
+
+
+def run_kv_disk_soak(args):
+    """The SSD-tier acceptance bench: restart-TTFT warm vs cold on the
+    same disk, seeded blob rot, plus serve_bench's disk-vs-RAM-only
+    hit-rate leg — one ``KVDISK_r01.json`` record."""
+    import importlib.util
+    import types
+
+    record = {"bench": "kv_disk", "trials": []}
+    problems = []
+    # 20 timed samples per leg: p95 is the second-worst sample, so one
+    # scheduler hiccup cannot decide the warm-vs-cold verdict
+    n_headers = 20
+    for trial in range(args.trials):
+        seed = args.kv_disk + trial
+        refs = kv_disk_references(seed, n_headers)
+        trial_rec, trial_problems = run_kv_disk_trial(args, seed, refs)
+        trial_rec["problems"] = list(trial_problems)
+        record["trials"].append(trial_rec)
+        problems.extend(trial_problems)
+        print(
+            f"kv-disk trial {trial} (seed {seed}): "
+            f"seeded_chains={trial_rec['warm']['seeded_chains']} "
+            f"warm_p95={trial_rec['warm'].get('ttft_ms_p95')}ms "
+            f"cold_p95={trial_rec.get('cold', {}).get('ttft_ms_p95')}ms "
+            f"rot_flipped={trial_rec.get('rot', {}).get('flipped_blobs')} "
+            f"problems={len(trial_problems)}"
+        )
+
+    # ---- hit-rate leg: in-process engines, disk-backed hierarchy vs
+    # RAM-only at a working set far above kv_host_blocks (serve_bench
+    # owns the workload; loaded by path, same trick as check_daemon)
+    spec = importlib.util.spec_from_file_location(
+        "serve_bench",
+        os.path.join(REPO_ROOT, "scripts", "serve_bench.py"),
+    )
+    sb = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(sb)
+    hit_rec, hit_violations = sb.run_kv_disk_bench(
+        None, None, None, seed=args.kv_disk,
+        logger=types.SimpleNamespace(log_record=lambda rec: None),
+    )
+    record["hit_rate_leg"] = hit_rec
+    problems.extend(f"hit-rate leg: {v}" for v in hit_violations)
+
+    record["ok"] = not problems
+    out = args.record or os.path.join(REPO_ROOT, "KVDISK_r01.json")
+    with open(out, "w") as fh:
+        json.dump(record, fh, indent=2)
+        fh.write("\n")
+    print(f"record: {out}")
+    return problems
+
+
+def run_kv_disk_smoke():
+    """One reduced warm-restart trial — no TTFT gate (CI boxes are too
+    noisy for a latency comparison), no rot leg: spill, kill -9,
+    manifest warm-start, typed restores, bitwise replay.  The SSD-tier
+    third of the ``check_daemon`` runtime gate."""
+    args = argparse.Namespace(
+        replicas=1, slots=2, grace=60.0, fsync_batch=4, workdir="",
+    )
+    seed = 11
+    refs = kv_disk_references(seed, n_headers=5)
+    _, problems = run_kv_disk_trial(
+        args, seed, refs, timing=False, rot_leg=False,
+    )
+    return problems
+
+
 def run_soak(args):
     """The seeded kill-9 / restart / drain acceptance soak."""
     from tpu_parallel.daemon import load_state
@@ -914,6 +1333,19 @@ def main():
                          "tails, one-bit journal rot, persistent "
                          "fsync-EIO degraded mode — trials use seeds "
                          "SEED..SEED+trials-1")
+    ap.add_argument("--kv-disk", type=int, default=None, metavar="SEED",
+                    help="SSD-KV-tier acceptance bench: warm vs cold "
+                         "restart TTFT on the same disk, seeded blob "
+                         "rot, and the serve_bench hit-rate leg; "
+                         "writes KVDISK_r01.json by default")
+    ap.add_argument("--kv-disk-smoke", action="store_true",
+                    help="fast SSD-tier gate: one reduced warm-restart "
+                         "trial (spill, kill -9, manifest warm-start, "
+                         "typed restores, bitwise replay)")
+    ap.add_argument("--kv-disk-dir", type=str, default="",
+                    help="INTERNAL (--serve): attach the radix + host "
+                         "+ SSD KV hierarchy, one subdirectory per "
+                         "replica")
     ap.add_argument("--io-fsync-eio", type=int, default=-1,
                     help="INTERNAL (--serve): arm the IO fault shim "
                          "with persistent fsync EIO from this fsync "
@@ -943,6 +1375,10 @@ def main():
         problems = run_smoke()
     elif args.disk_smoke:
         problems = run_disk_smoke()
+    elif args.kv_disk_smoke:
+        problems = run_kv_disk_smoke()
+    elif args.kv_disk is not None:
+        problems = run_kv_disk_soak(args)
     elif args.disk_faults is not None:
         problems = run_disk_soak(args)
     else:

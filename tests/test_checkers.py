@@ -428,3 +428,20 @@ def test_check_io_semantics():
     import check_all as ca
 
     assert "check_io" in ca.CHECKERS
+
+
+def test_check_io_fences_kv_disk_tier():
+    """The SSD KV tier is in the IO gate's default sweep: the live
+    module passes (every byte routes through iofaults), and a planted
+    raw ``open`` in it would be a CI failure."""
+    ci = _load("check_io")
+    kv_disk = "tpu_parallel/serving/kv_disk.py"
+    assert kv_disk in ci.DEFAULT_PATHS
+    assert ci.check_paths((os.path.join(REPO_ROOT, kv_disk),)) == []
+    planted = (
+        "def dump_blob(path, data):\n"
+        "    with open(path, 'wb') as fh:\n"
+        "        fh.write(data)\n"
+    )
+    found = ci.check_source(planted, kv_disk)
+    assert len(found) == 1 and "open()" in found[0]

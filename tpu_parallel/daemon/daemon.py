@@ -998,7 +998,7 @@ class ServingDaemon:
             self._m_kv_peer_exports.inc()
             return [export]
 
-    def kv_occupancy(self) -> Dict[str, int]:
+    def kv_occupancy(self) -> Dict[str, float]:
         """Device/host KV-tier block occupancy summed over live
         replicas — carried on ``/healthz`` so the fleet router's
         placement and the autopilot's role lever see pressure, not just
@@ -1007,6 +1007,9 @@ class ServingDaemon:
 
         with self._lock:
             device_used = device_total = host_used = 0
+            disk_used = disk_total = seeded_chains = 0
+            disk_restores = disk_restore_failures = 0
+            manifest_age = None
             for handle in self.frontend.replicas:
                 if handle.health == _REPLICA_DEAD:
                     continue
@@ -1020,11 +1023,40 @@ class ServingDaemon:
                     host_used += int(
                         getattr(radix, "host_blocks_in_use", 0)
                     )
-            return {
+                    store = getattr(radix, "disk", None)
+                    if store is not None:
+                        disk_used += int(store.blocks_in_use)
+                        disk_total += int(store.capacity_blocks)
+                        seeded_chains += int(
+                            getattr(radix, "disk_seeded_chains", 0)
+                        )
+                        disk_restores += int(
+                            getattr(radix, "disk_restores", 0)
+                        )
+                        disk_restore_failures += int(
+                            getattr(radix, "disk_restore_failures", 0)
+                        )
+                        age = float(store.manifest_age_seconds())
+                        if manifest_age is None or age > manifest_age:
+                            manifest_age = age
+            occ = {
                 "device_blocks_used": device_used,
                 "device_blocks_total": device_total,
                 "host_blocks_used": host_used,
             }
+            # disk-tier rows only when an SSD tier is attached — old
+            # routers .get() these, new ones see the fraction + the
+            # manifest's staleness in one probe
+            if disk_total:
+                occ["disk_blocks_used"] = disk_used
+                occ["disk_blocks_total"] = disk_total
+                occ["disk_seeded_chains"] = seeded_chains
+                occ["disk_restores"] = disk_restores
+                occ["disk_restore_failures"] = disk_restore_failures
+                occ["manifest_age_seconds"] = round(
+                    manifest_age or 0.0, 3
+                )
+            return occ
 
     def import_peer_kv(self, exports) -> Dict[str, int]:
         """Land already-decoded peer exports into every live replica's

@@ -1096,7 +1096,31 @@ class FleetRouter:
         ``verdicts`` map counts typed migration statuses); every
         verdict and refusal is counted under ``fleet_kv_*``.  Best
         effort: no donor, an empty export, or a refusal leaves the
-        newcomer merely cold, never wrong."""
+        newcomer merely cold, never wrong.
+
+        A newcomer that already warm-started from its OWN SSD manifest
+        (``/healthz`` ``kv.disk_seeded_chains > 0``) is left alone —
+        local disk is both cheaper and hotter than a donor's wire
+        export.  Old daemons without the field fall through to the
+        wire path unchanged."""
+        try:
+            code, body = self.transport.healthz(
+                newcomer, self.policy.connect_timeout_seconds
+            )
+        except TransportError:
+            code, body = 0, {}
+        if code == 200 and isinstance(body, dict):
+            kv = body.get("kv")
+            seeded = (
+                kv.get("disk_seeded_chains", 0)
+                if isinstance(kv, dict)
+                else 0
+            )
+            if isinstance(seeded, (int, float)) and seeded > 0:
+                self.registry.counter(
+                    "fleet_kv_warm_local_total"
+                ).inc()
+                return {"warm_local": int(seeded)}
         if donor is None:
             healthy = [a for a in self.peers.healthy() if a != newcomer]
             if not healthy:

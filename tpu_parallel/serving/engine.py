@@ -1202,6 +1202,8 @@ class ServingEngine:
         kv_pool_blocks: Optional[int] = None,
         kv_radix_cache: bool = False,
         kv_host_blocks: int = 0,
+        kv_disk_dir: Optional[str] = None,
+        kv_disk_blocks: int = 0,
         decode_steps_per_tick: Union[int, str] = "auto",
         unified_tick: Union[str, bool] = "auto",
         draft_tokens: int = 0,
@@ -1341,6 +1343,23 @@ class ServingEngine:
         # references), so only validate here
         if kv_host_blocks < 0:
             raise ValueError(f"kv_host_blocks={kv_host_blocks} < 0")
+        # SSD tier (kv_disk.py) UNDER the host tier: both knobs travel
+        # together, and the host tier must exist — disk spills are COLD
+        # host evictions, so a diskful hierarchy without a host tier
+        # would never populate it
+        if kv_disk_blocks < 0:
+            raise ValueError(f"kv_disk_blocks={kv_disk_blocks} < 0")
+        if (kv_disk_dir is None) != (kv_disk_blocks == 0):
+            raise ValueError(
+                "kv_disk_dir and kv_disk_blocks > 0 travel together"
+            )
+        if kv_disk_dir is not None and kv_host_blocks < 1:
+            raise ValueError(
+                "kv_disk_dir needs kv_host_blocks > 0 (the disk tier "
+                "sits UNDER the host offload tier)"
+            )
+        self._kv_disk_dir = kv_disk_dir
+        self._kv_disk_blocks = int(kv_disk_blocks)
         self._radix_requested = bool(kv_radix_cache) or kv_host_blocks > 0
         self._kv_host_blocks = int(kv_host_blocks)
         self._radix: Optional[RadixPrefixCache] = None
@@ -1548,10 +1567,21 @@ class ServingEngine:
                 # store/evict/counter surface), so every downstream
                 # consumer — admission, block-pressure valve, metrics,
                 # the cluster's hit-rate aggregation — is layout-blind
+                disk_store = None
+                if self._kv_disk_dir is not None:
+                    from tpu_parallel.serving.kv_disk import KVDiskStore
+
+                    disk_store = KVDiskStore(
+                        self._kv_disk_dir,
+                        self.clock,
+                        capacity_blocks=self._kv_disk_blocks,
+                    )
                 self._radix = RadixPrefixCache(
                     self.pool,
                     max_device_blocks=prefix_cache_size,
                     host_capacity_blocks=self._kv_host_blocks,
+                    disk_store=disk_store,
+                    weights_version=self.weights_version,
                 )
                 self._prefix = self._radix
         else:
@@ -1881,6 +1911,8 @@ class ServingEngine:
             )
             if self._radix is not None:
                 self.metrics.sync_host_tier(self._radix)
+                if self._radix.disk is not None:
+                    self.metrics.sync_disk_tier(self._radix)
         if self._paged:
             self.metrics.sync_block_pool(
                 self.pool, active_tokens=active_tokens
@@ -2048,6 +2080,10 @@ class ServingEngine:
         self.params = params
         if version is not None:
             self.weights_version = version
+            if self._radix is not None:
+                # future disk spills stamp the new version; persisted
+                # chains from the old weights refuse typed at hydrate
+                self._radix.weights_version = version
 
     # -- KV block export / import (cross-replica migration) ----------------
 

@@ -159,15 +159,18 @@ class KVPrefixExport:
 
 class _Node:
     """One radix-tree node == one KV block.  ``run`` is the
-    ``block_tokens``-id edge from ``parent``; exactly one of ``block``
+    ``block_tokens``-id edge from ``parent``; at most one of ``block``
     (device-resident, holds one allocator reference) or ``host``
     (offloaded leaf arrays, the export layout at k=1, with
     ``host_crc`` recorded at spill time and verified before any
-    restore) is set."""
+    restore) is set.  ``disk`` is a blob id in the SSD tier's store —
+    INCLUSIVE with the other two: a node promoted back up keeps its
+    disk copy, so re-spilling it later is free and the persisted
+    manifest keeps covering the chain across a restart."""
 
     __slots__ = (
         "run", "parent", "children", "block", "host", "host_crc",
-        "hits", "last_use", "born",
+        "disk", "hits", "last_use", "born",
     )
 
     def __init__(self, run, parent, born: int):
@@ -177,6 +180,7 @@ class _Node:
         self.block: Optional[int] = None
         self.host: Optional[list] = None
         self.host_crc: Optional[int] = None
+        self.disk: Optional[int] = None
         self.hits = 0
         self.last_use = born
         self.born = born
@@ -206,6 +210,8 @@ class RadixPrefixCache:
         hit_recency_bonus: int = 8,
         breaker_failures: int = 4,
         breaker_probe_ops: int = 64,
+        disk_store=None,
+        weights_version: str = "initial",
     ):
         if max_device_blocks < 1:
             raise ValueError(
@@ -252,6 +258,31 @@ class RadixPrefixCache:
         self.restore_failures = 0  # host hit unrestorable (no blocks)
         self.integrity_failures = 0  # checksum-failed host bytes dropped
         self.breaker_trips = 0  # times the host tier went down
+        # -- SSD tier (optional, UNDER the host tier) ----------------------
+        # ``disk_store`` is a ``serving/kv_disk.KVDiskStore``: cold host
+        # evictions spill their payload there (prefix-closed, so every
+        # disk chain restores from block 0 after a restart), lookups
+        # hydrate disk runs back through host to device, and a second
+        # breaker — same K-consecutive-failures / half-open shape as the
+        # host tier's — takes a sick disk out of the path entirely.
+        self.disk = disk_store
+        self.weights_version = str(weights_version)
+        self._consec_disk_failures = 0
+        self._disk_down_since: Optional[int] = None  # _seq at trip
+        self.disk_spills = 0  # blobs written (host -> disk)
+        self.disk_restores = 0  # blocks hydrated (disk -> host)
+        self.disk_restore_failures = 0  # typed hydrate refusals
+        self.disk_evictions = 0  # blobs dropped (capacity or subtree)
+        self.disk_breaker_trips = 0
+        # typed failure tally, keyed on kv_disk.DISK_REASONS — the
+        # vocabulary tests pin and the bench's rot leg audits
+        self.disk_failure_reasons: Dict[str, int] = {}
+        # restart seeding: manifest chains folded back into the tree
+        self.disk_seeded_blocks = 0
+        self.disk_seeded_chains = 0
+        self.disk_orphans_dropped = 0
+        if self.disk is not None:
+            self._seed_from_disk()
 
     # -- PrefixCache-compatible surface ------------------------------------
 
@@ -267,6 +298,10 @@ class RadixPrefixCache:
         self.offloads = self.restored_blocks = 0
         self.host_evictions = self.restore_failures = 0
         self.integrity_failures = self.breaker_trips = 0
+        self.disk_spills = self.disk_restores = 0
+        self.disk_restore_failures = self.disk_evictions = 0
+        self.disk_breaker_trips = 0
+        self.disk_failure_reasons = {}
 
     # -- host-tier breaker -------------------------------------------------
 
@@ -300,6 +335,44 @@ class RadixPrefixCache:
     def _restore_succeeded(self) -> None:
         self._consec_restore_failures = 0
         self._tier_down_since = None  # a successful probe closes it
+
+    # -- disk-tier breaker (mirror of the host tier's) ---------------------
+
+    @property
+    def disk_tier_up(self) -> bool:
+        return self._disk_down_since is None
+
+    @property
+    def disk_breaker_state(self) -> int:
+        """0 = closed, 1 = open (disk out of the path — RAM+device
+        serving continues bitwise), 2 = half-open (the next disk
+        operation is a probe) — ``serving_kv_disk_breaker_state``."""
+        if self.disk is None or self._disk_down_since is None:
+            return 0
+        if self._seq - self._disk_down_since >= self.breaker_probe_ops:
+            return 2
+        return 1
+
+    def _disk_failed(self, reason: str) -> None:
+        """One typed disk failure (spill or hydrate): tallied by
+        reason, and past ``breaker_failures`` consecutive ones the SSD
+        tier goes down; a failed half-open probe re-arms the window."""
+        self.disk_failure_reasons[reason] = (
+            self.disk_failure_reasons.get(reason, 0) + 1
+        )
+        self._consec_disk_failures += 1
+        if self._disk_down_since is not None:
+            self._disk_down_since = self._seq  # failed probe: re-arm
+        elif self._consec_disk_failures >= self.breaker_failures:
+            self._disk_down_since = self._seq
+            self.disk_breaker_trips += 1
+
+    def _disk_succeeded(self) -> None:
+        """A verified blob load proves the media; write success alone
+        does not close the breaker (a disk that takes bytes but cannot
+        give them back is still down)."""
+        self._consec_disk_failures = 0
+        self._disk_down_since = None
 
     def lookup(
         self,
@@ -380,12 +453,15 @@ class RadixPrefixCache:
                 child.block = int(blocks[j])
                 self.device_blocks += 1
             elif child.block is None:
-                # host-resident: adopt the fresh device block (the warm
-                # copy is now redundant)
+                # host- or disk-resident: adopt the fresh device block
+                # (the warm copy is now redundant; a disk copy is KEPT —
+                # inclusive retention makes the next spill free and the
+                # manifest keeps covering the chain across a restart)
                 child.block = int(blocks[j])
-                child.host = None
-                child.host_crc = None
-                self.host_blocks_in_use -= 1
+                if child.host is not None:
+                    child.host = None
+                    child.host_crc = None
+                    self.host_blocks_in_use -= 1
                 self.device_blocks += 1
             else:
                 dupes.append(blocks[j])
@@ -423,6 +499,14 @@ class RadixPrefixCache:
     @property
     def host_bytes(self) -> int:
         return self.host_blocks_in_use * self.pool.bytes_per_block
+
+    @property
+    def disk_blocks_in_use(self) -> int:
+        return 0 if self.disk is None else self.disk.blocks_in_use
+
+    @property
+    def disk_bytes(self) -> int:
+        return 0 if self.disk is None else self.disk.payload_bytes
 
     def hottest_chains(self, max_blocks: int):
         """Up to ``max_blocks`` blocks of root-to-leaf device chains,
@@ -500,67 +584,146 @@ class RadixPrefixCache:
             return 0  # tier down, probe window not open: recompute
         if state == 2:
             host_nodes = host_nodes[:1]  # half-open: ONE probe block
-        # verify the leading run BEFORE touching the pool: truncate at
-        # the first checksum-failed node (everything below it is
-        # unreachable without it anyway)
-        verified = []
-        corrupt = None
-        for node in host_nodes:
-            if node.host_crc is not None and (
-                block_checksums(node.host, 1)[0] != node.host_crc
-            ):
-                corrupt = node
-                break
-            verified.append(node)
-        if corrupt is not None:
-            self.integrity_failures += 1
-            self._drop_subtree(corrupt)
-            if not verified:
+        # the tail may continue past the host run into DISK-resident
+        # nodes: hydrate the leading disk run into transient host
+        # payloads first (disk -> host; the import below finishes the
+        # promotion to device).  Typed hydrate refusals drop the
+        # refused subtree — the verified leading run still restores.
+        host_nodes, hydrated = self._hydrate_disk_run(host_nodes)
+        if not host_nodes:
+            # all-disk tail behind an open disk breaker (or a refused
+            # first blob): not a host-tier failure — RAM+device serving
+            # continues on whatever device prefix the caller matched
+            return 0
+        try:
+            # verify the leading run BEFORE touching the pool: truncate
+            # at the first checksum-failed node (everything below it is
+            # unreachable without it anyway)
+            verified = []
+            corrupt = None
+            for node in host_nodes:
+                if node.host_crc is not None and (
+                    block_checksums(node.host, 1)[0] != node.host_crc
+                ):
+                    corrupt = node
+                    break
+                verified.append(node)
+            if corrupt is not None:
+                self.integrity_failures += 1
+                self._drop_subtree(corrupt)
+                if not verified:
+                    self._restore_failed()
+                    return 0
+            avail = self.pool.blocks_available() - int(reserve)
+            k = min(len(verified), max(0, avail))
+            if k == 0:
                 self._restore_failed()
                 return 0
-        avail = self.pool.blocks_available() - int(reserve)
-        k = min(len(verified), max(0, avail))
-        if k == 0:
-            self._restore_failed()
-            return 0
-        take = verified[:k]
-        rows = [
-            np.concatenate([n.host[i] for n in take], axis=0)
-            for i in range(len(take[0].host))
-        ]
-        try:
-            blocks = self.pool.import_stored(
-                rows, k,
-                checksums=[
-                    n.host_crc for n in take
-                ] if all(n.host_crc is not None for n in take) else None,
+            take = verified[:k]
+            rows = [
+                np.concatenate([n.host[i] for n in take], axis=0)
+                for i in range(len(take[0].host))
+            ]
+            try:
+                blocks = self.pool.import_stored(
+                    rows, k,
+                    checksums=[
+                        n.host_crc for n in take
+                    ] if all(
+                        n.host_crc is not None for n in take
+                    ) else None,
+                )
+            except KVIntegrityError:
+                # belt and braces: the pool's own verify disagreed
+                # (bytes rotted between our check and the upload
+                # staging).  The whole run drops — take[0]'s subtree
+                # contains the rest.
+                self.integrity_failures += 1
+                self._drop_subtree(take[0])
+                self._restore_failed()
+                return 0
+            if blocks is None:
+                self._restore_failed()
+                return 0
+            for node, blk in zip(take, blocks):
+                node.block = int(blk)
+                node.host = None
+                node.host_crc = None
+                self.host_blocks_in_use -= 1
+                self.device_blocks += 1
+                node.last_use = self._seq
+            self.restored_blocks += k
+            self._restore_succeeded()
+            # restoring may overshoot the device budget: evict cold
+            # nodes, never the chain the caller is about to map
+            self._enforce_device(
+                protect=frozenset(id(n) for n in chain)
             )
-        except KVIntegrityError:
-            # belt and braces: the pool's own verify disagreed (bytes
-            # rotted between our check and the upload staging).  The
-            # whole run drops — take[0]'s subtree contains the rest.
-            self.integrity_failures += 1
-            self._drop_subtree(take[0])
-            self._restore_failed()
-            return 0
-        if blocks is None:
-            self._restore_failed()
-            return 0
-        for node, blk in zip(take, blocks):
-            node.block = int(blk)
-            node.host = None
-            node.host_crc = None
-            self.host_blocks_in_use -= 1
-            self.device_blocks += 1
-            node.last_use = self._seq
-        self.restored_blocks += k
-        self._restore_succeeded()
-        # restoring may overshoot the device budget: evict cold nodes,
-        # never the chain the caller is about to map
-        self._enforce_device(
-            protect=frozenset(id(n) for n in chain)
-        )
-        return k
+            return k
+        finally:
+            # hydration is TRANSIENT: a hydrated node the import did
+            # not reach sheds its host payload again (the disk copy
+            # stays — nothing is lost) so a failed restore cannot
+            # overflow the host tier's capacity accounting
+            for node in hydrated:
+                if node.host is not None and node.block is None:
+                    node.host = None
+                    node.host_crc = None
+                    self.host_blocks_in_use -= 1
+
+    def _hydrate_disk_run(self, nodes):
+        """Load the leading disk run of ``nodes`` into host payloads.
+
+        Returns ``(usable_run, hydrated)``: the leading nodes that now
+        hold host payloads, and the subset hydrated HERE (whose
+        payloads are transient until the device import lands).  Every
+        refusal is typed into ``disk_failure_reasons`` and drops the
+        refused node's subtree — corrupted or unreadable blobs never
+        serve, the chain above them still does.  Breaker discipline
+        mirrors the host tier: open = no disk reads, half-open =
+        exactly one probe blob (a verified load closes the breaker)."""
+        run: List[_Node] = []
+        hydrated: List[_Node] = []
+        probe_spent = False
+        for node in nodes:
+            if node.host is not None:
+                run.append(node)
+                continue
+            if node.disk is None or self.disk is None:
+                break
+            state = self.disk_breaker_state
+            if state == 1 or (state == 2 and probe_spent):
+                break
+            probe_spent = True
+            from tpu_parallel.serving.kv_disk import (
+                DISK_WEIGHTS,
+                KVDiskError,
+            )
+
+            try:
+                export = self.disk.load(node.disk)
+            except KVDiskError as err:
+                self.disk_restore_failures += 1
+                self._disk_failed(err.reason)
+                self._drop_subtree(node)
+                break
+            if export.weights_version != self.weights_version:
+                # stale weight set: a typed refusal, not media sickness
+                # — no breaker feed
+                self.disk_restore_failures += 1
+                self.disk_failure_reasons[DISK_WEIGHTS] = (
+                    self.disk_failure_reasons.get(DISK_WEIGHTS, 0) + 1
+                )
+                self._drop_subtree(node)
+                break
+            self._disk_succeeded()
+            node.host = list(export.leaves)
+            node.host_crc = int(export.checksums[0])
+            self.host_blocks_in_use += 1
+            self.disk_restores += 1
+            hydrated.append(node)
+            run.append(node)
+        return run, hydrated
 
     def _enforce_device(self, protect=frozenset()) -> None:
         while self.device_blocks > self.max_device_blocks:
@@ -612,17 +775,25 @@ class RadixPrefixCache:
         victim.block = None
         self.device_blocks -= 1
         self.evictions += 1
-        if victim.host is None:
+        if victim.host is None and victim.disk is None:
             self._drop_subtree(victim)
         return True
 
     def _evict_host_one(self, colder_than: Optional[_Node] = None) -> bool:
-        """Drop the coldest childless host node for good; refuses when
-        it would drop something HOTTER than the node about to spill."""
+        """Evict the coldest leaf-most host node.  With an SSD tier
+        attached and healthy its payload SPILLS DOWN (the node stays in
+        the tree, disk-resident, and the persisted manifest now covers
+        its chain across a restart); otherwise it drops for good.
+        Refuses when the victim would be HOTTER than the node about to
+        spill into the freed slot."""
         cands = [
             n
             for n in self._walk()
-            if n.host is not None and not n.children
+            if n.host is not None
+            and not any(
+                c.block is not None or c.host is not None
+                for c in n.children.values()
+            )
         ]
         if not cands:
             return False
@@ -631,26 +802,200 @@ class RadixPrefixCache:
             self._score(victim) > self._score(colder_than)
         ):
             return False
+        if self._spill_to_disk(victim):
+            victim.host = None
+            victim.host_crc = None
+            self.host_blocks_in_use -= 1
+            self.host_evictions += 1
+            return True
         self._drop_subtree(victim)
         return True
 
+    def _chain_of(self, node: _Node) -> List[_Node]:
+        """Root-to-``node`` path, root's child first."""
+        chain: List[_Node] = []
+        cur = node
+        while cur.run is not None:
+            chain.append(cur)
+            cur = cur.parent
+        chain.reverse()
+        return chain
+
+    def _spill_to_disk(self, node: _Node) -> bool:
+        """Persist ``node``'s payload — and any not-yet-persisted
+        ancestors, the PREFIX-CLOSURE invariant: every disk chain must
+        be restorable from block 0 by a cold process that holds nothing
+        but the manifest.  Ancestors already on disk are skipped
+        (inclusive retention makes re-spills free).  Typed failures
+        feed the disk breaker and return False — the caller then drops
+        the node exactly as before this tier existed."""
+        store = self.disk
+        if store is None or store.wedged or self.disk_breaker_state == 1:
+            return False
+        chain = self._chain_of(node)
+        need = [n for n in chain if n.disk is None]
+        if not need:
+            return True  # already persisted
+        # make room with cold pure-disk leaves; never drop something
+        # hotter than what is arriving
+        while store.blocks_in_use + len(need) > store.capacity_blocks:
+            if not self._evict_disk_one(colder_than=node):
+                return False
+        from tpu_parallel.serving.kv_disk import KVDiskError
+
+        tokens: List[int] = []
+        for n in chain:
+            tokens.extend(n.run)
+            if n.disk is not None:
+                continue
+            if n.host is not None:
+                rows = list(n.host)
+                crc = n.host_crc
+                if crc is None:
+                    crc = block_checksums(rows, 1)[0]
+            elif n.block is not None:
+                rows = self.pool.export_blocks([n.block])
+                crc = block_checksums(rows, 1)[0]
+            else:
+                return False  # payload gone: the prefix cannot close
+            export = KVPrefixExport(
+                tokens=tuple(n.run),
+                length=self.block_tokens,
+                block_tokens=self.block_tokens,
+                weights_version=self.weights_version,
+                meta=self.pool.export_meta,
+                leaves=tuple(rows),
+                checksums=(int(crc),),
+            )
+            try:
+                n.disk = store.put(export, chain_tokens=tuple(tokens))
+            except KVDiskError as err:
+                self._disk_failed(err.reason)
+                return False
+            self.disk_spills += 1
+        return True
+
+    def _evict_disk_one(self, colder_than: Optional[_Node] = None) -> bool:
+        """The SSD tier's capacity valve: drop the coldest childless
+        disk-only leaf for good, falling back to shedding an INCLUSIVE
+        disk copy (a childless node still resident above — losing only
+        restart coverage, not serving).  Refuses rather than drop
+        something hotter than ``colder_than``."""
+        pure = [
+            n
+            for n in self._walk()
+            if n.disk is not None
+            and n.block is None
+            and n.host is None
+            and not n.children
+        ]
+        cands = pure or [
+            n
+            for n in self._walk()
+            if n.disk is not None and not n.children
+        ]
+        if not cands:
+            return False
+        victim = min(cands, key=self._score)
+        if colder_than is not None and (
+            self._score(victim) > self._score(colder_than)
+        ):
+            return False
+        if victim.block is None and victim.host is None:
+            self._drop_subtree(victim)
+        else:
+            if self.disk is not None:
+                self.disk.delete(victim.disk)
+            victim.disk = None
+            self.disk_evictions += 1
+        return True
+
     def _drop_subtree(self, node: _Node) -> None:
-        """Unlink ``node`` (and any host-resident descendants — they are
-        unreachable without their prefix) from the tree."""
+        """Unlink ``node`` (and any host- or disk-resident descendants
+        — they are unreachable without their prefix) from the tree;
+        disk blobs are deleted so the manifest keeps mirroring the
+        tree's disk-resident set."""
         stack = list(node.children.values())
         while stack:
             sub = stack.pop()
             stack.extend(sub.children.values())
-            if sub.host is not None:
-                self.host_blocks_in_use -= 1
-                self.host_evictions += 1
+            self._shed_residency(sub)
             # device descendants are impossible here: eviction is
             # deepest-first and the tier invariant keeps device nodes in
             # a contiguous prefix above any host node
             assert sub.block is None, "device node below an evicted one"
-        if node.host is not None:
-            self.host_blocks_in_use -= 1
-            self.host_evictions += 1
+        self._shed_residency(node)
         if node.parent is not None:
             node.parent.children.pop(node.run, None)
         node.children.clear()
+
+    def _shed_residency(self, node: _Node) -> None:
+        if node.host is not None:
+            node.host = None
+            node.host_crc = None
+            self.host_blocks_in_use -= 1
+            self.host_evictions += 1
+        if node.disk is not None:
+            if self.disk is not None:
+                self.disk.delete(node.disk)
+            node.disk = None
+            self.disk_evictions += 1
+
+    def _seed_from_disk(self) -> None:
+        """Cold-boot warm start: fold the persisted manifest back into
+        the tree as disk-resident nodes, shortest chain first so a
+        parent always folds before its children.  An entry whose prefix
+        is missing (its ancestor's blob was swept, superseded, or shed)
+        or whose weight set no longer matches is an ORPHAN — dropped
+        typed, blob deleted.  Payloads stay on disk: the first lookup
+        hydrates and CRC-verifies them, so a rotted blob is a typed
+        refusal at restore time, never wrong attention now."""
+        from tpu_parallel.serving.kv_disk import DISK_WEIGHTS
+
+        bt = self.block_tokens
+        for entry in self.disk.entries():
+            tokens = entry.tokens
+            if len(tokens) == 0 or len(tokens) % bt != 0:
+                self.disk.delete(entry.blob)
+                self.disk_orphans_dropped += 1
+                continue
+            if entry.weights_version != self.weights_version:
+                self.disk.delete(entry.blob)
+                self.disk_orphans_dropped += 1
+                self.disk_failure_reasons[DISK_WEIGHTS] = (
+                    self.disk_failure_reasons.get(DISK_WEIGHTS, 0) + 1
+                )
+                continue
+            cur = self._root
+            ok = True
+            n_runs = len(tokens) // bt
+            for j in range(n_runs - 1):
+                cur = cur.children.get(tokens[j * bt : (j + 1) * bt])
+                if cur is None or cur.disk is None:
+                    # a chain is only restorable from block 0 — a hole
+                    # in the prefix closure orphans everything below it
+                    ok = False
+                    break
+            if not ok:
+                self.disk.delete(entry.blob)
+                self.disk_orphans_dropped += 1
+                continue
+            run = tokens[(n_runs - 1) * bt :]
+            child = cur.children.get(run)
+            if child is not None:
+                if child.disk is not None:
+                    # duplicate chain (a crash between put and delete):
+                    # first blob wins, this one is garbage
+                    self.disk.delete(entry.blob)
+                    self.disk_orphans_dropped += 1
+                    continue
+                child.disk = entry.blob
+            else:
+                child = _Node(run, cur, 0)
+                child.disk = entry.blob
+                cur.children[run] = child
+            self.disk_seeded_blocks += 1
+        self.disk_seeded_chains = sum(
+            1 for n in self._walk()
+            if n.disk is not None and not n.children
+        )

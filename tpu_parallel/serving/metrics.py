@@ -132,6 +132,33 @@ class ServingMetrics:
         self._kv_breaker_trips = r.gauge(
             "serving_kv_host_breaker_trips"
         )
+        # SSD KV tier (kv_disk.KVDiskStore under the radix hierarchy):
+        # occupancy + spill/restore tallies, the disk breaker mirror,
+        # and the persisted manifest's record/compaction counts —
+        # the restart-warm-start story's observability surface
+        self._kv_disk_blocks = r.gauge("serving_kv_disk_blocks")
+        self._kv_disk_bytes = r.gauge("serving_kv_disk_bytes")
+        self._kv_disk_spills = r.gauge("serving_kv_disk_spills")
+        self._kv_disk_restores = r.gauge("serving_kv_disk_restores")
+        self._kv_disk_restore_failures = r.gauge(
+            "serving_kv_disk_restore_failures"
+        )
+        self._kv_disk_breaker_state = r.gauge(
+            "serving_kv_disk_breaker_state"
+        )
+        self._kv_disk_breaker_trips = r.gauge(
+            "serving_kv_disk_breaker_trips"
+        )
+        self._kv_disk_manifest_records = r.gauge(
+            "serving_kv_disk_manifest_records"
+        )
+        self._kv_disk_manifest_compactions = r.gauge(
+            "serving_kv_disk_manifest_compactions"
+        )
+        self._kv_disk_seeded_blocks = r.gauge(
+            "serving_kv_disk_seeded_blocks"
+        )
+        self._disk_tier_seen = False
         # device-side NaN/Inf sentinel trips: per-request typed
         # integrity failures instead of streamed garbage
         self._integrity_trips = r.counter(
@@ -463,6 +490,26 @@ class ServingMetrics:
         self._kv_breaker_state.set(radix.breaker_state)
         self._kv_breaker_trips.set(radix.breaker_trips)
 
+    def sync_disk_tier(self, radix) -> None:
+        """Mirror the SSD tier's occupancy, spill/restore tallies, disk
+        breaker and manifest accounting off a radix cache with a
+        ``kv_disk.KVDiskStore`` attached."""
+        self._disk_tier_seen = True
+        self._kv_disk_blocks.set(radix.disk_blocks_in_use)
+        self._kv_disk_bytes.set(radix.disk_bytes)
+        self._kv_disk_spills.set(radix.disk_spills)
+        self._kv_disk_restores.set(radix.disk_restores)
+        self._kv_disk_restore_failures.set(radix.disk_restore_failures)
+        self._kv_disk_breaker_state.set(radix.disk_breaker_state)
+        self._kv_disk_breaker_trips.set(radix.disk_breaker_trips)
+        self._kv_disk_seeded_blocks.set(radix.disk_seeded_blocks)
+        store = radix.disk
+        if store is not None:
+            self._kv_disk_manifest_records.set(store.manifest_records)
+            self._kv_disk_manifest_compactions.set(
+                store.manifest_compactions
+            )
+
     def seed_block_pool(self, pool) -> None:
         """Watermark a paged pool's CUMULATIVE COW/share tallies so this
         record's delta-synced counters start at zero (``reset_metrics``
@@ -514,7 +561,7 @@ class ServingMetrics:
 
         probes = self.prefix_hits + self.prefix_misses
         qd_max = self._queue_depth.max
-        return {
+        out = {
             "ticks": self.ticks,
             "decode_ticks": self.decode_ticks,
             "prefills": self.prefills,
@@ -617,3 +664,36 @@ class ServingMetrics:
                 None if qd_max is None else int(qd_max)
             ),
         }
+        # SSD-tier rows only appear once a disk store has synced at
+        # least once — a summary without them means "no disk tier",
+        # which old consumers (and disk-less configs) rely on
+        if self._disk_tier_seen:
+            out.update(
+                {
+                    "kv_disk_blocks": int(self._kv_disk_blocks.value),
+                    "kv_disk_bytes": int(self._kv_disk_bytes.value),
+                    "kv_disk_spills": int(self._kv_disk_spills.value),
+                    "kv_disk_restores": int(
+                        self._kv_disk_restores.value
+                    ),
+                    "kv_disk_restore_failures": int(
+                        self._kv_disk_restore_failures.value
+                    ),
+                    "kv_disk_breaker_state": int(
+                        self._kv_disk_breaker_state.value
+                    ),
+                    "kv_disk_breaker_trips": int(
+                        self._kv_disk_breaker_trips.value
+                    ),
+                    "kv_disk_manifest_records": int(
+                        self._kv_disk_manifest_records.value
+                    ),
+                    "kv_disk_manifest_compactions": int(
+                        self._kv_disk_manifest_compactions.value
+                    ),
+                    "kv_disk_seeded_blocks": int(
+                        self._kv_disk_seeded_blocks.value
+                    ),
+                }
+            )
+        return out
