@@ -58,6 +58,10 @@ CHECKERS: Dict[str, str] = {
         "fleet/ routes through the iofaults shim (seeded disk-fault "
         "coverage)"
     ),
+    "check_trace": (
+        "every FleetTransport call site under fleet/ passes the trace "
+        "kwarg explicitly (no silently-untraced wire crossings)"
+    ),
 }
 
 # gates that RUN the product rather than parse it (slower; spawn

@@ -1,0 +1,136 @@
+"""Static check: every FleetTransport call site states its trace.
+
+Distributed tracing (docs/11_observability.md) only stitches a request
+into ONE cross-process timeline if every wire crossing either forwards
+a :class:`~tpu_parallel.obs.tracer.TraceContext` or deliberately
+declines to.  A transport call that simply OMITS the ``trace`` kwarg is
+the silent third option — the crossing happens, the receiving daemon
+records orphan spans under no trace id, and the stitched timeline
+quietly loses a leg.  That regression does not fail a unit test (the
+request still serves), so it gets a gate instead: under
+``tpu_parallel/fleet/``, every call to a transport method must pass the
+``trace`` keyword explicitly — ``trace=ctx.fork()`` on a traced
+crossing, ``trace=None`` where the crossing is intentionally untraced
+(probes, warm-start, reconcile).
+
+- Flagged: ``<anything>.transport.<method>(...)`` or
+  ``transport.<method>(...)`` for any method in the
+  :class:`FleetTransport` contract, without a ``trace=`` keyword.
+- Exempt: any call whose source line span carries a
+  ``# no-trace: <why>`` annotation — the escape hatch, same shape as
+  ``check_io``'s ``# raw-io:``.
+
+Registered in ``scripts/check_all.py`` and self-tested in
+``tests/test_checkers.py``.  Usage: ``python scripts/check_trace.py
+[paths...]`` — prints one violation per line, exits nonzero on any.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List
+
+DEFAULT_PATHS = ("tpu_parallel/fleet",)
+
+WHITELIST_MARK = "# no-trace:"
+
+# the FleetTransport contract (fleet/router.py) — keep in sync when the
+# contract grows a method; the self-test in tests/test_checkers.py
+# cross-checks this set against the ABC
+TRANSPORT_METHODS = frozenset({
+    "healthz",
+    "submit",
+    "result",
+    "cancel",
+    "stream",
+    "kv_export",
+    "kv_export_request",
+    "kv_import",
+    "metricsz",
+    "tracez",
+})
+
+
+def _is_transport_call(node: ast.Call) -> bool:
+    """``self.transport.<m>(...)``, ``router.transport.<m>(...)`` or a
+    bare ``transport.<m>(...)`` for a contract method ``<m>``."""
+    func = node.func
+    if not isinstance(func, ast.Attribute):
+        return False
+    if func.attr not in TRANSPORT_METHODS:
+        return False
+    recv = func.value
+    if isinstance(recv, ast.Attribute) and recv.attr == "transport":
+        return True
+    if isinstance(recv, ast.Name) and recv.id == "transport":
+        return True
+    return False
+
+
+def check_source(source: str, filename: str) -> List[str]:
+    """Return ``file:line: message`` strings for every transport call
+    in ``source`` that neither passes ``trace=`` nor carries the
+    ``# no-trace: <why>`` annotation on its line span."""
+    tree = ast.parse(source, filename=filename)
+    lines = source.splitlines()
+    problems: List[str] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not _is_transport_call(node):
+            continue
+        if any(kw.arg == "trace" for kw in node.keywords):
+            continue
+        span = lines[node.lineno - 1 : (node.end_lineno or node.lineno)]
+        if any(WHITELIST_MARK in line for line in span):
+            continue
+        problems.append(
+            f"{filename}:{node.lineno}: transport."
+            f"{node.func.attr}() without an explicit trace= kwarg "
+            "(pass trace=ctx.fork() on a traced crossing, trace=None "
+            "for a deliberately untraced one, or annotate "
+            "'# no-trace: <why>')"
+        )
+    return problems
+
+
+def check_paths(paths=DEFAULT_PATHS) -> List[str]:
+    problems: List[str] = []
+    for path in paths:
+        if not os.path.exists(path):
+            # a typo'd path must not walk zero files and report OK
+            raise FileNotFoundError(f"check_trace: no such path: {path}")
+        if os.path.isfile(path):
+            files = [path]
+        else:
+            files = sorted(
+                os.path.join(root, f)
+                for root, _, names in os.walk(path)
+                for f in names
+                if f.endswith(".py")
+            )
+        for fname in files:
+            with open(fname) as fh:
+                problems.extend(check_source(fh.read(), fname))
+    return problems
+
+
+def main(argv: List[str]) -> int:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    os.chdir(repo_root)
+    paths = argv[1:] or list(DEFAULT_PATHS)
+    problems = check_paths(paths)
+    for problem in problems:
+        print(problem, file=sys.stderr)
+    if problems:
+        print(
+            f"check_trace: {len(problems)} untraced transport call(s)",
+            file=sys.stderr,
+        )
+        return 1
+    print("check_trace: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -156,7 +156,8 @@ class Peer:
     """One daemon child the parent manages: fixed port, its journal,
     its ready file, and the live Popen handle (replaced on restart)."""
 
-    def __init__(self, tmpdir, name, port, role="mixed", tick_sleep=0.0):
+    def __init__(self, tmpdir, name, port, role="mixed", tick_sleep=0.0,
+                 trace_log=None):
         self.name = name
         self.port = port
         self.role = role
@@ -164,6 +165,7 @@ class Peer:
         self.addr = f"127.0.0.1:{port}"
         self.journal = os.path.join(tmpdir, f"{name}.jsonl")
         self.ready = os.path.join(tmpdir, f"{name}.ready.json")
+        self.trace_log = trace_log
         self.proc = None
         self.pid = None
 
@@ -176,6 +178,8 @@ class Peer:
             "--port", str(self.port), "--grace", str(grace),
             "--role", self.role, "--tick-sleep", str(self.tick_sleep),
         ]
+        if self.trace_log:
+            cmd += ["--trace-log", self.trace_log]
         env = dict(os.environ)
         env.setdefault("JAX_PLATFORMS", "cpu")
         self.proc = subprocess.Popen(cmd, env=env)
@@ -192,7 +196,7 @@ class Peer:
 
 
 def spawn_router(tmpdir, peer_addrs, warm_blocks=64, roles=None,
-                 name="router"):
+                 name="router", trace_log=None):
     ready = os.path.join(tmpdir, f"{name}.ready.json")
     if os.path.exists(ready):
         os.remove(ready)
@@ -203,6 +207,8 @@ def spawn_router(tmpdir, peer_addrs, warm_blocks=64, roles=None,
     ]
     if roles:
         cmd += ["--roles", ",".join(roles)]
+    if trace_log:
+        cmd += ["--trace-log", trace_log]
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
     return subprocess.Popen(cmd, env=env), ready
@@ -297,7 +303,24 @@ def serve(args):
     )
     from tpu_parallel.models import GPTLM, tiny_test
     from tpu_parallel.obs.registry import MetricRegistry
+    from tpu_parallel.obs.spool import SpanSpool
+    from tpu_parallel.obs.tracer import Tracer
     from tpu_parallel.serving import SchedulerConfig, ServingEngine
+
+    from tpu_parallel.daemon.wallclock import WallClock
+
+    # --trace-log arms distributed tracing: ONE tracer shared by the
+    # engines, the frontend and the daemon (so every layer's spans land
+    # in one list), spooled to the named JSONL by the daemon's tick.
+    # The tracer runs on the daemon's OWN clock — span timestamps and
+    # the ``ts`` this process reports on the wire must share a base or
+    # the stitcher's clock-offset math rebases against the wrong zero.
+    wallclock = WallClock()
+    tracer = Tracer(wallclock) if args.trace_log else None
+    spool = (
+        SpanSpool(args.trace_log, proc=f"daemon:{args.role}")
+        if args.trace_log else None
+    )
 
     cfg = tiny_test(remat=False)
     model = GPTLM(cfg)
@@ -312,7 +335,7 @@ def serve(args):
                 model, params, n_slots=args.slots,
                 scheduler=SchedulerConfig(max_prefills_per_tick=2),
                 kv_block_tokens=BLOCK_TOKENS, prefix_cache_size=64,
-                kv_radix_cache=True,
+                kv_radix_cache=True, tracer=tracer,
                 # one decode token per paced tick: the fused-scan
                 # default drains a whole budget in ~3 ticks, which no
                 # tick pacing can stretch — and mid-flight legs (kills,
@@ -324,7 +347,7 @@ def serve(args):
         fe = Frontend(
             engines, router="least",
             config=FrontendConfig(restart=None),
-            clock=clock, registry=MetricRegistry(),
+            clock=clock, registry=MetricRegistry(), tracer=tracer,
         )
         if args.tick_sleep > 0:
             # pace each pump tick like a realistically-sized model's
@@ -347,6 +370,8 @@ def serve(args):
             grace_seconds=args.grace, fsync_batch=args.fsync_batch,
             role=args.role,
         ),
+        clock=wallclock,
+        span_spool=spool,
     )
     server = DaemonHTTPServer(daemon, port=args.port).start()
     daemon.install_signals()
@@ -369,7 +394,18 @@ def route(args):
         PeerPolicy,
     )
     from tpu_parallel.obs.registry import MetricRegistry
+    from tpu_parallel.obs.spool import SpanSpool
+    from tpu_parallel.obs.tracer import Tracer
 
+    wallclock = WallClock()
+    # same-clock rule as serve(): the router's clock_sync attrs
+    # (t_send/t_recv on self.clock) and its span timestamps must share
+    # a base for the stitcher's rebasing to be exact
+    tracer = Tracer(wallclock) if args.trace_log else None
+    spool = (
+        SpanSpool(args.trace_log, proc="router")
+        if args.trace_log else None
+    )
     peers = [p for p in args.peers.split(",") if p]
     roles = None
     if args.roles:
@@ -379,7 +415,7 @@ def route(args):
         roles = dict(zip(peers, parts))
     router = FleetRouter(
         peers,
-        clock=WallClock(),
+        clock=wallclock,
         transport=HTTPFleetTransport(),
         roles=roles,
         # key placement on the shared-prefix head (2 KV blocks = 8
@@ -402,6 +438,8 @@ def route(args):
         ),
         registry=MetricRegistry(),
         warm_start_blocks=args.warm_blocks,
+        tracer=tracer,
+        span_spool=spool,
     )
     server = FleetHTTPServer(router, port=args.port).start()
     signal.signal(signal.SIGTERM, lambda *_: router.stop())
@@ -642,25 +680,130 @@ def direct_import_leg(donor_addrs, victim_addr, problems):
     return 0
 
 
+# -- the trace leg's stitch + verdict ----------------------------------------
+
+
+def stitch_and_judge(trace_out, router_log, peers, rids, evidence):
+    """Run ``scripts/trace_stitch.py`` over the router's and every
+    peer's span log, then judge the stitched forest: each request in
+    ``rids`` must map (via the router's ``route`` span) to a trace that
+    is single-rooted, touches >= 2 pids and carries a cross-process
+    parent link.  Fills ``evidence`` (the TRACE_r01 record) and returns
+    a problem list."""
+    from tpu_parallel.obs.spool import read_span_log
+
+    problems = []
+    cmd = [
+        sys.executable,
+        os.path.join(REPO_ROOT, "scripts", "trace_stitch.py"),
+        trace_out, router_log,
+    ] + [
+        f"{p.trace_log}={p.addr}" for p in peers if p.trace_log
+    ] + ["--summary"]
+    res = subprocess.run(cmd, capture_output=True, text=True)
+    if res.returncode != 0:
+        problems.append(
+            f"trace leg: stitch failed rc={res.returncode}: "
+            f"{res.stderr.strip()}"
+        )
+        return problems
+    try:
+        summary = json.loads(res.stdout)
+    except ValueError:
+        problems.append(
+            f"trace leg: stitch summary unparseable: {res.stdout!r}"
+        )
+        return problems
+    with open(trace_out) as fh:
+        stitched = json.load(fh)
+
+    # rid -> trace id, from the router's root spans
+    records, _skipped = read_span_log(router_log)
+    trace_of_rid = {}
+    span_counts = {"router": len(records)}
+    for rec in records:
+        if rec.get("kind") == "span" and rec.get("name") == "route":
+            rid = (rec.get("attrs") or {}).get("rid")
+            if rid and rec.get("trace_id"):
+                trace_of_rid[rid] = rec["trace_id"]
+    for p in peers:
+        if p.trace_log:
+            peer_records, _ = read_span_log(p.trace_log)
+            span_counts[p.name] = len(peer_records)
+
+    connected = 0
+    for tok, rid in sorted(rids.items()):
+        trace_id = trace_of_rid.get(rid)
+        verdict = summary.get(trace_id) if trace_id else None
+        if verdict is None:
+            problems.append(
+                f"trace leg: {tok} ({rid}) has no stitched trace"
+            )
+            continue
+        broken = []
+        if not verdict.get("single_rooted"):
+            broken.append(f"roots={verdict.get('roots')}")
+        if len(verdict.get("pids", [])) < 2:
+            broken.append(f"pids={verdict.get('pids')}")
+        if verdict.get("cross_process_links", 0) < 1:
+            broken.append("no cross-process link")
+        if broken:
+            problems.append(
+                f"trace leg: {tok} trace not connected: "
+                + ", ".join(broken)
+            )
+        else:
+            connected += 1
+    flow_arrows = stitched.get("metadata", {}).get("flow_arrows", 0)
+    if flow_arrows < 1:
+        problems.append(
+            "trace leg: stitched file carries no flow arrows"
+        )
+    evidence.update({
+        "trace_file": trace_out,
+        "requests": len(rids),
+        "connected_traces": connected,
+        "completeness": (
+            round(connected / len(rids), 4) if rids else None
+        ),
+        "span_counts": span_counts,
+        "stitched_traces": len(summary),
+        "flow_arrows": flow_arrows,
+        "trace_events": len(stitched.get("traceEvents", [])),
+    })
+    return problems
+
+
 # -- modes -------------------------------------------------------------------
 
 
-def run_smoke(tmpdir=None, keep=False):
+def run_smoke(tmpdir=None, keep=False, trace_out="", record=""):
     """router + 2 daemons -> traffic -> one SIGKILL mid-stream (bitwise
     handoff) -> victim restart (remote KV warm start) -> corrupt-import
     refusal -> graceful stop.  The gate check_fleet and tier-1 run.
-    Returns a problem list."""
+    Returns a problem list.
+
+    The TRACE leg rides the disagg leg: the daemons spool spans from
+    boot, the disagg router runs traced, and after it drains the three
+    span logs are stitched (``scripts/trace_stitch.py``) into ONE
+    Perfetto file — every disagg request must form a single-rooted
+    trace crossing >= 2 pids with a cross-process parent link.
+    ``trace_out`` names the stitched file (default: inside tmpdir);
+    ``record`` writes the TRACE_r01-shape evidence JSON."""
     import tempfile
 
     problems = []
     tmpdir = tmpdir or tempfile.mkdtemp(prefix="fleet_smoke_")
+    trace_out = trace_out or os.path.join(tmpdir, "stitched_trace.json")
     ports = pick_ports(2)
     # paced ticks: the tiny model must not outrun the mid-flight legs
     # (the kill and the disagg migration both race one HTTP round-trip)
     peers = [
-        Peer(tmpdir, f"d{i}", p, tick_sleep=0.01)
+        Peer(tmpdir, f"d{i}", p, tick_sleep=0.01,
+             trace_log=os.path.join(tmpdir, f"d{i}.trace.jsonl"))
         for i, p in enumerate(ports)
     ]
+    trace_evidence = {}
     by_addr = {p.addr: p for p in peers}
     router_proc = None
     try:
@@ -836,9 +979,11 @@ def run_smoke(tmpdir=None, keep=False):
             "max_new_tokens": HANDOFF_NEW_TOKENS,
         }
         refs_d = greedy_references(d_entries + [kill_entry])
+        router2_log = os.path.join(tmpdir, "router2.trace.jsonl")
         router2, r2ready = spawn_router(
             tmpdir, [p.addr for p in peers],
             roles=["prefill", "decode"], name="router2",
+            trace_log=router2_log,
         )
         try:
             r2port = wait_ready(r2ready, router2)["port"]
@@ -888,6 +1033,128 @@ def run_smoke(tmpdir=None, keep=False):
                     f"(disagg={migrated}, fallbacks="
                     f"{read_metric_sum(base2, 'fleet_handoff_fallbacks_total')})"
                 )
+
+            # ---- trace leg, live surfaces: per-request attribution
+            # (/v1/requestz), the raw span feed (/v1/tracez), and the
+            # aggregated fleet exposition (peer-labelled /metricsz)
+            probe_rid = next(iter(rids_d.values()), None)
+            if probe_rid is not None:
+                code, tl = http_json(
+                    "GET", f"{base2}/v1/requestz/{probe_rid}"
+                )
+                if code != 200 or not tl.get("trace_id"):
+                    problems.append(
+                        f"trace leg: requestz {code}: {tl}"
+                    )
+                elif not tl.get("phases"):
+                    problems.append(
+                        f"trace leg: requestz has no phase "
+                        f"attribution: {tl}"
+                    )
+                elif len(tl.get("processes", [])) < 2:
+                    problems.append(
+                        "trace leg: requestz stitched fewer than 2 "
+                        f"processes: {tl.get('processes')}"
+                    )
+            code, tz = http_json("GET", f"{base2}/v1/tracez")
+            if code != 200 or not tz.get("records"):
+                problems.append(
+                    f"trace leg: router tracez empty: {code}"
+                )
+            with urllib.request.urlopen(
+                f"{base2}/metricsz", timeout=30
+            ) as resp:
+                fleet_text = resp.read().decode()
+            if not any(
+                line.startswith("daemon_") and 'peer="' in line
+                for line in fleet_text.splitlines()
+            ):
+                problems.append(
+                    "trace leg: fleet /metricsz re-exports no "
+                    "peer-labelled daemon_* series"
+                )
+            if "fleet:" not in fleet_text:
+                problems.append(
+                    "trace leg: fleet /metricsz carries no fleet-level "
+                    "sum series"
+                )
+            if "fleet_phase_seconds" not in fleet_text:
+                problems.append(
+                    "trace leg: no fleet_phase_seconds histogram "
+                    "observed"
+                )
+
+            # ---- overhead leg: the same cold schedule through an
+            # UNTRACED role-pinned router vs the traced one — tracing
+            # must not tax the serve path measurably
+            rnd_o = random.Random(45)
+
+            def oh_batch(tag):
+                return [
+                    {
+                        "dedupe_token": f"fleet-oh-{tag}-{i}",
+                        "prompt": [
+                            rnd_o.randrange(1, 250) for _ in range(11)
+                        ],
+                        "max_new_tokens": DEFAULT_NEW_TOKENS,
+                    }
+                    for i in range(4)
+                ]
+
+            batch_plain, batch_traced = oh_batch("p"), oh_batch("t")
+            refs_oh = greedy_references(batch_plain + batch_traced)
+            router2b, r2bready = spawn_router(
+                tmpdir, [p.addr for p in peers],
+                roles=["prefill", "decode"], name="router2b",
+            )
+            try:
+                r2bport = wait_ready(r2bready, router2b)["port"]
+                base2b = f"http://127.0.0.1:{r2bport}"
+
+                def timed_batch(base_url, batch):
+                    t0 = time.monotonic()
+                    rids = {}
+                    for entry in batch:
+                        code, rec = http_json(
+                            "POST", f"{base_url}/v1/submit", entry
+                        )
+                        if code == 200:
+                            rids[entry["dedupe_token"]] = (
+                                rec["request_id"]
+                            )
+                        else:
+                            problems.append(
+                                f"overhead submit {code}: {rec}"
+                            )
+                    wait_finished(
+                        base_url, rids, refs_oh, problems,
+                        label="overhead: ",
+                    )
+                    return time.monotonic() - t0
+
+                t_plain = timed_batch(base2b, batch_plain)
+                t_traced = timed_batch(base2, batch_traced)
+                overhead = max(0.0, t_traced / max(t_plain, 1e-9) - 1.0)
+                trace_evidence["overhead"] = {
+                    "untraced_seconds": round(t_plain, 3),
+                    "traced_seconds": round(t_traced, 3),
+                    "ratio": round(overhead, 4),
+                }
+                # generous gate bound: batches this small are noisy on
+                # a 1-core box; the recorded artifact carries the
+                # measured ratio for the <=5% acceptance judgment
+                if overhead > 0.25:
+                    problems.append(
+                        "trace leg: traced serve path "
+                        f"{overhead:.1%} slower than untraced"
+                    )
+                stop_gracefully(router2b, problems, "router2b")
+                router2b = None
+            finally:
+                if router2b is not None and router2b.poll() is None:
+                    router2b.kill()
+                    router2b.wait(timeout=30)
+
             # kill the decode peer; fresh work falls back TYPED
             peers[1].sigkill()
             code, rec = http_json("POST", f"{base2}/v1/submit", kill_entry)
@@ -923,6 +1190,19 @@ def run_smoke(tmpdir=None, keep=False):
         # bring the decode daemon back so the fleet drains gracefully
         peers[1].spawn()
         peers[1].wait_ready()
+
+        # ---- trace leg, stitching: the three span logs -> ONE
+        # Perfetto file via the CLI, then judge connectivity — every
+        # disagg request must be a single-rooted trace crossing >= 2
+        # pids with a cross-process parent link (the flow arrow)
+        trace_problems = stitch_and_judge(
+            trace_out, router2_log, peers, rids_d, trace_evidence
+        )
+        problems.extend(trace_problems)
+        if record:
+            with open(record, "w") as fh:
+                json.dump(trace_evidence, fh, indent=2)
+                fh.write("\n")
 
         # ---- graceful stop: router first, then the daemons
         stop_gracefully(router_proc, problems, "router")
@@ -1472,6 +1752,13 @@ def main():
     ap.add_argument("--trials", type=int, default=3)
     ap.add_argument("--workdir", type=str, default="")
     ap.add_argument("--record", type=str, default="")
+    ap.add_argument("--trace-log", type=str, default="",
+                    help="arm tracing in a child (--serve/--route): "
+                         "spool spans to this JSONL, served at "
+                         "/v1/tracez")
+    ap.add_argument("--trace-out", type=str, default="",
+                    help="smoke/disagg: write the stitched Perfetto "
+                         "trace here (also enables the trace leg)")
     args = ap.parse_args()
 
     if args.serve:
@@ -1483,7 +1770,9 @@ def main():
             ap.error("--route needs --peers and --ready-file")
         sys.exit(route(args))
     if args.smoke:
-        problems = run_smoke()
+        problems = run_smoke(
+            trace_out=args.trace_out, record=args.record,
+        )
     elif args.soak is not None:
         problems = run_soak(args)
     elif args.disagg is not None:

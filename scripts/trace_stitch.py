@@ -1,0 +1,161 @@
+"""Collect N processes' span logs and stitch ONE Perfetto timeline.
+
+The fleet's tracing story ends here: every process spooled its spans
+locally (``tpu_parallel/obs/spool.py``, served at ``GET /v1/tracez``),
+and this CLI gathers those per-process views — from span-log FILES on
+disk, from live ``/v1/tracez`` ENDPOINTS, or both — rebases them onto
+the router's clock via the spooled ``clock_sync`` samples, and writes
+one Chrome/Perfetto trace-event JSON with one pid per process and flow
+arrows across every wire crossing (``tpu_parallel/obs/stitch.py`` does
+the math; docs/11_observability.md tells the story).
+
+Usage::
+
+    python scripts/trace_stitch.py out.json LOG[=ADDR] ... \
+        [--url HOST:PORT ...] [--trace-id ID] [--summary]
+
+- ``LOG[=ADDR]`` — a span-log JSONL file; the optional ``=ADDR`` names
+  the ``host:port`` the router knows this process by, which is how its
+  records join the router's ``clock_sync`` samples for EXACT alignment
+  (without it, the stitcher falls back to earliest-record alignment).
+- ``--url HOST:PORT`` — fetch ``http://HOST:PORT/v1/tracez`` live; the
+  address doubles as the clock-alignment key.
+- ``--trace-id ID`` — filter every source to one trace.
+- ``--summary`` — also print the per-trace verdict (span count, pids,
+  single-rootedness, cross-process links) as JSON on stdout.
+
+Exit status is nonzero when no records were collected — an empty
+stitch is a misconfiguration, not a timeline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, List, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from tpu_parallel.obs.spool import read_span_log  # noqa: E402
+from tpu_parallel.obs.stitch import (  # noqa: E402
+    stitch_traces,
+    trace_summary,
+)
+
+
+def _proc_from_log(path: str, addr: Optional[str],
+                   trace_id: Optional[str]) -> Dict:
+    """One stitchable process view from a span-log file.  Name and pid
+    come from the log's own meta record — the process stamped them."""
+    records, skipped = read_span_log(path, trace_id=trace_id)
+    meta = next((r for r in records if r.get("kind") == "meta"), {})
+    proc = {
+        "name": meta.get("proc", path),
+        "pid": meta.get("pid", 0),
+        "records": records,
+        "skipped": skipped,
+    }
+    if addr:
+        proc["addr"] = addr
+    return proc
+
+
+def _proc_from_url(addr: str, trace_id: Optional[str],
+                   timeout: float) -> Dict:
+    """One stitchable process view from a live ``/v1/tracez``."""
+    query = (
+        f"?trace_id={urllib.parse.quote(trace_id, safe='')}"
+        if trace_id else ""
+    )
+    url = f"http://{addr}/v1/tracez{query}"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        payload = json.loads(resp.read())
+    return {
+        "name": payload.get("proc", addr),
+        "pid": payload.get("pid", 0),
+        "addr": addr,
+        "records": payload.get("records", []),
+        "skipped": payload.get("skipped", {}),
+    }
+
+
+def collect(
+    logs: List[str],
+    urls: List[str],
+    trace_id: Optional[str] = None,
+    timeout: float = 10.0,
+) -> List[Dict]:
+    """Gather every named source into stitch_traces' input shape.  A
+    file that does not exist yields an empty view (read_span_log's
+    contract); an unreachable URL is a hard error — the operator named
+    a live endpoint and should hear that it is not one."""
+    processes: List[Dict] = []
+    for spec in logs:
+        path, _, addr = spec.partition("=")
+        processes.append(_proc_from_log(path, addr or None, trace_id))
+    for addr in urls:
+        try:
+            processes.append(_proc_from_url(addr, trace_id, timeout))
+        except (urllib.error.URLError, OSError, ValueError) as exc:
+            raise SystemExit(f"trace_stitch: {addr}/v1/tracez: {exc}")
+    return processes
+
+
+def main(argv: List[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="trace_stitch",
+        description="stitch N span logs into one Perfetto trace",
+    )
+    ap.add_argument("out", help="output trace-event JSON path")
+    ap.add_argument(
+        "logs", nargs="*",
+        help="span-log files, each optionally LOG=ADDR for clock "
+             "alignment against the router's clock_sync samples",
+    )
+    ap.add_argument(
+        "--url", action="append", default=[], metavar="HOST:PORT",
+        help="fetch a live /v1/tracez (repeatable)",
+    )
+    ap.add_argument("--trace-id", default=None)
+    ap.add_argument("--timeout", type=float, default=10.0)
+    ap.add_argument(
+        "--summary", action="store_true",
+        help="print the per-trace verdict JSON on stdout",
+    )
+    args = ap.parse_args(argv[1:])
+    if not args.logs and not args.url:
+        ap.error("need at least one span log or --url")
+
+    processes = collect(
+        args.logs, args.url, trace_id=args.trace_id,
+        timeout=args.timeout,
+    )
+    total = sum(len(p["records"]) for p in processes)
+    if total == 0:
+        print("trace_stitch: no records collected", file=sys.stderr)
+        return 1
+    trace = stitch_traces(processes)
+    with open(args.out, "w") as fh:
+        json.dump(trace, fh)
+    summary = trace_summary(processes)
+    print(
+        f"trace_stitch: {len(processes)} process(es), {total} records, "
+        f"{len(trace['traceEvents'])} events, "
+        f"{trace['metadata']['flow_arrows']} flow arrow(s), "
+        f"{len(summary)} trace(s) -> {args.out}",
+        file=sys.stderr,
+    )
+    if args.summary:
+        json.dump(summary, sys.stdout, indent=2)
+        print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

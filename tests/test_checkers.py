@@ -430,6 +430,60 @@ def test_check_io_semantics():
     assert "check_io" in ca.CHECKERS
 
 
+def test_check_trace_semantics():
+    """The trace gate (tracing PR): a FleetTransport call site under
+    fleet/ that omits ``trace=`` is flagged — whether spelled
+    ``self.transport.<m>`` or bare ``transport.<m>`` — while an
+    explicit ``trace=ctx`` / ``trace=None``, a ``# no-trace: <why>``
+    annotation (including on a wrapped call's span), and same-named
+    methods on non-transport receivers all stay legal."""
+    ct = _load("check_trace")
+    bad = (
+        "def probe(self, addr):\n"
+        "    code, body = self.transport.healthz(addr, 5.0)\n"
+        "def route(transport, addr, body):\n"
+        "    return transport.submit(addr, body, 5.0)\n"
+    )
+    found = ct.check_source(bad, "tpu_parallel/fleet/router.py")
+    assert len(found) == 2, found
+    assert any("healthz" in p and ":2:" in p for p in found)
+    assert any("submit" in p and ":4:" in p for p in found)
+    ok = (
+        "def probe(self, addr, ctx):\n"
+        "    a = self.transport.healthz(addr, 5.0, trace=None)\n"
+        "    b = self.transport.submit(addr, {}, 5.0, trace=ctx.fork())\n"
+        "    c = self.transport.result(addr, 'r', 5.0)  # no-trace: replay\n"
+        "    d = self.transport.cancel(\n"
+        "        addr, 'r', 5.0,\n"
+        "    )  # no-trace: wrapped-call annotation spans lines\n"
+        "    e = self.daemon.submit({})\n"
+        "    f = client.submit(addr, {})\n"
+        "    return a, b, c, d, e, f\n"
+    )
+    assert ct.check_source(ok, "tpu_parallel/fleet/router.py") == []
+    with pytest.raises(FileNotFoundError):
+        ct.check_paths((os.path.join(REPO_ROOT, "no_such_dir"),))
+    # registered: the registry sweep covers it with zero extra wiring
+    assert "check_trace" in check_all.CHECKERS
+
+
+def test_check_trace_matches_transport_contract():
+    """The gate's method set IS the FleetTransport contract: a method
+    added to the ABC without updating the gate (or vice versa) fails
+    here, so the two cannot drift apart silently."""
+    ct = _load("check_trace")
+    from tpu_parallel.fleet.router import FleetTransport
+
+    contract = {
+        name
+        for name in vars(FleetTransport)
+        if not name.startswith("_") and callable(
+            getattr(FleetTransport, name)
+        )
+    }
+    assert ct.TRANSPORT_METHODS == contract
+
+
 def test_check_io_fences_kv_disk_tier():
     """The SSD KV tier is in the IO gate's default sweep: the live
     module passes (every byte routes through iofaults), and a planted

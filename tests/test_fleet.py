@@ -430,11 +430,14 @@ class FakeDaemon:
         self.kv_import_response = (200, {"verdicts": {}})
         self.kv_imports = []
         self.kv_request_exports = []
+        self.metrics_text = ""  # served by FakeTransport.metricsz
+        self.trace_records = []  # served by FakeTransport.tracez
 
 
 class FakeTransport(FleetTransport):
     def __init__(self, daemons):
         self.daemons = {d.addr: d for d in daemons}
+        self.traces = []  # every TraceContext any call carried
 
     def _d(self, addr):
         d = self.daemons.get(addr)
@@ -442,7 +445,12 @@ class FakeTransport(FleetTransport):
             raise TransportError(addr, "connection refused")
         return d
 
-    def healthz(self, addr, timeout):
+    def _note_trace(self, trace):
+        if trace is not None:
+            self.traces.append(trace)
+
+    def healthz(self, addr, timeout, trace=None):
+        self._note_trace(trace)
         d = self._d(addr)
         return 200, {
             "ok": True, "role": d.role,
@@ -452,7 +460,8 @@ class FakeTransport(FleetTransport):
             },
         }
 
-    def submit(self, addr, body, timeout):
+    def submit(self, addr, body, timeout, trace=None):
+        self._note_trace(trace)
         d = self._d(addr)
         if d.role == "decode" and body.get("phase") != "decode":
             # the real daemon's typed role gate: fresh work bounces,
@@ -469,7 +478,8 @@ class FakeTransport(FleetTransport):
         d.requests[rid] = script
         return 200, {"request_id": rid, "status": "queued"}
 
-    def result(self, addr, rid, timeout):
+    def result(self, addr, rid, timeout, trace=None):
+        self._note_trace(trace)
         d = self._d(addr)
         script = d.requests.get(rid)
         if script is None:
@@ -485,12 +495,14 @@ class FakeTransport(FleetTransport):
             "tokens": list(script["tokens"]), "finish_reason": "length",
         }
 
-    def cancel(self, addr, rid, timeout):
+    def cancel(self, addr, rid, timeout, trace=None):
+        self._note_trace(trace)
         d = self._d(addr)
         d.cancels.append(rid)
         return 200, {"cancelled": rid}
 
-    def stream(self, addr, rid, idle_timeout):
+    def stream(self, addr, rid, idle_timeout, trace=None):
+        self._note_trace(trace)
         d = self._d(addr)
         script = d.requests.get(rid)
         if script is None:
@@ -513,19 +525,32 @@ class FakeTransport(FleetTransport):
 
         return events()
 
-    def kv_export(self, addr, max_blocks, timeout):
+    def kv_export(self, addr, max_blocks, timeout, trace=None):
+        self._note_trace(trace)
         d = self._d(addr)
         return d.kv_export_code, d.kv_blob
 
-    def kv_export_request(self, addr, rid, timeout):
+    def kv_export_request(self, addr, rid, timeout, trace=None):
+        self._note_trace(trace)
         d = self._d(addr)
         d.kv_request_exports.append(rid)
         return d.kv_export_code, d.kv_blob
 
-    def kv_import(self, addr, blob, timeout):
+    def kv_import(self, addr, blob, timeout, trace=None):
+        self._note_trace(trace)
         d = self._d(addr)
         d.kv_imports.append(blob)
         return d.kv_import_response
+
+    def metricsz(self, addr, timeout, trace=None):
+        d = self._d(addr)
+        return 200, getattr(d, "metrics_text", "")
+
+    def tracez(self, addr, trace_id, timeout, trace=None):
+        d = self._d(addr)
+        return 200, {"proc": addr, "pid": 0,
+                     "records": list(getattr(d, "trace_records", [])),
+                     "skipped": {}}
 
 
 def _fleet(n=2, **router_kw):

@@ -22,11 +22,13 @@ from tpu_parallel.obs import (
     PercentileWindow,
     Tracer,
     chrome_trace_events,
+    parse_prometheus_text,
     prometheus_lines,
     prometheus_text,
     validate_snapshot,
     write_chrome_trace,
 )
+from tpu_parallel.obs.exporters import _prom_labels
 from tpu_parallel.obs.registry import Histogram
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -398,6 +400,42 @@ def test_prometheus_text_parses_line_by_line():
         if not ln.startswith("#")
     )
     assert _PROM_SAMPLE.match(sample), sample
+
+
+def test_prometheus_label_escaping_roundtrips_through_parser():
+    """The escaping regression test the fleet aggregator depends on:
+    a peer label value containing every escape-worthy character
+    (backslash, double-quote, newline — and the adversarial ``\\n``
+    TEXT sequence that naive chained str.replace corrupts) must render
+    through ``prometheus_text`` and come back BYTE-IDENTICAL through
+    ``parse_prometheus_text``.  The router re-exports peer series via
+    exactly this parse -> relabel -> render loop, so a one-way escape
+    bug would corrupt every aggregated fleet metric."""
+    nasty = {
+        'a"b': 'quote"inside',
+        "back\\slash": "trailing\\",
+        "newline": "two\nlines",
+        "combo": 'mix\\"of\n all',
+        "literal_backslash_n": "not\\na newline",  # \\ then n, NOT \n
+    }
+    r = MetricRegistry()
+    for key, value in nasty.items():
+        r.counter("fleet_echo_total", peer=value, which=key).inc()
+    text = prometheus_text(r)
+    samples = [
+        s for s in parse_prometheus_text(text)
+        if s["name"] == "fleet_echo_total"
+    ]
+    assert len(samples) == len(nasty)
+    recovered = {s["labels"]["which"]: s["labels"]["peer"]
+                 for s in samples}
+    assert recovered == nasty  # every label value back verbatim
+    # and a second render of the parsed samples is stable: render ->
+    # parse -> render must be a fixed point for the label bodies
+    for s in samples:
+        line = "fleet_echo_total" + _prom_labels(s["labels"]) + " 1"
+        (reparsed,) = parse_prometheus_text(line)
+        assert reparsed["labels"] == s["labels"]
 
 
 def test_jsonl_exporter_rebases_registry_onto_metric_logger(tmp_path):

@@ -1,0 +1,358 @@
+"""Cross-process trace stitching: fake-clock fleets through the REAL
+span pipeline.
+
+Every test here drives the production path end to end — two
+:class:`~tpu_parallel.obs.tracer.Tracer` instances on deliberately
+skewed fake clocks, spans spooled through :class:`SpanSpool` (CRC'd
+JSONL, iofaults IO), read back with :func:`read_span_log`, stitched by
+:mod:`tpu_parallel.obs.stitch` — because the contract under test is the
+COMPOSITION: the router's forked span id must thread through the spool
+round-trip and come out the other side as a single-rooted tree with a
+flow arrow, and a seeded clock skew must cancel to within the sync
+sample's RTT.  The damage tests corrupt real spool bytes (garbage line,
+checksum tamper) and assert the reader skips them TYPED, never fatally.
+"""
+
+import importlib.util
+import json
+import os
+import random
+from unittest import mock
+
+import pytest
+
+from tpu_parallel.obs.spool import SpanSpool, read_span_log
+from tpu_parallel.obs.stitch import (
+    clock_offsets,
+    phase_breakdown,
+    stitch_traces,
+    trace_summary,
+)
+from tpu_parallel.obs.tracer import TraceContext, Tracer
+
+ADDR = "127.0.0.1:9101"
+RID = "req-stitch-1"
+
+# the seeded cross-host skew every fixture injects: the daemon's clock
+# reads 1000s ahead of the router's.  Any stitched daemon timestamp
+# that is not rebased by ~-1000s is wildly, visibly wrong.
+SKEW = 1000.0
+
+# the sync sample's send/recv window (symmetric here, so the offset
+# estimate is exact; its RTT is still the honest error bound)
+T_SEND, T_RECV = 0.01, 0.05
+SYNC_RTT = T_RECV - T_SEND
+
+
+class FakeClock:
+    """A settable monotonic clock: ``base`` + whatever the test adds."""
+
+    def __init__(self, base=0.0):
+        self.now = base
+
+    def __call__(self):
+        return self.now
+
+
+def _fleet_processes(tmp_path):
+    """One traced request crossing router -> daemon, through the REAL
+    pipeline: two Tracers on skewed fake clocks, spans stamped via
+    bind_trace, spooled to disk, read back.  Returns
+    ``(processes, ctx, wire_ctx)`` in stitch_traces' input shape.
+
+    Router timeline (its own clock): route [0.0, 0.5] owning
+    wire:submit [0.01, 0.05] and wire:kv_import [0.30, 0.34]; one
+    clock_sync sample around the submit.  Daemon timeline (router time
+    + SKEW): queue [0.06, 0.08], prefill [0.08, 0.20],
+    decode [0.20, 0.45].
+    """
+    router = Tracer(FakeClock())
+    ctx = TraceContext.new()
+    router.bind_trace(RID, ctx)
+
+    root = router.record("route", "fleet", 0.0, 0.5, rid=RID)
+    # the router's root discipline: the root span IS the minted
+    # context — its own id, no parent (fleet/router.py does exactly
+    # this, so receiver spans have a resolvable ancestor)
+    root.span_id = ctx.span_id
+    root.parent_id = None
+
+    wire_ctx = ctx.fork()
+    wire = router.record(
+        "wire:submit", "fleet", T_SEND, T_RECV, rid=RID, peer=ADDR
+    )
+    wire.span_id = wire_ctx.span_id  # receiver spans parent HERE
+
+    kv_ctx = ctx.fork()
+    kv = router.record(
+        "wire:kv_import", "fleet", 0.30, 0.34, rid=RID, peer=ADDR,
+        bytes=2048,
+    )
+    kv.span_id = kv_ctx.span_id
+
+    router.instant(
+        "clock_sync", track="fleet", peer=ADDR,
+        t_send=T_SEND, t_recv=T_RECV, peer_ts=SKEW + (T_SEND + T_RECV) / 2,
+    )
+    router.release_trace(RID)
+
+    daemon = Tracer(FakeClock(SKEW))
+    daemon.bind_trace(RID, wire_ctx)
+    daemon.record(
+        "queue", "scheduler", SKEW + 0.06, SKEW + 0.08, request_id=RID
+    )
+    daemon.record(
+        "prefill", "slot 0", SKEW + 0.08, SKEW + 0.20, request_id=RID
+    )
+    daemon.record(
+        "decode", "slot 0", SKEW + 0.20, SKEW + 0.45, request_id=RID
+    )
+    daemon.release_trace(RID)
+
+    processes = []
+    for name, pid, tracer, extra in (
+        ("router", 101, router, {}),
+        ("daemon:serve", 202, daemon, {"addr": ADDR}),
+    ):
+        path = os.path.join(str(tmp_path), f"{name.replace(':', '_')}.jsonl")
+        # both "processes" live in this one test process; stamp the
+        # fleet pids a real deployment would have (SpanSpool captures
+        # the pid at construction)
+        with mock.patch("os.getpid", return_value=pid):
+            spool = SpanSpool(path, proc=name)
+        assert spool.drain(tracer) > 0
+        spool.close()
+        records, skipped = read_span_log(path)
+        assert skipped == {"garbage": 0, "crc": 0}
+        proc = {"name": name, "pid": pid, "records": records,
+                "skipped": skipped}
+        proc.update(extra)
+        processes.append(proc)
+    return processes, ctx, wire_ctx
+
+
+# -- the stitched verdict ---------------------------------------------------
+
+
+def test_fleet_trace_is_single_rooted_across_processes(tmp_path):
+    processes, ctx, _wire_ctx = _fleet_processes(tmp_path)
+    summary = trace_summary(processes)
+    assert list(summary) == [ctx.trace_id]
+    verdict = summary[ctx.trace_id]
+    assert verdict["spans"] == 6  # route, 2x wire, queue, prefill, decode
+    assert verdict["pids"] == [101, 202]
+    assert verdict["roots"] == 1
+    assert verdict["single_rooted"] is True
+    # queue, prefill and decode all parent to the router's wire span
+    assert verdict["cross_process_links"] == 3
+
+
+def test_stitch_recovers_seeded_skew_within_sync_rtt(tmp_path):
+    processes, _ctx, _wire_ctx = _fleet_processes(tmp_path)
+    trace = stitch_traces(processes)
+    meta = {p["name"]: p for p in trace["metadata"]["processes"]}
+    # the daemon's 1000s skew cancels to within the sync sample's RTT
+    assert abs(meta["daemon:serve"]["clock_offset_seconds"] + SKEW) \
+        <= SYNC_RTT
+    queue = next(
+        ev for ev in trace["traceEvents"]
+        if ev.get("ph") == "X" and ev.get("name") == "queue"
+    )
+    # true router-frame start is 0.06s; the stitched microsecond
+    # timestamp must land within the RTT error bound, not 1000s away
+    assert abs(queue["ts"] - 0.06e6) <= SYNC_RTT * 1e6
+    assert queue["pid"] == 202
+
+
+def test_stitch_draws_flow_arrow_across_the_wire(tmp_path):
+    processes, ctx, wire_ctx = _fleet_processes(tmp_path)
+    trace = stitch_traces(processes)
+    assert trace["metadata"]["flow_arrows"] == 1
+    starts = [ev for ev in trace["traceEvents"] if ev.get("ph") == "s"]
+    ends = [ev for ev in trace["traceEvents"] if ev.get("ph") == "f"]
+    assert len(starts) == 1 and len(ends) == 1
+    assert starts[0]["id"] == ends[0]["id"]
+    assert ctx.trace_id in starts[0]["id"]
+    # the arrow leaves the router's wire span and lands on the
+    # daemon's first span — distinct pids, or it proved nothing
+    assert starts[0]["pid"] == 101
+    assert ends[0]["pid"] == 202
+    assert ends[0]["bp"] == "e"
+
+
+def test_dropped_context_shows_up_as_second_root():
+    """The failure mode the check_trace gate exists for: a crossing
+    that forgot the trace kwarg leaves the receiver's spans parented to
+    an id nobody recorded — the summary must call that out as a second
+    root, not quietly report a healthy tree."""
+    tid = "f" * 32
+    router_rec = {"kind": "span", "name": "route", "track": "fleet",
+                  "start": 0.0, "end": 0.5, "trace_id": tid,
+                  "span_id": "a" * 16, "parent_id": None, "attrs": {}}
+    orphan_rec = {"kind": "span", "name": "queue", "track": "scheduler",
+                  "start": 0.1, "end": 0.2, "trace_id": tid,
+                  "span_id": "b" * 16, "parent_id": "c" * 16, "attrs": {}}
+    summary = trace_summary([
+        {"name": "router", "pid": 1, "records": [router_rec]},
+        {"name": "daemon", "pid": 2, "records": [orphan_rec]},
+    ])
+    verdict = summary[tid]
+    assert verdict["roots"] == 2
+    assert verdict["single_rooted"] is False
+    assert verdict["cross_process_links"] == 0
+
+
+def test_phase_breakdown_attributes_the_fleet_trace(tmp_path):
+    processes, _ctx, _wire_ctx = _fleet_processes(tmp_path)
+    spans = [r for p in processes for r in p["records"]
+             if r.get("kind") == "span"]
+    breakdown = phase_breakdown(spans)
+    assert breakdown["spans"] == 6
+    phases = breakdown["phases"]
+    assert phases["queue"]["seconds"] == pytest.approx(0.02)
+    assert phases["prefill"]["seconds"] == pytest.approx(0.12)
+    assert phases["decode"]["seconds"] == pytest.approx(0.25)
+    assert phases["wire"]["seconds"] == pytest.approx(SYNC_RTT)
+    assert phases["kv_wire"]["count"] == 1
+    assert breakdown["kv_wire_bytes"] == 2048
+
+
+# -- clock-offset estimation ------------------------------------------------
+
+
+def test_clock_offsets_min_rtt_discipline():
+    """Seeded noisy sync samples with asymmetric one-way delays: the
+    estimator must keep the minimum-RTT sample, whose error is bounded
+    by half ITS OWN rtt — not an average polluted by the slow ones."""
+    rnd = random.Random(1234)
+    true_offset = -567.89  # router ~= peer + offset
+    records = []
+    min_rtt = None
+    for i in range(24):
+        t_send = float(i)
+        rtt = 0.002 + rnd.random() * 0.08
+        d_out = rnd.uniform(0.0, rtt)  # asymmetric split of the rtt
+        peer_ts = (t_send + d_out) - true_offset
+        records.append({
+            "kind": "instant", "name": "clock_sync",
+            "attrs": {"peer": ADDR, "t_send": t_send,
+                      "t_recv": t_send + rtt, "peer_ts": peer_ts},
+        })
+        min_rtt = rtt if min_rtt is None else min(min_rtt, rtt)
+    offsets = clock_offsets(records)
+    est = offsets[ADDR]
+    assert est["samples"] == 24
+    assert est["rtt"] == pytest.approx(min_rtt)
+    assert abs(est["offset"] - true_offset) <= min_rtt / 2 + 1e-9
+
+
+def test_clock_offsets_ignores_malformed_samples():
+    good = {"kind": "instant", "name": "clock_sync",
+            "attrs": {"peer": ADDR, "t_send": 1.0, "t_recv": 1.1,
+                      "peer_ts": 5.0}}
+    bad = [
+        {"kind": "instant", "name": "clock_sync", "attrs": {}},
+        {"kind": "instant", "name": "clock_sync",
+         "attrs": {"peer": ADDR, "t_send": "x", "t_recv": 1.0,
+                   "peer_ts": 1.0}},
+        {"kind": "instant", "name": "clock_sync",  # negative rtt
+         "attrs": {"peer": ADDR, "t_send": 2.0, "t_recv": 1.0,
+                   "peer_ts": 1.0}},
+        {"kind": "span", "name": "clock_sync"},
+    ]
+    offsets = clock_offsets([good] + bad)
+    assert list(offsets) == [ADDR]
+    assert offsets[ADDR]["samples"] == 1
+
+
+# -- damaged span logs ------------------------------------------------------
+
+
+def _spooled_log(tmp_path, n_spans=4):
+    tracer = Tracer(FakeClock())
+    for i in range(n_spans):
+        tracer.record(f"span{i}", "main", float(i), float(i) + 0.5)
+    path = os.path.join(str(tmp_path), "damaged.jsonl")
+    spool = SpanSpool(path, proc="victim")
+    spool.drain(tracer)
+    spool.close()
+    return path
+
+
+def test_damaged_lines_skipped_typed_not_fatal(tmp_path):
+    path = _spooled_log(tmp_path)
+    with open(path) as fh:
+        lines = fh.read().splitlines()
+    # tamper a MID-FILE span record without recomputing its checksum:
+    # parseable JSON, checksum disagrees -> the "crc" bucket
+    tampered = json.loads(lines[2])
+    tampered["name"] = "tampered"
+    lines[2] = json.dumps(tampered)
+    # and splice in an unparseable line -> the "garbage" bucket
+    lines.insert(3, "not json {{{")
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+
+    records, skipped = read_span_log(path)
+    assert skipped == {"garbage": 1, "crc": 1}
+    names = [r.get("name") for r in records if r.get("kind") == "span"]
+    assert "tampered" not in names
+    assert len(names) == 3  # the other three spans all survived
+    assert records[0]["kind"] == "meta"  # meta record intact
+
+
+def test_trace_filter_keeps_clock_sync_and_meta(tmp_path):
+    processes, ctx, _wire_ctx = _fleet_processes(tmp_path)
+    router_path = os.path.join(str(tmp_path), "router.jsonl")
+    # filter to a trace id that matches NOTHING: spans drop, but the
+    # alignment-critical records (meta, clock_sync) must survive
+    records, _skipped = read_span_log(router_path, trace_id="0" * 32)
+    kinds = sorted(r["kind"] for r in records)
+    assert kinds == ["instant", "meta"]
+    assert records[1]["name"] == "clock_sync"
+    # and the REAL trace id keeps every stamped span
+    records, _skipped = read_span_log(router_path, trace_id=ctx.trace_id)
+    assert sum(1 for r in records if r["kind"] == "span") == 3
+
+
+# -- the CLI ----------------------------------------------------------------
+
+
+def _load_cli():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "trace_stitch", os.path.join(repo, "scripts", "trace_stitch.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_trace_stitch_cli_writes_one_perfetto_file(tmp_path, capsys):
+    _fleet_processes(tmp_path)
+    cli = _load_cli()
+    out = os.path.join(str(tmp_path), "stitched.json")
+    rc = cli.main([
+        "trace_stitch", out,
+        os.path.join(str(tmp_path), "router.jsonl"),
+        os.path.join(str(tmp_path), "daemon_serve.jsonl") + f"={ADDR}",
+        "--summary",
+    ])
+    assert rc == 0
+    with open(out) as fh:
+        trace = json.load(fh)
+    assert trace["metadata"]["flow_arrows"] == 1
+    assert any(ev.get("ph") == "X" for ev in trace["traceEvents"])
+    summary = json.loads(capsys.readouterr().out)
+    assert len(summary) == 1
+    (verdict,) = summary.values()
+    assert verdict["single_rooted"] is True
+    assert len(verdict["pids"]) == 2
+
+
+def test_trace_stitch_cli_rejects_an_empty_stitch(tmp_path):
+    cli = _load_cli()
+    out = os.path.join(str(tmp_path), "empty.json")
+    missing = os.path.join(str(tmp_path), "no_such_log.jsonl")
+    assert cli.main(["trace_stitch", out, missing]) == 1
+    assert not os.path.exists(out)

@@ -1011,6 +1011,7 @@ class Frontend:
         moved = 0
         for eout in handle.take_queued():
             st = self._by_attempt.pop(eout.request.request_id, None)
+            self.tracer.release_trace(eout.request.request_id)
             if st is None or st.out.done:
                 continue
             st.handle = None
@@ -1148,6 +1149,13 @@ class Frontend:
             loads = [h.load() for h in cands]
             self._imbalance.observe(pick.load() - min(loads))
             ereq = self._attempt_request(st)
+            # engine spans carry the ATTEMPT id (rid@N), not the cluster
+            # rid the daemon bound its trace under — alias the attempt
+            # to the same context BEFORE the engine admission records
+            # its queue span, and release wherever the attempt retires
+            ctx = self.tracer.trace_of(req.request_id)
+            if ctx is not None:
+                self.tracer.bind_trace(ereq.request_id, ctx)
             # requeue=True: frontend-accepted work being PLACED is not a
             # new admission from the engine's point of view — the drain
             # gate guards direct engine submissions, the frontend's gate
@@ -1156,6 +1164,7 @@ class Frontend:
                 ereq, requeue=True, arrival_time=st.out.arrival_time
             )
             if eout.done:  # synchronous engine rejection (queue_full)
+                self.tracer.release_trace(ereq.request_id)
                 self.registry.counter(
                     "cluster_dispatch_rejects_total",
                     reason=eout.finish_reason or "unknown",
@@ -1263,6 +1272,8 @@ class Frontend:
                 if st.handle is None:
                     return
                 self._by_attempt.pop(st.engine_rid, None)
+                if st.engine_rid is not None:
+                    self.tracer.release_trace(st.engine_rid)
                 st.handle = None
                 st.engine_rid = None
                 st.out.retries += 1
@@ -1330,6 +1341,7 @@ class Frontend:
         for eout in handle.orphans():
             handle.forget(eout.request.request_id)
             st = self._by_attempt.pop(eout.request.request_id, None)
+            self.tracer.release_trace(eout.request.request_id)
             if st is None or st.out.done:
                 continue
             st.excluded.add(handle.replica_id)
@@ -1428,6 +1440,7 @@ class Frontend:
         st.out.finish_time = now
         if st.engine_rid is not None:
             self._by_attempt.pop(st.engine_rid, None)
+            self.tracer.release_trace(st.engine_rid)
         st.handle = None
         st.engine_rid = None
         self._reserved -= st.budget

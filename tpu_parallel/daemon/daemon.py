@@ -37,6 +37,7 @@ rides lock-free subscriber queues fed from inside the tick.
 from __future__ import annotations
 
 import dataclasses
+import os
 import queue
 import signal as _signal
 import threading
@@ -56,7 +57,8 @@ from tpu_parallel.daemon.journal import (
     load_state,
 )
 from tpu_parallel.daemon.wallclock import WallClock
-from tpu_parallel.obs.tracer import NULL_TRACER
+from tpu_parallel.obs.spool import read_span_log
+from tpu_parallel.obs.tracer import NULL_TRACER, TraceContext
 from tpu_parallel.serving.request import (
     FINISHED,
     QUEUED,
@@ -199,12 +201,18 @@ class ServingDaemon:
         *,
         config: Optional[DaemonConfig] = None,
         clock=None,
+        span_spool=None,
     ):
         self.config = config or DaemonConfig()
         self.clock = clock if clock is not None else WallClock()
         self.frontend = frontend_factory(self.clock)
         self.registry = self.frontend.registry
         self.tracer = self.frontend.tracer or NULL_TRACER
+        # the per-process span log behind GET /v1/tracez; drained by
+        # the tick pump, under its own lock (handler threads serving
+        # tracez drain too, and a spool drain does file IO)
+        self.span_spool = span_spool
+        self._spool_lock = threading.Lock()
         self._lock = threading.RLock()
         self._requests: Dict[str, _DaemonRequest] = {}
         self._dedupe: Dict[str, str] = {}
@@ -495,6 +503,7 @@ class ServingDaemon:
     def submit(
         self, request: Request, dedupe_token: Optional[str] = None,
         phase: Optional[str] = None,
+        trace: Optional[TraceContext] = None,
     ) -> Dict:
         """Accept one request: dedupe first (an already-seen token
         returns the live/completed record instead of re-admitting —
@@ -503,7 +512,11 @@ class ServingDaemon:
         DURABLE accept — the submit record is fsynced before this
         returns.  ``phase="decode"`` marks a router-issued handoff
         continuation, the only submissions a ``decode``-role daemon
-        takes."""
+        takes.  ``trace`` is the wire-adopted trace context (the
+        router's fork for this crossing): bound on the tracer so every
+        span this daemon records for the request carries the fleet
+        trace id, unbound when the request turns terminal or is
+        refused."""
         from tpu_parallel.fleet.roles import (
             PHASE_DECODE,
             REJECT_ROLE,
@@ -550,11 +563,16 @@ class ServingDaemon:
                     f"daemon degraded: {self._degraded_reason}"
                 )
                 return record
+            if trace is not None and self.tracer.enabled:
+                # bind BEFORE frontend.submit so the queue span the
+                # admission records already carries the fleet trace id
+                self.tracer.bind_trace(request.request_id, trace)
             dr = _DaemonRequest(record, dedupe_token)
             request.on_token = self._make_on_token(dr)
             now = self.clock()
             out = self.frontend.submit(request)
             if out.status == REJECTED:
+                self.tracer.release_trace(request.request_id)
                 record["status"] = REJECTED
                 record["finish_reason"] = out.finish_reason
                 record["detail"] = out.detail
@@ -593,6 +611,7 @@ class ServingDaemon:
                 self.frontend.cancel(
                     request.request_id, reason=REJECT_JOURNAL
                 )
+                self.tracer.release_trace(request.request_id)
                 record["status"] = REJECTED
                 record["finish_reason"] = REJECT_JOURNAL
                 record["detail"] = repr(exc)
@@ -601,6 +620,7 @@ class ServingDaemon:
                 self.frontend.cancel(
                     request.request_id, reason=REJECT_JOURNAL
                 )
+                self.tracer.release_trace(request.request_id)
                 raise
             # registered only AFTER the durable append: a failed write
             # leaves no acknowledged-but-undurable state behind
@@ -669,6 +689,7 @@ class ServingDaemon:
                 dr.out = None
                 if record["request_id"] in self._requests:
                     self._note_terminal(dr, was_open)
+                self.tracer.release_trace(record["request_id"])
             if dr.staged or dr.terminal_staged:
                 self._dirty[record["request_id"]] = None
             for q in dr.subscribers:
@@ -812,7 +833,48 @@ class ServingDaemon:
             self.registry.gauge("daemon_degraded").set(
                 0.0 if self._degraded_reason is None else 1.0
             )
-            return events
+        # span IO happens OUTSIDE the daemon lock: a slow (or
+        # fault-injected) spool write must not stall admission
+        self._drain_spool()
+        return events
+
+    def _drain_spool(self) -> None:
+        """Flush newly-recorded spans to the per-process span log.  A
+        spool write failure is logged as a skip by the spool itself;
+        tracing is never allowed to take the daemon down."""
+        if self.span_spool is None:
+            return
+        with self._spool_lock:
+            try:
+                self.span_spool.drain(self.tracer)
+            except OSError:
+                pass
+
+    def trace_payload(self, trace_id: Optional[str] = None) -> Dict:
+        """The ``GET /v1/tracez`` body: this process's spooled span
+        records (optionally filtered to one trace), plus the damage
+        counters ``read_span_log`` kept while skipping bad lines."""
+        if self.span_spool is None:
+            return {
+                "proc": f"daemon:{self.config.role or 'serve'}",
+                "pid": os.getpid(),
+                "records": [],
+                "skipped": {},
+            }
+        with self._spool_lock:
+            try:
+                self.span_spool.drain(self.tracer)
+            except OSError:
+                pass
+            records, skipped = read_span_log(
+                self.span_spool.path, trace_id=trace_id
+            )
+        return {
+            "proc": f"daemon:{self.config.role or 'serve'}",
+            "pid": os.getpid(),
+            "records": records,
+            "skipped": skipped,
+        }
 
     def install_signals(self) -> None:
         """Wire the POSIX contract (main thread only): SIGTERM/SIGINT =

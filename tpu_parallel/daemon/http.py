@@ -33,6 +33,9 @@ Endpoints (docs/13_daemon.md is the reference):
   leak assertions read ``inflight_tokens`` and per-replica pools here).
 - ``GET /metricsz`` — Prometheus text exposition of the shared
   registry (``daemon_*``, ``cluster_*`` and per-engine series).
+- ``GET /v1/tracez[?trace_id=...]`` — this process's spooled span
+  records (docs/11_observability.md): what ``scripts/trace_stitch.py``
+  and the fleet router's ``/v1/requestz`` collect and stitch.
 """
 
 from __future__ import annotations
@@ -47,6 +50,7 @@ from typing import Optional
 from tpu_parallel.daemon.daemon import REJECT_DEGRADED, REJECT_JOURNAL
 from tpu_parallel.fleet.roles import REJECT_ROLE
 from tpu_parallel.obs.exporters import prometheus_text
+from tpu_parallel.obs.tracer import TRACE_HEADER, TraceContext
 from tpu_parallel.serving.kv_wire import (
     CHUNK_MAGIC,
     SEGMENT_OVERHEAD,
@@ -182,11 +186,20 @@ class _Handler(BaseHTTPRequestHandler):
                 req = build_request(body)
             except (ValueError, TypeError) as exc:
                 return self._json(400, {"error": str(exc)})
+            # adopt the caller's trace context (the router forks one
+            # per wire crossing); garbage parses to None = untraced
+            ctx = TraceContext.parse(self.headers.get(TRACE_HEADER))
             record = d.submit(
                 req,
                 dedupe_token=body.get("dedupe_token"),
                 phase=body.get("phase"),
+                trace=ctx,
             )
+            # ``ts`` is this process's clock at response time: the
+            # router pairs it with its send/recv stamps to estimate the
+            # cross-host clock offset the stitcher aligns with
+            record = dict(record)
+            record["ts"] = d.clock()
             if record["status"] == REJECTED:
                 code = (
                     503
@@ -369,6 +382,9 @@ class _Handler(BaseHTTPRequestHandler):
                 # autopilot's role lever read pressure here instead of
                 # probing blind
                 "kv": d.kv_occupancy(),
+                # this process's clock, for the router's probe-driven
+                # clock-offset estimation (obs/stitch.py aligns on it)
+                "ts": d.clock(),
             })
         if self.path == "/statez":
             return self._json(200, {
@@ -381,6 +397,10 @@ class _Handler(BaseHTTPRequestHandler):
                 "text/plain; version=0.0.4",
             )
         parts = urllib.parse.urlsplit(self.path)
+        if parts.path == "/v1/tracez":
+            qs = urllib.parse.parse_qs(parts.query)
+            trace_id = qs.get("trace_id", [None])[0]
+            return self._json(200, d.trace_payload(trace_id))
         if parts.path == "/v1/kv/export":
             max_blocks = 16
             qs = urllib.parse.parse_qs(parts.query)

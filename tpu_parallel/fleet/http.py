@@ -32,7 +32,7 @@ from tpu_parallel.fleet.router import (
     FleetTransport,
     TransportError,
 )
-from tpu_parallel.obs.exporters import prometheus_text
+from tpu_parallel.obs.tracer import TRACE_HEADER, TraceContext
 
 _MAX_BODY_BYTES = 1 << 20  # same submit cap as the daemon server
 
@@ -52,10 +52,14 @@ class HTTPFleetTransport(FleetTransport):
         data: Optional[bytes] = None,
         content_type: str = "application/json",
         binary_response: bool = False,
+        trace: Optional[TraceContext] = None,
     ):
+        headers = {"Content-Type": content_type} if data else {}
+        if trace is not None:
+            headers[TRACE_HEADER] = trace.header_value()
         req = urllib.request.Request(
             f"http://{addr}{path}", data=data, method=method,
-            headers={"Content-Type": content_type} if data else {},
+            headers=headers,
         )
         try:
             with urllib.request.urlopen(req, timeout=timeout) as resp:
@@ -73,41 +77,51 @@ class HTTPFleetTransport(FleetTransport):
                 addr, f"{method} {path}: non-JSON {code} response"
             ) from None
 
-    def healthz(self, addr: str, timeout: float) -> Tuple[int, dict]:
-        return self._request(addr, "GET", "/healthz", timeout)
+    def healthz(
+        self, addr: str, timeout: float, trace=None
+    ) -> Tuple[int, dict]:
+        return self._request(addr, "GET", "/healthz", timeout,
+                             trace=trace)
 
     def submit(
-        self, addr: str, body: dict, timeout: float
+        self, addr: str, body: dict, timeout: float, trace=None
     ) -> Tuple[int, dict]:
         return self._request(
             addr, "POST", "/v1/submit", timeout,
-            data=json.dumps(body).encode(),
+            data=json.dumps(body).encode(), trace=trace,
         )
 
     def result(
-        self, addr: str, request_id: str, timeout: float
+        self, addr: str, request_id: str, timeout: float, trace=None
     ) -> Tuple[int, dict]:
         return self._request(
-            addr, "GET", f"/v1/result/{request_id}", timeout
+            addr, "GET", f"/v1/result/{request_id}", timeout,
+            trace=trace,
         )
 
     def cancel(
-        self, addr: str, request_id: str, timeout: float
+        self, addr: str, request_id: str, timeout: float, trace=None
     ) -> Tuple[int, dict]:
         return self._request(
-            addr, "POST", f"/v1/cancel/{request_id}", timeout, data=b"{}"
+            addr, "POST", f"/v1/cancel/{request_id}", timeout,
+            data=b"{}", trace=trace,
         )
 
     def stream(
-        self, addr: str, request_id: str, idle_timeout: float
+        self, addr: str, request_id: str, idle_timeout: float,
+        trace=None,
     ) -> Iterator[dict]:
         """Attach to the daemon's SSE stream; ``idle_timeout`` is the
         per-read socket timeout — the daemon's keepalive comments (which
         we skip) reset it, so only a genuinely wedged or dead peer trips
         it.  Any tear mid-iteration raises :class:`TransportError`: the
         router's handoff trigger."""
+        headers = (
+            {TRACE_HEADER: trace.header_value()}
+            if trace is not None else {}
+        )
         req = urllib.request.Request(
-            f"http://{addr}/v1/stream/{request_id}"
+            f"http://{addr}/v1/stream/{request_id}", headers=headers
         )
         try:
             resp = urllib.request.urlopen(req, timeout=idle_timeout)
@@ -144,28 +158,49 @@ class HTTPFleetTransport(FleetTransport):
         return events()
 
     def kv_export(
-        self, addr: str, max_blocks: int, timeout: float
+        self, addr: str, max_blocks: int, timeout: float, trace=None
     ) -> Tuple[int, bytes]:
         return self._request(
             addr, "GET", f"/v1/kv/export?max_blocks={int(max_blocks)}",
-            timeout, binary_response=True,
+            timeout, binary_response=True, trace=trace,
         )
 
     def kv_export_request(
-        self, addr: str, request_id: str, timeout: float
+        self, addr: str, request_id: str, timeout: float, trace=None
     ) -> Tuple[int, bytes]:
         rid = urllib.parse.quote(request_id, safe="")
         return self._request(
             addr, "GET", f"/v1/kv/export?request_id={rid}",
-            timeout, binary_response=True,
+            timeout, binary_response=True, trace=trace,
         )
 
     def kv_import(
-        self, addr: str, blob: bytes, timeout: float
+        self, addr: str, blob: bytes, timeout: float, trace=None
     ) -> Tuple[int, dict]:
         return self._request(
             addr, "POST", "/v1/kv/import", timeout, data=blob,
-            content_type="application/octet-stream",
+            content_type="application/octet-stream", trace=trace,
+        )
+
+    def metricsz(
+        self, addr: str, timeout: float, trace=None
+    ) -> Tuple[int, str]:
+        code, payload = self._request(
+            addr, "GET", "/metricsz", timeout, binary_response=True,
+            trace=trace,
+        )
+        return code, payload.decode("utf-8", errors="replace")
+
+    def tracez(
+        self, addr: str, trace_id: Optional[str], timeout: float,
+        trace=None,
+    ) -> Tuple[int, dict]:
+        query = (
+            f"?trace_id={urllib.parse.quote(trace_id, safe='')}"
+            if trace_id else ""
+        )
+        return self._request(
+            addr, "GET", f"/v1/tracez{query}", timeout, trace=trace,
         )
 
 
@@ -206,7 +241,11 @@ class _FleetHandler(BaseHTTPRequestHandler):
                 body = None
             if not isinstance(body, dict):
                 return self._json(400, {"error": "malformed JSON body"})
-            code, record = r.submit(body)
+            # adopt the client's trace context when it sent a
+            # well-formed one; garbage parses to None and the router
+            # mints its own
+            ctx = TraceContext.parse(self.headers.get(TRACE_HEADER))
+            code, record = r.submit(body, trace=ctx)
             return self._json(code, record)
         if self.path.startswith("/v1/cancel/"):
             rid = self.path[len("/v1/cancel/"):]
@@ -222,11 +261,14 @@ class _FleetHandler(BaseHTTPRequestHandler):
             return self._json(code, {
                 "ok": code == 200,
                 "peers": r.peers.states(),
+                "ts": r.clock(),
             })
         if self.path == "/statez":
             return self._json(200, r.status())
         if self.path == "/metricsz":
-            body = prometheus_text(r.registry).encode()
+            # the FLEET exposition: router series + peer series under a
+            # ``peer`` label + cross-peer sums — one scrape target
+            body = r.fleet_metrics_text().encode()
             self.send_response(200)
             self.send_header(
                 "Content-Type", "text/plain; version=0.0.4"
@@ -235,6 +277,16 @@ class _FleetHandler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return
+        if self.path.startswith("/v1/tracez"):
+            query = urllib.parse.urlparse(self.path).query
+            trace_id = (
+                urllib.parse.parse_qs(query).get("trace_id", [None])[0]
+            )
+            return self._json(200, r.trace_payload(trace_id))
+        if self.path.startswith("/v1/requestz/"):
+            rid = self.path[len("/v1/requestz/"):]
+            code, payload = r.request_timeline(rid)
+            return self._json(code, payload)
         if self.path.startswith("/v1/result/"):
             rid = self.path[len("/v1/result/"):]
             code, record = r.result(rid)

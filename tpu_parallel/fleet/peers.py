@@ -153,14 +153,25 @@ class PeerState:
         self.transitions.append(f"{self.state}->{state}")
         self.state = state
 
-    def summary(self) -> dict:
-        return {
+    def summary(self, now: Optional[float] = None) -> dict:
+        """The breaker's ``/statez`` row.  With ``now`` (the caller's
+        clock) it also reports probe RECENCY — ``last_probe_age`` is
+        the operator's first stale-router tell — and when the next
+        probe is due (the DEAD-backoff schedule, made visible)."""
+        out = {
             "addr": self.addr,
             "state": self.state,
             "failures": self.failures,
             "deaths": self.deaths,
             "last_ok": self.last_ok,
         }
+        if now is not None:
+            out["last_probe_age"] = (
+                None if self.last_probe == float("-inf")
+                else max(0.0, now - self.last_probe)
+            )
+            out["next_probe_in"] = max(0.0, self.next_probe_at - now)
+        return out
 
 
 class PeerSet:
@@ -234,5 +245,5 @@ class PeerSet:
     def states(self) -> Dict[str, str]:
         return {a: s.state for a, s in self.peers.items()}
 
-    def summary(self) -> dict:
-        return {a: s.summary() for a, s in self.peers.items()}
+    def summary(self, now: Optional[float] = None) -> dict:
+        return {a: s.summary(now=now) for a, s in self.peers.items()}

@@ -55,6 +55,7 @@ lose latency, never tokens.
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 import uuid
 from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
@@ -72,8 +73,16 @@ from tpu_parallel.fleet.roles import (
     disaggregated,
     validate_role,
 )
+from tpu_parallel.obs.exporters import (
+    _prom_labels,
+    _prom_value,
+    parse_prometheus_text,
+    prometheus_text,
+)
 from tpu_parallel.obs.registry import MetricRegistry
-from tpu_parallel.obs.tracer import NULL_TRACER
+from tpu_parallel.obs.spool import read_span_log
+from tpu_parallel.obs.stitch import phase_breakdown
+from tpu_parallel.obs.tracer import NULL_TRACER, TraceContext
 from tpu_parallel.serving.kv_wire import DEFAULT_MAX_WIRE_BYTES, chunk_body
 from tpu_parallel.serving.request import (
     CANCELLED,
@@ -123,28 +132,37 @@ class FleetTransport:
     response — ``(status_code, parsed body)`` — or raises
     :class:`TransportError`; an HTTP error code is a RESPONSE (the peer
     is alive and saying something typed), only failing to get one is
-    transport failure."""
+    transport failure.
 
-    def healthz(self, addr: str, timeout: float) -> Tuple[int, dict]:
+    Every method takes ``trace`` — a :class:`~tpu_parallel.obs.tracer.
+    TraceContext` or None — and a real transport propagates it as the
+    ``X-TP-Trace`` header so the receiving daemon's spans join the
+    sender's trace.  ``scripts/check_trace.py`` enforces that every
+    call SITE in the fleet package passes the kwarg: forgetting it is a
+    silent trace break, exactly the bug class an AST gate exists for."""
+
+    def healthz(
+        self, addr: str, timeout: float, trace=None
+    ) -> Tuple[int, dict]:
         raise NotImplementedError
 
     def submit(
-        self, addr: str, body: dict, timeout: float
+        self, addr: str, body: dict, timeout: float, trace=None
     ) -> Tuple[int, dict]:
         raise NotImplementedError
 
     def result(
-        self, addr: str, request_id: str, timeout: float
+        self, addr: str, request_id: str, timeout: float, trace=None
     ) -> Tuple[int, dict]:
         raise NotImplementedError
 
     def cancel(
-        self, addr: str, request_id: str, timeout: float
+        self, addr: str, request_id: str, timeout: float, trace=None
     ) -> Tuple[int, dict]:
         raise NotImplementedError
 
     def stream(
-        self, addr: str, request_id: str, idle_timeout: float
+        self, addr: str, request_id: str, idle_timeout: float, trace=None
     ) -> Iterator[dict]:
         """Yield the daemon's SSE events as dicts; raise
         :class:`TransportError` on disconnect/idle-timeout (including
@@ -152,20 +170,35 @@ class FleetTransport:
         raise NotImplementedError
 
     def kv_export(
-        self, addr: str, max_blocks: int, timeout: float
+        self, addr: str, max_blocks: int, timeout: float, trace=None
     ) -> Tuple[int, bytes]:
         raise NotImplementedError
 
     def kv_export_request(
-        self, addr: str, request_id: str, timeout: float
+        self, addr: str, request_id: str, timeout: float, trace=None
     ) -> Tuple[int, bytes]:
         """Export ONE live request's written KV prefix (the
         prefill→decode handoff donor leg)."""
         raise NotImplementedError
 
     def kv_import(
-        self, addr: str, blob: bytes, timeout: float
+        self, addr: str, blob: bytes, timeout: float, trace=None
     ) -> Tuple[int, dict]:
+        raise NotImplementedError
+
+    def metricsz(
+        self, addr: str, timeout: float, trace=None
+    ) -> Tuple[int, str]:
+        """The peer's Prometheus text exposition (the fleet
+        aggregation scrape leg)."""
+        raise NotImplementedError
+
+    def tracez(
+        self, addr: str, trace_id: Optional[str], timeout: float,
+        trace=None,
+    ) -> Tuple[int, dict]:
+        """The peer's span-log payload (``/v1/tracez``), optionally
+        filtered to one trace id."""
         raise NotImplementedError
 
 
@@ -177,6 +210,7 @@ class _FleetRequest:
         "rid", "body", "prompt", "max_new", "dedupe_token", "addr",
         "daemon_rid", "base", "tokens", "status", "finish_reason",
         "detail", "handoffs", "inflight", "done_at", "disagg_done",
+        "trace", "t_submit", "t_first",
     )
 
     def __init__(self, rid: str, body: dict, addr: str, daemon_rid: str,
@@ -201,6 +235,9 @@ class _FleetRequest:
         # a request that already moved, or already failed to, decodes
         # where it sits
         self.disagg_done = False
+        self.trace: Optional[TraceContext] = None
+        self.t_submit: Optional[float] = None  # router clock at accept
+        self.t_first: Optional[float] = None  # first live token relayed
 
     @property
     def terminal(self) -> bool:
@@ -247,6 +284,7 @@ class FleetRouter:
         terminal_ttl_seconds: float = 600.0,
         roles: Optional[Dict[str, str]] = None,
         disagg_max_wire_bytes: int = DEFAULT_MAX_WIRE_BYTES,
+        span_spool=None,
     ):
         self.clock = clock
         self.transport = transport
@@ -303,6 +341,33 @@ class FleetRouter:
         self._m_handoff_seconds = self.registry.counter(
             "fleet_handoff_seconds_total"
         )
+        # span spooling (tracez) + fleet metrics aggregation state.  The
+        # spool has its own lock: drains happen from the pump thread AND
+        # any handler thread serving /v1/tracez, and must not contend
+        # with the request-table lock (a drain does file IO).
+        self._spool = span_spool
+        self._spool_lock = threading.Lock()
+        self._peer_metrics: Dict[str, str] = {}  # addr -> last /metricsz
+
+    def _observe_phase(self, phase: str, seconds: float) -> None:
+        self.registry.histogram(
+            "fleet_phase_seconds", phase=phase
+        ).observe(max(0.0, seconds))
+
+    def _note_clock_sync(
+        self, addr: str, t_send: float, t_recv: float, body
+    ) -> None:
+        """Record one (send, recv, peer-reported) timestamp triple — the
+        stitcher's clock-alignment sample.  Any wire response carrying a
+        ``ts`` field feeds it; min-RTT samples win at stitch time."""
+        if not self.tracer.enabled or not isinstance(body, dict):
+            return
+        ts = body.get("ts")
+        if isinstance(ts, (int, float)) and not isinstance(ts, bool):
+            self.tracer.instant(
+                "clock_sync", track=FLEET_TRACK, peer=addr,
+                t_send=t_send, t_recv=t_recv, peer_ts=float(ts),
+            )
 
     # -- roles (prefill/decode disaggregation) -----------------------------
 
@@ -397,11 +462,19 @@ class FleetRouter:
 
     # -- client surface ----------------------------------------------------
 
-    def submit(self, body: dict) -> Tuple[int, dict]:
+    def submit(
+        self, body: dict, trace: Optional[TraceContext] = None
+    ) -> Tuple[int, dict]:
         """Route one client submission; returns ``(http_code, record)``.
         Retries with exclusion across ring successors on transport
         failure or a typed 503/429 from the daemon; the accepted record
-        is the ROUTER's (its request id outlives any one daemon)."""
+        is the ROUTER's (its request id outlives any one daemon).
+
+        ``trace`` is an ADOPTED context (the HTTP surface parsed a
+        client's ``X-TP-Trace`` header); absent one, an enabled tracer
+        mints a fresh trace here — the router is the fleet's trace
+        origin, and its ``route`` span is the single ROOT every other
+        process's spans stitch under."""
         prompt = body.get("prompt")
         if (
             not isinstance(prompt, list)
@@ -418,6 +491,10 @@ class FleetRouter:
                 req = self._requests[self._ledger[dedupe]]
                 return 200, req.record()
             attempts = len(self.ring)
+        ctx = trace
+        if ctx is None and self.tracer.enabled:
+            ctx = TraceContext.new()
+        t0 = self.clock()
         exclude: Set[str] = set()
         last: Tuple[int, dict] = (503, {
             "error": "no routable peer",
@@ -436,15 +513,23 @@ class FleetRouter:
                 )
             if addr is None:
                 break
+            # the wire span's id is assigned BEFORE the call so the
+            # daemon's spans can parent to it: the fork rides the
+            # X-TP-Trace header, the span is recorded on return
+            wire_ctx = ctx.fork() if ctx is not None else None
+            t_send = self.clock()
             try:
                 code, rec = self.transport.submit(
-                    addr, body, self.policy.request_timeout_seconds
+                    addr, body, self.policy.request_timeout_seconds,
+                    trace=wire_ctx,
                 )
             except TransportError:
                 self.peers.note_failure(addr)
                 exclude.add(addr)
                 continue
+            t_recv = self.clock()
             self.peers.note_success(addr)
+            self._note_clock_sync(addr, t_send, t_recv, rec)
             if code == 200:
                 redundant = None
                 with self._lock:
@@ -462,12 +547,31 @@ class FleetRouter:
                             rec.get("status", "queued"),
                         )
                         self._requests[rid] = req
+                        req.t_submit = t0
                         if dedupe is not None:
                             self._ledger[dedupe] = rid
                         self._m_submits.inc()
                         self.registry.counter(
                             "fleet_routed_total", peer=addr
                         ).inc()
+                        if self.tracer.enabled and ctx is not None:
+                            req.trace = ctx
+                            self.tracer.bind_trace(rid, ctx)
+                            root = self.tracer.record(
+                                "route", FLEET_TRACK, t0, self.clock(),
+                                rid=rid, peer=addr,
+                            )
+                            # the ROOT of the cross-process tree: it IS
+                            # the context's span, and it parents to
+                            # nothing (a self-parented root would make
+                            # the stitched tree rootless)
+                            root.span_id = ctx.span_id
+                            root.parent_id = None
+                            wire = self.tracer.record(
+                                "wire:submit", FLEET_TRACK, t_send,
+                                t_recv, rid=rid, peer=addr,
+                            )
+                            wire.span_id = wire_ctx.span_id
                         if self.tracer.enabled:
                             self.tracer.instant(
                                 "route", track=FLEET_TRACK, rid=rid,
@@ -479,6 +583,7 @@ class FleetRouter:
                         self.transport.cancel(
                             redundant[0], redundant[1],
                             self.policy.request_timeout_seconds,
+                            trace=wire_ctx,
                         )
                     except TransportError:
                         pass
@@ -513,9 +618,11 @@ class FleetRouter:
             if req.terminal:
                 return 200, req.record()
             addr, daemon_rid, base = req.addr, req.daemon_rid, req.base
+            tr = req.trace
         try:
             code, rec = self.transport.result(
-                addr, daemon_rid, self.policy.request_timeout_seconds
+                addr, daemon_rid, self.policy.request_timeout_seconds,
+                trace=tr,
             )
         except TransportError:
             self.peers.note_failure(addr)
@@ -547,10 +654,12 @@ class FleetRouter:
             if req is None or req.terminal:
                 return 404, {"error": f"unknown/done request {rid}"}
             addr, daemon_rid = req.addr, req.daemon_rid
+            tr = req.trace
             self._finalize_locked(req, CANCELLED, "cancelled")
         try:
             self.transport.cancel(
-                addr, daemon_rid, self.policy.request_timeout_seconds
+                addr, daemon_rid, self.policy.request_timeout_seconds,
+                trace=tr,
             )
         except TransportError:
             self.peers.note_failure(addr)  # best effort; record stands
@@ -569,6 +678,7 @@ class FleetRouter:
                 yield {"error": f"unknown request {rid}"}
                 return
             replay = list(req.tokens)
+            tr = req.trace
         sent = 0
         for tok in replay:
             yield {"request_id": rid, "token": tok, "index": sent}
@@ -598,43 +708,67 @@ class FleetRouter:
                 yield final
                 return
             moved = False
+            relay = None
+            if self.tracer.enabled and tr is not None:
+                # one relay span per daemon attach: a handed-off stream
+                # shows as consecutive relay spans on the fleet track
+                relay = self.tracer.start(
+                    "relay", FLEET_TRACK, rid=rid, peer=addr
+                )
             try:
-                for ev in self.transport.stream(
-                    addr, daemon_rid,
-                    self.policy.stream_idle_timeout_seconds,
-                ):
-                    if "token" in ev and "index" in ev:
-                        idx = base + int(ev["index"])
-                        with self._lock:
-                            if idx == len(req.tokens):
-                                req.tokens.append(int(ev["token"]))
-                        if idx == sent:
-                            yield {
-                                "request_id": rid,
-                                "token": int(ev["token"]), "index": idx,
-                            }
-                            sent += 1
-                        if self._maybe_disagg(req):
-                            # first token delivered and the request just
-                            # migrated to its decode peer: re-snapshot
-                            # and re-attach there — the client's stream
-                            # never blinks, the indices never reset
-                            moved = True
-                            break
-                    if ev.get("finished"):
-                        with self._lock:
-                            self._finalize_locked(
-                                req,
-                                ev.get("status") or FINISHED,
-                                ev.get("finish_reason"),
-                            )
-                            final = {
-                                "request_id": rid, "finished": True,
-                                "status": req.status,
-                                "finish_reason": req.finish_reason,
-                            }
-                        yield final
-                        return
+                try:
+                    for ev in self.transport.stream(
+                        addr, daemon_rid,
+                        self.policy.stream_idle_timeout_seconds,
+                        trace=tr,
+                    ):
+                        if "token" in ev and "index" in ev:
+                            idx = base + int(ev["index"])
+                            with self._lock:
+                                if idx == len(req.tokens):
+                                    req.tokens.append(int(ev["token"]))
+                            if idx == sent:
+                                yield {
+                                    "request_id": rid,
+                                    "token": int(ev["token"]),
+                                    "index": idx,
+                                }
+                                sent += 1
+                                if req.t_first is None:
+                                    now = self.clock()
+                                    with self._lock:
+                                        if req.t_first is None:
+                                            req.t_first = now
+                                            if req.t_submit is not None:
+                                                self._observe_phase(
+                                                    "ttft",
+                                                    now - req.t_submit,
+                                                )
+                            if self._maybe_disagg(req):
+                                # first token delivered and the request
+                                # just migrated to its decode peer:
+                                # re-snapshot and re-attach there — the
+                                # client's stream never blinks, the
+                                # indices never reset
+                                moved = True
+                                break
+                        if ev.get("finished"):
+                            with self._lock:
+                                self._finalize_locked(
+                                    req,
+                                    ev.get("status") or FINISHED,
+                                    ev.get("finish_reason"),
+                                )
+                                final = {
+                                    "request_id": rid, "finished": True,
+                                    "status": req.status,
+                                    "finish_reason": req.finish_reason,
+                                }
+                            yield final
+                            return
+                finally:
+                    if relay is not None:
+                        relay.finish()
                 if moved:
                     misses = 0
                     continue  # re-attach to the decode peer NOW
@@ -696,11 +830,16 @@ class FleetRouter:
         req.finish_reason = finish_reason
         req.done_at = self.clock()
         self._m_completions.inc()
+        if req.t_submit is not None:
+            self._observe_phase("total", req.done_at - req.t_submit)
+        if req.t_first is not None:
+            self._observe_phase("decode", req.done_at - req.t_first)
         if self.tracer.enabled:
             self.tracer.instant(
                 "complete", track=FLEET_TRACK, rid=req.rid,
                 status=status, reason=str(finish_reason),
             )
+        self.tracer.release_trace(req.rid)
 
     def _handoff(
         self,
@@ -746,6 +885,7 @@ class FleetRouter:
                 return True
             req.inflight = True
             old_addr, old_rid = req.addr, req.daemon_rid
+            tr = req.trace
             delivered = list(req.tokens)
             body = dict(req.body)
             body["prompt"] = req.prompt + delivered
@@ -779,18 +919,32 @@ class FleetRouter:
                     )
                 if addr is None:
                     return False
+                # fresh fork per attempt: each wire submit is its own
+                # crossing, and the accepting daemon's spans parent to
+                # the one that actually carried the handoff
+                h_ctx = tr.fork() if tr is not None else None
+                t_send = self.clock()
                 try:
                     code, rec = self.transport.submit(
-                        addr, body, self.policy.request_timeout_seconds
+                        addr, body, self.policy.request_timeout_seconds,
+                        trace=h_ctx,
                     )
                 except TransportError:
                     self.peers.note_failure(addr)
                     exclude.add(addr)
                     continue
+                t_recv = self.clock()
                 self.peers.note_success(addr)
+                self._note_clock_sync(addr, t_send, t_recv, rec)
                 if code != 200:
                     exclude.add(addr)
                     continue
+                if self.tracer.enabled and h_ctx is not None:
+                    wire = self.tracer.record(
+                        "wire:handoff", FLEET_TRACK, t_send, t_recv,
+                        rid=req.rid, peer=addr, src=old_addr,
+                    )
+                    wire.span_id = h_ctx.span_id
                 orphan = False
                 with self._lock:
                     if req.terminal:
@@ -816,6 +970,7 @@ class FleetRouter:
                         self.transport.cancel(
                             addr, rec["request_id"],
                             self.policy.request_timeout_seconds,
+                            trace=h_ctx,
                         )
                     except TransportError:
                         pass
@@ -872,17 +1027,21 @@ class FleetRouter:
                 return False  # already sitting on a decode peer
             req.disagg_done = True  # one shot, success or fallback
             src, src_rid = req.addr, req.daemon_rid
+            tr = req.trace
             dst = self._pick(req.prompt, {src}, need="decode")
         t0 = self.clock()
         if dst is None:
             return self._disagg_fallback(req, "no_decode_peer")
+        kx_ctx = tr.fork() if tr is not None else None
         try:
             code, blob = self.transport.kv_export_request(
-                src, src_rid, self.policy.request_timeout_seconds
+                src, src_rid, self.policy.request_timeout_seconds,
+                trace=kx_ctx,
             )
         except TransportError:
             self.peers.note_failure(src)
             return self._disagg_fallback(req, "export_transport")
+        t_export = self.clock()
         self.peers.note_success(src)
         if code != 200:
             self.registry.counter(
@@ -897,6 +1056,12 @@ class FleetRouter:
             return self._disagg_fallback(req, "export_empty")
         self._m_kv_export_bytes.inc(len(blob))
         self._m_handoff_bytes.inc(len(blob))
+        if self.tracer.enabled and kx_ctx is not None:
+            kx = self.tracer.record(
+                "wire:kv_export", FLEET_TRACK, t0, t_export,
+                rid=req.rid, peer=src, bytes=len(blob),
+            )
+            kx.span_id = kx_ctx.span_id
         # re-frame as the bounded chunk stream: the decode daemon lands
         # whole frames as segments arrive (Mooncake-style overlap), and
         # a transfer torn mid-stream is a typed ``segment`` refusal
@@ -904,16 +1069,28 @@ class FleetRouter:
         wire = b"".join(
             chunk_body(blob, max_wire_bytes=self.disagg_max_wire_bytes)
         )
+        ki_ctx = tr.fork() if tr is not None else None
+        t_imp0 = self.clock()
         try:
             code, body = self.transport.kv_import(
-                dst, wire, self.policy.request_timeout_seconds
+                dst, wire, self.policy.request_timeout_seconds,
+                trace=ki_ctx,
             )
         except TransportError:
             # the decode peer died mid-transfer: breaker evidence AND
             # typed fallback — the stream never left the prefill peer
             self.peers.note_failure(dst)
             return self._disagg_fallback(req, "decode_peer_dead")
+        t_imp1 = self.clock()
         self.peers.note_success(dst)
+        self._note_clock_sync(dst, t_imp0, t_imp1, body)
+        if self.tracer.enabled and ki_ctx is not None:
+            ki = self.tracer.record(
+                "wire:kv_import", FLEET_TRACK, t_imp0, t_imp1,
+                rid=req.rid, peer=dst, bytes=len(wire),
+            )
+            ki.span_id = ki_ctx.span_id
+        self._observe_phase("kv_wire", t_imp1 - t0)
         if code != 200:
             self.registry.counter(
                 "fleet_kv_wire_refusals_total",
@@ -953,12 +1130,15 @@ class FleetRouter:
         # actively (its record is disowned; this is compute hygiene)
         try:
             self.transport.cancel(
-                src, src_rid, self.policy.request_timeout_seconds
+                src, src_rid, self.policy.request_timeout_seconds,
+                trace=tr,
             )
         except TransportError:
             self.peers.note_failure(src)
         self._m_disagg.inc()
-        self._m_handoff_seconds.inc(max(0.0, self.clock() - t0))
+        elapsed = max(0.0, self.clock() - t0)
+        self._m_handoff_seconds.inc(elapsed)
+        self._observe_phase("handoff", elapsed)
         return True
 
     # -- health ------------------------------------------------------------
@@ -978,15 +1158,22 @@ class FleetRouter:
             was = state.state
             self._m_probes.inc()
             state.last_probe = self.clock()
+            t_send = self.clock()
             try:
                 code, _body = self.transport.healthz(
-                    addr, self.policy.connect_timeout_seconds
+                    addr, self.policy.connect_timeout_seconds,
+                    trace=None,
                 )
                 ok = code == 200
             except TransportError:
                 ok = False
                 _body = {}
+            t_recv = self.clock()
             if ok:
+                # probes are the clock-alignment workhorse: frequent,
+                # small, so their min-RTT samples bound the offset well
+                self._note_clock_sync(addr, t_send, t_recv, _body)
+                self._scrape_peer_metrics(addr)
                 # fold the role the daemon ADVERTISES — unless pinned
                 # by config/set_role, the daemon's word is the truth
                 # (a restarted daemon may come back under a new role)
@@ -1007,6 +1194,10 @@ class FleetRouter:
                 now_state = self.peers.note_failure(addr)
                 if was != DEAD and now_state == DEAD:
                     self._m_peer_deaths.inc()
+                    with self._lock:
+                        # a dead peer's series must not be re-exported
+                        # as if freshly scraped
+                        self._peer_metrics.pop(addr, None)
                     if self.tracer.enabled:
                         self.tracer.instant(
                             "peer_dead", track=FLEET_TRACK, peer=addr
@@ -1021,6 +1212,36 @@ class FleetRouter:
                     self._roles.get(addr, ROLE_MIXED), 0.0
                 )
             )
+        self._drain_spool()
+
+    def _drain_spool(self) -> None:
+        """Flush finished spans to the span log (telemetry: an IO fault
+        here is counted by the spool, never fatal to the pump)."""
+        if self._spool is None:
+            return
+        with self._spool_lock:
+            try:
+                self._spool.drain(self.tracer)
+            except OSError:
+                pass
+
+    def _scrape_peer_metrics(self, addr: str) -> None:
+        """Cache the peer's latest ``/metricsz`` text for the fleet
+        aggregation surface.  Best effort: a scrape failure of a peer
+        that just answered its probe is NOT breaker evidence, and a
+        transport predating ``metricsz`` simply opts the peer out."""
+        try:
+            code, text = self.transport.metricsz(
+                addr, self.policy.connect_timeout_seconds, trace=None
+            )
+        except (TransportError, NotImplementedError, AttributeError):
+            return
+        if code == 200 and isinstance(text, str):
+            with self._lock:
+                self._peer_metrics[addr] = text
+        else:
+            with self._lock:
+                self._peer_metrics.pop(addr, None)
 
     def _handoff_open(self, dead_addr: str) -> None:
         """Move every open request off a peer the breaker just declared
@@ -1075,7 +1296,8 @@ class FleetRouter:
         for daemon_rid in stale:
             try:
                 self.transport.cancel(
-                    addr, daemon_rid, self.policy.request_timeout_seconds
+                    addr, daemon_rid,
+                    self.policy.request_timeout_seconds, trace=None,
                 )
             except TransportError:
                 self.peers.note_failure(addr)
@@ -1105,7 +1327,8 @@ class FleetRouter:
         wire path unchanged."""
         try:
             code, body = self.transport.healthz(
-                newcomer, self.policy.connect_timeout_seconds
+                newcomer, self.policy.connect_timeout_seconds,
+                trace=None,
             )
         except TransportError:
             code, body = 0, {}
@@ -1156,7 +1379,8 @@ class FleetRouter:
             else self.warm_start_blocks
         try:
             code, blob = self.transport.kv_export(
-                src, blocks, self.policy.request_timeout_seconds
+                src, blocks, self.policy.request_timeout_seconds,
+                trace=None,
             )
         except TransportError:
             self.peers.note_failure(src)
@@ -1176,7 +1400,8 @@ class FleetRouter:
         self._m_kv_export_bytes.inc(len(blob))
         try:
             code, body = self.transport.kv_import(
-                dst, blob, self.policy.request_timeout_seconds
+                dst, blob, self.policy.request_timeout_seconds,
+                trace=None,
             )
         except TransportError:
             self.peers.note_failure(dst)
@@ -1225,12 +1450,21 @@ class FleetRouter:
         self._handoff_open(addr)
 
     def status(self) -> dict:
+        now = self.clock()
         with self._lock:
             open_reqs = [
                 r.rid for r in self._requests.values() if not r.terminal
             ]
+            inflight: Dict[str, int] = {}
+            for r in self._requests.values():
+                if not r.terminal:
+                    inflight[r.addr] = inflight.get(r.addr, 0) + 1
+            peers = self.peers.summary(now=now)
+            for addr, info in peers.items():
+                info["role"] = self._roles.get(addr, ROLE_MIXED)
+                info["inflight"] = inflight.get(addr, 0)
             return {
-                "peers": self.peers.summary(),
+                "peers": peers,
                 "roles": dict(self._roles),
                 "disagg": self._disagg_active(),
                 "requests": len(self._requests),
@@ -1239,6 +1473,127 @@ class FleetRouter:
                 "ledger": len(self._ledger),
                 "stale": {a: len(v) for a, v in self._stale.items()},
             }
+
+    # -- trace + metrics surfaces (docs/11_observability.md) ---------------
+
+    def trace_payload(self, trace_id: Optional[str] = None) -> dict:
+        """The router's OWN span log, served at ``GET /v1/tracez`` —
+        one process's contribution to a stitched fleet timeline."""
+        if self._spool is None:
+            return {"proc": "router", "pid": os.getpid(),
+                    "records": [], "skipped": {}}
+        self._drain_spool()
+        with self._spool_lock:
+            records, skipped = read_span_log(self._spool.path, trace_id)
+        return {"proc": self._spool.proc, "pid": self._spool.pid,
+                "records": records, "skipped": skipped}
+
+    def request_timeline(self, rid: str) -> Tuple[int, dict]:
+        """Per-request latency attribution (``GET /v1/requestz/<rid>``):
+        pull the request's trace from the router's own spool and every
+        routable peer's ``/v1/tracez``, then break the wall time down
+        by phase — queue wait, prefill, decode, KV wire bytes/seconds,
+        SSE relay.  Durations are per-process clock DELTAS, so no clock
+        alignment is needed to attribute them."""
+        with self._lock:
+            req = self._requests.get(rid)
+            if req is None:
+                return 404, {"error": f"unknown request {rid}"}
+            record = req.record()
+            tr = req.trace
+        if tr is None:
+            return 200, {
+                "request_id": rid, "trace_id": None, "record": record,
+                "phases": {}, "detail": "tracing disabled",
+            }
+        processes = [self.trace_payload(tr.trace_id)]
+        for addr in self.peers.routable():
+            try:
+                code, body = self.transport.tracez(
+                    addr, tr.trace_id,
+                    self.policy.request_timeout_seconds, trace=None,
+                )
+            except (TransportError, NotImplementedError,
+                    AttributeError):
+                continue
+            if code == 200 and isinstance(body, dict):
+                body.setdefault("proc", addr)
+                processes.append(body)
+        records = [
+            r
+            for p in processes
+            for r in p.get("records", [])
+            if r.get("trace_id") == tr.trace_id
+        ]
+        breakdown = phase_breakdown(records)
+        return 200, {
+            "request_id": rid,
+            "trace_id": tr.trace_id,
+            "record": record,
+            "phases": breakdown["phases"],
+            "kv_wire_bytes": breakdown["kv_wire_bytes"],
+            "spans": breakdown["spans"],
+            "processes": [
+                {"proc": p.get("proc"), "pid": p.get("pid"),
+                 "records": len(p.get("records", []))}
+                for p in processes
+            ],
+        }
+
+    def fleet_metrics_text(self) -> str:
+        """ONE scrape target for the whole fleet: the router's own
+        registry, then every peer's last-scraped series re-emitted with
+        a ``peer`` label, then fleet-level sums (``fleet:<name>:sum``,
+        the recording-rule naming) across peers for every counter and
+        histogram family.  A peer whose text fails to parse is counted
+        visibly — an aggregator must never silently drop a peer."""
+        own = prometheus_text(self.registry).rstrip("\n")
+        typed = {
+            line.split()[2]
+            for line in own.splitlines()
+            if line.startswith("# TYPE ")
+        }
+        with self._lock:
+            peer_texts = sorted(self._peer_metrics.items())
+        lines: List[str] = [own] if own else []
+        sums: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+        for addr, text in peer_texts:
+            try:
+                samples = parse_prometheus_text(text)
+            except ValueError:
+                self.registry.counter(
+                    "fleet_peer_scrape_parse_errors_total", peer=addr
+                ).inc()
+                continue
+            for s in samples:
+                name, kind = s["name"], s["type"]
+                family = name
+                if kind == "histogram":
+                    for suffix in ("_bucket", "_sum", "_count"):
+                        if name.endswith(suffix):
+                            family = name[: -len(suffix)]
+                            break
+                if kind and family not in typed:
+                    typed.add(family)
+                    lines.append(f"# TYPE {family} {kind}")
+                labels = dict(s["labels"])
+                labels["peer"] = addr
+                lines.append(
+                    f"{name}{_prom_labels(labels)} "
+                    f"{_prom_value(s['value'])}"
+                )
+                # histogram components are cumulative counters, so they
+                # sum across peers just like counters do; gauges do not
+                # (a sum of utilizations is not a utilization)
+                if kind in ("counter", "histogram"):
+                    key = (name, tuple(sorted(s["labels"].items())))
+                    sums[key] = sums.get(key, 0.0) + s["value"]
+        for (name, labelitems), value in sorted(sums.items()):
+            lines.append(
+                f"fleet:{name}:sum{_prom_labels(dict(labelitems))} "
+                f"{_prom_value(value)}"
+            )
+        return "\n".join(lines) + "\n"
 
     def stop(self) -> None:
         self._stop.set()

@@ -5,11 +5,18 @@ Shared by the serving engine and the trainer (docs/11_observability.md):
 ``MetricRegistry`` is the one store every counter/gauge/histogram lives
 in, ``Tracer`` records lifecycle spans on per-slot tracks, and the
 exporters serialize both without touching instrumentation.
+
+Since the fleet-tracing PR the layer also crosses processes:
+``TraceContext`` travels in the ``X-TP-Trace`` header, ``SpanSpool``
+appends each process's finished spans to a bounded JSONL span log, and
+``stitch_traces`` rebases N processes' logs onto one clock and emits a
+single Perfetto timeline with flow arrows across the wire crossings.
 """
 
 from tpu_parallel.obs.exporters import (
     chrome_trace_events,
     export_snapshot_jsonl,
+    parse_prometheus_text,
     prometheus_lines,
     prometheus_text,
     write_chrome_trace,
@@ -24,11 +31,20 @@ from tpu_parallel.obs.registry import (
     PercentileWindow,
     validate_snapshot,
 )
+from tpu_parallel.obs.spool import SpanSpool, read_span_log
+from tpu_parallel.obs.stitch import (
+    clock_offsets,
+    phase_breakdown,
+    stitch_traces,
+    trace_summary,
+)
 from tpu_parallel.obs.tracer import (
     NULL_SPAN,
     NULL_TRACER,
+    TRACE_HEADER,
     NullTracer,
     Span,
+    TraceContext,
     Tracer,
 )
 
@@ -42,13 +58,22 @@ __all__ = [
     "validate_snapshot",
     "Span",
     "Tracer",
+    "TraceContext",
+    "TRACE_HEADER",
     "NullTracer",
     "NULL_SPAN",
     "NULL_TRACER",
+    "SpanSpool",
+    "read_span_log",
+    "clock_offsets",
+    "stitch_traces",
+    "trace_summary",
+    "phase_breakdown",
     "chrome_trace_events",
     "write_chrome_trace",
     "prometheus_lines",
     "prometheus_text",
+    "parse_prometheus_text",
     "write_prometheus",
     "export_snapshot_jsonl",
 ]

@@ -184,6 +184,89 @@ def prometheus_text(source: Union[MetricRegistry, Dict]) -> str:
     return "\n".join(prometheus_lines(snap)) + "\n"
 
 
+def _prom_unescape(value: str) -> str:
+    """Invert the exposition-format label-value escaping (backslash,
+    double-quote, newline) — a left-to-right scan, NOT chained
+    ``str.replace`` (which would corrupt ``\\\\n`` into a newline)."""
+    out: List[str] = []
+    i = 0
+    while i < len(value):
+        ch = value[i]
+        if ch == "\\" and i + 1 < len(value):
+            nxt = value[i + 1]
+            if nxt == "n":
+                out.append("\n")
+            elif nxt in ('"', "\\"):
+                out.append(nxt)
+            else:  # unknown escape: keep it verbatim
+                out.append(ch)
+                out.append(nxt)
+            i += 2
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out)
+
+
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r"\s+(?P<value>\S+)\s*$"
+)
+_LABEL_RE = re.compile(
+    r'\s*(?P<key>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<val>(?:[^"\\]|\\.)*)"\s*(?:,|$)'
+)
+
+
+def parse_prometheus_text(text: str) -> List[Dict]:
+    """Parse text exposition back into samples:
+    ``[{"name", "labels", "value", "type"}]``.  The label values are
+    UNescaped, so this round-trips :func:`prometheus_lines` exactly —
+    the fleet aggregator relabels peer series through it, and the
+    round-trip is the escaping regression test's oracle.  Unparseable
+    lines raise ``ValueError`` (an aggregator must not silently drop a
+    peer's series)."""
+    samples: List[Dict] = []
+    types: Dict[str, str] = {}
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"unparseable exposition line: {raw!r}")
+        labels: Dict[str, str] = {}
+        body = m.group("labels")
+        if body:
+            pos = 0
+            while pos < len(body):
+                lm = _LABEL_RE.match(body, pos)
+                if lm is None:
+                    raise ValueError(
+                        f"unparseable label body in line: {raw!r}"
+                    )
+                labels[lm.group("key")] = _prom_unescape(lm.group("val"))
+                pos = lm.end()
+        name = m.group("name")
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[: -len(suffix)] in types:
+                base = name[: -len(suffix)]
+                break
+        samples.append({
+            "name": name,
+            "labels": labels,
+            "value": float(m.group("value")),
+            "type": types.get(base),
+        })
+    return samples
+
+
 def write_prometheus(source: Union[MetricRegistry, Dict], path: str) -> str:
     """Write one text-exposition snapshot (node-exporter textfile style —
     point a file scrape at it, or re-export per tick for a live series);

@@ -1,0 +1,356 @@
+"""Cross-process trace stitching: N span logs -> ONE Perfetto timeline.
+
+Every fleet process records spans on its OWN monotonic clock — the
+readings are not comparable across hosts (each process's zero is its
+own boot).  What IS comparable: at every wire crossing the router holds
+a send/recv timestamp pair around the peer's reported clock reading
+(the ``clock_sync`` instants its probe pump and submit path drop, attrs
+``peer`` / ``t_send`` / ``t_recv`` / ``peer_ts``).  For a peer whose
+one-way delays are roughly symmetric,
+
+    offset = (t_send + t_recv) / 2 - peer_ts
+
+rebases that peer's clock onto the router's, with error bounded by the
+sample's RTT — so :func:`clock_offsets` keeps the minimum-RTT sample
+per peer (NTP's discipline), and skew just rides into the offset.
+
+:func:`stitch_traces` takes the processes' span-log records (the
+``/v1/tracez`` payloads, or :func:`tpu_parallel.obs.spool.read_span_log`
+output) and emits one Chrome trace-event JSON: one pid per process, one
+tid per track, spans as ``X``/``b``/``e`` events, instants as ``i`` —
+plus FLOW ARROWS (``s``/``f`` pairs) from each wire-crossing span to
+the first span its receiver emitted for the same trace, found through
+the span identity chain (the receiver's spans parent to the forked
+context id the sender assigned to its wire span; see
+:class:`tpu_parallel.obs.tracer.TraceContext`).
+
+:func:`trace_summary` judges the stitched forest (span counts, pids
+touched, single-rootedness), and :func:`phase_breakdown` attributes one
+request's latency to phases (queue / prefill / decode / KV wire / SSE
+relay) — durations are offset-invariant, so attribution needs no clock
+alignment at all.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "clock_offsets",
+    "stitch_traces",
+    "trace_summary",
+    "phase_breakdown",
+]
+
+# phase vocabulary: span name (prefix) -> fleet_phase_seconds label
+PHASE_OF_SPAN = (
+    ("queue", "queue"),
+    ("prefill", "prefill"),
+    ("decode", "decode"),
+    ("wire:kv", "kv_wire"),
+    ("wire:", "wire"),
+    ("relay", "relay"),
+)
+
+_WIRE_PREFIX = "wire:"
+
+
+def clock_offsets(records: Sequence[Dict]) -> Dict[str, Dict]:
+    """Per-peer clock offset from the root process's ``clock_sync``
+    instants, minimum-RTT sample wins.  Returns
+    ``{peer_addr: {"offset": s, "rtt": s, "samples": n}}`` where
+    ``root_time ~= peer_time + offset``."""
+    best: Dict[str, Dict] = {}
+    for rec in records:
+        if rec.get("kind") != "instant" or rec.get("name") != "clock_sync":
+            continue
+        attrs = rec.get("attrs") or {}
+        peer = attrs.get("peer")
+        try:
+            t_send = float(attrs["t_send"])
+            t_recv = float(attrs["t_recv"])
+            peer_ts = float(attrs["peer_ts"])
+        except (KeyError, TypeError, ValueError):
+            continue
+        rtt = t_recv - t_send
+        if peer is None or rtt < 0:
+            continue
+        offset = (t_send + t_recv) / 2.0 - peer_ts
+        cur = best.get(peer)
+        if cur is None:
+            best[peer] = {"offset": offset, "rtt": rtt, "samples": 1}
+        else:
+            cur["samples"] += 1
+            if rtt < cur["rtt"]:
+                cur["offset"], cur["rtt"] = offset, rtt
+    return best
+
+
+def _spans_of(proc: Dict) -> List[Dict]:
+    return [r for r in proc.get("records", ())
+            if r.get("kind") == "span" and r.get("end") is not None]
+
+
+def _instants_of(proc: Dict) -> List[Dict]:
+    return [r for r in proc.get("records", ())
+            if r.get("kind") == "instant"]
+
+
+def _root_index(processes: Sequence[Dict]) -> int:
+    """The root process: the one holding clock_sync samples (the
+    router), else the first."""
+    for i, proc in enumerate(processes):
+        for rec in proc.get("records", ()):
+            if rec.get("kind") == "instant" \
+                    and rec.get("name") == "clock_sync":
+                return i
+    return 0
+
+
+def _process_offsets(processes: Sequence[Dict]) -> List[float]:
+    """One rebasing offset per process, onto the root's clock.  A
+    process without a clock_sync sample (its ``addr`` never probed in
+    the captured window) falls back to aligning its earliest record
+    with the root's — coarse, but it keeps the timeline renderable and
+    is exact for same-host fake clocks started together."""
+    root = _root_index(processes)
+    offsets_by_addr = clock_offsets(processes[root].get("records", ()))
+    root_starts = [r.get("start", r.get("ts"))
+                   for r in processes[root].get("records", ())
+                   if r.get("kind") in ("span", "instant")]
+    root_min = min((t for t in root_starts if t is not None), default=0.0)
+    out: List[float] = []
+    for i, proc in enumerate(processes):
+        if i == root:
+            out.append(0.0)
+            continue
+        sample = offsets_by_addr.get(proc.get("addr"))
+        if sample is not None:
+            out.append(sample["offset"])
+            continue
+        starts = [r.get("start", r.get("ts"))
+                  for r in proc.get("records", ())
+                  if r.get("kind") in ("span", "instant")]
+        local_min = min((t for t in starts if t is not None), default=0.0)
+        out.append(root_min - local_min)
+    return out
+
+
+def _span_args(rec: Dict) -> Dict:
+    args = dict(rec.get("attrs") or {})
+    for key in ("trace_id", "span_id", "parent_id"):
+        if rec.get(key) is not None:
+            args[key] = rec[key]
+    return args
+
+
+def stitch_traces(processes: Sequence[Dict]) -> Dict:
+    """Emit ONE Chrome trace over every process's records.
+
+    ``processes``: sequence of ``{"name", "pid", "records"}`` dicts
+    (``addr`` required on non-root processes for exact clock alignment;
+    ``skipped`` passed through into the summary).  Returns
+    ``{"traceEvents": [...], "metadata": {...}}``.
+    """
+    processes = list(processes)
+    if not processes:
+        return {"traceEvents": [], "metadata": {"processes": []}}
+    offsets = _process_offsets(processes)
+    events: List[Dict] = []
+    # spans indexed by identity, for the flow pass
+    span_site: Dict[str, Tuple[int, Dict]] = {}  # span_id -> (proc_i, rec)
+    by_trace: Dict[str, Dict[int, List[Dict]]] = {}
+
+    for i, proc in enumerate(processes):
+        pid = int(proc.get("pid", i + 1))
+        offset = offsets[i]
+        events.append({
+            "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "args": {"name": proc.get("name", f"proc{i}")},
+        })
+        tids: Dict[str, int] = {}
+
+        def tid_of(track: str, pid=pid, tids=tids) -> int:
+            if track not in tids:
+                tids[track] = len(tids) + 1
+                events.append({
+                    "ph": "M", "name": "thread_name", "pid": pid,
+                    "tid": tids[track], "args": {"name": track},
+                })
+                events.append({
+                    "ph": "M", "name": "thread_sort_index", "pid": pid,
+                    "tid": tids[track],
+                    "args": {"sort_index": tids[track]},
+                })
+            return tids[track]
+
+        for rec in _spans_of(proc):
+            ts = (rec["start"] + offset) * 1e6
+            tid = tid_of(rec.get("track", "main"))
+            sid = rec.get("span_id")
+            if sid:
+                span_site[sid] = (i, rec)
+            trace_id = rec.get("trace_id")
+            if trace_id:
+                by_trace.setdefault(trace_id, {}).setdefault(
+                    i, []
+                ).append(rec)
+            if rec.get("async_id") is not None:
+                shared = {
+                    "cat": "async", "name": rec.get("name", "?"),
+                    "id": str(rec["async_id"]), "pid": pid, "tid": tid,
+                }
+                events.append(dict(shared, ph="b", ts=ts,
+                                   args=_span_args(rec)))
+                events.append(dict(
+                    shared, ph="e",
+                    ts=(rec["end"] + offset) * 1e6,
+                ))
+            else:
+                events.append({
+                    "ph": "X", "name": rec.get("name", "?"),
+                    "cat": rec.get("track", "main"), "pid": pid,
+                    "tid": tid, "ts": ts,
+                    "dur": max(0.0, (rec["end"] - rec["start"]) * 1e6),
+                    "args": _span_args(rec),
+                })
+        for rec in _instants_of(proc):
+            events.append({
+                "ph": "i", "name": rec.get("name", "?"),
+                "pid": pid, "tid": tid_of(rec.get("track", "main")),
+                "ts": (rec.get("ts", 0.0) + offset) * 1e6, "s": "t",
+                "args": _span_args(rec),
+            })
+
+    # flow arrows: receiver's first span -> the sender's wire span it
+    # parents to (the forked-context splice)
+    flows = 0
+    for trace_id, procs in sorted(by_trace.items()):
+        for i, recs in sorted(procs.items()):
+            first = min(recs, key=lambda r: r["start"])
+            parent = first.get("parent_id")
+            site = span_site.get(parent) if parent else None
+            if site is None or site[0] == i:
+                continue
+            src_i, src = site
+            flows += 1
+            flow_id = f"{trace_id}:{flows}"
+            events.append({
+                "ph": "s", "cat": "trace", "name": "handoff",
+                "id": flow_id,
+                "pid": int(processes[src_i].get("pid", src_i + 1)),
+                "tid": _tid_lookup(events, processes, src_i, src),
+                "ts": (src["start"] + offsets[src_i]) * 1e6,
+            })
+            events.append({
+                "ph": "f", "cat": "trace", "name": "handoff",
+                "bp": "e", "id": flow_id,
+                "pid": int(processes[i].get("pid", i + 1)),
+                "tid": _tid_lookup(events, processes, i, first),
+                "ts": (first["start"] + offsets[i]) * 1e6,
+            })
+    return {
+        "traceEvents": events,
+        "metadata": {
+            "processes": [
+                {"name": p.get("name"), "pid": p.get("pid"),
+                 "addr": p.get("addr"),
+                 "clock_offset_seconds": offsets[i],
+                 "skipped": p.get("skipped")}
+                for i, p in enumerate(processes)
+            ],
+            "flow_arrows": flows,
+        },
+    }
+
+
+def _tid_lookup(events: Sequence[Dict], processes: Sequence[Dict],
+                proc_i: int, rec: Dict) -> int:
+    """The tid already assigned to ``rec``'s track in ``proc_i`` (the
+    metadata events are emitted before any flow pass runs)."""
+    pid = int(processes[proc_i].get("pid", proc_i + 1))
+    track = rec.get("track", "main")
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == "thread_name" \
+                and ev.get("pid") == pid \
+                and ev.get("args", {}).get("name") == track:
+            return ev["tid"]
+    return 0
+
+
+def trace_summary(processes: Sequence[Dict]) -> Dict[str, Dict]:
+    """Judge the stitched forest: for every trace id, the span count,
+    the pids it touched, whether its span tree is SINGLE-ROOTED (one
+    span without an in-trace parent — the router's root span; a second
+    root means a context was dropped at some crossing), and whether a
+    cross-process parent link (a flow arrow) exists."""
+    spans_by_trace: Dict[str, List[Tuple[int, Dict]]] = {}
+    for i, proc in enumerate(processes):
+        for rec in _spans_of(proc):
+            tid = rec.get("trace_id")
+            if tid:
+                spans_by_trace.setdefault(tid, []).append((i, rec))
+    out: Dict[str, Dict] = {}
+    for trace_id, sited in sorted(spans_by_trace.items()):
+        ids = {r.get("span_id") for _i, r in sited if r.get("span_id")}
+        roots = [r for _i, r in sited
+                 if not r.get("parent_id") or r["parent_id"] not in ids]
+        site_of = {r.get("span_id"): i for i, r in sited
+                   if r.get("span_id")}
+        cross_links = sum(
+            1 for i, r in sited
+            if r.get("parent_id") in site_of
+            and site_of[r["parent_id"]] != i
+        )
+        pids = sorted({
+            int(processes[i].get("pid", i + 1)) for i, _r in sited
+        })
+        out[trace_id] = {
+            "spans": len(sited),
+            "pids": pids,
+            "roots": len(roots),
+            "single_rooted": len(roots) == 1,
+            "cross_process_links": cross_links,
+        }
+    return out
+
+
+def phase_breakdown(records: Sequence[Dict]) -> Dict:
+    """Attribute one trace's records to latency phases.  ``records``
+    is every span/instant of ONE trace across all processes (clock
+    alignment unnecessary: durations are offset-invariant).  Returns
+    ``{"phases": {phase: {"seconds", "count"}}, "kv_wire_bytes": n,
+    "spans": n}``."""
+    phases: Dict[str, Dict[str, float]] = {}
+    kv_bytes = 0.0
+    spans = 0
+    for rec in records:
+        if rec.get("kind") != "span" or rec.get("end") is None:
+            continue
+        spans += 1
+        name = rec.get("name", "")
+        phase = None
+        for prefix, label in PHASE_OF_SPAN:
+            if name.startswith(prefix):
+                phase = label
+                break
+        if phase is None:
+            continue
+        slot = phases.setdefault(phase, {"seconds": 0.0, "count": 0})
+        slot["seconds"] += max(0.0, rec["end"] - rec["start"])
+        slot["count"] += 1
+        if phase == "kv_wire":
+            try:
+                kv_bytes += float(
+                    (rec.get("attrs") or {}).get("bytes", 0) or 0
+                )
+            except (TypeError, ValueError):
+                pass
+    return {
+        "phases": {
+            k: {"seconds": round(v["seconds"], 6), "count": v["count"]}
+            for k, v in sorted(phases.items())
+        },
+        "kv_wire_bytes": kv_bytes,
+        "spans": spans,
+    }

@@ -17,6 +17,17 @@ Two span shapes:
   requests.  Exported as Chrome ``b``/``e`` nestable-async pairs, which
   Perfetto renders on per-id sub-rows instead of corrupting the track.
 
+Since the fleet-tracing PR each span also carries an IDENTITY —
+``span_id`` / ``parent_id`` / ``trace_id`` — so spans emitted by
+different PROCESSES (router, prefill daemon, decode daemon) can be
+stitched back into one tree per request.  The wire carries a
+:class:`TraceContext` (trace id + the parent span id for anything the
+receiver emits) in the ``X-TP-Trace`` header; inside a process the
+tracer stamps it onto spans by request id via :meth:`Tracer.bind_trace`
+— the engine and frontend already attribute every span/instant with
+``request_id=`` (or the router's ``rid=``), so they need no API change
+to participate.
+
 Timestamps come from an injectable monotonic ``clock`` so lifecycle tests
 run on a fake clock, deterministically.
 
@@ -24,12 +35,72 @@ run on a fake clock, deterministically.
 (the engine/trainer default) returns one shared no-op span from every
 call — no timestamp read, no allocation, no list append.  Hot loops that
 would even BUILD attribute dicts per token guard on ``tracer.enabled``.
+The trace-binding surface keeps that contract: ``bind_trace`` /
+``release_trace`` on the null tracer are no-ops, and an enabled tracer
+with ZERO bindings pays one falsy dict check per span.
 """
 
 from __future__ import annotations
 
+import itertools
 import time
+import uuid
 from typing import Callable, Dict, List, Optional
+
+TRACE_HEADER = "X-TP-Trace"
+
+_TRACE_ID_LEN = 32  # 128-bit trace id, lowercase hex
+_SPAN_ID_LEN = 16  # 64-bit span id, lowercase hex
+_HEX = set("0123456789abcdef")
+
+
+class TraceContext:
+    """The portable identity of one request's trace: a 128-bit trace id
+    plus the span id every span the HOLDER emits should parent to.
+
+    Crossing a process boundary, :meth:`fork` mints a child context (same
+    trace, fresh parent span id) whose id the SENDER assigns to its wire
+    span — so the receiver's spans hang off the wire crossing, and the
+    stitched tree keeps its depth.  On the wire it travels as the
+    ``X-TP-Trace`` header, ``<trace32hex>-<span16hex>``.
+    """
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+    @classmethod
+    def new(cls) -> "TraceContext":
+        return cls(uuid.uuid4().hex, uuid.uuid4().hex[:_SPAN_ID_LEN])
+
+    def fork(self) -> "TraceContext":
+        """Same trace, fresh parent span id (a child boundary)."""
+        return TraceContext(
+            self.trace_id, uuid.uuid4().hex[:_SPAN_ID_LEN]
+        )
+
+    def header_value(self) -> str:
+        return f"{self.trace_id}-{self.span_id}"
+
+    @classmethod
+    def parse(cls, value: Optional[str]) -> Optional["TraceContext"]:
+        """The inbound-header gate: a well-formed ``<trace>-<span>``
+        pair or None — garbage from a client never becomes identity."""
+        if not value or not isinstance(value, str):
+            return None
+        trace_id, sep, span_id = value.strip().partition("-")
+        if not sep:
+            return None
+        if len(trace_id) != _TRACE_ID_LEN or len(span_id) != _SPAN_ID_LEN:
+            return None
+        if not (_HEX >= set(trace_id) and _HEX >= set(span_id)):
+            return None
+        return cls(trace_id, span_id)
+
+    def __repr__(self) -> str:
+        return f"TraceContext({self.trace_id!r}, {self.span_id!r})"
 
 
 class Span:
@@ -38,7 +109,7 @@ class Span:
     :meth:`finish` (the engine's queue-wait spans live for many ticks)."""
 
     __slots__ = ("name", "track", "start", "end", "attrs", "async_id",
-                 "_tracer")
+                 "span_id", "parent_id", "trace_id", "_tracer")
 
     def __init__(self, tracer: "Tracer", name: str, track: str,
                  attrs: Dict[str, object], start: float,
@@ -50,6 +121,9 @@ class Span:
         self.start = start
         self.end: Optional[float] = None
         self.async_id = async_id
+        self.span_id: Optional[str] = None
+        self.parent_id: Optional[str] = None
+        self.trace_id: Optional[str] = None
 
     def set(self, **attrs) -> "Span":
         self.attrs.update(attrs)
@@ -61,6 +135,23 @@ class Span:
         if self.end is None:
             self.end = self._tracer.now()
         return self
+
+    def to_dict(self) -> Dict[str, object]:
+        """The span-log record body (see :mod:`tpu_parallel.obs.spool`)."""
+        rec: Dict[str, object] = {
+            "name": self.name,
+            "track": self.track,
+            "start": self.start,
+            "end": self.end,
+            "attrs": dict(self.attrs),
+        }
+        if self.async_id is not None:
+            rec["async_id"] = self.async_id
+        if self.trace_id is not None:
+            rec["trace_id"] = self.trace_id
+            rec["span_id"] = self.span_id
+            rec["parent_id"] = self.parent_id
+        return rec
 
     def __enter__(self) -> "Span":
         return self
@@ -100,6 +191,12 @@ class Tracer:
     caller (the engine's batched prefill fans one device call out into
     per-slot spans sharing the measured window), ``instant`` drops a
     zero-duration marker.
+
+    **Trace binding**: ``bind_trace(request_id, ctx)`` makes every
+    subsequent span/instant whose attrs carry that ``request_id`` (or
+    ``rid``) a child of ``ctx`` — stamped with the trace id, a fresh
+    span id, and ``ctx.span_id`` as parent — until ``release_trace``.
+    The lookup costs one falsy dict check when nothing is bound.
     """
 
     enabled = True
@@ -108,12 +205,44 @@ class Tracer:
         self.clock = clock
         self.spans: List[Span] = []
         self.instants: List[Dict] = []
+        self._bindings: Dict[str, TraceContext] = {}
+        # per-tracer span-id mint: a process nonce + a counter keeps ids
+        # unique across the fleet without a uuid4 per span
+        self._span_nonce = uuid.uuid4().hex[:8]
+        self._span_seq = itertools.count()
 
     def now(self) -> float:
         return self.clock()
 
+    def next_span_id(self) -> str:
+        return f"{self._span_nonce}{next(self._span_seq):08x}"
+
+    # -- trace binding ----------------------------------------------------
+
+    def bind_trace(self, request_id: str, ctx: TraceContext) -> None:
+        self._bindings[request_id] = ctx
+
+    def release_trace(self, request_id: str) -> None:
+        self._bindings.pop(request_id, None)
+
+    def trace_of(self, request_id: str) -> Optional[TraceContext]:
+        return self._bindings.get(request_id)
+
+    def _stamp(self, span: Span) -> Span:
+        if self._bindings:
+            key = span.attrs.get("request_id") or span.attrs.get("rid")
+            ctx = self._bindings.get(key) if key is not None else None
+            if ctx is not None:
+                span.trace_id = ctx.trace_id
+                span.parent_id = ctx.span_id
+                span.span_id = self.next_span_id()
+        return span
+
+    # -- recording --------------------------------------------------------
+
     def start(self, name: str, track: str = "main", **attrs) -> Span:
         span = Span(self, name, track, attrs, self.clock())
+        self._stamp(span)
         self.spans.append(span)
         return span
 
@@ -123,6 +252,7 @@ class Tracer:
                     **attrs) -> Span:
         span = Span(self, name, track, attrs, self.clock(),
                     async_id=async_id)
+        self._stamp(span)
         self.spans.append(span)
         return span
 
@@ -130,14 +260,20 @@ class Tracer:
                **attrs) -> Span:
         span = Span(self, name, track, attrs, start)
         span.end = end
+        self._stamp(span)
         self.spans.append(span)
         return span
 
     def instant(self, name: str, track: str = "main", **attrs) -> None:
-        self.instants.append(
-            {"name": name, "track": track, "ts": self.clock(),
-             "attrs": attrs}
-        )
+        ev = {"name": name, "track": track, "ts": self.clock(),
+              "attrs": attrs}
+        if self._bindings:
+            key = attrs.get("request_id") or attrs.get("rid")
+            ctx = self._bindings.get(key) if key is not None else None
+            if ctx is not None:
+                ev["trace_id"] = ctx.trace_id
+                ev["parent_id"] = ctx.span_id
+        self.instants.append(ev)
 
     def tracks(self) -> List[str]:
         """Every track touched, ``scheduler`` and ``trainer`` first, the
@@ -165,6 +301,18 @@ class NullTracer:
 
     def now(self) -> float:
         return 0.0
+
+    def next_span_id(self) -> str:
+        return ""
+
+    def bind_trace(self, request_id: str, ctx: TraceContext) -> None:
+        pass
+
+    def release_trace(self, request_id: str) -> None:
+        pass
+
+    def trace_of(self, request_id: str) -> None:
+        return None
 
     def start(self, name: str, track: str = "main", **attrs) -> _NullSpan:
         return NULL_SPAN
